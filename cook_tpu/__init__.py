@@ -1,0 +1,15 @@
+"""cook-tpu: a TPU-native multitenant batch-scheduling framework.
+
+A from-scratch rebuild of the capabilities of twosigma/Cook (reference layout
+documented in SURVEY.md): DRU fair-share ranking, jobs x nodes bin-packing
+with constraints and groups, preemptive rebalancing, pools, quotas/shares,
+rate limits, a pluggable compute-cluster boundary, a REST API with clients,
+and a deterministic faster-than-real-time trace simulator.
+
+The defining difference from the reference: the per-cycle matchmaking core
+(DRU scoring, bin-packing, preemption-victim search) is implemented as batched
+dense-tensor solves in JAX (see `cook_tpu.ops`), sharded over the TPU ICI mesh
+(see `cook_tpu.parallel`).
+"""
+
+__version__ = "0.1.0"

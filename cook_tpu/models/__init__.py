@@ -1,0 +1,22 @@
+"""Domain model: entities, state machine, failure reasons, job store."""
+from cook_tpu.models.entities import (  # noqa: F401
+    Application,
+    Checkpoint,
+    Container,
+    DruMode,
+    Group,
+    GroupPlacementType,
+    HostPlacement,
+    Instance,
+    InstanceStatus,
+    Job,
+    JobConstraint,
+    JobState,
+    Pool,
+    Quota,
+    Resources,
+    Share,
+    StragglerHandling,
+    new_uuid,
+)
+from cook_tpu.models.store import Event, JobStore, TransactionVetoed  # noqa: F401

@@ -1,0 +1,64 @@
+"""Test configuration: force an 8-device virtual CPU mesh so sharding tests
+run without TPU hardware (the driver separately dry-runs the multi-chip path
+via __graft_entry__.dryrun_multichip)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from cook_tpu.models.entities import (  # noqa: E402
+    Instance,
+    Job,
+    Pool,
+    Resources,
+    new_uuid,
+)
+from cook_tpu.models.store import JobStore  # noqa: E402
+
+
+class FakeClock:
+    def __init__(self, now_ms: int = 1_000_000):
+        self.now_ms = now_ms
+
+    def __call__(self) -> int:
+        return self.now_ms
+
+    def advance(self, ms: int) -> None:
+        self.now_ms += ms
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def store(clock):
+    s = JobStore(clock=clock)
+    s.set_pool(Pool(name="default"))
+    return s
+
+
+def make_job(user="alice", pool="default", mem=100.0, cpus=1.0, gpus=0.0,
+             priority=50, max_retries=1, **kw) -> Job:
+    return Job(
+        uuid=new_uuid(),
+        user=user,
+        pool=pool,
+        priority=priority,
+        max_retries=max_retries,
+        resources=Resources(mem=mem, cpus=cpus, gpus=gpus),
+        command="true",
+        **kw,
+    )
+
+
+@pytest.fixture
+def job_factory():
+    return make_job

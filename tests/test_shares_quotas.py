@@ -1,0 +1,53 @@
+"""Share/quota semantics (reference: share.clj, quota.clj): default-user
+fallback, partial shares, quota resource+count caps."""
+from cook_tpu.models.entities import DEFAULT_USER, Quota, Resources, Share
+
+
+def test_share_default_user_fallback(store):
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=1000.0, cpus=10.0, gpus=1.0)))
+    s = store.get_share("alice", "default")
+    assert (s.mem, s.cpus, s.gpus) == (1000.0, 10.0, 1.0)
+
+
+def test_share_partial_override_falls_back_per_resource(store):
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=1000.0, cpus=10.0, gpus=1.0)))
+    store.set_share(Share(user="bob", pool="default",
+                          resources=Resources(mem=4000.0)))
+    s = store.get_share("bob", "default")
+    assert s.mem == 4000.0
+    assert s.cpus == 10.0  # falls back to default user
+    assert s.gpus == 1.0
+
+
+def test_share_no_defaults_is_infinite(store):
+    s = store.get_share("carol", "default")
+    assert s.mem == float("inf")
+
+
+def test_quota_fallback_and_retract(store):
+    store.set_quota(Quota(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=100.0, cpus=1.0), count=5))
+    q = store.get_quota("alice", "default")
+    assert q.count == 5 and q.resources.mem == 100.0
+    store.set_quota(Quota(user="alice", pool="default",
+                          resources=Resources(mem=999.0, cpus=9.0), count=7))
+    q = store.get_quota("alice", "default")
+    assert q.count == 7 and q.resources.mem == 999.0
+    store.retract_quota("alice", "default")
+    assert store.get_quota("alice", "default").count == 5
+
+
+def test_usage_accounting(store, job_factory):
+    j1 = job_factory(user="alice", mem=100, cpus=2)
+    j2 = job_factory(user="alice", mem=50, cpus=1)
+    j3 = job_factory(user="bob", mem=10, cpus=1)
+    store.submit_jobs([j1, j2, j3])
+    store.create_instance(j1.uuid, "t1", hostname="h1")
+    store.create_instance(j2.uuid, "t2", hostname="h2")
+    usage = store.user_usage("default")
+    assert usage["alice"].mem == 150 and usage["alice"].cpus == 3
+    assert "bob" not in usage
+    assert store.pending_count("default") == 1
+    assert store.pending_count("default", user="bob") == 1

@@ -1,0 +1,202 @@
+"""State-machine tests, modeled on the reference's schema tests
+(/root/reference/scheduler/test/cook/test/schema.clj): instance transition
+validity, job-state derivation, mea-culpa retry accounting, allowed-to-start
+preconditions, and the store's transactional behavior."""
+import pytest
+
+from cook_tpu.models import reasons
+from cook_tpu.models.entities import InstanceStatus as I
+from cook_tpu.models.entities import JobState as J
+from cook_tpu.models.state import (
+    JobNotAllowedToStart,
+    check_allowed_to_start,
+    update_instance_state,
+    valid_instance_transition,
+)
+from cook_tpu.models.store import TransactionVetoed
+from tests.conftest import make_job
+
+
+def test_instance_transitions():
+    assert valid_instance_transition(I.UNKNOWN, I.RUNNING)
+    assert valid_instance_transition(I.UNKNOWN, I.FAILED)
+    assert valid_instance_transition(I.UNKNOWN, I.SUCCESS)
+    assert valid_instance_transition(I.RUNNING, I.SUCCESS)
+    assert valid_instance_transition(I.RUNNING, I.FAILED)
+    # terminal states are sticky
+    assert not valid_instance_transition(I.SUCCESS, I.FAILED)
+    assert not valid_instance_transition(I.FAILED, I.RUNNING)
+    assert not valid_instance_transition(I.RUNNING, I.UNKNOWN)
+
+
+class TestStoreLifecycle:
+    def test_submit_launch_success(self, store):
+        job = make_job()
+        store.submit_jobs([job])
+        assert store.jobs[job.uuid].state == J.WAITING
+        assert store.pending_jobs("default")[0].uuid == job.uuid
+
+        inst = store.create_instance(job.uuid, "t1", hostname="h1")
+        assert inst.status == I.UNKNOWN
+        assert store.jobs[job.uuid].state == J.RUNNING
+        assert not store.pending_jobs("default")
+
+        store.update_instance_state("t1", I.RUNNING)
+        assert store.jobs[job.uuid].state == J.RUNNING
+
+        store.update_instance_state("t1", I.SUCCESS, reasons.NORMAL_EXIT)
+        assert store.jobs[job.uuid].state == J.COMPLETED
+        assert store.instances["t1"].status == I.SUCCESS
+
+    def test_fail_with_retries_goes_back_to_waiting(self, store):
+        job = make_job(max_retries=3)
+        store.submit_jobs([job])
+        store.create_instance(job.uuid, "t1", hostname="h1")
+        store.update_instance_state("t1", I.RUNNING)
+        store.update_instance_state("t1", I.FAILED, reasons.UNKNOWN)
+        assert store.jobs[job.uuid].state == J.WAITING
+
+    def test_fail_out_of_retries_completes(self, store):
+        job = make_job(max_retries=1)
+        store.submit_jobs([job])
+        store.create_instance(job.uuid, "t1", hostname="h1")
+        store.update_instance_state("t1", I.FAILED, reasons.UNKNOWN)
+        assert store.jobs[job.uuid].state == J.COMPLETED
+
+    def test_mea_culpa_failure_is_free(self, store):
+        job = make_job(max_retries=1)
+        store.submit_jobs([job])
+        # preemption is mea-culpa: does not consume the single retry
+        store.create_instance(job.uuid, "t1", hostname="h1")
+        store.update_instance_state(
+            "t1", I.FAILED, reasons.PREEMPTED_BY_REBALANCER
+        )
+        assert store.jobs[job.uuid].state == J.WAITING
+        # a plain failure then consumes it
+        store.create_instance(job.uuid, "t2", hostname="h2")
+        store.update_instance_state("t2", I.FAILED, reasons.UNKNOWN)
+        assert store.jobs[job.uuid].state == J.COMPLETED
+
+    def test_mea_culpa_limit_exhausts(self, store):
+        store.mea_culpa_limit = 2
+        job = make_job(max_retries=1)
+        store.submit_jobs([job])
+        for i in range(3):
+            store.create_instance(job.uuid, f"t{i}", hostname="h1")
+            store.update_instance_state(
+                f"t{i}", I.FAILED, reasons.PREEMPTED_BY_REBALANCER
+            )
+        # 3 mea-culpa failures - limit 2 = 1 consumed = max_retries
+        assert store.jobs[job.uuid].state == J.COMPLETED
+
+    def test_disable_mea_culpa_retries(self, store):
+        job = make_job(max_retries=1, disable_mea_culpa_retries=True)
+        store.submit_jobs([job])
+        store.create_instance(job.uuid, "t1", hostname="h1")
+        store.update_instance_state(
+            "t1", I.FAILED, reasons.PREEMPTED_BY_REBALANCER
+        )
+        assert store.jobs[job.uuid].state == J.COMPLETED
+
+    def test_per_reason_failure_limit(self, store):
+        # scheduling-failed-on-host has failure-limit 3
+        job = make_job(max_retries=1)
+        store.submit_jobs([job])
+        for i in range(3):
+            store.create_instance(job.uuid, f"t{i}", hostname="h1")
+            store.update_instance_state(
+                f"t{i}", I.FAILED, reasons.REASONS_BY_NAME["scheduling-failed-on-host"]
+            )
+            assert store.jobs[job.uuid].state == J.WAITING
+        store.create_instance(job.uuid, "t3", hostname="h1")
+        store.update_instance_state(
+            "t3", I.FAILED, reasons.REASONS_BY_NAME["scheduling-failed-on-host"]
+        )
+        assert store.jobs[job.uuid].state == J.COMPLETED
+
+    def test_allowed_to_start_vetoes_double_launch(self, store):
+        job = make_job()
+        store.submit_jobs([job])
+        store.create_instance(job.uuid, "t1", hostname="h1")
+        with pytest.raises(TransactionVetoed):
+            store.create_instance(job.uuid, "t2", hostname="h2")
+
+    def test_completed_job_is_terminal(self, store):
+        job = make_job()
+        store.submit_jobs([job])
+        store.kill_jobs([job.uuid])
+        assert store.jobs[job.uuid].state == J.COMPLETED
+        with pytest.raises(TransactionVetoed):
+            store.create_instance(job.uuid, "t1", hostname="h1")
+
+    def test_kill_emits_event_for_fanout(self, store):
+        seen = []
+        store.add_watcher(lambda e: seen.append(e))
+        job = make_job()
+        store.submit_jobs([job])
+        store.create_instance(job.uuid, "t1", hostname="h1")
+        store.update_instance_state("t1", I.RUNNING)
+        store.kill_jobs([job.uuid])
+        kinds = [e.kind for e in seen]
+        assert "job/state" in kinds
+        last = [e for e in seen if e.kind == "job/state"][-1]
+        assert last.data.get("killed") is True
+        # the live instance is still live: the fan-out consumer kills it
+        assert store.instances["t1"].status == I.RUNNING
+
+    def test_retry_revives_completed_job(self, store):
+        job = make_job(max_retries=1)
+        store.submit_jobs([job])
+        store.create_instance(job.uuid, "t1", hostname="h1")
+        store.update_instance_state("t1", I.FAILED, reasons.UNKNOWN)
+        assert store.jobs[job.uuid].state == J.COMPLETED
+        store.retry_job(job.uuid, 3)
+        assert store.jobs[job.uuid].state == J.WAITING
+
+    def test_retry_does_not_revive_successful_job(self, store):
+        job = make_job(max_retries=1)
+        store.submit_jobs([job])
+        store.create_instance(job.uuid, "t1", hostname="h1")
+        store.update_instance_state("t1", I.SUCCESS, reasons.NORMAL_EXIT)
+        store.retry_job(job.uuid, 5)
+        assert store.jobs[job.uuid].state == J.COMPLETED
+
+    def test_duplicate_submit_rejected(self, store):
+        job = make_job()
+        store.submit_jobs([job])
+        with pytest.raises(TransactionVetoed):
+            store.submit_jobs([job])
+
+
+def test_update_instance_state_invalid_transition_ignored():
+    job = make_job()
+    from cook_tpu.models.entities import Instance
+
+    inst = Instance(task_id="t1", job_uuid=job.uuid, status=I.SUCCESS)
+    upd = update_instance_state(job, [inst], "t1", I.FAILED, None)
+    assert not upd.applied
+
+
+def test_attempts_consumed_unknown_reason_counts():
+    assert reasons.attempts_consumed_by_reasons([None, None]) == 2
+    assert reasons.attempts_consumed_by_reasons([1002] * 5) == 0
+    assert reasons.attempts_consumed_by_reasons([1002] * 7) == 2
+    assert (
+        reasons.attempts_consumed_by_reasons([1002] * 7,
+                                             disable_mea_culpa_retries=True)
+        == 7
+    )
+
+
+def test_check_allowed_to_start():
+    from cook_tpu.models.entities import Instance, JobState
+
+    job = make_job()
+    check_allowed_to_start(job, [])
+    done = Instance(task_id="t0", job_uuid=job.uuid, status=I.FAILED)
+    check_allowed_to_start(job, [done])
+    live = Instance(task_id="t1", job_uuid=job.uuid, status=I.RUNNING)
+    with pytest.raises(JobNotAllowedToStart):
+        check_allowed_to_start(job, [done, live])
+    with pytest.raises(JobNotAllowedToStart):
+        check_allowed_to_start(job.with_(state=JobState.COMPLETED), [])
