@@ -1,0 +1,87 @@
+"""Shared kernel utilities: padding/bucketing (static shapes for XLA) and
+multi-key sorting helpers.
+
+XLA compiles one program per shape, so all kernels take fixed-size padded
+arrays with validity masks; `bucket_size` rounds problem sizes up to a small
+set of buckets to bound recompilation (SURVEY §7 "hard parts").
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+# A value larger than any real DRU/score; used instead of +inf so arithmetic
+# on padded lanes stays finite.
+BIG = 1e30
+
+
+def bucket_size(n: int, minimum: int = 64) -> int:
+    """Round n up to the next power-of-two bucket (>= minimum)."""
+    if n <= minimum:
+        return minimum
+    return 1 << math.ceil(math.log2(n))
+
+
+def pad_to(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
+    """Pad axis 0 of arr to `size` with `fill`."""
+    n = arr.shape[0]
+    if n == size:
+        return arr
+    if n > size:
+        raise ValueError(f"cannot pad {n} down to {size}")
+    pad_width = [(0, size - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width, constant_values=fill)
+
+
+def lexsort_perm(*keys):
+    """Permutation sorting rows ascending by keys, last key least significant
+    (numpy.lexsort convention reversed: keys[0] is MOST significant here).
+
+    Implemented as repeated stable argsort from least- to most-significant
+    key, which XLA handles natively (jnp.argsort is stable).
+    """
+    n = keys[0].shape[0]
+    perm = jnp.arange(n)
+    for key in reversed(keys):
+        order = jnp.argsort(key[perm], stable=True)
+        perm = perm[order]
+    return perm
+
+
+def segment_starts(sorted_ids):
+    """Boolean mask of positions where a new segment begins in a sorted id
+    vector."""
+    prev = jnp.concatenate([sorted_ids[:1] - 1, sorted_ids[:-1]])
+    return sorted_ids != prev
+
+
+def segmented_cumsum(values, sorted_ids):
+    """Cumulative sum of `values` restarting at each new id in `sorted_ids`
+    (which must be sorted).  O(n log n)-free: plain cumsum minus the running
+    total at each segment start, broadcast forward with a max-scan via
+    cummax on masked prefix sums."""
+    total = jnp.cumsum(values, axis=0)
+    starts = segment_starts(sorted_ids)
+    # index of each row's segment start, carried forward with a running max
+    idx = jnp.arange(sorted_ids.shape[0])
+    seg_first = jax_cummax(jnp.where(starts, idx, 0))
+    base = jnp.take(total, jnp.maximum(seg_first - 1, 0), axis=0)
+    nonzero = seg_first > 0
+    if values.ndim > 1:
+        nonzero = nonzero.reshape((-1,) + (1,) * (values.ndim - 1))
+    base = jnp.where(nonzero, base, jnp.zeros_like(base))
+    return total - base
+
+
+def jax_cummax(x):
+    import jax
+
+    return jax.lax.cummax(x, axis=0)
+
+
+def inverse_permutation(perm):
+    """inv[perm[i]] = i."""
+    n = perm.shape[0]
+    return jnp.zeros(n, dtype=perm.dtype).at[perm].set(jnp.arange(n, dtype=perm.dtype))

@@ -1,0 +1,111 @@
+"""DRU fair-share ranking as a batched tensor solve.
+
+Replaces the reference's lazy k-way sorted merge
+(/root/reference/scheduler/src/cook/scheduler/dru.clj:50-126 and
+`sort-jobs-by-dru-pool`, scheduler/scheduler.clj:2073-2175) with:
+
+  1. lexicographic sort of all tasks by (user, order_key)  -- the reference's
+     per-user sorted task lists, flattened;
+  2. per-user segmented cumulative sums of (mem, cpus) / divisors, DRU =
+     elementwise max  -- `compute-task-scored-task-pairs`;
+  3. one global stable sort by (dru, order)  -- `sorted-merge`.
+
+Semantics preserved: within a user, tasks are ordered by the caller-provided
+order key ((-priority, start-time, id) in the rank cycle); each task's DRU is
+the cumulative dominant share of that user's tasks up to and including it;
+ties in DRU may break arbitrarily (dru.clj docstring for
+`sorted-task-scored-task-pairs` explicitly allows any order on equal dru).
+
+All inputs are fixed-size padded arrays (mask via `valid`); the whole thing
+is jit-able and vmap-able over a pool batch axis.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cook_tpu.ops.common import BIG, inverse_permutation, lexsort_perm, segmented_cumsum
+
+
+class DruTasks(NamedTuple):
+    """Padded task tensors for one pool.  Tasks cover BOTH running tasks and
+    pending jobs (treated as hypothetical tasks), exactly like the rank
+    cycle's input."""
+
+    user: jnp.ndarray       # [T] int32 user index
+    mem: jnp.ndarray        # [T] f32
+    cpus: jnp.ndarray       # [T] f32
+    gpus: jnp.ndarray       # [T] f32
+    order_key: jnp.ndarray  # [T] f32/int — per-user task order (smaller first)
+    valid: jnp.ndarray      # [T] bool
+
+
+class DruResult(NamedTuple):
+    dru: jnp.ndarray        # [T] f32 per-task cumulative DRU (BIG on padding)
+    rank: jnp.ndarray       # [T] int32 global rank position per task
+                            # (0 = schedule first; padding ranks last)
+    order: jnp.ndarray      # [T] int32 task indices in global DRU order
+
+
+@functools.partial(jax.jit, static_argnames=("gpu_mode",))
+def dru_rank(
+    tasks: DruTasks,
+    mem_div: jnp.ndarray,   # [U] per-user mem divisor (share)
+    cpu_div: jnp.ndarray,   # [U]
+    gpu_div: jnp.ndarray,   # [U]
+    *,
+    gpu_mode: bool = False,
+) -> DruResult:
+    """Compute per-task cumulative DRU and the global fair-share order.
+
+    gpu_mode selects the reference's `:pool.dru-mode/gpu` scoring
+    (cumulative gpus/divisor) instead of max(mem, cpus) dominant share.
+    """
+    user = tasks.user
+    valid = tasks.valid
+    t = user.shape[0]
+
+    # Push padding to the end of every sort: invalid users sort as +inf.
+    user_sort_key = jnp.where(valid, user, jnp.iinfo(jnp.int32).max)
+    perm = lexsort_perm(user_sort_key, tasks.order_key)
+
+    s_user = user[perm]
+    s_valid = valid[perm]
+    res = jnp.stack([tasks.mem[perm], tasks.cpus[perm], tasks.gpus[perm]], axis=-1)
+    res = jnp.where(s_valid[:, None], res, 0.0)
+
+    cum = segmented_cumsum(res, jnp.where(s_valid, s_user, -1))
+    s_mem_div = jnp.take(mem_div, s_user, mode="clip")
+    s_cpu_div = jnp.take(cpu_div, s_user, mode="clip")
+    s_gpu_div = jnp.take(gpu_div, s_user, mode="clip")
+    if gpu_mode:
+        dru_sorted = cum[:, 2] / jnp.maximum(s_gpu_div, 1e-30)
+    else:
+        dru_sorted = jnp.maximum(
+            cum[:, 0] / jnp.maximum(s_mem_div, 1e-30),
+            cum[:, 1] / jnp.maximum(s_cpu_div, 1e-30),
+        )
+    dru_sorted = jnp.where(s_valid, dru_sorted, BIG)
+
+    # back to original task order
+    inv = inverse_permutation(perm)
+    dru = dru_sorted[inv]
+
+    # global order: stable sort by dru, tie-broken by the per-user position
+    # so the within-user order is preserved even on equal dru (critical: a
+    # user's later task must never schedule before an earlier one).
+    order = lexsort_perm(dru, tasks.order_key)
+    rank = inverse_permutation(order)
+    return DruResult(dru=dru, rank=rank.astype(jnp.int32),
+                     order=order.astype(jnp.int32))
+
+
+# Batched over a leading pool axis; shard this axis over the device mesh for
+# the multi-pool solve (parallel/mesh.py wires the shardings).
+dru_rank_pools = jax.vmap(
+    lambda tasks, md, cd, gd: dru_rank(tasks, md, cd, gd),
+    in_axes=(DruTasks(0, 0, 0, 0, 0, 0), 0, 0, 0),
+)

@@ -1,0 +1,135 @@
+"""Preemption-victim search as a tensor solve.
+
+Replaces the reference rebalancer's per-host sequential prefix scan
+(/root/reference/scheduler/src/cook/rebalancer.clj:320-407): among all
+(host, prefix-of-highest-DRU-tasks) candidates that free enough resources
+for the pending job, pick the one whose minimum preempted DRU is largest
+(preempt the least-deserving work possible); a host whose spare resources
+alone cover the demand scores +inf (preempt nothing).
+
+Tensorized as: mask-filter tasks -> sort by (host, -dru) -> per-host
+segmented prefix sums seeded with host spare -> first-feasible-prefix per
+host (the max-min-DRU prefix for that host) -> global argmax over hosts.
+One kernel call evaluates all 100k tasks x 10k hosts at once.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cook_tpu.ops.common import BIG, lexsort_perm, segmented_cumsum
+
+
+class RebalanceState(NamedTuple):
+    """Padded running-task + host tensors for one pool."""
+
+    task_host: jnp.ndarray      # [T] int32 host index
+    task_dru: jnp.ndarray       # [T] f32
+    task_res: jnp.ndarray       # [T, 3] (mem, cpus, gpus)
+    task_eligible: jnp.ndarray  # [T] bool (valid & quota/user filters & not preempted)
+    spare: jnp.ndarray          # [H, 3] spare resources per host
+    host_ok: jnp.ndarray        # [H] bool (constraints pass for the pending job)
+
+
+class PreemptionDecision(NamedTuple):
+    host: jnp.ndarray          # int32 chosen host, -1 if none
+    score: jnp.ndarray         # f32 min-preempted-dru of the decision (BIG = spare-only)
+    preempt_mask: jnp.ndarray  # [T] bool — tasks to preempt
+    freed: jnp.ndarray         # [3] resources freed on the chosen host (spare + preempted)
+
+
+@jax.jit
+def find_preemption_decision(
+    state: RebalanceState,
+    demand: jnp.ndarray,        # [3] pending job (mem, cpus, gpus)
+    pending_dru: jnp.ndarray,   # scalar
+    safe_dru_threshold: jnp.ndarray,
+    min_dru_diff: jnp.ndarray,
+) -> PreemptionDecision:
+    t = state.task_host.shape[0]
+    h = state.spare.shape[0]
+
+    mask = (
+        state.task_eligible
+        & (state.task_dru >= safe_dru_threshold)
+        & ((state.task_dru - pending_dru) > min_dru_diff)
+    )
+
+    # Sort tasks by (host asc, dru desc, index asc); masked-out tasks sink to
+    # a sentinel host so they never join a real segment.
+    host_key = jnp.where(mask, state.task_host, jnp.iinfo(jnp.int32).max)
+    idx = jnp.arange(t)
+    perm = lexsort_perm(host_key, -state.task_dru, idx)
+    s_host = host_key[perm]
+    s_dru = state.task_dru[perm]
+    s_res = jnp.where(mask[perm][:, None], state.task_res[perm], 0.0)
+    s_valid = mask[perm]
+
+    # Per-host prefix sums of freed resources, seeded with the host's spare.
+    cum = segmented_cumsum(s_res, s_host)
+    spare_of = jnp.where(
+        ((s_host >= 0) & (s_host < h))[:, None],
+        state.spare[jnp.clip(s_host, 0, h - 1)],
+        0.0,
+    )
+    freed = cum + spare_of
+    prefix_feasible = jnp.all(freed >= demand[None, :], axis=-1) & s_valid
+
+    host_allowed = jnp.where(
+        (s_host >= 0) & (s_host < h),
+        state.host_ok[jnp.clip(s_host, 0, h - 1)],
+        False,
+    )
+    # Candidate score: dru of the last task in the prefix (== min in prefix,
+    # since sorted desc).  Only the FIRST feasible prefix per host matters —
+    # longer ones can only lower the min-dru — and within a host that is the
+    # prefix ending at the first position where prefix_feasible flips true.
+    feas_cum = segmented_cumsum(prefix_feasible.astype(jnp.int32), s_host)
+    first_feasible = prefix_feasible & (feas_cum == 1)
+
+    cand_score = jnp.where(first_feasible & host_allowed, s_dru, -BIG)
+
+    # Spare-only candidates: hosts whose spare covers demand preempt nothing
+    # and score BIG (reference: Double/MAX_VALUE pseudo-task).
+    spare_fits = jnp.all(state.spare >= demand[None, :], axis=-1) & state.host_ok
+    spare_score = jnp.where(spare_fits, BIG, -BIG)
+
+    best_task_pos = jnp.argmax(cand_score)
+    best_task_score = cand_score[best_task_pos]
+    best_spare_host = jnp.argmax(spare_score)
+    best_spare_score = spare_score[best_spare_host]
+
+    use_spare = best_spare_score >= best_task_score
+    none_found = (best_task_score <= -BIG) & (best_spare_score <= -BIG)
+
+    chosen_host = jnp.where(
+        use_spare, best_spare_host, s_host[best_task_pos]
+    ).astype(jnp.int32)
+    chosen_host = jnp.where(none_found, -1, chosen_host)
+    score = jnp.where(use_spare, best_spare_score, best_task_score)
+
+    # Preempt-mask: tasks in the chosen host's prefix up through best_task_pos.
+    same_host = s_host == s_host[best_task_pos]
+    in_prefix = same_host & (jnp.arange(t) <= best_task_pos) & s_valid
+    take_tasks = (~use_spare) & (~none_found)
+    preempt_sorted = in_prefix & take_tasks
+    # scatter back to original task order
+    preempt = jnp.zeros(t, dtype=bool).at[perm].set(preempt_sorted)
+
+    freed_amount = jnp.where(
+        none_found,
+        jnp.zeros(3),
+        jnp.where(
+            use_spare,
+            state.spare[jnp.clip(best_spare_host, 0, h - 1)],
+            freed[best_task_pos],
+        ),
+    )
+    return PreemptionDecision(
+        host=chosen_host,
+        score=jnp.where(none_found, -BIG, score),
+        preempt_mask=preempt,
+        freed=freed_amount,
+    )

@@ -1,0 +1,223 @@
+"""Parity tests: the JAX kernels against the reference-faithful CPU
+implementations, over randomized problems (the role of the reference's
+dru/scheduler/rebalancer unit suites, SURVEY §4.1)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cook_tpu.ops import cpu_reference as ref
+from cook_tpu.ops.common import BIG, pad_to
+from cook_tpu.ops.dru import DruTasks, dru_rank
+from cook_tpu.ops.match import MatchProblem, chunked_match, greedy_match
+from cook_tpu.ops.rebalance import RebalanceState, find_preemption_decision
+
+
+def random_dru_problem(rng, t=200, u=13):
+    user = rng.integers(0, u, size=t)
+    mem = rng.uniform(1, 100, size=t)
+    cpus = rng.uniform(0.1, 8, size=t)
+    gpus = rng.integers(0, 3, size=t).astype(float)
+    order_key = rng.permutation(t).astype(np.float64)
+    mem_div = rng.uniform(100, 1000, size=u)
+    cpu_div = rng.uniform(1, 50, size=u)
+    gpu_div = rng.uniform(1, 8, size=u)
+    return user, mem, cpus, gpus, order_key, mem_div, cpu_div, gpu_div
+
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("gpu_mode", [False, True])
+def test_dru_parity(seed, gpu_mode):
+    rng = np.random.default_rng(seed)
+    user, mem, cpus, gpus, order_key, mem_div, cpu_div, gpu_div = (
+        random_dru_problem(rng)
+    )
+    want_dru, want_order = ref.ref_dru_order(
+        user, mem, cpus, gpus, order_key, mem_div, cpu_div, gpu_div,
+        gpu_mode=gpu_mode,
+    )
+    # pad to a bucket with invalid tail
+    t, pad_t = len(user), 256
+    tasks = DruTasks(
+        user=jnp.asarray(pad_to(user.astype(np.int32), pad_t)),
+        mem=jnp.asarray(pad_to(mem, pad_t)),
+        cpus=jnp.asarray(pad_to(cpus, pad_t)),
+        gpus=jnp.asarray(pad_to(gpus, pad_t)),
+        order_key=jnp.asarray(pad_to(order_key, pad_t, fill=BIG)),
+        valid=jnp.asarray(pad_to(np.ones(t, dtype=bool), pad_t, fill=False)),
+    )
+    got = dru_rank(
+        tasks,
+        jnp.asarray(mem_div),
+        jnp.asarray(cpu_div),
+        jnp.asarray(gpu_div),
+        gpu_mode=gpu_mode,
+    )
+    np.testing.assert_allclose(np.asarray(got.dru[:t]), want_dru, rtol=1e-4)
+    # padding scores BIG and ranks last
+    assert np.all(np.asarray(got.dru[t:]) >= BIG)
+    assert np.all(np.asarray(got.rank[:t]) < t)
+    # order parity: equal-dru ties may permute across users, so compare the
+    # sequence of dru values along the order, and exact within-user order.
+    got_order = np.asarray(got.order[:t])
+    np.testing.assert_allclose(
+        want_dru[got_order], want_dru[want_order], rtol=1e-4
+    )
+    for uu in range(13):
+        mine = [i for i in got_order if user[i] == uu]
+        theirs = [i for i in want_order if user[i] == uu]
+        assert mine == theirs
+
+
+def random_match_problem(rng, j=150, n=40):
+    demands = np.stack(
+        [
+            rng.uniform(10, 500, size=j),
+            rng.uniform(0.5, 8, size=j),
+            (rng.uniform(0, 1, size=j) < 0.1) * rng.integers(1, 4, size=j),
+        ],
+        axis=-1,
+    )
+    totals = np.stack(
+        [rng.uniform(1000, 8000, size=n), rng.uniform(8, 64, size=n)], axis=-1
+    )
+    frac = rng.uniform(0.3, 1.0, size=(n, 1))
+    avail = np.concatenate(
+        [totals * frac, rng.integers(0, 5, size=(n, 1)).astype(float)], axis=-1
+    )
+    feasible = rng.uniform(size=(j, n)) > 0.05
+    return demands, avail, totals, feasible
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_greedy_match_exact_parity(seed):
+    rng = np.random.default_rng(100 + seed)
+    demands, avail, totals, feasible = random_match_problem(rng)
+    want = ref.ref_greedy_match(demands, avail, totals, feasible)
+    j, n = feasible.shape
+    problem = MatchProblem(
+        demands=jnp.asarray(demands),
+        job_valid=jnp.ones(j, dtype=bool),
+        avail=jnp.asarray(avail),
+        totals=jnp.asarray(totals),
+        node_valid=jnp.ones(n, dtype=bool),
+        feasible=jnp.asarray(feasible),
+    )
+    got = greedy_match(problem)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want)
+    # availability bookkeeping agrees
+    placed = want >= 0
+    spent = np.zeros_like(avail)
+    for jj in np.where(placed)[0]:
+        spent[want[jj]] += demands[jj]
+    np.testing.assert_allclose(np.asarray(got.new_avail), avail - spent,
+                               rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_chunked_match_near_parity(seed):
+    rng = np.random.default_rng(200 + seed)
+    demands, avail, totals, feasible = random_match_problem(rng, j=256, n=64)
+    j, n = feasible.shape
+    problem = MatchProblem(
+        demands=jnp.asarray(demands),
+        job_valid=jnp.ones(j, dtype=bool),
+        avail=jnp.asarray(avail),
+        totals=jnp.asarray(totals),
+        node_valid=jnp.ones(n, dtype=bool),
+        feasible=jnp.asarray(feasible),
+    )
+    exact = greedy_match(problem)
+    fast = chunked_match(problem, chunk=64)
+    q_exact = ref.packing_quality(demands, np.asarray(exact.assignment))
+    q_fast = ref.packing_quality(demands, np.asarray(fast.assignment))
+    # chunked must never oversubscribe
+    assert np.all(np.asarray(fast.new_avail) >= -1e-6)
+    # and must place ~the same amount of work (>= 95% on these configs)
+    assert q_fast["num_placed"] >= 0.95 * q_exact["num_placed"]
+
+
+def test_match_respects_validity_masks():
+    j, n = 8, 4
+    demands = np.tile([100.0, 1.0, 0.0], (j, 1))
+    avail = np.tile([1000.0, 10.0, 0.0], (n, 1))
+    totals = avail[:, :2].copy()
+    problem = MatchProblem(
+        demands=jnp.asarray(demands),
+        job_valid=jnp.asarray([True] * 4 + [False] * 4),
+        avail=jnp.asarray(avail),
+        totals=jnp.asarray(totals),
+        node_valid=jnp.asarray([True, True, False, False]),
+        feasible=None,
+    )
+    got = greedy_match(problem)
+    a = np.asarray(got.assignment)
+    assert np.all(a[4:] == -1)          # invalid jobs unplaced
+    assert set(a[:4]) <= {0, 1}          # invalid nodes untouched
+
+
+def random_rebalance_problem(rng, t=300, h=25):
+    task_host = rng.integers(0, h, size=t)
+    task_dru = rng.uniform(0, 5, size=t)
+    task_res = np.stack(
+        [
+            rng.uniform(10, 500, size=t),
+            rng.uniform(0.5, 8, size=t),
+            (rng.uniform(size=t) < 0.1) * rng.integers(1, 4, size=t),
+        ],
+        axis=-1,
+    )
+    eligible = rng.uniform(size=t) > 0.2
+    spare = np.stack(
+        [
+            rng.uniform(0, 300, size=h),
+            rng.uniform(0, 4, size=h),
+            np.zeros(h),
+        ],
+        axis=-1,
+    )
+    host_ok = rng.uniform(size=h) > 0.1
+    return task_host, task_dru, task_res, eligible, spare, host_ok
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_rebalance_parity(seed):
+    rng = np.random.default_rng(300 + seed)
+    task_host, task_dru, task_res, eligible, spare, host_ok = (
+        random_rebalance_problem(rng)
+    )
+    demand = (400.0, 6.0, 0.0)
+    pending_dru, thresh, mindiff = 0.4, 1.0, 0.5
+    want = ref.ref_preemption_decision(
+        task_host, task_dru, task_res[:, 0], task_res[:, 1], task_res[:, 2],
+        eligible, spare, host_ok, demand, pending_dru, thresh, mindiff,
+    )
+    state = RebalanceState(
+        task_host=jnp.asarray(task_host, dtype=jnp.int32),
+        task_dru=jnp.asarray(task_dru),
+        task_res=jnp.asarray(task_res),
+        task_eligible=jnp.asarray(eligible),
+        spare=jnp.asarray(spare),
+        host_ok=jnp.asarray(host_ok),
+    )
+    got = find_preemption_decision(
+        state, jnp.asarray(demand), pending_dru, thresh, mindiff
+    )
+    if want is None:
+        assert int(got.host) == -1
+        assert not np.any(np.asarray(got.preempt_mask))
+        return
+    want_host, want_tasks = want
+    got_mask = np.asarray(got.preempt_mask)
+    if not want_tasks:  # spare-only decision
+        assert float(got.score) >= BIG
+        assert not got_mask.any()
+        # any spare-fitting host is acceptable; check chosen host's spare fits
+        ch = int(got.host)
+        assert np.all(spare[ch] >= np.asarray(demand))
+    else:
+        assert int(got.host) == want_host
+        assert sorted(np.where(got_mask)[0].tolist()) == sorted(want_tasks)
+        np.testing.assert_allclose(
+            float(got.score), task_dru[want_tasks[-1]], rtol=1e-6
+        )
