@@ -1,0 +1,8 @@
+"""Mesh/sharding layer: pool-axis and node-axis sharded scheduling solves."""
+from cook_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    node_sharded_greedy_match,
+    pool_sharded_dru,
+    pool_sharded_match,
+    shard_pools,
+)

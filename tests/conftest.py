@@ -10,6 +10,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# The environment may pre-register an accelerator platform ahead of cpu
+# (jax_platforms=axon,cpu); force pure-CPU for deterministic 8-device tests.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 from cook_tpu.models.entities import (  # noqa: E402
