@@ -1,0 +1,9 @@
+"""Compute-cluster backends behind the ComputeCluster boundary."""
+from cook_tpu.cluster.base import (  # noqa: F401
+    ClusterState,
+    ComputeCluster,
+    KillLock,
+    Offer,
+    TaskSpec,
+)
+from cook_tpu.cluster.mock import MockCluster, MockHost  # noqa: F401

@@ -1,0 +1,189 @@
+"""The ComputeCluster boundary: the pluggable backend interface.
+
+Mirrors the reference's `ComputeCluster` protocol
+(/root/reference/scheduler/src/cook/compute_cluster.clj:27-112): offers in,
+launches/kills out, autoscaling, draining, and the launch/kill read-write
+lock that closes the kill-before-launch race the reference documents at
+compute_cluster.clj:86-112 (a kill observed while a launch is mid-flight
+must not be lost: kills take the write side, launches the read side).
+"""
+from __future__ import annotations
+
+import abc
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+@dataclass(frozen=True)
+class Offer:
+    """Available resources on one node.  K8s-style backends synthesize these
+    from capacity minus consumption (kubernetes/compute_cluster.clj:68-190);
+    mock/Mesos-style backends hand them out directly."""
+
+    node_id: str
+    hostname: str
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    disk: float = 0.0
+    attributes: tuple = ()       # ((key, value), ...) host attributes
+    total_mem: float = 0.0       # capacity, for binpacking fitness
+    total_cpus: float = 0.0
+
+    def attr_dict(self) -> dict:
+        return dict(self.attributes)
+
+
+@dataclass(frozen=True)
+class TaskSpec:
+    """What a backend needs to launch one task."""
+
+    task_id: str
+    job_uuid: str
+    user: str
+    command: str
+    mem: float
+    cpus: float
+    gpus: float
+    node_id: str
+    hostname: str
+    env: tuple = ()
+    container_image: str = ""
+    expected_runtime_ms: int = 0
+
+
+class ClusterState(enum.Enum):
+    """Dynamic cluster config state machine
+    (compute_cluster.clj:340-359,450-530): running accepts new work,
+    draining only finishes existing work, deleted is gone."""
+
+    RUNNING = "running"
+    DRAINING = "draining"
+    DELETED = "deleted"
+
+    def valid_next(self) -> set["ClusterState"]:
+        return {
+            ClusterState.RUNNING: {ClusterState.RUNNING, ClusterState.DRAINING},
+            ClusterState.DRAINING: {ClusterState.DRAINING, ClusterState.RUNNING,
+                                    ClusterState.DELETED},
+            ClusterState.DELETED: {ClusterState.DELETED},
+        }[self]
+
+
+class KillLock:
+    """Read-write lock guarding launch (read side, many concurrent) against
+    kill (write side, exclusive) — `kill-lock-object`
+    (compute_cluster.clj:86-112)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    class _Read:
+        def __init__(self, lock):
+            self.lock = lock
+
+        def __enter__(self):
+            with self.lock._cond:
+                while self.lock._writer:
+                    self.lock._cond.wait()
+                self.lock._readers += 1
+
+        def __exit__(self, *exc):
+            with self.lock._cond:
+                self.lock._readers -= 1
+                self.lock._cond.notify_all()
+
+    class _Write:
+        def __init__(self, lock):
+            self.lock = lock
+
+        def __enter__(self):
+            with self.lock._cond:
+                while self.lock._writer or self.lock._readers:
+                    self.lock._cond.wait()
+                self.lock._writer = True
+
+        def __exit__(self, *exc):
+            with self.lock._cond:
+                self.lock._writer = False
+                self.lock._cond.notify_all()
+
+    def read(self):
+        return self._Read(self)
+
+    def write(self):
+        return self._Write(self)
+
+
+class ComputeCluster(abc.ABC):
+    """Backend interface.  Implementations: `cluster.mock.MockCluster` (the
+    simulator backbone, reference mesos_mock.clj) and `cluster.k8s`
+    (synthesized offers + expected-vs-actual controller)."""
+
+    name: str
+    state: ClusterState
+
+    def __init__(self, name: str):
+        self.name = name
+        self.state = ClusterState.RUNNING
+        self.kill_lock = KillLock()
+
+    # --- offers ---
+    @abc.abstractmethod
+    def pending_offers(self, pool: str) -> list[Offer]:
+        ...
+
+    def restore_offers(self, pool: str, offers: Sequence[Offer]) -> None:
+        """Return unmatched offers (Mesos semantics; no-op for synthesized)."""
+
+    # --- task lifecycle ---
+    @abc.abstractmethod
+    def launch_tasks(self, pool: str, specs: Sequence[TaskSpec]) -> None:
+        ...
+
+    @abc.abstractmethod
+    def kill_task(self, task_id: str) -> None:
+        ...
+
+    def safe_kill_task(self, task_id: str) -> None:
+        """Kill that tolerates backend errors (reference safe-kill-task)."""
+        try:
+            with self.kill_lock.write():
+                self.kill_task(task_id)
+        except Exception:  # noqa: BLE001 — kill must never propagate
+            pass
+
+    # --- autoscaling ---
+    def autoscaling(self, pool: str) -> bool:
+        return False
+
+    def autoscale(self, pool: str, pending_demand: Sequence[TaskSpec]) -> None:
+        """Request capacity for unmatched demand (reference: synthetic pods,
+        kubernetes/compute_cluster.clj:606)."""
+
+    # --- capacity limits ---
+    def max_launchable(self) -> int:
+        return 2**31
+
+    def max_tasks_per_host(self) -> int:
+        return 2**31
+
+    def num_tasks_on_host(self, hostname: str) -> int:
+        return 0
+
+    # --- state/queries ---
+    def set_state(self, new_state: ClusterState) -> None:
+        if new_state not in self.state.valid_next():
+            raise ValueError(f"invalid cluster transition {self.state} -> {new_state}")
+        self.state = new_state
+
+    @property
+    def accepts_work(self) -> bool:
+        return self.state == ClusterState.RUNNING
+
+    def retrieve_sandbox_url_path(self, task_id: str) -> str:
+        return ""

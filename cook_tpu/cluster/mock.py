@@ -1,0 +1,142 @@
+"""In-memory mock compute cluster: the simulator backbone.
+
+Plays the role of the reference's in-memory Mesos master mock
+(/root/reference/scheduler/src/cook/mesos/mesos_mock.clj): hosts with fixed
+capacity hand out offers of their spare resources; launched tasks consume
+resources and complete (success) after their simulated runtime when virtual
+time advances; kills release resources immediately.  Status transitions are
+reported to a callback, exactly like a real backend's watch/callback feed.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
+from cook_tpu.models.entities import InstanceStatus
+
+
+@dataclass
+class MockHost:
+    node_id: str
+    hostname: str
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    attributes: tuple = ()
+    pool: str = "default"
+
+
+@dataclass
+class _RunningTask:
+    spec: TaskSpec
+    started_ms: int
+    ends_ms: int  # virtual completion time
+
+
+StatusCallback = Callable[[str, InstanceStatus, Optional[str]], None]
+# (task_id, new_status, reason_name)
+
+
+class MockCluster(ComputeCluster):
+    """Deterministic fake backend driven by a virtual clock."""
+
+    def __init__(self, name: str, hosts: Sequence[MockHost],
+                 clock: Callable[[], int], *,
+                 default_runtime_ms: int = 60_000):
+        super().__init__(name)
+        self.hosts = {h.node_id: h for h in hosts}
+        self.clock = clock
+        self.default_runtime_ms = default_runtime_ms
+        self.running: dict[str, _RunningTask] = {}
+        self.status_callback: Optional[StatusCallback] = None
+        self.launched_count = 0
+        self.killed_count = 0
+
+    # ------------------------------------------------------------- offers
+
+    def _host_used(self, node_id: str) -> tuple[float, float, float]:
+        mem = cpus = gpus = 0.0
+        for rt in self.running.values():
+            if rt.spec.node_id == node_id:
+                mem += rt.spec.mem
+                cpus += rt.spec.cpus
+                gpus += rt.spec.gpus
+        return mem, cpus, gpus
+
+    def pending_offers(self, pool: str) -> list[Offer]:
+        offers = []
+        for h in self.hosts.values():
+            if h.pool != pool:
+                continue
+            um, uc, ug = self._host_used(h.node_id)
+            offers.append(
+                Offer(
+                    node_id=h.node_id,
+                    hostname=h.hostname,
+                    mem=h.mem - um,
+                    cpus=h.cpus - uc,
+                    gpus=h.gpus - ug,
+                    attributes=h.attributes,
+                    total_mem=h.mem,
+                    total_cpus=h.cpus,
+                )
+            )
+        return offers
+
+    # ------------------------------------------------------ task lifecycle
+
+    def launch_tasks(self, pool: str, specs: Sequence[TaskSpec]) -> None:
+        now = self.clock()
+        for spec in specs:
+            if spec.node_id not in self.hosts:
+                self._report(spec.task_id, InstanceStatus.FAILED,
+                             "scheduling-failed-on-host")
+                continue
+            runtime = spec.expected_runtime_ms or self.default_runtime_ms
+            self.running[spec.task_id] = _RunningTask(
+                spec=spec, started_ms=now, ends_ms=now + runtime
+            )
+            self.launched_count += 1
+            self._report(spec.task_id, InstanceStatus.RUNNING, None)
+
+    def kill_task(self, task_id: str) -> None:
+        rt = self.running.pop(task_id, None)
+        self.killed_count += 1
+        if rt is not None:
+            self._report(task_id, InstanceStatus.FAILED, "killed-by-user")
+
+    def num_tasks_on_host(self, hostname: str) -> int:
+        return sum(1 for rt in self.running.values()
+                   if rt.spec.hostname == hostname)
+
+    # --------------------------------------------------------- virtual time
+
+    def advance_to(self, now_ms: int) -> list[str]:
+        """Complete every task whose simulated runtime has elapsed; returns
+        the completed task ids (mesos_mock.clj `complete-task!`)."""
+        done = [tid for tid, rt in self.running.items() if rt.ends_ms <= now_ms]
+        for tid in sorted(done):  # deterministic order
+            self.running.pop(tid)
+            self._report(tid, InstanceStatus.SUCCESS, "normal-exit")
+        return done
+
+    def fail_task(self, task_id: str, reason: str = "unknown") -> None:
+        """Test/fault-injection hook."""
+        if self.running.pop(task_id, None) is not None:
+            self._report(task_id, InstanceStatus.FAILED, reason)
+
+    def remove_host(self, node_id: str) -> list[str]:
+        """Simulate node loss: fail all its tasks mea-culpa."""
+        lost = [tid for tid, rt in self.running.items()
+                if rt.spec.node_id == node_id]
+        for tid in sorted(lost):
+            self.running.pop(tid)
+            self._report(tid, InstanceStatus.FAILED, "node-removed")
+        self.hosts.pop(node_id, None)
+        return lost
+
+    def _report(self, task_id: str, status: InstanceStatus,
+                reason: Optional[str]) -> None:
+        if self.status_callback is not None:
+            self.status_callback(task_id, status, reason)

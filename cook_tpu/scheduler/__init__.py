@@ -1,0 +1,4 @@
+"""Scheduler core: rank/match/rebalance cycles over the JAX kernels."""
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig  # noqa: F401
+from cook_tpu.scheduler.matcher import MatchConfig  # noqa: F401
+from cook_tpu.scheduler.rebalancer import RebalancerParams  # noqa: F401

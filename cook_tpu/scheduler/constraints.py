@@ -1,0 +1,196 @@
+"""Constraint encoding: job/group placement rules -> tensor masks.
+
+The reference evaluates a zoo of Fenzo constraint objects per (job, node)
+pair (/root/reference/scheduler/src/cook/scheduler/constraints.clj).  Here
+constraints are split the way SURVEY §7 prescribes:
+
+  * vectorizable constraints (novel-host, gpu-host, attribute EQUALS,
+    max-tasks-per-host, group member-exclusion) are encoded host-side into
+    one [J, N] boolean feasibility mask fed to the match kernel — numpy
+    vectorized, O(J*N) bitwork, no Python loops over pairs;
+
+  * order-dependent group constraints (unique-host / balanced /
+    attribute-equals *within the current cycle*) are enforced by a
+    post-kernel validation pass that unassigns violators (they simply wait
+    a cycle, like any unplaced job).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from cook_tpu.cluster.base import Offer
+from cook_tpu.models.entities import (
+    Group,
+    GroupPlacementType,
+    Job,
+)
+
+
+@dataclass
+class EncodedNodes:
+    """Host-side encoding of one pool's offers."""
+
+    offers: list[Offer]
+    hostname_to_idx: dict[str, int]
+    has_gpus: np.ndarray          # [N] bool
+    attr_codes: dict[str, np.ndarray]  # attr name -> [N] int codes (-1 missing)
+    attr_vocab: dict[str, dict[str, int]]
+
+    @property
+    def n(self) -> int:
+        return len(self.offers)
+
+
+def encode_nodes(offers: Sequence[Offer]) -> EncodedNodes:
+    hostname_to_idx = {o.hostname: i for i, o in enumerate(offers)}
+    has_gpus = np.array([o.gpus > 0 for o in offers], dtype=bool)
+    attr_names = set()
+    for o in offers:
+        attr_names.update(dict(o.attributes).keys())
+    attr_codes: dict[str, np.ndarray] = {}
+    attr_vocab: dict[str, dict[str, int]] = {}
+    for name in attr_names:
+        vocab: dict[str, int] = {}
+        codes = np.full(len(offers), -1, dtype=np.int32)
+        for i, o in enumerate(offers):
+            val = dict(o.attributes).get(name)
+            if val is None:
+                continue
+            if val not in vocab:
+                vocab[val] = len(vocab)
+            codes[i] = vocab[val]
+        attr_codes[name] = codes
+        attr_vocab[name] = vocab
+    return EncodedNodes(
+        offers=list(offers),
+        hostname_to_idx=hostname_to_idx,
+        has_gpus=has_gpus,
+        attr_codes=attr_codes,
+        attr_vocab=attr_vocab,
+    )
+
+
+def feasibility_mask(
+    jobs: Sequence[Job],
+    nodes: EncodedNodes,
+    *,
+    previous_hosts: Optional[dict[str, set[str]]] = None,
+    group_used_hosts: Optional[dict[str, set[str]]] = None,
+    group_attr_value: Optional[dict[str, tuple[str, str]]] = None,
+    groups: Optional[dict[str, Group]] = None,
+    tasks_on_host: Optional[dict[str, int]] = None,
+    max_tasks_per_host: int = 0,
+) -> np.ndarray:
+    """Build the [J, N] mask.
+
+    previous_hosts: job uuid -> hostnames of prior failed instances
+      (novel-host constraint, constraints.clj:68).
+    group_used_hosts: group uuid -> hostnames already used by RUNNING group
+      members (unique-host member exclusion, constraints.clj:586).
+    group_attr_value: group uuid -> (attr, value) pinned by running members
+      (attribute-equals, constraints.clj:628).
+    tasks_on_host + max_tasks_per_host: constraints.clj:433.
+    """
+    j, n = len(jobs), nodes.n
+    mask = np.ones((j, n), dtype=bool)
+    if n == 0:
+        return mask
+
+    # gpu-host constraint (constraints.clj:122): gpu jobs only on gpu nodes,
+    # non-gpu jobs never on gpu nodes.
+    job_gpu = np.array([job.resources.gpus > 0 for job in jobs], dtype=bool)
+    mask &= job_gpu[:, None] == nodes.has_gpus[None, :]
+
+    # max tasks per host
+    if max_tasks_per_host and tasks_on_host:
+        full = np.array(
+            [tasks_on_host.get(o.hostname, 0) >= max_tasks_per_host
+             for o in nodes.offers],
+            dtype=bool,
+        )
+        mask &= ~full[None, :]
+
+    for ji, job in enumerate(jobs):
+        # novel-host: never revisit a host this job failed on
+        if previous_hosts:
+            for hostname in previous_hosts.get(job.uuid, ()):
+                idx = nodes.hostname_to_idx.get(hostname)
+                if idx is not None:
+                    mask[ji, idx] = False
+        # user-specified attribute constraints (EQUALS)
+        for c in job.constraints:
+            codes = nodes.attr_codes.get(c.attribute)
+            if codes is None:
+                mask[ji, :] = False
+                continue
+            want = nodes.attr_vocab[c.attribute].get(c.pattern, -2)
+            mask[ji, :] &= codes == want
+        # group placement derived from already-running members
+        if job.group_uuid and groups:
+            group = groups.get(job.group_uuid)
+            if group is not None:
+                ptype = group.host_placement.type
+                if ptype == GroupPlacementType.UNIQUE and group_used_hosts:
+                    for hostname in group_used_hosts.get(job.group_uuid, ()):
+                        idx = nodes.hostname_to_idx.get(hostname)
+                        if idx is not None:
+                            mask[ji, idx] = False
+                elif (ptype == GroupPlacementType.ATTRIBUTE_EQUALS
+                      and group_attr_value):
+                    pinned = group_attr_value.get(job.group_uuid)
+                    if pinned is not None:
+                        attr, value = pinned
+                        codes = nodes.attr_codes.get(attr)
+                        if codes is None:
+                            mask[ji, :] = False
+                        else:
+                            want = nodes.attr_vocab[attr].get(value, -2)
+                            mask[ji, :] &= codes == want
+    return mask
+
+
+def validate_group_assignments(
+    jobs: Sequence[Job],
+    assignment: np.ndarray,
+    nodes: EncodedNodes,
+    groups: dict[str, Group],
+    group_used_hosts: dict[str, set[str]],
+    group_attr_value: dict[str, tuple[str, str]],
+) -> np.ndarray:
+    """Post-kernel pass enforcing intra-cycle group semantics: walk matches
+    in schedule order; a match that violates its group's unique-host /
+    attribute-equals placement against *earlier* matches this cycle is
+    unassigned (set to -1).  Returns the corrected assignment."""
+    assignment = assignment.copy()
+    used: dict[str, set[str]] = {g: set(h) for g, h in group_used_hosts.items()}
+    pinned: dict[str, tuple[str, str]] = dict(group_attr_value)
+    for ji, job in enumerate(jobs):
+        node_idx = int(assignment[ji])
+        if node_idx < 0 or not job.group_uuid:
+            continue
+        group = groups.get(job.group_uuid)
+        if group is None:
+            continue
+        hostname = nodes.offers[node_idx].hostname
+        ptype = group.host_placement.type
+        if ptype == GroupPlacementType.UNIQUE:
+            seen = used.setdefault(job.group_uuid, set())
+            if hostname in seen:
+                assignment[ji] = -1
+                continue
+            seen.add(hostname)
+        elif ptype == GroupPlacementType.ATTRIBUTE_EQUALS:
+            attr = group.host_placement.attribute
+            value = dict(nodes.offers[node_idx].attributes).get(attr)
+            if value is None:
+                assignment[ji] = -1
+                continue
+            prev = pinned.get(job.group_uuid)
+            if prev is None:
+                pinned[job.group_uuid] = (attr, value)
+            elif prev != (attr, value):
+                assignment[ji] = -1
+    return assignment

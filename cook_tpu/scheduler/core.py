@@ -1,0 +1,268 @@
+"""Scheduler composition: cycles, status handling, kill fan-out, monitors.
+
+This is the equivalent of the reference's leader-side wiring
+(/root/reference/scheduler/src/cook/mesos.clj:153-328 +
+scheduler/scheduler.clj:2473-2517): per-pool rank/match/rebalance cycles
+driven by triggers, backend status updates flowing into the store's state
+machine, the store's event feed driving kill fan-out for completed jobs, and
+the task-lifecycle monitors (lingering/straggler/cancelled killers,
+reconciliation).
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import statistics
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from cook_tpu.cluster.base import ComputeCluster
+from cook_tpu.models.entities import (
+    InstanceStatus,
+    Job,
+    JobState,
+    Pool,
+    Resources,
+)
+from cook_tpu.models.store import Event, JobStore
+from cook_tpu.models.reasons import get_reason
+from cook_tpu.scheduler.matcher import (
+    MatchConfig,
+    MatchOutcome,
+    PoolMatchState,
+    match_pool,
+)
+from cook_tpu.scheduler.ranking import RankedQueue, rank_pool
+from cook_tpu.scheduler.rebalancer import (
+    Decision,
+    RebalancerParams,
+    rebalance_pool,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SchedulerConfig:
+    match: MatchConfig = field(default_factory=MatchConfig)
+    rebalancer: RebalancerParams = field(default_factory=RebalancerParams)
+    max_runtime_check: bool = True
+
+
+class Scheduler:
+    """One leader's scheduling brain.  Host-side orchestration; all the
+    heavy per-cycle math runs in the JAX kernels."""
+
+    def __init__(
+        self,
+        store: JobStore,
+        clusters: Sequence[ComputeCluster],
+        config: Optional[SchedulerConfig] = None,
+    ):
+        self.store = store
+        self.clusters = list(clusters)
+        self.config = config or SchedulerConfig()
+        self._task_seq = itertools.count()
+        self.pool_queues: dict[str, RankedQueue] = {}
+        self.pool_match_state: dict[str, PoolMatchState] = {}
+        self.last_unmatched_offers: dict[str, dict[str, Resources]] = {}
+        self.placement_failures: dict[str, str] = {}  # job uuid -> reason text
+        self.metrics: dict[str, float] = {}
+        store.add_watcher(self._on_event)
+        for cluster in self.clusters:
+            if hasattr(cluster, "status_callback"):
+                cluster.status_callback = self.handle_status_update
+
+    # ------------------------------------------------------------ plumbing
+
+    def cluster_by_name(self, name: str) -> Optional[ComputeCluster]:
+        for c in self.clusters:
+            if c.name == name:
+                return c
+        return None
+
+    def _make_task_id(self, job: Job) -> str:
+        return f"task-{job.uuid[:8]}-{next(self._task_seq)}"
+
+    # ---------------------------------------------------- status + fan-out
+
+    def handle_status_update(
+        self, task_id: str, status: InstanceStatus, reason: Optional[str]
+    ) -> None:
+        """Backend callback -> store state machine (write-status-to-datomic,
+        scheduler.clj:217)."""
+        self.store.update_instance_state(task_id, status, reason)
+
+    def _on_event(self, event: Event) -> None:
+        """Store event feed consumer: when a job completes while instances
+        are still live, kill them (monitor-tx-report-queue,
+        scheduler.clj:378)."""
+        if event.kind != "job/state" or event.data.get("state") != "completed":
+            return
+        job_uuid = event.data["uuid"]
+        for inst in self.store.live_instances_of_job(job_uuid):
+            cluster = self.cluster_by_name(inst.compute_cluster)
+            if cluster is not None:
+                cluster.safe_kill_task(inst.task_id)
+                self.store.update_instance_state(
+                    inst.task_id, InstanceStatus.FAILED, "killed-by-user"
+                )
+
+    # -------------------------------------------------------------- cycles
+
+    def rank_cycle(self, pool: Pool) -> RankedQueue:
+        queue = rank_pool(self.store, pool)
+        self.pool_queues[pool.name] = queue
+        self.metrics[f"rank.{pool.name}.queue_len"] = len(queue.jobs)
+        return queue
+
+    def match_cycle(self, pool: Pool) -> MatchOutcome:
+        queue = self.pool_queues.get(pool.name) or self.rank_cycle(pool)
+        state = self.pool_match_state.setdefault(
+            pool.name,
+            PoolMatchState(num_considerable=self.config.match.max_jobs_considered),
+        )
+        outcome = match_pool(
+            self.store,
+            pool,
+            queue,
+            self.clusters,
+            self.config.match,
+            state,
+            make_task_id=self._make_task_id,
+            record_placement_failure=self._record_placement_failure,
+        )
+        # cache spare resources for the rebalancer (view-incubating-offers,
+        # scheduler.clj:1537): offers minus what this cycle just placed
+        matched_uuids = {j.uuid for j, _ in outcome.matched}
+        queue.jobs = [j for j in queue.jobs if j.uuid not in matched_uuids]
+        self._cache_spare(pool)
+        self.metrics[f"match.{pool.name}.matched"] = len(outcome.matched)
+        self.metrics[f"match.{pool.name}.offers"] = outcome.offers_total
+        return outcome
+
+    def _cache_spare(self, pool: Pool) -> None:
+        spare: dict[str, Resources] = {}
+        for cluster in self.clusters:
+            if not cluster.accepts_work:
+                continue
+            for offer in cluster.pending_offers(pool.name):
+                spare[offer.hostname] = Resources(
+                    mem=offer.mem, cpus=offer.cpus, gpus=offer.gpus
+                )
+        self.last_unmatched_offers[pool.name] = spare
+
+    def rebalance_cycle(self, pool: Pool) -> list[Decision]:
+        queue = self.pool_queues.get(pool.name) or self.rank_cycle(pool)
+        spare = self.last_unmatched_offers.get(pool.name, {})
+        decisions = rebalance_pool(
+            self.store, pool, queue.jobs, spare, self.config.rebalancer
+        )
+        for decision in decisions:
+            self._transact_preemption(decision)
+        self.metrics[f"rebalance.{pool.name}.preempted"] = sum(
+            len(d.task_ids) for d in decisions
+        )
+        return decisions
+
+    def _transact_preemption(self, decision: Decision) -> None:
+        """transact-preemption! + safe-kill-task (rebalancer.clj:482-533)."""
+        for task_id in decision.task_ids:
+            inst = self.store.instances.get(task_id)
+            if inst is None or inst.status.terminal:
+                continue
+            self.store.update_instance_state(
+                task_id, InstanceStatus.FAILED, "preempted-by-rebalancer"
+            )
+            cluster = self.cluster_by_name(inst.compute_cluster)
+            if cluster is not None:
+                cluster.safe_kill_task(task_id)
+
+    def _record_placement_failure(self, job: Job, reason: str) -> None:
+        self.placement_failures[job.uuid] = reason
+
+    # ------------------------------------------------------------ monitors
+
+    def kill_lingering_tasks(self, now_ms: int) -> list[str]:
+        """Max-runtime enforcement (lingering-task-killer,
+        scheduler.clj:1941-1974)."""
+        killed = []
+        for pool_name in list(self.store.pools):
+            for inst in self.store.running_instances(pool_name):
+                job = self.store.jobs[inst.job_uuid]
+                if job.max_runtime_ms and inst.start_time_ms + job.max_runtime_ms <= now_ms:
+                    self.store.update_instance_state(
+                        inst.task_id, InstanceStatus.FAILED,
+                        "max-runtime-exceeded",
+                    )
+                    cluster = self.cluster_by_name(inst.compute_cluster)
+                    if cluster is not None:
+                        cluster.safe_kill_task(inst.task_id)
+                    killed.append(inst.task_id)
+        return killed
+
+    def kill_stragglers(self, now_ms: int) -> list[str]:
+        """Group straggler handling (straggler-handler, scheduler.clj:1976;
+        docs/groups.md quantile-deviation): if a group's running task has
+        run longer than `multiplier` x the `quantile` runtime of its
+        completed siblings, kill it mea-culpa."""
+        killed = []
+        for group in self.store.groups.values():
+            sh = group.straggler_handling
+            if sh.type != "quantile-deviation":
+                continue
+            completed_ms = []
+            running: list = []
+            for member in group.job_uuids:
+                for inst in self.store.job_instances(member):
+                    if inst.status == InstanceStatus.SUCCESS:
+                        completed_ms.append(inst.end_time_ms - inst.start_time_ms)
+                    elif inst.status == InstanceStatus.RUNNING:
+                        running.append(inst)
+            if len(completed_ms) < 2:
+                continue
+            quantiles = statistics.quantiles(completed_ms, n=100)
+            threshold = quantiles[int(sh.quantile * 100) - 1] * sh.multiplier
+            for inst in running:
+                if now_ms - inst.start_time_ms > threshold:
+                    self.store.update_instance_state(
+                        inst.task_id, InstanceStatus.FAILED, "straggler"
+                    )
+                    cluster = self.cluster_by_name(inst.compute_cluster)
+                    if cluster is not None:
+                        cluster.safe_kill_task(inst.task_id)
+                    killed.append(inst.task_id)
+        return killed
+
+    def kill_cancelled_tasks(self) -> list[str]:
+        """cancelled-task-killer (scheduler.clj:2000)."""
+        killed = []
+        for inst in list(self.store.instances.values()):
+            if inst.cancelled and not inst.status.terminal:
+                self.store.update_instance_state(
+                    inst.task_id, InstanceStatus.FAILED, "killed-by-user"
+                )
+                cluster = self.cluster_by_name(inst.compute_cluster)
+                if cluster is not None:
+                    cluster.safe_kill_task(inst.task_id)
+                killed.append(inst.task_id)
+        return killed
+
+    def reconcile(self) -> list[str]:
+        """Resync store vs backends (reconcile-tasks, scheduler.clj:1828):
+        store-live tasks unknown to their backend are failed mea-culpa."""
+        fixed = []
+        backend_known: set[str] = set()
+        for cluster in self.clusters:
+            running = getattr(cluster, "running", None)
+            if running is not None:
+                backend_known.update(running.keys())
+        for inst in list(self.store.instances.values()):
+            if inst.status.terminal:
+                continue
+            if inst.task_id not in backend_known:
+                self.store.update_instance_state(
+                    inst.task_id, InstanceStatus.FAILED, "task-unknown"
+                )
+                fixed.append(inst.task_id)
+        return fixed

@@ -1,0 +1,292 @@
+"""The match cycle: ranked queue + offers -> kernel solve -> launches.
+
+Reference: `handle-fenzo-pool` / `handle-resource-offers!` / `launch-matched-
+tasks!` (/root/reference/scheduler/src/cook/scheduler/scheduler.clj:617-1651)
+with the Fenzo solve replaced by the `ops.match` kernels, plus:
+
+  * considerable-job selection with per-cycle cap and quota filtering
+    (`pending-jobs->considerable-jobs`, scheduler.clj:729);
+  * head-of-queue fairness backoff — if the queue head fails to match, the
+    next cycle considers 5% fewer jobs, floored; a matched head resets the
+    cap (scheduler.clj:1613-1651);
+  * launch transactions with the allowed-to-start precondition, then backend
+    launch under the cluster's kill-lock read side (scheduler.clj:962-1048);
+  * placement-failure bookkeeping for /unscheduled_jobs
+    (fenzo_utils.clj/record-placement-failures!).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
+from cook_tpu.models.entities import Job, Pool
+from cook_tpu.models.store import JobStore, TransactionVetoed
+from cook_tpu.ops.common import bucket_size, pad_to
+from cook_tpu.ops.match import MatchProblem, chunked_match, greedy_match
+from cook_tpu.scheduler.constraints import (
+    EncodedNodes,
+    encode_nodes,
+    feasibility_mask,
+    validate_group_assignments,
+)
+from cook_tpu.scheduler.ranking import RankedQueue
+
+
+@dataclass
+class MatchConfig:
+    """Fenzo-knob equivalents (reference config.clj:108-116)."""
+
+    max_jobs_considered: int = 1000
+    scaleback: float = 0.95
+    floor_iterations_before_reset: int = 1000000
+    chunk: int = 0           # 0 = exact sequential greedy kernel
+    chunk_rounds: int = 4
+
+
+@dataclass
+class PoolMatchState:
+    """Mutable per-pool matcher state (head-of-queue backoff)."""
+
+    num_considerable: int
+    iterations_at_floor: int = 0
+
+
+@dataclass
+class MatchOutcome:
+    matched: list[tuple[Job, Offer]] = field(default_factory=list)
+    launched_task_ids: list[str] = field(default_factory=list)
+    unmatched: list[Job] = field(default_factory=list)
+    offers_total: int = 0
+    head_matched: bool = True
+
+
+def select_considerable(
+    store: JobStore,
+    pool: Pool,
+    queue: RankedQueue,
+    limit: int,
+    *,
+    launch_filter: Optional[Callable[[Job], bool]] = None,
+) -> list[Job]:
+    """Head of the ranked queue, quota- and plugin-filtered
+    (scheduler.clj:729 `pending-jobs->considerable-jobs`)."""
+    out = []
+    for job in queue.jobs:
+        if launch_filter is not None and not launch_filter(job):
+            continue
+        out.append(job)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def build_match_problem(
+    jobs: Sequence[Job],
+    nodes: EncodedNodes,
+    feasible: np.ndarray,
+    *,
+    chunk: int = 0,
+) -> MatchProblem:
+    j, n = len(jobs), nodes.n
+    pad_j = bucket_size(max(j, 1))
+    if chunk:
+        pad_j = max(pad_j, chunk)
+        pad_j += (-pad_j) % chunk
+    pad_n = bucket_size(max(n, 1))
+    demands = np.zeros((j, 3), dtype=np.float32)
+    for i, job in enumerate(jobs):
+        demands[i] = (job.resources.mem, job.resources.cpus, job.resources.gpus)
+    avail = np.zeros((n, 3), dtype=np.float32)
+    totals = np.zeros((n, 2), dtype=np.float32)
+    for i, o in enumerate(nodes.offers):
+        avail[i] = (o.mem, o.cpus, o.gpus)
+        totals[i] = (o.total_mem or o.mem, o.total_cpus or o.cpus)
+    feas = np.zeros((pad_j, pad_n), dtype=bool)
+    feas[:j, :n] = feasible
+    return MatchProblem(
+        demands=jnp.asarray(pad_to(demands, pad_j)),
+        job_valid=jnp.asarray(pad_to(np.ones(j, dtype=bool), pad_j, fill=False)),
+        avail=jnp.asarray(pad_to(avail, pad_n)),
+        totals=jnp.asarray(pad_to(totals, pad_n)),
+        node_valid=jnp.asarray(pad_to(np.ones(n, dtype=bool), pad_n, fill=False)),
+        feasible=jnp.asarray(feas),
+    )
+
+
+def gather_group_context(store: JobStore, jobs: Sequence[Job]):
+    """Hostnames/attr-values pinned by running group members."""
+    group_used_hosts: dict[str, set[str]] = {}
+    group_attr_value: dict[str, tuple[str, str]] = {}
+    groups = {}
+    for job in jobs:
+        if not job.group_uuid or job.group_uuid in groups:
+            continue
+        group = store.groups.get(job.group_uuid)
+        if group is None:
+            continue
+        groups[group.uuid] = group
+        hosts: set[str] = set()
+        for member_uuid in group.job_uuids:
+            for inst in store.job_instances(member_uuid):
+                if not inst.status.terminal and inst.hostname:
+                    hosts.add(inst.hostname)
+        group_used_hosts[group.uuid] = hosts
+    return groups, group_used_hosts, group_attr_value
+
+
+def previous_failed_hosts(store: JobStore, jobs: Sequence[Job]) -> dict[str, set[str]]:
+    """novel-host constraint input: hosts each job already failed on."""
+    out: dict[str, set[str]] = {}
+    for job in jobs:
+        hosts = {
+            inst.hostname
+            for inst in store.job_instances(job.uuid)
+            if inst.status.terminal and inst.hostname
+        }
+        if hosts:
+            out[job.uuid] = hosts
+    return out
+
+
+def match_pool(
+    store: JobStore,
+    pool: Pool,
+    queue: RankedQueue,
+    clusters: Sequence[ComputeCluster],
+    config: MatchConfig,
+    state: PoolMatchState,
+    *,
+    make_task_id: Callable[[Job], str],
+    launch_filter: Optional[Callable[[Job], bool]] = None,
+    record_placement_failure: Optional[Callable[[Job, str], None]] = None,
+) -> MatchOutcome:
+    """One pool's match cycle end to end."""
+    outcome = MatchOutcome()
+
+    # 1. offers from every running cluster (scheduler.clj:1574-1585)
+    cluster_offers: list[tuple[ComputeCluster, Offer]] = []
+    for cluster in clusters:
+        if not cluster.accepts_work:
+            continue
+        for offer in cluster.pending_offers(pool.name):
+            cluster_offers.append((cluster, offer))
+    outcome.offers_total = len(cluster_offers)
+
+    considerable = select_considerable(
+        store, pool, queue, state.num_considerable, launch_filter=launch_filter
+    )
+    if not considerable or not cluster_offers:
+        outcome.unmatched = considerable
+        outcome.head_matched = not considerable
+        _apply_backoff(config, state, outcome.head_matched)
+        return outcome
+
+    nodes = encode_nodes([o for _, o in cluster_offers])
+    groups, group_used_hosts, group_attr_value = gather_group_context(
+        store, considerable
+    )
+    feasible = feasibility_mask(
+        considerable,
+        nodes,
+        previous_hosts=previous_failed_hosts(store, considerable),
+        group_used_hosts=group_used_hosts,
+        group_attr_value=group_attr_value,
+        groups=groups,
+    )
+
+    # 2. the solve
+    problem = build_match_problem(considerable, nodes, feasible,
+                                  chunk=config.chunk)
+    if config.chunk:
+        result = chunked_match(problem, chunk=config.chunk,
+                               rounds=config.chunk_rounds)
+    else:
+        result = greedy_match(problem)
+    assignment = np.asarray(result.assignment[: len(considerable)])
+    assignment = validate_group_assignments(
+        considerable, assignment, nodes, groups, group_used_hosts,
+        group_attr_value,
+    )
+
+    # 3. transact + launch (scheduler.clj:790-1048)
+    launches_per_cluster: dict[str, list[TaskSpec]] = {}
+    cluster_by_name = {}
+    for ji, job in enumerate(considerable):
+        node_idx = int(assignment[ji])
+        if node_idx < 0:
+            outcome.unmatched.append(job)
+            if record_placement_failure is not None:
+                record_placement_failure(job, _failure_reason(job, nodes, feasible[ji]))
+            continue
+        cluster, offer = cluster_offers[node_idx]
+        task_id = make_task_id(job)
+        try:
+            store.create_instance(
+                job.uuid,
+                task_id,
+                hostname=offer.hostname,
+                node_id=offer.node_id,
+                compute_cluster=cluster.name,
+            )
+        except TransactionVetoed:
+            # job completed/launched concurrently; drop the match
+            continue
+        spec = TaskSpec(
+            task_id=task_id,
+            job_uuid=job.uuid,
+            user=job.user,
+            command=job.command,
+            mem=job.resources.mem,
+            cpus=job.resources.cpus,
+            gpus=job.resources.gpus,
+            node_id=offer.node_id,
+            hostname=offer.hostname,
+            env=job.user_provided_env,
+            container_image=(job.container.image if job.container else ""),
+            expected_runtime_ms=job.expected_runtime_ms,
+        )
+        launches_per_cluster.setdefault(cluster.name, []).append(spec)
+        cluster_by_name[cluster.name] = cluster
+        outcome.matched.append((job, offer))
+        outcome.launched_task_ids.append(task_id)
+
+    for cname, specs in launches_per_cluster.items():
+        cluster = cluster_by_name[cname]
+        # read side of the kill-lock: kills can't interleave mid-launch
+        with cluster.kill_lock.read():
+            cluster.launch_tasks(pool.name, specs)
+
+    # 4. head-of-queue backoff
+    head = considerable[0]
+    outcome.head_matched = any(j.uuid == head.uuid for j, _ in outcome.matched)
+    _apply_backoff(config, state, outcome.head_matched)
+    return outcome
+
+
+def _apply_backoff(config: MatchConfig, state: PoolMatchState,
+                   head_matched: bool) -> None:
+    if head_matched:
+        state.num_considerable = config.max_jobs_considered
+        state.iterations_at_floor = 0
+    else:
+        shrunk = max(1, int(state.num_considerable * config.scaleback))
+        if shrunk == state.num_considerable:
+            state.iterations_at_floor += 1
+            if state.iterations_at_floor >= config.floor_iterations_before_reset:
+                state.num_considerable = config.max_jobs_considered
+                state.iterations_at_floor = 0
+                return
+        state.num_considerable = shrunk
+
+
+def _failure_reason(job: Job, nodes: EncodedNodes, feas_row: np.ndarray) -> str:
+    if nodes.n == 0:
+        return "no offers"
+    if not feas_row.any():
+        return "all nodes filtered by constraints"
+    return "insufficient resources on feasible nodes"

@@ -1,0 +1,175 @@
+"""The rank cycle: store state -> DRU kernel -> ordered pending queue.
+
+Reference: `rank-jobs` + `sort-jobs-by-dru-pool`
+(/root/reference/scheduler/src/cook/scheduler/scheduler.clj:2057-2296) —
+every few seconds, per pool: per-user task lists (running tasks first, then
+pending jobs, ordered by (-priority, start-time, id)), quota-capped, DRU
+scored, merged into one global fairness order, filtered to pending.
+
+Here the scoring+merge is the `dru_rank` kernel; this module does the
+host-side gather/encode and the over-quota capping.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from cook_tpu.models.entities import DruMode, Job, Pool, Resources
+from cook_tpu.models.store import JobStore
+from cook_tpu.ops.common import BIG, bucket_size, pad_to
+from cook_tpu.ops.dru import DruTasks, dru_rank
+
+
+@dataclass
+class RankedQueue:
+    """Output of one pool's rank cycle."""
+
+    jobs: list[Job]          # pending jobs in fair-share order
+    dru: dict[str, float]    # job uuid -> queue dru
+    capped: list[str]        # job uuids dropped by quota capping
+
+
+def _quota_cap(
+    store: JobStore,
+    pool: str,
+    pending: list[Job],
+) -> tuple[list[Job], list[str]]:
+    """Drop pending jobs that would exceed their user's quota given running
+    usage + earlier pending jobs (reference `limit-over-quota-jobs` +
+    `filter-based-on-quota`, scheduler.clj:2057-2157).  `pending` must be in
+    per-user priority order."""
+    usage = store.user_usage(pool)
+    running_counts: dict[str, int] = {}
+    for job in store.running_jobs(pool):
+        running_counts[job.user] = running_counts.get(job.user, 0) + 1
+    kept, capped = [], []
+    cum_res: dict[str, Resources] = {}
+    cum_count: dict[str, int] = {}
+    for job in pending:
+        quota = store.get_quota(job.user, pool)
+        res = cum_res.get(job.user, usage.get(job.user, Resources()))
+        count = cum_count.get(job.user, running_counts.get(job.user, 0))
+        new_res = res + job.resources
+        new_count = count + 1
+        if (
+            new_count <= quota.count
+            and new_res.mem <= quota.resources.mem
+            and new_res.cpus <= quota.resources.cpus
+            and new_res.gpus <= quota.resources.gpus
+        ):
+            kept.append(job)
+            cum_res[job.user] = new_res
+            cum_count[job.user] = new_count
+        else:
+            capped.append(job.uuid)
+    return kept, capped
+
+
+def rank_pool(
+    store: JobStore,
+    pool: Pool,
+    *,
+    offensive_job_filter=None,
+) -> RankedQueue:
+    """Rank one pool's pending jobs by cumulative DRU."""
+    pool_name = pool.name
+    pending = store.pending_jobs(pool_name)
+    if offensive_job_filter is not None:
+        pending = [j for j in pending if offensive_job_filter(j)]
+
+    # order pending per user by (-priority, submit-time, uuid) — the
+    # pending-job part of task->feature-vector (tools.clj:614-641)
+    pending.sort(key=lambda j: (-j.priority, j.submit_time_ms, j.uuid))
+    pending, capped = _quota_cap(store, pool_name, pending)
+
+    running = []
+    for job in store.running_jobs(pool_name):
+        for inst in store.job_instances(job.uuid):
+            if not inst.status.terminal:
+                running.append((job, inst))
+
+    t_total = len(running) + len(pending)
+    if t_total == 0 or not pending:
+        return RankedQueue(jobs=[], dru={}, capped=capped)
+
+    users = sorted(
+        {j.user for j in pending} | {j.user for j, _ in running}
+    )
+    user_idx = {u: i for i, u in enumerate(users)}
+
+    # Build the flat task tensor: running tasks sort before pending ones for
+    # the same user/priority (start-time < infinity), matching the
+    # reference's feature vector.
+    n = t_total
+    user = np.empty(n, dtype=np.int32)
+    mem = np.empty(n, dtype=np.float32)
+    cpus = np.empty(n, dtype=np.float32)
+    gpus = np.empty(n, dtype=np.float32)
+    neg_prio = np.empty(n, dtype=np.int64)
+    start = np.empty(n, dtype=np.int64)
+    is_pending = np.zeros(n, dtype=bool)
+    job_refs: list[Job] = []
+    for i, (job, inst) in enumerate(running):
+        user[i] = user_idx[job.user]
+        mem[i], cpus[i], gpus[i] = (job.resources.mem, job.resources.cpus,
+                                    job.resources.gpus)
+        neg_prio[i] = -job.priority
+        start[i] = inst.start_time_ms
+        job_refs.append(job)
+    for k, job in enumerate(pending):
+        i = len(running) + k
+        user[i] = user_idx[job.user]
+        mem[i], cpus[i], gpus[i] = (job.resources.mem, job.resources.cpus,
+                                    job.resources.gpus)
+        neg_prio[i] = -job.priority
+        start[i] = 2**62  # pending sorts after running at equal priority
+        is_pending[i] = True
+        job_refs.append(job)
+
+    # per-user order key: global lexicographic position (host-side lexsort;
+    # preserves (-priority, start, submit-order) within each user)
+    perm = np.lexsort((np.arange(n), start, neg_prio, user))
+    order_key = np.empty(n, dtype=np.float32)
+    order_key[perm] = np.arange(n, dtype=np.float32)
+
+    mem_div = np.empty(len(users), dtype=np.float32)
+    cpu_div = np.empty(len(users), dtype=np.float32)
+    gpu_div = np.empty(len(users), dtype=np.float32)
+    for u, i in user_idx.items():
+        share = store.get_share(u, pool_name)
+        mem_div[i] = min(share.mem, BIG)
+        cpu_div[i] = min(share.cpus, BIG)
+        gpu_div[i] = min(share.gpus, BIG)
+
+    pad_t = bucket_size(n)
+    tasks = DruTasks(
+        user=jnp.asarray(pad_to(user, pad_t)),
+        mem=jnp.asarray(pad_to(mem, pad_t)),
+        cpus=jnp.asarray(pad_to(cpus, pad_t)),
+        gpus=jnp.asarray(pad_to(gpus, pad_t)),
+        order_key=jnp.asarray(pad_to(order_key, pad_t, fill=BIG)),
+        valid=jnp.asarray(pad_to(np.ones(n, dtype=bool), pad_t, fill=False)),
+    )
+    result = dru_rank(
+        tasks,
+        jnp.asarray(mem_div),
+        jnp.asarray(cpu_div),
+        jnp.asarray(gpu_div),
+        gpu_mode=(pool.dru_mode == DruMode.GPU),
+    )
+    order = np.asarray(result.order[:])
+    dru = np.asarray(result.dru[:])
+
+    ranked_jobs: list[Job] = []
+    dru_map: dict[str, float] = {}
+    for pos in order:
+        if pos >= n or not is_pending[pos]:
+            continue
+        job = job_refs[pos]
+        ranked_jobs.append(job)
+        dru_map[job.uuid] = float(dru[pos])
+    return RankedQueue(jobs=ranked_jobs, dru=dru_map, capped=capped)

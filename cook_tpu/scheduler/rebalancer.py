@@ -1,0 +1,299 @@
+"""The rebalancer: periodic DRU-driven preemption.
+
+Reference: /root/reference/scheduler/src/cook/rebalancer.clj — per cycle,
+walk the top pending jobs in fairness order; for each, find the preemption
+decision (host + prefix of highest-DRU tasks) that frees enough room while
+maximizing the minimum preempted DRU, guarded by `safe-dru-threshold` and
+`min-dru-diff`; simulate the launch so later decisions see the updated
+fairness picture; then transact the preemptions and kill the victims.
+
+The victim search itself is the `ops.rebalance.find_preemption_decision`
+kernel (one call scans all tasks x hosts); this module keeps the host-side
+incremental state (`next-state`, rebalancer.clj:270-318): preempted tasks
+drop out, the simulated launch joins the user's task list, and only changed
+users are re-scored (dru.clj:128 `next-task->scored-task`).
+"""
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from cook_tpu.models.entities import DruMode, Instance, Job, Pool, Resources
+from cook_tpu.models.store import JobStore
+from cook_tpu.ops.common import BIG
+from cook_tpu.ops.rebalance import RebalanceState, find_preemption_decision
+
+
+@dataclass
+class RebalancerParams:
+    """Runtime-mutable knobs (reference: Datomic-stored `:rebalancer/config`,
+    rebalancer.clj:535-557, docs/rebalancer-config.adoc)."""
+
+    safe_dru_threshold: float = 1.0
+    min_dru_diff: float = 0.5
+    max_preemption: int = 100
+
+
+@dataclass
+class Decision:
+    job: Job                      # to make room for
+    hostname: str
+    task_ids: list[str]           # victims (empty = spare-only)
+    min_preempted_dru: float
+
+
+@dataclass
+class _UserTasks:
+    """One user's running tasks in feature-vector order."""
+
+    keys: list[tuple] = field(default_factory=list)      # sort keys
+    ids: list[str] = field(default_factory=list)         # task ids ("" = simulated)
+    res: list[tuple] = field(default_factory=list)       # (mem, cpus, gpus)
+    dru: list[float] = field(default_factory=list)
+
+
+class RebalanceCycle:
+    """Host-side state for one pool's rebalance cycle."""
+
+    def __init__(
+        self,
+        store: JobStore,
+        pool: Pool,
+        host_spare: dict[str, Resources],
+        params: RebalancerParams,
+    ):
+        self.store = store
+        self.pool = pool
+        self.params = params
+        self.gpu_mode = pool.dru_mode == DruMode.GPU
+
+        # hosts
+        self.hostnames = sorted(
+            set(host_spare)
+            | {
+                i.hostname
+                for i in store.running_instances(pool.name)
+                if i.hostname
+            }
+        )
+        self.host_idx = {h: i for i, h in enumerate(self.hostnames)}
+        h = len(self.hostnames)
+        self.spare = np.zeros((max(h, 1), 3), dtype=np.float64)
+        for hostname, res in host_spare.items():
+            i = self.host_idx[hostname]
+            self.spare[i] = (res.mem, res.cpus, res.gpus)
+
+        # per-user ordered running tasks
+        self.users: dict[str, _UserTasks] = {}
+        self.task_info: dict[str, tuple[str, str]] = {}  # task id -> (user, host)
+        for job in store.running_jobs(pool.name):
+            for inst in store.job_instances(job.uuid):
+                if inst.status.terminal:
+                    continue
+                ut = self.users.setdefault(job.user, _UserTasks())
+                ut.keys.append(self._task_key(job, inst))
+                ut.ids.append(inst.task_id)
+                ut.res.append(
+                    (job.resources.mem, job.resources.cpus, job.resources.gpus)
+                )
+                self.task_info[inst.task_id] = (job.user, inst.hostname)
+        for user, ut in self.users.items():
+            order = sorted(range(len(ut.keys)), key=lambda i: ut.keys[i])
+            ut.keys = [ut.keys[i] for i in order]
+            ut.ids = [ut.ids[i] for i in order]
+            ut.res = [ut.res[i] for i in order]
+            self._rescore(user)
+        self.preempted: set[str] = set()
+
+    # ------------------------------------------------------------ internals
+
+    @staticmethod
+    def _task_key(job: Job, inst: Optional[Instance]) -> tuple:
+        start = inst.start_time_ms if inst is not None else 2**62
+        tid = inst.task_id if inst is not None else "￿"
+        return (-job.priority, start, tid)
+
+    def _divisors(self, user: str) -> tuple[float, float, float]:
+        share = self.store.get_share(user, self.pool.name)
+        return (min(share.mem, BIG), min(share.cpus, BIG), min(share.gpus, BIG))
+
+    def _rescore(self, user: str) -> None:
+        """Recompute the user's cumulative DRUs (only-changed-users rescore)."""
+        ut = self.users.get(user)
+        if ut is None:
+            return
+        md, cd, gd = self._divisors(user)
+        cum_m = cum_c = cum_g = 0.0
+        ut.dru = []
+        for mem, cpus, gpus in ut.res:
+            cum_m += mem
+            cum_c += cpus
+            cum_g += gpus
+            if self.gpu_mode:
+                ut.dru.append(cum_g / gd)
+            else:
+                ut.dru.append(max(cum_m / md, cum_c / cd))
+
+    def _flat_state(self) -> tuple[RebalanceState, list[str]]:
+        """Flatten per-user state into kernel tensors."""
+        ids, hosts, drus, res, elig = [], [], [], [], []
+        for user, ut in sorted(self.users.items()):
+            for k, tid in enumerate(ut.ids):
+                if tid in self.preempted:
+                    continue
+                host = self.task_info.get(tid, (user, ""))[1] if tid else ""
+                ids.append(tid)
+                hosts.append(self.host_idx.get(host, -1))
+                drus.append(ut.dru[k])
+                res.append(ut.res[k])
+                elig.append(bool(tid) and host in self.host_idx)
+        t = max(len(ids), 1)
+        task_host = np.full(t, -1, dtype=np.int32)
+        task_dru = np.zeros(t, dtype=np.float32)
+        task_res = np.zeros((t, 3), dtype=np.float32)
+        task_elig = np.zeros(t, dtype=bool)
+        for i in range(len(ids)):
+            task_host[i] = hosts[i]
+            task_dru[i] = drus[i]
+            task_res[i] = res[i]
+            task_elig[i] = elig[i]
+        state = RebalanceState(
+            task_host=jnp.asarray(task_host),
+            task_dru=jnp.asarray(task_dru),
+            task_res=jnp.asarray(task_res),
+            task_eligible=jnp.asarray(task_elig),
+            spare=jnp.asarray(self.spare.astype(np.float32)),
+            host_ok=jnp.ones(len(self.spare), dtype=bool),
+        )
+        return state, ids
+
+    def pending_job_dru(self, job: Job) -> float:
+        """compute-pending-default-job-dru / -gpu (rebalancer.clj:157-205):
+        the user's nearest running task's dru + the job's own share."""
+        md, cd, gd = self._divisors(job.user)
+        ut = self.users.get(job.user)
+        nearest = 0.0
+        if ut is not None and ut.ids:
+            key = self._task_key(job, None)
+            pos = bisect.bisect_right(ut.keys, key)
+            if pos > 0:
+                nearest = ut.dru[pos - 1]
+        r = job.resources
+        if self.gpu_mode:
+            return nearest + r.gpus / gd
+        return max(nearest + r.mem / md, nearest + r.cpus / cd)
+
+    def user_below_quota(self, job: Job) -> bool:
+        """job-below-quota (rebalancer.clj:212-222): would launching exceed
+        the user's quota?"""
+        quota = self.store.get_quota(job.user, self.pool.name)
+        ut = self.users.get(job.user)
+        mem = cpus = gpus = 0.0
+        count = 0
+        if ut is not None:
+            for k, tid in enumerate(ut.ids):
+                if tid in self.preempted:
+                    continue
+                mem += ut.res[k][0]
+                cpus += ut.res[k][1]
+                gpus += ut.res[k][2]
+                count += 1
+        r = job.resources
+        return (
+            mem + r.mem <= quota.resources.mem
+            and cpus + r.cpus <= quota.resources.cpus
+            and gpus + r.gpus <= quota.resources.gpus
+            and count + 1 <= quota.count
+        )
+
+    # ----------------------------------------------------------- main loop
+
+    def compute_decision(self, job: Job) -> Optional[Decision]:
+        state, ids = self._flat_state()
+        pending_dru = self.pending_job_dru(job)
+        below_quota = self.user_below_quota(job)
+        if not below_quota:
+            # over-quota users may only preempt their own tasks
+            # (rebalancer.clj:339-346)
+            own = set()
+            ut = self.users.get(job.user)
+            if ut is not None:
+                own = {tid for tid in ut.ids if tid}
+            elig = np.array([tid in own for tid in ids], dtype=bool)
+            if len(elig) < state.task_eligible.shape[0]:
+                elig = np.pad(elig, (0, state.task_eligible.shape[0] - len(elig)))
+            state = state._replace(
+                task_eligible=jnp.asarray(elig) & state.task_eligible
+            )
+        r = job.resources
+        decision = find_preemption_decision(
+            state,
+            jnp.asarray([r.mem, r.cpus, r.gpus], dtype=jnp.float32),
+            jnp.float32(pending_dru),
+            jnp.float32(self.params.safe_dru_threshold),
+            jnp.float32(self.params.min_dru_diff),
+        )
+        host = int(decision.host)
+        if host < 0:
+            return None
+        mask = np.asarray(decision.preempt_mask)
+        task_ids = [ids[i] for i in np.where(mask[: len(ids)])[0]]
+        self._apply(job, host, task_ids, np.asarray(decision.freed))
+        return Decision(
+            job=job,
+            hostname=self.hostnames[host],
+            task_ids=task_ids,
+            min_preempted_dru=float(decision.score),
+        )
+
+    def _apply(self, job: Job, host: int, task_ids: list[str],
+               freed: np.ndarray) -> None:
+        """next-state (rebalancer.clj:270-318): remove victims, add the
+        simulated launch, rescore changed users, update host spare."""
+        changed = {job.user}
+        for tid in task_ids:
+            self.preempted.add(tid)
+            user, _ = self.task_info[tid]
+            ut = self.users[user]
+            k = ut.ids.index(tid)
+            del ut.keys[k], ut.ids[k], ut.res[k]
+            changed.add(user)
+        # simulated launch of the pending job on the chosen host
+        ut = self.users.setdefault(job.user, _UserTasks())
+        key = self._task_key(job, None)
+        pos = bisect.bisect_right(ut.keys, key)
+        sim_id = f"sim-{job.uuid}"
+        ut.keys.insert(pos, key)
+        ut.ids.insert(pos, sim_id)
+        ut.res.insert(pos, (job.resources.mem, job.resources.cpus,
+                            job.resources.gpus))
+        self.task_info[sim_id] = (job.user, self.hostnames[host])
+        for user in changed:
+            self._rescore(user)
+        r = job.resources
+        self.spare[host] = np.maximum(
+            freed - np.array([r.mem, r.cpus, r.gpus]), 0.0
+        )
+
+
+def rebalance_pool(
+    store: JobStore,
+    pool: Pool,
+    pending_in_dru_order: Sequence[Job],
+    host_spare: dict[str, Resources],
+    params: RebalancerParams,
+) -> list[Decision]:
+    """One pool's rebalance cycle: returns the preemption decisions
+    (rebalancer.clj:434-479 `rebalance`).  The caller transacts + kills."""
+    cycle = RebalanceCycle(store, pool, host_spare, params)
+    decisions = []
+    for job in list(pending_in_dru_order)[: params.max_preemption]:
+        decision = cycle.compute_decision(job)
+        if decision is not None and decision.task_ids:
+            decisions.append(decision)
+    return decisions

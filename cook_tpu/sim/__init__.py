@@ -1,0 +1,10 @@
+"""Deterministic faster-than-real-time trace simulator."""
+from cook_tpu.sim.simulator import (  # noqa: F401
+    SimConfig,
+    SimResult,
+    Simulator,
+    TraceHost,
+    TraceJob,
+    load_trace,
+    synth_trace,
+)
