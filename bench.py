@@ -1,0 +1,135 @@
+"""Benchmark: scheduling-cycle latency at the BASELINE.md north-star scale.
+
+Measures the TPU match solve (the Fenzo replacement) on the headline config
+— 100k pending jobs x 10k nodes, one cycle — against the reference-faithful
+CPU greedy baseline (same decisions, numpy-vectorized inner loop), plus
+packing-efficiency parity on a smaller exactly-comparable config.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": speedup}
+All supporting detail goes to stderr.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def make_problem(j, n, seed=0):
+    rng = np.random.default_rng(seed)
+    demands = np.stack(
+        [
+            rng.choice([512, 1024, 2048, 4096, 8192], j).astype(np.float32),
+            rng.choice([0.5, 1, 2, 4], j).astype(np.float32),
+            np.zeros(j, dtype=np.float32),
+        ],
+        axis=-1,
+    )
+    totals = np.stack(
+        [np.full(n, 65536.0, dtype=np.float32),
+         np.full(n, 32.0, dtype=np.float32)],
+        axis=-1,
+    )
+    frac = rng.uniform(0.2, 1.0, (n, 1)).astype(np.float32)
+    avail = np.concatenate([totals * frac, np.zeros((n, 1), np.float32)],
+                           axis=-1)
+    return demands, avail, totals
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from cook_tpu.ops import cpu_reference as ref
+    from cook_tpu.ops.match import MatchProblem, chunked_match
+
+    platform = jax.devices()[0].platform
+    log(f"device: {jax.devices()[0]} ({platform})")
+
+    # ---- parity check on an exactly-comparable config (1k x 1k) ----
+    d_s, a_s, t_s = make_problem(1024, 1024, seed=1)
+    small = MatchProblem(
+        demands=jnp.asarray(d_s),
+        job_valid=jnp.ones(1024, dtype=bool),
+        avail=jnp.asarray(a_s),
+        totals=jnp.asarray(t_s),
+        node_valid=jnp.ones(1024, dtype=bool),
+        feasible=None,
+    )
+    t0 = time.perf_counter()
+    cpu_small = ref.np_greedy_match(d_s, a_s, t_s)
+    cpu_small_ms = (time.perf_counter() - t0) * 1000
+    tpu_small = np.asarray(chunked_match(small, chunk=256, rounds=4).assignment)
+    q_cpu = ref.packing_quality(d_s, cpu_small)
+    q_tpu = ref.packing_quality(d_s, tpu_small)
+    packing_eff = (q_tpu["cpus_placed"] / q_cpu["cpus_placed"]
+                   if q_cpu["cpus_placed"] else 1.0)
+    log(f"parity 1k x 1k: cpu placed {q_cpu['num_placed']}, "
+        f"tpu placed {q_tpu['num_placed']}, packing efficiency "
+        f"{packing_eff:.4f} (target >= 0.99); cpu greedy {cpu_small_ms:.1f} ms")
+
+    # ---- headline config: 100k x 10k ----
+    J, N = 131072, 16384  # padded buckets over 100k x 10k
+    j_real, n_real = 100_000, 10_000
+    demands, avail, totals = make_problem(J, N, seed=2)
+    job_valid = np.zeros(J, dtype=bool)
+    job_valid[:j_real] = True
+    node_valid = np.zeros(N, dtype=bool)
+    node_valid[:n_real] = True
+    problem = MatchProblem(
+        demands=jnp.asarray(demands),
+        job_valid=jnp.asarray(job_valid),
+        avail=jnp.asarray(avail),
+        totals=jnp.asarray(totals),
+        node_valid=jnp.asarray(node_valid),
+        feasible=None,
+    )
+    solve = lambda: chunked_match(problem, chunk=1024, rounds=4)
+    t0 = time.perf_counter()
+    result = solve()
+    result.assignment.block_until_ready()
+    compile_ms = (time.perf_counter() - t0) * 1000
+    log(f"headline compile+first run: {compile_ms:.0f} ms")
+
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        result = solve()
+        result.assignment.block_until_ready()
+        times.append((time.perf_counter() - t0) * 1000)
+    p50 = float(np.percentile(times, 50))
+    placed = int(np.asarray(jnp.sum(result.assignment >= 0)))
+    log(f"headline 100k x 10k: p50 {p50:.1f} ms over {len(times)} runs "
+        f"(all: {[f'{t:.0f}' for t in times]}), placed {placed}")
+
+    # ---- CPU baseline on the same headline config ----
+    t0 = time.perf_counter()
+    cpu_big = ref.np_greedy_match(
+        demands[:j_real], avail[:n_real], totals[:n_real]
+    )
+    cpu_big_ms = (time.perf_counter() - t0) * 1000
+    q_cpu_big = ref.packing_quality(demands[:j_real], cpu_big)
+    tpu_big = np.asarray(result.assignment[:j_real])
+    q_tpu_big = ref.packing_quality(demands[:j_real], tpu_big)
+    big_eff = (q_tpu_big["cpus_placed"] / q_cpu_big["cpus_placed"]
+               if q_cpu_big["cpus_placed"] else 1.0)
+    log(f"cpu baseline 100k x 10k: {cpu_big_ms:.0f} ms, "
+        f"placed {q_cpu_big['num_placed']}; tpu placed "
+        f"{q_tpu_big['num_placed']}; packing efficiency {big_eff:.4f}")
+
+    print(json.dumps({
+        "metric": "match-cycle p50 latency, 100k jobs x 10k nodes "
+                  f"(packing_eff={big_eff:.4f}, platform={platform})",
+        "value": round(p50, 2),
+        "unit": "ms",
+        "vs_baseline": round(cpu_big_ms / p50, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

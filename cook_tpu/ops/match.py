@@ -74,10 +74,12 @@ def greedy_match(problem: MatchProblem) -> MatchResult:
     """Sequential-order greedy matcher via lax.scan (exact Fenzo-order
     semantics; O(J) scan steps of O(N) vector work each)."""
     j = problem.demands.shape[0]
+    # shape [J,1] when unconstrained: broadcasts against [N] without ever
+    # materializing a [J,N] mask (100k x 10k bool would be ~1 GB)
     feas = (
         problem.feasible
         if problem.feasible is not None
-        else jnp.ones((j, problem.avail.shape[0]), dtype=bool)
+        else jnp.ones((j, 1), dtype=bool)
     )
 
     def step(avail, inputs):
@@ -117,14 +119,13 @@ def chunked_match(
     """
     j, n = problem.demands.shape[0], problem.avail.shape[0]
     assert j % chunk == 0, "pad jobs to a multiple of chunk"
-    feas = (
-        problem.feasible
-        if problem.feasible is not None
-        else jnp.ones((j, n), dtype=bool)
-    )
     demands = problem.demands.reshape(j // chunk, chunk, 3)
     job_ok = problem.job_valid.reshape(j // chunk, chunk)
-    feas = feas.reshape(j // chunk, chunk, n)
+    if problem.feasible is not None:
+        feas = problem.feasible.reshape(j // chunk, chunk, n)
+    else:
+        # [C,1,1]: broadcasts inside each chunk step without a [J,N] mask
+        feas = jnp.ones((j // chunk, 1, 1), dtype=bool)
     denom = jnp.maximum(problem.totals, 1e-30)
 
     def round_step(carry, _):
