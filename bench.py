@@ -64,7 +64,7 @@ def main():
     t0 = time.perf_counter()
     cpu_small = ref.np_greedy_match(d_s, a_s, t_s)
     cpu_small_ms = (time.perf_counter() - t0) * 1000
-    tpu_small = np.asarray(chunked_match(small, chunk=256, rounds=4).assignment)
+    tpu_small = np.asarray(chunked_match(small, chunk=256, rounds=6, kc=128).assignment)
     q_cpu = ref.packing_quality(d_s, cpu_small)
     q_tpu = ref.packing_quality(d_s, tpu_small)
     packing_eff = (q_tpu["cpus_placed"] / q_cpu["cpus_placed"]
@@ -89,7 +89,7 @@ def main():
         node_valid=jnp.asarray(node_valid),
         feasible=None,
     )
-    solve = lambda: chunked_match(problem, chunk=1024, rounds=4)
+    solve = lambda: chunked_match(problem, chunk=1024, rounds=6, kc=128)
     t0 = time.perf_counter()
     result = solve()
     result.assignment.block_until_ready()

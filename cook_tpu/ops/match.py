@@ -8,23 +8,38 @@ in fair-share order, on the feasible node with the best binpacking fitness —
 is computed on TPU:
 
   * `greedy_match`: a `lax.scan` over ranked jobs; each step is a fully
-    vectorized feasibility mask + fitness argmax over all N nodes (the MXU/
-    VPU-friendly inner loop).  Bit-exact with the sequential CPU reference
+    vectorized feasibility mask + fitness argmax over all N nodes.
+    Bit-exact with the sequential CPU reference
     (`cpu_reference.ref_greedy_match`) including tie-breaks, so packing
-    parity is exact by construction.
+    parity is exact by construction.  O(J) scan steps — the exactness
+    oracle, not the fast path.
 
-  * `chunked_match`: processes jobs in chunks of K with one conflict-
-    resolution pass per chunk — each chunk computes all K best-node choices
-    against a frozen availability snapshot, then accepts the longest prefix
-    of non-conflicting picks per node via segmented prefix sums.  Identical
-    results to `greedy_match` (conflicts are re-tried next chunk), but the
-    scan length drops from J to J/K, which is what makes 100k-job cycles
-    fast on TPU.
+  * `chunked_match`: the fast path.  Jobs are processed in chunks of K (in
+    schedule order).  Per chunk, ONE [K, N] fitness pass ranks each job's
+    top-`kc` candidate nodes (`lax.approx_max_k` — the TPU-native partial
+    reduce); then `rounds` cheap conflict-resolution rounds run entirely on
+    [K, kc] candidate tensors:
 
-Constraints enter as a [J, N] boolean mask (see scheduler/constraints.py for
-the encoders) and via node validity; group constraints that depend on
-earlier placements in the same cycle are handled with on-device updates of
-per-group host counts.
+      1. each unplaced job takes its first still-feasible candidate;
+      2. jobs contending for the same node are spread: the c-th contender
+         (in chunk order) takes its c-th feasible candidate — the parallel
+         analog of "earlier jobs grabbed it first";
+      3. a pick is accepted iff the node holds the cumulative demand of all
+         earlier accepted picks on it (segmented prefix-sum over the K jobs
+         sorted by picked node — O(K log K), never materializing [K, N]);
+      4. accepted demand is scatter-subtracted and the next round retries
+         the rest.
+
+    Divergence from pure sequential greedy: fitness is snapshotted per
+    chunk, candidate lists are top-kc (a job whose kc best nodes all fill
+    up this chunk waits a cycle), and approx_max_k has a recall target
+    (~0.95 by default).  Parity tests bound the packing gap; on the
+    BASELINE headline config it packs >= the CPU greedy baseline.
+
+Constraints enter as a [J, N] boolean mask (see scheduler/constraints.py
+for the encoders); when `feasible` is None no mask is materialized.  Group
+constraints that depend on earlier placements in the same cycle are enforced
+by a host-side post-pass (scheduler/constraints.py:validate_group_assignments).
 """
 from __future__ import annotations
 
@@ -95,90 +110,129 @@ def greedy_match(problem: MatchProblem) -> MatchResult:
     return MatchResult(assignment=assignment, new_avail=new_avail)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk", "rounds"))
+def _segment_rank(keys, order):
+    """Rank of each element within its run of equal keys, where runs are
+    taken over `keys` sorted with tie-break `order`.  Returns ranks in the
+    original index space."""
+    k = keys.shape[0]
+    idxs = jnp.arange(k)
+    perm = jnp.lexsort((order, keys))
+    sk = keys[perm]
+    starts = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+    seg_first = jax.lax.cummax(jnp.where(starts, idxs, 0))
+    rank_sorted = idxs - seg_first
+    return jnp.zeros(k, jnp.int32).at[perm].set(rank_sorted.astype(jnp.int32))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk", "rounds", "kc", "use_approx")
+)
 def chunked_match(
-    problem: MatchProblem, *, chunk: int = 128, rounds: int = 4
+    problem: MatchProblem,
+    *,
+    chunk: int = 1024,
+    rounds: int = 6,
+    kc: int = 128,
+    use_approx: bool = True,
 ) -> MatchResult:
-    """Greedy matcher with chunked conflict resolution.
-
-    Per chunk of K jobs (in schedule order):
-      1. every job picks its best feasible node against the chunk-start
-         availability snapshot;
-      2. a pick is accepted iff its node can hold the cumulative demand of
-         all earlier picks in the chunk that chose the same node (per-node
-         prefix-sum test), so intra-chunk over-subscription is impossible;
-      3. accepted placements are subtracted and the next chunk proceeds.
-
-    Jobs whose pick conflicts in a round are retried in the next round
-    against updated availability (`rounds` fixed rounds per chunk), so the
-    only divergence from pure sequential greedy is (a) fitness choices made
-    against a round-start snapshot rather than job-by-job, and (b) jobs
-    still conflicted after the last round stay unplaced this cycle (as in a
-    Fenzo cycle, they just wait).  Parity tests bound the packing gap vs
-    `greedy_match`; use `greedy_match` where exactness is required.
-    """
+    """Fast chunked greedy matcher (see module docstring for the scheme)."""
     j, n = problem.demands.shape[0], problem.avail.shape[0]
     assert j % chunk == 0, "pad jobs to a multiple of chunk"
-    demands = problem.demands.reshape(j // chunk, chunk, 3)
-    job_ok = problem.job_valid.reshape(j // chunk, chunk)
+    kc = min(kc, n)
+    demands_c = problem.demands.reshape(j // chunk, chunk, 3)
+    ok_c = problem.job_valid.reshape(j // chunk, chunk)
     if problem.feasible is not None:
-        feas = problem.feasible.reshape(j // chunk, chunk, n)
+        feas_c = problem.feasible.reshape(j // chunk, chunk, n)
     else:
-        # [C,1,1]: broadcasts inside each chunk step without a [J,N] mask
-        feas = jnp.ones((j // chunk, 1, 1), dtype=bool)
+        feas_c = jnp.ones((j // chunk, 1, 1), dtype=bool)
     denom = jnp.maximum(problem.totals, 1e-30)
-
-    def round_step(carry, _):
-        avail, assignment, d, fr = carry
-        unplaced = assignment < 0
-        fits = jnp.all(avail[None, :, :] >= d[:, None, :], axis=-1)  # [K,N]
-        feasible = fits & problem.node_valid[None, :] & fr & unplaced[:, None]
-        used = problem.totals - avail[:, :2]
-        fit = ((used[None, :, 0] + d[:, 0:1]) / denom[None, :, 0]
-               + (used[None, :, 1] + d[:, 1:2]) / denom[None, :, 1]) * 0.5
-        score = jnp.where(feasible, fit, -BIG)         # [K,N]
-        ranked = jnp.argsort(-score, axis=-1)          # [K,N] best-first
-        first = ranked[:, 0]
-        had_any = jnp.max(score, axis=-1) > -BIG
-        # Contention spreading: if c earlier unplaced jobs (chunk order)
-        # share my best node, I take my (c)th-best node instead — the
-        # parallel analog of "earlier jobs grabbed it first".
-        onehot0 = jax.nn.one_hot(first, n, dtype=jnp.float32) * had_any[:, None]
-        crank = (jnp.cumsum(onehot0, axis=0) - onehot0)  # [K,N]
-        c = jnp.take_along_axis(crank, first[:, None], axis=1)[:, 0]  # [K]
-        c = jnp.clip(c.astype(jnp.int32), 0, n - 1)
-        pick = jnp.take_along_axis(ranked, c[:, None], axis=1)[:, 0]
-        pick_score = jnp.take_along_axis(score, pick[:, None], axis=1)[:, 0]
-        picked = pick_score > -BIG
-        # per-node prefix demand in chunk order: job k accepted iff its
-        # node's cumulative demand through k fits that node's availability
-        onehot = jax.nn.one_hot(pick, n, dtype=d.dtype) * picked[:, None]  # [K,N]
-        prefix = jnp.cumsum(onehot[:, :, None] * d[:, None, :], axis=0)   # [K,N,3]
-        need = jnp.take_along_axis(
-            prefix, pick[:, None, None].repeat(3, axis=2), axis=1
-        )[:, 0, :]                                      # [K,3]
-        have = avail[pick]                              # [K,3]
-        accept = picked & jnp.all(need <= have + 1e-9, axis=-1)
-        assignment = jnp.where(accept, pick, assignment).astype(jnp.int32)
-        placed_delta = jnp.sum(
-            (onehot * accept[:, None])[:, :, None] * d[:, None, :], axis=0
-        )                                               # [N,3]
-        return (avail - placed_delta, assignment, d, fr), None
+    node_valid = problem.node_valid
+    totals = problem.totals
+    order = jnp.arange(chunk)
+    idxs = jnp.arange(chunk)
 
     def chunk_step(avail, inputs):
-        d, ok, fr = inputs  # [K,3], [K], [K,N]
-        assignment = jnp.where(ok, -1, -2).astype(jnp.int32)  # -2: never place
-        (avail, assignment, _, _), _ = jax.lax.scan(
-            round_step, (avail, assignment, d, fr), None, length=rounds
+        d, ok, fr = inputs  # [K,3], [K], [K,N]|[1,1]
+        # one full fitness pass against the chunk-start snapshot
+        fits = jnp.all(avail[None, :, :] >= d[:, None, :], axis=-1)
+        feasible = fits & node_valid[None, :] & fr & ok[:, None]
+        used0 = totals[:, 0] - avail[:, 0]
+        used1 = totals[:, 1] - avail[:, 1]
+        fit = ((used0[None, :] + d[:, 0:1]) / denom[None, :, 0]
+               + (used1[None, :] + d[:, 1:2]) / denom[None, :, 1]) * 0.5
+        score = jnp.where(feasible, fit, -BIG)
+        if use_approx:
+            cand_val, cand_idx = jax.lax.approx_max_k(
+                score, kc, recall_target=0.95
+            )
+        else:
+            cand_val, cand_idx = jax.lax.top_k(score, kc)
+        cand_ok = cand_val > -BIG  # [K,kc]
+
+        def round_step(carry, _):
+            avail, assignment = carry
+            unplaced = assignment < 0
+            # candidate feasibility vs CURRENT availability (tiny gather)
+            avail_cand = avail[cand_idx]  # [K,kc,3]
+            feas_cand = (
+                jnp.all(avail_cand >= d[:, None, :], axis=-1)
+                & cand_ok
+                & unplaced[:, None]
+            )
+            has = feas_cand.any(axis=1)
+            f0 = jnp.argmax(feas_cand, axis=1)
+            pick0 = jnp.where(
+                has,
+                jnp.take_along_axis(cand_idx, f0[:, None], axis=1)[:, 0],
+                n,
+            )
+            # contention spreading: c-th contender takes its c-th feasible
+            # candidate
+            c = _segment_rank(pick0, order)
+            cum = jnp.cumsum(feas_cand, axis=1)
+            sel = (cum == (c + 1)[:, None]) & feas_cand
+            has_c = sel.any(axis=1)
+            pos = jnp.argmax(sel, axis=1)
+            pick = jnp.take_along_axis(cand_idx, pos[:, None], axis=1)[:, 0]
+            take = has & has_c
+            pick_key = jnp.where(take, pick, n)
+            # prefix-accept: per-node cumulative demand among this round's
+            # picks must fit availability (segmented over sorted picks)
+            perm2 = jnp.lexsort((order, pick_key))
+            sp2 = pick_key[perm2]
+            d2 = jnp.where((sp2 < n)[:, None], d[perm2], 0.0)
+            cums = jnp.cumsum(d2, axis=0)
+            starts2 = jnp.concatenate(
+                [jnp.ones(1, bool), sp2[1:] != sp2[:-1]]
+            )
+            seg_first2 = jax.lax.cummax(jnp.where(starts2, idxs, 0))
+            base = jnp.where(
+                (seg_first2 > 0)[:, None],
+                cums[jnp.maximum(seg_first2 - 1, 0)],
+                0.0,
+            )
+            segcum = cums - base
+            have2 = avail[jnp.clip(sp2, 0, n - 1)]
+            accept2 = (sp2 < n) & jnp.all(segcum <= have2 + 1e-9, axis=-1)
+            accept = jnp.zeros(chunk, bool).at[perm2].set(accept2)
+            assignment = jnp.where(accept, pick, assignment).astype(jnp.int32)
+            delta = (
+                jnp.zeros((n, 3), d.dtype)
+                .at[jnp.where(accept, pick, n - 1)]
+                .add(jnp.where(accept[:, None], d, 0.0))
+            )
+            return (avail - delta, assignment), None
+
+        assignment = jnp.full((chunk,), -1, jnp.int32)
+        (avail, assignment), _ = jax.lax.scan(
+            round_step, (avail, assignment), None, length=rounds
         )
-        return avail, jnp.maximum(assignment, -1)
+        return avail, assignment
 
     new_avail, assignment = jax.lax.scan(
-        chunk_step, problem.avail, (demands, job_ok, feas)
+        chunk_step, problem.avail, (demands_c, ok_c, feas_c)
     )
-    return MatchResult(
-        assignment=assignment.reshape(j), new_avail=new_avail
-    )
+    return MatchResult(assignment=assignment.reshape(j), new_avail=new_avail)
 
 
 # Pool-batched variants: vmap over a leading pool axis; `parallel.mesh`
