@@ -1,0 +1,2 @@
+"""REST API layer (aiohttp)."""
+from cook_tpu.rest.api import ApiConfig, CookApi, run_server  # noqa: F401

@@ -1,0 +1,837 @@
+"""REST API: the full client-facing surface.
+
+Reference: cook.rest.api (/root/reference/scheduler/src/cook/rest/api.clj,
+routes at :3649-4016).  Same resources and JSON shapes, served with aiohttp:
+
+  /rawscheduler (deprecated alias), /jobs[/:uuid], /instances[/:uuid],
+  /group, /share, /quota, /usage, /retry, /queue, /running, /list,
+  /unscheduled_jobs, /stats/instances, /pools, /settings, /info,
+  /failure_reasons, /progress/:uuid, /metrics, /compute-clusters,
+  /incremental-config, /shutdown-leader.
+
+Auth mirrors the reference's pluggable schemes in spirit: the requesting
+user comes from HTTP basic auth or the X-Cook-Requesting-User dev header
+(the reference's :one-user / :http-basic dev modes), with X-Cook-Impersonate
+honored for configured admins (rest/impersonation.clj).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import statistics
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from aiohttp import web
+
+from cook_tpu.cluster.base import ClusterState
+from cook_tpu.models.entities import (
+    Group,
+    GroupPlacementType,
+    HostPlacement,
+    Instance,
+    Job,
+    JobConstraint,
+    ConstraintOperator,
+    Pool,
+    Quota,
+    Resources,
+    Share,
+    StragglerHandling,
+    job_display,
+    new_uuid,
+)
+from cook_tpu.models.reasons import _REASONS, REASONS_BY_CODE
+from cook_tpu.models.store import JobStore, TransactionVetoed
+from cook_tpu.scheduler.core import Scheduler
+from cook_tpu.scheduler.plugins import PluginRegistry
+from cook_tpu.scheduler.queue_limit import QueueLimitChecker
+from cook_tpu.scheduler.ratelimit import TokenBucketRateLimiter, UnlimitedRateLimiter
+from cook_tpu.utils.metrics import global_registry
+
+
+@dataclass
+class ApiConfig:
+    default_pool: str = "default"
+    max_job_mem: float = 512_000.0       # MB
+    max_job_cpus: float = 512.0
+    max_job_gpus: float = 64.0
+    max_retries_limit: int = 200
+    admins: tuple = ("admin",)
+    version: str = "cook-tpu-0.1.0"
+    submission_rate_per_minute: float = 0.0  # 0 = unlimited
+
+
+def _parse_user(request: web.Request) -> str:
+    auth = request.headers.get("Authorization", "")
+    if auth.startswith("Basic "):
+        try:
+            decoded = base64.b64decode(auth[6:]).decode()
+            return decoded.split(":", 1)[0]
+        except Exception:
+            pass
+    return request.headers.get("X-Cook-Requesting-User", "anonymous")
+
+
+class CookApi:
+    def __init__(
+        self,
+        store: JobStore,
+        scheduler: Optional[Scheduler] = None,
+        config: Optional[ApiConfig] = None,
+        plugins: Optional[PluginRegistry] = None,
+    ):
+        self.store = store
+        self.scheduler = scheduler
+        self.config = config or ApiConfig()
+        self.plugins = plugins or PluginRegistry()
+        self.queue_limits = QueueLimitChecker(store)
+        if self.config.submission_rate_per_minute > 0:
+            self.submission_limiter = TokenBucketRateLimiter(
+                tokens_replenished_per_minute=self.config.submission_rate_per_minute,
+                bucket_size=self.config.submission_rate_per_minute,
+                clock=store.clock,
+            )
+        else:
+            self.submission_limiter = UnlimitedRateLimiter()
+        self.leader = True
+
+    # ------------------------------------------------------------ app wiring
+
+    def build_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._auth_middleware])
+        r = app.router
+        for path in ("/rawscheduler", "/jobs"):
+            r.add_get(path, self.get_jobs)
+            r.add_post(path, self.post_jobs)
+            r.add_delete(path, self.delete_jobs)
+        r.add_get("/jobs/{uuid}", self.get_job)
+        r.add_get("/instances/{uuid}", self.get_instance)
+        r.add_get("/instances", self.get_instances)
+        r.add_get("/group", self.get_groups)
+        r.add_delete("/group", self.delete_groups)
+        r.add_get("/share", self.get_share)
+        r.add_post("/share", self.post_share)
+        r.add_delete("/share", self.delete_share)
+        r.add_get("/quota", self.get_quota)
+        r.add_post("/quota", self.post_quota)
+        r.add_delete("/quota", self.delete_quota)
+        r.add_get("/usage", self.get_usage)
+        r.add_get("/retry", self.get_retry)
+        r.add_post("/retry", self.post_retry)
+        r.add_put("/retry", self.post_retry)
+        r.add_get("/queue", self.get_queue)
+        r.add_get("/running", self.get_running)
+        r.add_get("/list", self.get_list)
+        r.add_get("/unscheduled_jobs", self.get_unscheduled)
+        r.add_get("/stats/instances", self.get_instance_stats)
+        r.add_get("/pools", self.get_pools)
+        r.add_get("/settings", self.get_settings)
+        r.add_get("/info", self.get_info)
+        r.add_get("/failure_reasons", self.get_failure_reasons)
+        r.add_get("/progress/{uuid}", self.get_progress)
+        r.add_post("/progress/{uuid}", self.post_progress)
+        r.add_get("/metrics", self.get_metrics)
+        r.add_get("/compute-clusters", self.get_compute_clusters)
+        r.add_post("/compute-clusters", self.post_compute_cluster)
+        r.add_delete("/compute-clusters/{name}", self.delete_compute_cluster)
+        r.add_get("/incremental-config", self.get_incremental_config)
+        r.add_post("/incremental-config", self.post_incremental_config)
+        r.add_post("/shutdown-leader", self.post_shutdown_leader)
+        return app
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        user = _parse_user(request)
+        impersonate = request.headers.get("X-Cook-Impersonate")
+        if impersonate:
+            if user not in self.config.admins:
+                return _err(403, f"user {user} may not impersonate")
+            user = impersonate
+        request["user"] = user
+        try:
+            return await handler(request)
+        except web.HTTPException:
+            raise
+        except TransactionVetoed as e:
+            return _err(400, str(e))
+
+    # ------------------------------------------------------------------ jobs
+
+    async def post_jobs(self, request: web.Request) -> web.Response:
+        user = request["user"]
+        body = await request.json()
+        specs = body.get("jobs", [])
+        group_specs = body.get("groups", [])
+        if not specs:
+            return _err(400, "no jobs to schedule")
+        if not self.submission_limiter.try_spend(user, len(specs)):
+            return _err(429, "job submission rate limit exceeded")
+
+        groups: dict[str, Group] = {}
+        for gs in group_specs:
+            group, err = self._parse_group(gs)
+            if err:
+                return _err(400, err)
+            groups[group.uuid] = group
+
+        jobs = []
+        pools_counted: dict[str, int] = {}
+        for spec in specs:
+            pool = self.plugins.pool_selector.select_pool(
+                spec, self.config.default_pool
+            )
+            pool_ent = self.store.pools.get(pool)
+            if pool_ent is None or not pool_ent.accepts_submissions:
+                return _err(400, f"pool {pool} does not accept submissions")
+            result = self.plugins.validate_submission(spec, user, pool)
+            if not result.accepted:
+                return _err(400, result.message or "rejected by plugin")
+            spec = self.plugins.modify_submission(spec, user, pool)
+            job, err = self._parse_job(spec, user, pool, groups)
+            if err:
+                return _err(400, err)
+            jobs.append(job)
+            pools_counted[pool] = pools_counted.get(pool, 0) + 1
+        for pool, count in pools_counted.items():
+            limit_err = self.queue_limits.check_submission(user, pool, count)
+            if limit_err:
+                return _err(400, limit_err)
+        try:
+            self.store.submit_jobs(jobs, list(groups.values()))
+        except TransactionVetoed as e:
+            return _err(400, str(e))
+        global_registry.counter("jobs_submitted").inc(len(jobs))
+        return web.json_response(
+            {"jobs": [j.uuid for j in jobs]}, status=201
+        )
+
+    def _parse_job(self, spec: dict, user: str, pool: str,
+                   groups: dict[str, Group]) -> tuple[Optional[Job], Optional[str]]:
+        uuid = spec.get("uuid") or new_uuid()
+        if uuid in self.store.jobs:
+            return None, f"job {uuid} already exists"
+        command = spec.get("command", "")
+        if not command:
+            return None, "command is required"
+        mem = float(spec.get("mem", 128.0))
+        cpus = float(spec.get("cpus", 1.0))
+        gpus = float(spec.get("gpus", 0.0))
+        if mem <= 0 or mem > self.config.max_job_mem:
+            return None, f"mem {mem} out of range (0, {self.config.max_job_mem}]"
+        if cpus <= 0 or cpus > self.config.max_job_cpus:
+            return None, f"cpus {cpus} out of range (0, {self.config.max_job_cpus}]"
+        if gpus < 0 or gpus > self.config.max_job_gpus:
+            return None, f"gpus {gpus} out of range [0, {self.config.max_job_gpus}]"
+        max_retries = int(spec.get("max_retries", 1))
+        if not 0 < max_retries <= self.config.max_retries_limit:
+            return None, f"max_retries {max_retries} out of range"
+        priority = int(spec.get("priority", 50))
+        if not 0 <= priority <= 100:
+            return None, f"priority {priority} out of range [0, 100]"
+        constraints = []
+        for c in spec.get("constraints", []):
+            # ["attribute", "EQUALS", "pattern"]
+            if len(c) != 3 or str(c[1]).upper() != "EQUALS":
+                return None, f"unsupported constraint {c}"
+            constraints.append(
+                JobConstraint(attribute=c[0],
+                              operator=ConstraintOperator.EQUALS,
+                              pattern=c[2])
+            )
+        group_uuid = spec.get("group")
+        if group_uuid and group_uuid not in groups \
+                and group_uuid not in self.store.groups:
+            # implicit group creation (reference: make-default-host-placement)
+            groups[group_uuid] = Group(uuid=group_uuid)
+        job = Job(
+            uuid=uuid,
+            user=user,
+            command=command,
+            name=spec.get("name", "cookjob"),
+            priority=priority,
+            max_retries=max_retries,
+            max_runtime_ms=int(spec.get("max_runtime", 2**62)),
+            expected_runtime_ms=int(spec.get("expected_runtime", 0)),
+            resources=Resources(mem=mem, cpus=cpus, gpus=gpus,
+                                disk=float(spec.get("disk", 0.0))),
+            pool=pool,
+            user_provided_env=tuple(sorted(spec.get("env", {}).items())),
+            labels=tuple(sorted(spec.get("labels", {}).items())),
+            constraints=tuple(constraints),
+            group_uuid=group_uuid,
+            disable_mea_culpa_retries=bool(
+                spec.get("disable_mea_culpa_retries", False)),
+        )
+        return job, None
+
+    def _parse_group(self, spec: dict) -> tuple[Optional[Group], Optional[str]]:
+        uuid = spec.get("uuid") or new_uuid()
+        hp = spec.get("host_placement", {"type": "all"})
+        try:
+            ptype = GroupPlacementType(hp.get("type", "all"))
+        except ValueError:
+            return None, f"unknown host placement type {hp.get('type')}"
+        sh = spec.get("straggler_handling", {"type": "none"})
+        params = sh.get("parameters", {})
+        return (
+            Group(
+                uuid=uuid,
+                name=spec.get("name", "defaultgroup"),
+                host_placement=HostPlacement(
+                    type=ptype,
+                    attribute=hp.get("parameters", {}).get("attribute", ""),
+                ),
+                straggler_handling=StragglerHandling(
+                    type=sh.get("type", "none"),
+                    quantile=float(params.get("quantile", 0.5)),
+                    multiplier=float(params.get("multiplier", 2.0)),
+                ),
+            ),
+            None,
+        )
+
+    async def get_jobs(self, request: web.Request) -> web.Response:
+        uuids = request.query.getall("job", []) + request.query.getall("uuid", [])
+        user = request.query.get("user")
+        states = set(
+            s for q in request.query.getall("state", []) for s in q.split("+")
+        )
+        out = []
+        if uuids:
+            for uuid in uuids:
+                job = self.store.jobs.get(uuid)
+                if job is None:
+                    return _err(404, f"unknown job {uuid}")
+                out.append(self._job_json(job))
+        elif user:
+            start = int(request.query.get("start-ms", 0))
+            end = int(request.query.get("end-ms", 2**62))
+            for job in self.store.user_jobs(user):
+                if states and job.state.value not in states:
+                    continue
+                if not (start <= job.submit_time_ms <= end):
+                    continue
+                out.append(self._job_json(job))
+        else:
+            return _err(400, "specify job uuids or a user")
+        return web.json_response(out)
+
+    async def get_job(self, request: web.Request) -> web.Response:
+        job = self.store.jobs.get(request.match_info["uuid"])
+        if job is None:
+            return _err(404, "unknown job")
+        return web.json_response(self._job_json(job))
+
+    def _job_json(self, job: Job) -> dict:
+        d = job_display(job)
+        d["instances"] = [
+            self._instance_json(i) for i in self.store.job_instances(job.uuid)
+        ]
+        d["retries_remaining"] = max(
+            0,
+            job.max_retries
+            - __import__("cook_tpu.models.state", fromlist=["attempts_consumed"])
+            .attempts_consumed(job, self.store.job_instances(job.uuid)),
+        )
+        if job.group_uuid:
+            d["groups"] = [job.group_uuid]
+        return d
+
+    def _instance_json(self, inst: Instance) -> dict:
+        d = {
+            "task_id": inst.task_id,
+            "slave_id": inst.node_id,
+            "hostname": inst.hostname,
+            "status": inst.status.value,
+            "preempted": inst.preempted,
+            "backfilled": inst.backfilled,
+            "compute-cluster": inst.compute_cluster,
+            "start_time": inst.start_time_ms,
+            "progress": inst.progress,
+        }
+        if inst.end_time_ms:
+            d["end_time"] = inst.end_time_ms
+        if inst.reason_code is not None:
+            reason = REASONS_BY_CODE.get(inst.reason_code)
+            d["reason_code"] = inst.reason_code
+            if reason:
+                d["reason_string"] = reason.description
+                d["reason_mea_culpa"] = reason.mea_culpa
+        if inst.exit_code is not None:
+            d["exit_code"] = inst.exit_code
+        if inst.sandbox_directory:
+            d["sandbox_directory"] = inst.sandbox_directory
+        if inst.progress_message:
+            d["progress_message"] = inst.progress_message
+        return d
+
+    async def delete_jobs(self, request: web.Request) -> web.Response:
+        uuids = request.query.getall("job", []) + request.query.getall("uuid", [])
+        if not uuids:
+            return _err(400, "no jobs specified")
+        user = request["user"]
+        for uuid in uuids:
+            job = self.store.jobs.get(uuid)
+            if job is None:
+                return _err(404, f"unknown job {uuid}")
+            if job.user != user and user not in self.config.admins:
+                return _err(403, f"not authorized to kill {uuid}")
+        self.store.kill_jobs(uuids)
+        global_registry.counter("jobs_killed").inc(len(uuids))
+        return web.Response(status=204)
+
+    # ------------------------------------------------------------- instances
+
+    async def get_instance(self, request: web.Request) -> web.Response:
+        inst = self.store.instances.get(request.match_info["uuid"])
+        if inst is None:
+            return _err(404, "unknown instance")
+        d = self._instance_json(inst)
+        d["job"] = self._job_json(self.store.jobs[inst.job_uuid])
+        return web.json_response(d)
+
+    async def get_instances(self, request: web.Request) -> web.Response:
+        uuids = request.query.getall("instance", [])
+        out = []
+        for uuid in uuids:
+            inst = self.store.instances.get(uuid)
+            if inst is None:
+                return _err(404, f"unknown instance {uuid}")
+            out.append(self._instance_json(inst))
+        return web.json_response(out)
+
+    # ---------------------------------------------------------------- groups
+
+    async def get_groups(self, request: web.Request) -> web.Response:
+        uuids = request.query.getall("uuid", [])
+        detailed = request.query.get("detailed") in ("true", "1")
+        out = []
+        for uuid in uuids:
+            group = self.store.groups.get(uuid)
+            if group is None:
+                return _err(404, f"unknown group {uuid}")
+            d = {
+                "uuid": group.uuid,
+                "name": group.name,
+                "host_placement": {
+                    "type": group.host_placement.type.value,
+                    "parameters": (
+                        {"attribute": group.host_placement.attribute}
+                        if group.host_placement.attribute else {}
+                    ),
+                },
+                "jobs": list(group.job_uuids),
+            }
+            if detailed:
+                by_state: dict[str, int] = {}
+                for ju in group.job_uuids:
+                    job = self.store.jobs.get(ju)
+                    if job:
+                        by_state[job.state.value] = by_state.get(
+                            job.state.value, 0) + 1
+                d["composition"] = by_state
+            out.append(d)
+        return web.json_response(out)
+
+    async def delete_groups(self, request: web.Request) -> web.Response:
+        uuids = request.query.getall("uuid", [])
+        for uuid in uuids:
+            group = self.store.groups.get(uuid)
+            if group is None:
+                return _err(404, f"unknown group {uuid}")
+            self.store.kill_jobs(group.job_uuids)
+        return web.Response(status=204)
+
+    # ------------------------------------------------------------ share/quota
+
+    async def get_share(self, request: web.Request) -> web.Response:
+        user = request.query.get("user")
+        pool = request.query.get("pool", self.config.default_pool)
+        if not user:
+            return _err(400, "user required")
+        share = self.store.get_share(user, pool)
+        return web.json_response(_res_json(share))
+
+    async def post_share(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        user = body.get("user")
+        pool = body.get("pool", self.config.default_pool)
+        res = body.get("share", {})
+        if not user:
+            return _err(400, "user required")
+        self.store.set_share(Share(
+            user=user, pool=pool,
+            resources=Resources(
+                mem=float(res.get("mem", 0)),
+                cpus=float(res.get("cpus", 0)),
+                gpus=float(res.get("gpus", 0)),
+            ),
+            reason=body.get("reason", ""),
+        ))
+        return web.json_response(_res_json(self.store.get_share(user, pool)),
+                                 status=201)
+
+    async def delete_share(self, request: web.Request) -> web.Response:
+        user = request.query.get("user")
+        pool = request.query.get("pool", self.config.default_pool)
+        self.store.retract_share(user, pool)
+        return web.Response(status=204)
+
+    async def get_quota(self, request: web.Request) -> web.Response:
+        user = request.query.get("user")
+        pool = request.query.get("pool", self.config.default_pool)
+        if not user:
+            return _err(400, "user required")
+        quota = self.store.get_quota(user, pool)
+        d = _res_json(quota.resources)
+        d["count"] = quota.count
+        return web.json_response(d)
+
+    async def post_quota(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        user = body.get("user")
+        pool = body.get("pool", self.config.default_pool)
+        res = body.get("quota", {})
+        if not user:
+            return _err(400, "user required")
+        inf = float("inf")
+        self.store.set_quota(Quota(
+            user=user, pool=pool,
+            resources=Resources(
+                mem=float(res.get("mem", inf)),
+                cpus=float(res.get("cpus", inf)),
+                gpus=float(res.get("gpus", inf)),
+            ),
+            count=int(res.get("count", 2**31)),
+            reason=body.get("reason", ""),
+        ))
+        return web.json_response({"user": user, "pool": pool}, status=201)
+
+    async def delete_quota(self, request: web.Request) -> web.Response:
+        user = request.query.get("user")
+        pool = request.query.get("pool", self.config.default_pool)
+        self.store.retract_quota(user, pool)
+        return web.Response(status=204)
+
+    async def get_usage(self, request: web.Request) -> web.Response:
+        user = request.query.get("user")
+        if not user:
+            return _err(400, "user required")
+        out = {"total_usage": {"mem": 0.0, "cpus": 0.0, "gpus": 0.0, "jobs": 0}}
+        pools = {}
+        for pool_name in self.store.pools:
+            usage = self.store.user_usage(pool_name).get(user)
+            running = [
+                j for j in self.store.running_jobs(pool_name) if j.user == user
+            ]
+            if usage is None and not running:
+                continue
+            usage = usage or Resources()
+            pools[pool_name] = {
+                "usage": {"mem": usage.mem, "cpus": usage.cpus,
+                          "gpus": usage.gpus, "jobs": len(running)},
+            }
+            out["total_usage"]["mem"] += usage.mem
+            out["total_usage"]["cpus"] += usage.cpus
+            out["total_usage"]["gpus"] += usage.gpus
+            out["total_usage"]["jobs"] += len(running)
+        out["pools"] = pools
+        return web.json_response(out)
+
+    # ----------------------------------------------------------------- retry
+
+    async def get_retry(self, request: web.Request) -> web.Response:
+        uuid = request.query.get("job")
+        job = self.store.jobs.get(uuid or "")
+        if job is None:
+            return _err(404, "unknown job")
+        return web.json_response(job.max_retries)
+
+    async def post_retry(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        uuids = body.get("jobs") or ([body["job"]] if "job" in body else [])
+        if not uuids:
+            return _err(400, "no jobs specified")
+        retries = body.get("retries")
+        increment = body.get("increment")
+        for uuid in uuids:
+            if uuid not in self.store.jobs:
+                return _err(404, f"unknown job {uuid}")
+            try:
+                if retries is not None:
+                    self.store.retry_job(uuid, int(retries))
+                elif increment is not None:
+                    self.store.retry_job(uuid, int(increment), increment=True)
+                else:
+                    return _err(400, "retries or increment required")
+            except (TransactionVetoed, ValueError) as e:
+                return _err(400, str(e))
+        return web.json_response(
+            {"jobs": uuids}, status=201
+        )
+
+    # ------------------------------------------------------------- queue etc
+
+    async def get_queue(self, request: web.Request) -> web.Response:
+        if self.scheduler is None:
+            return _err(503, "no scheduler attached")
+        out = {}
+        for pool_name, queue in self.scheduler.pool_queues.items():
+            out[pool_name] = [
+                {"uuid": j.uuid, "user": j.user, "dru": queue.dru.get(j.uuid)}
+                for j in queue.jobs[:100]
+            ]
+        return web.json_response(out)
+
+    async def get_running(self, request: web.Request) -> web.Response:
+        out = []
+        for pool_name in self.store.pools:
+            for job in self.store.running_jobs(pool_name):
+                out.append(self._job_json(job))
+        return web.json_response(out)
+
+    async def get_list(self, request: web.Request) -> web.Response:
+        user = request.query.get("user")
+        if not user:
+            return _err(400, "user required")
+        states = set(
+            s
+            for q in request.query.getall("state", [])
+            for s in q.replace("+", ",").split(",")
+        )
+        start = int(request.query.get("start-ms", 0))
+        end = int(request.query.get("end-ms", 2**62))
+        limit = int(request.query.get("limit", 1000))
+        out = []
+        for job in self.store.user_jobs(user):
+            if states and job.state.value not in states:
+                continue
+            if not (start <= job.submit_time_ms <= end):
+                continue
+            out.append(self._job_json(job))
+            if len(out) >= limit:
+                break
+        return web.json_response(out)
+
+    async def get_unscheduled(self, request: web.Request) -> web.Response:
+        uuids = request.query.getall("job", [])
+        out = []
+        for uuid in uuids:
+            job = self.store.jobs.get(uuid)
+            if job is None:
+                return _err(404, f"unknown job {uuid}")
+            out.append({
+                "uuid": uuid,
+                "reasons": self._unscheduled_reasons(job),
+            })
+        return web.json_response(out)
+
+    def _unscheduled_reasons(self, job: Job) -> list[dict]:
+        """Why isn't this job running (reference unscheduled.clj:172)."""
+        from cook_tpu.models import state as state_mod
+
+        reasons = []
+        if job.state.value == "completed":
+            return [{"reason": "The job is already completed."}]
+        if job.state.value == "running":
+            return [{"reason": "The job is running now."}]
+        insts = self.store.job_instances(job.uuid)
+        if state_mod.all_attempts_consumed(job, insts):
+            reasons.append({
+                "reason": "The job has exhausted its maximum number of retries.",
+            })
+        quota = self.store.get_quota(job.user, job.pool)
+        usage = self.store.user_usage(job.pool).get(job.user, Resources())
+        if (usage.mem + job.resources.mem > quota.resources.mem
+                or usage.cpus + job.resources.cpus > quota.resources.cpus):
+            reasons.append({
+                "reason": "The job would cause you to exceed resource quotas.",
+            })
+        if self.scheduler is not None:
+            failure = self.scheduler.placement_failures.get(job.uuid)
+            if failure:
+                reasons.append({
+                    "reason": "The job couldn't be placed on any available hosts.",
+                    "data": {"reasons": [{"reason": failure}]},
+                })
+            queue = self.scheduler.pool_queues.get(job.pool)
+            if queue is not None:
+                for pos, qjob in enumerate(queue.jobs):
+                    if qjob.uuid == job.uuid:
+                        reasons.append({
+                            "reason": "You have 1 other jobs ahead in the "
+                                      "queue." if pos == 1 else
+                                      f"You have {pos} other jobs ahead in "
+                                      "the queue.",
+                            "data": {"position": pos},
+                        })
+                        break
+        return reasons or [{"reason": "The job is waiting to be matched."}]
+
+    async def get_instance_stats(self, request: web.Request) -> web.Response:
+        """Aggregate instance stats (reference task_stats.clj)."""
+        start = int(request.query.get("start-ms", 0))
+        end = int(request.query.get("end-ms", 2**62))
+        durations = []
+        by_status: dict[str, int] = {}
+        for inst in self.store.instances.values():
+            if not inst.status.terminal:
+                continue
+            if not (start <= inst.end_time_ms <= end):
+                continue
+            by_status[inst.status.value] = by_status.get(inst.status.value, 0) + 1
+            durations.append(inst.end_time_ms - inst.start_time_ms)
+        percentiles = {}
+        if durations:
+            qs = statistics.quantiles(durations, n=100) if len(durations) > 1 \
+                else [durations[0]] * 99
+            percentiles = {"50": qs[49], "75": qs[74], "95": qs[94],
+                           "99": qs[98], "100": max(durations)}
+        return web.json_response({
+            "by-status": by_status,
+            "run-time-ms": {"percentiles": percentiles,
+                            "count": len(durations)},
+        })
+
+    async def get_pools(self, request: web.Request) -> web.Response:
+        return web.json_response([
+            {"name": p.name, "purpose": p.purpose, "state": p.state,
+             "dru-mode": p.dru_mode.value}
+            for p in self.store.pools.values()
+        ])
+
+    async def get_settings(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "default-pool": self.config.default_pool,
+            "max-job-mem": self.config.max_job_mem,
+            "max-job-cpus": self.config.max_job_cpus,
+            "max-retries-limit": self.config.max_retries_limit,
+            "version": self.config.version,
+        })
+
+    async def get_info(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "authentication-scheme": "http-basic",
+            "commit": self.config.version,
+            "start-time": 0,
+            "version": self.config.version,
+            "leader-url": "http://localhost",
+        })
+
+    async def get_failure_reasons(self, request: web.Request) -> web.Response:
+        return web.json_response([
+            {"code": r.code, "name": r.name, "description": r.description,
+             "mea_culpa": r.mea_culpa,
+             **({"failure_limit": r.failure_limit}
+                if r.failure_limit is not None else {})}
+            for r in _REASONS
+        ])
+
+    # -------------------------------------------------------------- progress
+
+    async def get_progress(self, request: web.Request) -> web.Response:
+        inst = self.store.instances.get(request.match_info["uuid"])
+        if inst is None:
+            return _err(404, "unknown instance")
+        return web.json_response({
+            "progress": inst.progress,
+            "progress_message": inst.progress_message,
+        })
+
+    async def post_progress(self, request: web.Request) -> web.Response:
+        """Sidecar/executor progress feed (reference: progress.clj +
+        rest/api.clj:3995)."""
+        task_id = request.match_info["uuid"]
+        body = await request.json()
+        ok = self.store.update_instance_progress(
+            task_id,
+            int(body.get("progress_percent", 0)),
+            str(body.get("progress_message", "")),
+        )
+        if not ok and task_id not in self.store.instances:
+            return _err(404, "unknown instance")
+        return web.json_response({"accepted": ok}, status=202 if ok else 200)
+
+    # --------------------------------------------------------------- metrics
+
+    async def get_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=global_registry.render_prometheus(),
+                            content_type="text/plain")
+
+    # ------------------------------------------------- dynamic clusters etc.
+
+    async def get_compute_clusters(self, request: web.Request) -> web.Response:
+        if self.scheduler is None:
+            return web.json_response({"in-mem-configs": []})
+        return web.json_response({
+            "in-mem-configs": [
+                {"name": c.name, "state": c.state.value,
+                 "accepts-work": c.accepts_work}
+                for c in self.scheduler.clusters
+            ]
+        })
+
+    async def post_compute_cluster(self, request: web.Request) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "admin required")
+        body = await request.json()
+        name = body.get("name")
+        new_state = body.get("state")
+        if self.scheduler is None:
+            return _err(503, "no scheduler attached")
+        cluster = self.scheduler.cluster_by_name(name)
+        if cluster is None:
+            return _err(404, f"unknown cluster {name}")
+        try:
+            cluster.set_state(ClusterState(new_state))
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response({"name": name, "state": new_state}, status=201)
+
+    async def delete_compute_cluster(self, request: web.Request) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "admin required")
+        name = request.match_info["name"]
+        if self.scheduler is None:
+            return _err(503, "no scheduler attached")
+        cluster = self.scheduler.cluster_by_name(name)
+        if cluster is None:
+            return _err(404, f"unknown cluster {name}")
+        try:
+            cluster.set_state(ClusterState.DELETED)
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.Response(status=204)
+
+    async def get_incremental_config(self, request: web.Request) -> web.Response:
+        return web.json_response(self.store.dynamic_config)
+
+    async def post_incremental_config(self, request: web.Request) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "admin required")
+        body = await request.json()
+        self.store.dynamic_config.update(body)
+        return web.json_response(self.store.dynamic_config, status=201)
+
+    async def post_shutdown_leader(self, request: web.Request) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "admin required")
+        self.leader = False
+        return web.json_response({"shutdown": "requested"}, status=202)
+
+
+def _res_json(res: Resources) -> dict:
+    def clean(x):
+        return x if x != float("inf") else 1e300
+    return {"mem": clean(res.mem), "cpus": clean(res.cpus),
+            "gpus": clean(res.gpus)}
+
+
+def _err(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+def run_server(api: CookApi, host: str = "127.0.0.1", port: int = 12321):
+    """Blocking server entry point."""
+    web.run_app(api.build_app(), host=host, port=port)

@@ -1,0 +1,63 @@
+"""Threaded server harness: runs the aiohttp app on a background thread.
+
+Used by tests and by deployments that want the REST layer beside the
+scheduler loops in one process (the reference runs jetty in-process,
+components.clj:260-294).
+"""
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+from typing import Optional
+
+from aiohttp import web
+
+from cook_tpu.rest.api import CookApi
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ServerThread:
+    def __init__(self, api: CookApi, host: str = "127.0.0.1",
+                 port: Optional[int] = None):
+        self.api = api
+        self.host = host
+        self.port = port or free_port()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServerThread":
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            runner = web.AppRunner(self.api.build_app())
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(runner.cleanup())
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="cook-rest")
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("REST server failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)

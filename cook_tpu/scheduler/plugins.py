@@ -1,0 +1,126 @@
+"""Plugin hook points.
+
+Reference: cook.plugins (/root/reference/scheduler/src/cook/plugins/
+definitions.clj:18-70 + submission.clj/launch.clj caching wrappers).  The
+same seven extension seams, as Python protocols resolved from dotted paths
+(the analog of `lazy-load-var`), with the submission/launch results cached
+for a TTL like the reference's caching wrappers.
+"""
+from __future__ import annotations
+
+import importlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Protocol, runtime_checkable
+
+from cook_tpu.models.entities import Job
+
+
+@dataclass(frozen=True)
+class PluginResult:
+    accepted: bool
+    message: str = ""
+    # for launch filters: suppress retries until this time
+    cache_expires_ms: int = 0
+
+
+ACCEPT = PluginResult(accepted=True)
+
+
+@runtime_checkable
+class JobSubmissionValidator(Protocol):
+    def check_job_submission(self, job_spec: dict, user: str, pool: str
+                             ) -> PluginResult: ...
+
+
+@runtime_checkable
+class JobSubmissionModifier(Protocol):
+    def modify_job(self, job_spec: dict, user: str, pool: str) -> dict: ...
+
+
+@runtime_checkable
+class JobLaunchFilter(Protocol):
+    def check_job_launch(self, job: Job) -> PluginResult: ...
+
+
+@runtime_checkable
+class InstanceCompletionHandler(Protocol):
+    def on_instance_completion(self, job: Job, instance) -> None: ...
+
+
+@runtime_checkable
+class PoolSelector(Protocol):
+    def select_pool(self, job_spec: dict, default_pool: str) -> str: ...
+
+
+@runtime_checkable
+class JobAdjuster(Protocol):
+    def adjust_job(self, job: Job) -> Job: ...
+
+
+@runtime_checkable
+class JobRouter(Protocol):
+    def route_pool(self, job_spec: dict) -> str: ...
+
+
+class AttributePoolSelector:
+    """Default pool selection: an explicit `pool` field, else the default
+    (reference plugins/pool.clj attribute-pool-selector)."""
+
+    def select_pool(self, job_spec: dict, default_pool: str) -> str:
+        return job_spec.get("pool") or default_pool
+
+
+def load_plugin(dotted_path: str) -> Any:
+    """`lazy-load-var` analog: 'package.module:ClassName' or
+    'package.module.factory_fn'."""
+    if ":" in dotted_path:
+        mod_name, attr = dotted_path.split(":", 1)
+    else:
+        mod_name, _, attr = dotted_path.rpartition(".")
+    module = importlib.import_module(mod_name)
+    obj = getattr(module, attr)
+    return obj() if isinstance(obj, type) else obj
+
+
+@dataclass
+class PluginRegistry:
+    submission_validators: list = field(default_factory=list)
+    submission_modifiers: list = field(default_factory=list)
+    launch_filters: list = field(default_factory=list)
+    completion_handlers: list = field(default_factory=list)
+    pool_selector: Any = field(default_factory=AttributePoolSelector)
+    job_adjusters: list = field(default_factory=list)
+    job_routers: list = field(default_factory=list)
+
+    def validate_submission(self, job_spec: dict, user: str, pool: str
+                            ) -> PluginResult:
+        for validator in self.submission_validators:
+            result = validator.check_job_submission(job_spec, user, pool)
+            if not result.accepted:
+                return result
+        return ACCEPT
+
+    def modify_submission(self, job_spec: dict, user: str, pool: str) -> dict:
+        for modifier in self.submission_modifiers:
+            job_spec = modifier.modify_job(job_spec, user, pool)
+        return job_spec
+
+    def check_launch(self, job: Job, now_ms: int,
+                     cache: dict[str, tuple[int, PluginResult]]) -> bool:
+        """Launch-filter with TTL cache (reference plugins/launch.clj)."""
+        cached = cache.get(job.uuid)
+        if cached is not None and cached[0] > now_ms:
+            return cached[1].accepted
+        for plugin in self.launch_filters:
+            result = plugin.check_job_launch(job)
+            if not result.accepted:
+                expires = result.cache_expires_ms or (now_ms + 60_000)
+                cache[job.uuid] = (expires, result)
+                return False
+        cache[job.uuid] = (now_ms + 60_000, ACCEPT)
+        return True
+
+    def on_completion(self, job: Job, instance) -> None:
+        for handler in self.completion_handlers:
+            handler.on_instance_completion(job, instance)

@@ -1,0 +1,84 @@
+"""Token-bucket rate limiters.
+
+Reference: cook.rate-limit (/root/reference/scheduler/src/cook/
+rate_limit/{generic,token_bucket_filter}.clj): a lazily-refilled token
+bucket per key, used for (a) global job-submission rate, (b) per-user
+per-pool launch rate (quota.clj:118), (c) per-compute-cluster launch rate.
+`spend!` is always allowed to go negative ("spend-through"): enforcement
+happens at `allowed?` time, which keeps the hot path lock-free-ish and
+matches the reference's semantics of charging work that was already done.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Hashable
+
+
+@dataclass
+class _Bucket:
+    tokens: float
+    last_ms: int
+
+
+class TokenBucketRateLimiter:
+    def __init__(
+        self,
+        *,
+        tokens_replenished_per_minute: float,
+        bucket_size: float,
+        clock: Callable[[], int],
+        enforce: bool = True,
+    ):
+        self.rate_per_ms = tokens_replenished_per_minute / 60_000.0
+        self.bucket_size = bucket_size
+        self.clock = clock
+        self.enforce = enforce
+        self._buckets: dict[Hashable, _Bucket] = {}
+        self._lock = threading.Lock()
+
+    def _refill(self, key: Hashable) -> _Bucket:
+        now = self.clock()
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            bucket = _Bucket(tokens=self.bucket_size, last_ms=now)
+            self._buckets[key] = bucket
+        else:
+            elapsed = max(0, now - bucket.last_ms)
+            bucket.tokens = min(
+                self.bucket_size, bucket.tokens + elapsed * self.rate_per_ms
+            )
+            bucket.last_ms = now
+        return bucket
+
+    def allowed(self, key: Hashable) -> bool:
+        if not self.enforce:
+            return True
+        with self._lock:
+            return self._refill(key).tokens >= 1.0
+
+    def spend(self, key: Hashable, amount: float = 1.0) -> None:
+        with self._lock:
+            self._refill(key).tokens -= amount
+
+    def try_spend(self, key: Hashable, amount: float = 1.0) -> bool:
+        """allowed? + spend! in one step (submission path)."""
+        if not self.enforce:
+            return True
+        with self._lock:
+            bucket = self._refill(key)
+            if bucket.tokens < 1.0:
+                return False
+            bucket.tokens -= amount
+            return True
+
+
+class UnlimitedRateLimiter:
+    def allowed(self, key: Hashable) -> bool:
+        return True
+
+    def spend(self, key: Hashable, amount: float = 1.0) -> None:
+        pass
+
+    def try_spend(self, key: Hashable, amount: float = 1.0) -> bool:
+        return True

@@ -1,0 +1,2 @@
+"""Cross-cutting utilities: metrics, structured logging, tracing, config."""
+from cook_tpu.utils.metrics import Registry, global_registry  # noqa: F401

@@ -1,0 +1,63 @@
+"""Structured JSON logging + passport audit events.
+
+Reference: cook.log-structured (/root/reference/scheduler/src/cook/
+log_structured.clj — JSON log lines with standard keys) and cook.passport
+(passport.clj — an audit event stream on a dedicated logger: job-created,
+job-launched, pod-completed, ...).
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Optional
+
+structured_logger = logging.getLogger("cook_tpu.structured")
+passport_logger = logging.getLogger("cook_tpu.passport")
+
+
+def log_structured(
+    level: int,
+    message: str,
+    *,
+    pool: Optional[str] = None,
+    user: Optional[str] = None,
+    job: Optional[str] = None,
+    instance: Optional[str] = None,
+    compute_cluster: Optional[str] = None,
+    component: Optional[str] = None,
+    **extra: Any,
+) -> None:
+    record = {"message": message}
+    for key, value in [
+        ("pool", pool), ("user", user), ("job", job), ("instance", instance),
+        ("compute-cluster", compute_cluster), ("component", component),
+    ]:
+        if value is not None:
+            record[key] = value
+    record.update(extra)
+    structured_logger.log(level, json.dumps(record, default=str))
+
+
+def log_info(message: str, **kw) -> None:
+    log_structured(logging.INFO, message, **kw)
+
+
+def log_error(message: str, **kw) -> None:
+    log_structured(logging.ERROR, message, **kw)
+
+
+# Passport event types (the reference enumerates these as keywords)
+JOB_CREATED = "job-created"
+JOB_SUBMITTED = "job-submitted"
+JOB_LAUNCHED = "job-launched"
+JOB_COMPLETED = "job-completed"
+INSTANCE_COMPLETED = "instance-completed"
+INSTANCE_PREEMPTED = "instance-preempted"
+CLUSTER_STATE_CHANGED = "cluster-state-changed"
+
+
+def passport(event_type: str, **data: Any) -> None:
+    """Emit one audit event (reference: passport.clj `log-event`)."""
+    passport_logger.info(
+        json.dumps({"event-type": event_type, **data}, default=str)
+    )

@@ -1,0 +1,154 @@
+"""Metrics registry: counters, gauges, histograms with Prometheus text
+rendering.
+
+Reference: cook.prometheus-metrics (/root/reference/scheduler/src/cook/
+prometheus_metrics.clj — ~200 named metrics + `with-duration` wrappers
+around every hot section) and the codahale stack (reporter.clj).  One
+process-global registry; the REST /metrics endpoint renders it.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+
+def _labels_key(labels: Optional[dict]) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, labels: Optional[dict] = None) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, labels: Optional[dict] = None) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self._values[_labels_key(labels)] = value
+
+    def value(self, labels: Optional[dict] = None) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+
+_DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, math.inf)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Iterable[float] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, labels: Optional[dict] = None) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def count(self, labels: Optional[dict] = None) -> int:
+        return sum(self._counts.get(_labels_key(labels), []))
+
+    def sum(self, labels: Optional[dict] = None) -> float:
+        return self._sums.get(_labels_key(labels), 0.0)
+
+    @contextmanager
+    def time(self, labels: Optional[dict] = None):
+        """The `with-duration` analog."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0, labels)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        return self._get(name, lambda: Histogram(name, help_), Histogram)
+
+    def _get(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            if not isinstance(m, cls):
+                raise TypeError(f"metric {name} is {type(m)}, wanted {cls}")
+            return m
+
+    def render_prometheus(self) -> str:
+        lines = []
+        for name, metric in sorted(self._metrics.items()):
+            pname = "cook_" + name.replace(".", "_").replace("-", "_")
+            if isinstance(metric, Counter):
+                lines.append(f"# TYPE {pname} counter")
+                for key, v in sorted(metric._values.items()):
+                    lines.append(f"{pname}{_fmt_labels(key)} {v}")
+            elif isinstance(metric, Gauge):
+                lines.append(f"# TYPE {pname} gauge")
+                for key, v in sorted(metric._values.items()):
+                    lines.append(f"{pname}{_fmt_labels(key)} {v}")
+            elif isinstance(metric, Histogram):
+                lines.append(f"# TYPE {pname} histogram")
+                for key, counts in sorted(metric._counts.items()):
+                    cum = 0
+                    for b, c in zip(metric.buckets, counts):
+                        cum += c
+                        le = "+Inf" if b == math.inf else repr(b)
+                        lines.append(
+                            f"{pname}_bucket{_fmt_labels(key + (('le', le),))} {cum}"
+                        )
+                    lines.append(f"{pname}_count{_fmt_labels(key)} {cum}")
+                    lines.append(
+                        f"{pname}_sum{_fmt_labels(key)} {metric._sums.get(key, 0.0)}"
+                    )
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+global_registry = Registry()

@@ -1,0 +1,53 @@
+"""Lightweight tracing spans around cycle phases.
+
+Reference: opentracing spans around every match-cycle phase
+(/root/reference/scheduler/src/cook/scheduler/scheduler.clj:626-671 uses
+`tracing/with-span`).  Spans record wall durations into the metrics
+registry (histogram per span name) and an optional in-memory trace ring for
+debugging; `jax.profiler` can be layered on for device-side traces.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from cook_tpu.utils.metrics import global_registry
+
+_trace_ring: collections.deque = collections.deque(maxlen=4096)
+_lock = threading.Lock()
+_active: dict[int, list[str]] = {}
+
+
+@contextmanager
+def span(name: str, **tags):
+    """with span("match-cycle", pool="default"): ..."""
+    tid = threading.get_ident()
+    with _lock:
+        stack = _active.setdefault(tid, [])
+        parent = stack[-1] if stack else None
+        stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        duration = time.perf_counter() - t0
+        with _lock:
+            _active[tid].pop()
+            _trace_ring.append({
+                "name": name,
+                "parent": parent,
+                "duration_s": duration,
+                "tags": tags,
+                "t": time.time(),
+            })
+        global_registry.histogram(f"span.{name}").observe(
+            duration, labels=tags or None
+        )
+
+
+def recent_spans(limit: int = 100) -> list[dict]:
+    with _lock:
+        return list(_trace_ring)[-limit:]

@@ -1,0 +1,242 @@
+"""REST API integration tests over a real HTTP server (the role of the
+reference's test/cook/test/rest/api.clj + integration test_basic.py)."""
+import pytest
+import requests
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import ServerThread
+from cook_tpu.scheduler.core import Scheduler
+from tests.conftest import FakeClock
+
+
+@pytest.fixture(scope="module")
+def server():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    store.set_pool(Pool(name="gpu-pool"))
+    cluster = MockCluster(
+        "mock",
+        [MockHost(node_id=f"n{i}", hostname=f"n{i}", mem=4096, cpus=16)
+         for i in range(4)],
+        clock=clock,
+    )
+    scheduler = Scheduler(store, [cluster])
+    api = CookApi(store, scheduler, ApiConfig(admins=("admin",)))
+    srv = ServerThread(api).start()
+    srv.clock = clock
+    srv.store = store
+    srv.scheduler = scheduler
+    srv.cluster = cluster
+    yield srv
+    srv.stop()
+
+
+def hdr(user="alice"):
+    return {"X-Cook-Requesting-User": user}
+
+
+def submit(server, jobs, user="alice", groups=None, expect=201):
+    body = {"jobs": jobs}
+    if groups:
+        body["groups"] = groups
+    r = requests.post(f"{server.url}/jobs", json=body, headers=hdr(user))
+    assert r.status_code == expect, r.text
+    return r.json()
+
+
+def test_submit_and_query_job(server):
+    out = submit(server, [{"command": "echo hi", "mem": 100, "cpus": 1,
+                           "uuid": "11111111-0000-0000-0000-000000000001"}])
+    uuid = out["jobs"][0]
+    r = requests.get(f"{server.url}/jobs/{uuid}", headers=hdr())
+    assert r.status_code == 200
+    job = r.json()
+    assert job["status"] == "waiting"
+    assert job["user"] == "alice"
+    assert job["mem"] == 100
+    # query by user
+    r = requests.get(f"{server.url}/jobs", params={"user": "alice"},
+                     headers=hdr())
+    assert any(j["uuid"] == uuid for j in r.json())
+
+
+def test_submit_validation_errors(server):
+    submit(server, [{"mem": 100, "cpus": 1}], expect=400)  # no command
+    submit(server, [{"command": "x", "mem": -5}], expect=400)
+    submit(server, [{"command": "x", "cpus": 99999}], expect=400)
+    submit(server, [{"command": "x", "priority": 500}], expect=400)
+    submit(server, [{"command": "x", "pool": "nope"}], expect=400)
+    r = requests.post(f"{server.url}/jobs", json={"jobs": []}, headers=hdr())
+    assert r.status_code == 400
+
+
+def test_duplicate_uuid_rejected(server):
+    spec = {"command": "x", "uuid": "22222222-0000-0000-0000-000000000002"}
+    submit(server, [spec])
+    submit(server, [spec], expect=400)
+
+
+def test_kill_job_authz(server):
+    uuid = submit(server, [{"command": "sleep"}], user="bob")["jobs"][0]
+    # alice may not kill bob's job
+    r = requests.delete(f"{server.url}/jobs", params={"job": uuid},
+                        headers=hdr("alice"))
+    assert r.status_code == 403
+    # admin may
+    r = requests.delete(f"{server.url}/jobs", params={"job": uuid},
+                        headers=hdr("admin"))
+    assert r.status_code == 204
+    r = requests.get(f"{server.url}/jobs/{uuid}", headers=hdr())
+    assert r.json()["status"] == "completed"
+
+
+def test_impersonation(server):
+    uuid = submit(server, [{"command": "sleep"}], user="carol")["jobs"][0]
+    headers = {"X-Cook-Requesting-User": "admin",
+               "X-Cook-Impersonate": "carol"}
+    r = requests.delete(f"{server.url}/jobs", params={"job": uuid},
+                        headers=headers)
+    assert r.status_code == 204
+    # non-admin cannot impersonate
+    headers = {"X-Cook-Requesting-User": "bob",
+               "X-Cook-Impersonate": "carol"}
+    r = requests.get(f"{server.url}/jobs", params={"user": "carol"},
+                     headers=headers)
+    assert r.status_code == 403
+
+
+def test_share_quota_endpoints(server):
+    r = requests.post(f"{server.url}/share", json={
+        "user": "default", "share": {"mem": 1000, "cpus": 10, "gpus": 1}},
+        headers=hdr("admin"))
+    assert r.status_code == 201
+    r = requests.get(f"{server.url}/share", params={"user": "dave"},
+                     headers=hdr())
+    assert r.json()["mem"] == 1000
+    r = requests.post(f"{server.url}/quota", json={
+        "user": "dave", "quota": {"count": 3, "mem": 500, "cpus": 5}},
+        headers=hdr("admin"))
+    assert r.status_code == 201
+    r = requests.get(f"{server.url}/quota", params={"user": "dave"},
+                     headers=hdr())
+    assert r.json()["count"] == 3
+    r = requests.delete(f"{server.url}/quota", params={"user": "dave"},
+                        headers=hdr("admin"))
+    assert r.status_code == 204
+
+
+def test_retry_endpoint(server):
+    uuid = submit(server, [{"command": "x", "max_retries": 1}])["jobs"][0]
+    r = requests.get(f"{server.url}/retry", params={"job": uuid}, headers=hdr())
+    assert r.json() == 1
+    r = requests.post(f"{server.url}/retry",
+                      json={"job": uuid, "retries": 5}, headers=hdr())
+    assert r.status_code == 201
+    r = requests.get(f"{server.url}/retry", params={"job": uuid}, headers=hdr())
+    assert r.json() == 5
+
+
+def test_groups_endpoint(server):
+    guuid = "33333333-0000-0000-0000-000000000003"
+    submit(server, [
+        {"command": "x", "group": guuid},
+        {"command": "y", "group": guuid},
+    ], groups=[{"uuid": guuid, "host_placement": {"type": "unique"}}])
+    r = requests.get(f"{server.url}/group",
+                     params=[("uuid", guuid), ("detailed", "true")],
+                     headers=hdr())
+    g = r.json()[0]
+    assert g["host_placement"]["type"] == "unique"
+    assert len(g["jobs"]) == 2
+    assert g["composition"]["waiting"] == 2
+
+
+def test_full_lifecycle_via_api(server):
+    """submit -> match cycle -> running -> complete -> query"""
+    uuid = submit(server, [{"command": "work", "mem": 100, "cpus": 1,
+                            "expected_runtime": 50_000}])["jobs"][0]
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+    r = requests.get(f"{server.url}/jobs/{uuid}", headers=hdr())
+    assert r.json()["status"] == "running"
+    assert len(r.json()["instances"]) == 1
+    inst = r.json()["instances"][0]
+    assert inst["status"] == "running"
+    # progress update (sidecar path)
+    r = requests.post(f"{server.url}/progress/{inst['task_id']}",
+                      json={"progress_percent": 50,
+                            "progress_message": "half"},
+                      headers=hdr())
+    assert r.status_code == 202
+    r = requests.get(f"{server.url}/progress/{inst['task_id']}", headers=hdr())
+    assert r.json() == {"progress": 50, "progress_message": "half"}
+    # usage shows the running job
+    r = requests.get(f"{server.url}/usage", params={"user": "alice"},
+                     headers=hdr())
+    assert r.json()["total_usage"]["jobs"] >= 1
+    # complete it
+    server.clock.advance(60_000)
+    server.cluster.advance_to(server.clock.now_ms)
+    r = requests.get(f"{server.url}/jobs/{uuid}", headers=hdr())
+    assert r.json()["status"] == "completed"
+    assert r.json()["instances"][0]["status"] == "success"
+
+
+def test_unscheduled_reasons(server):
+    uuid = submit(server, [{"command": "x", "mem": 999999999, "cpus": 1,
+                            "max_retries": 1}], expect=400)
+    uuid = submit(server, [{"command": "x", "mem": 400000, "cpus": 400}])["jobs"][0]
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+    r = requests.get(f"{server.url}/unscheduled_jobs", params={"job": uuid},
+                     headers=hdr())
+    reasons = r.json()[0]["reasons"]
+    assert any("placed" in x["reason"] or "queue" in x["reason"]
+               for x in reasons), reasons
+
+
+def test_info_pools_settings_reasons_metrics(server):
+    assert requests.get(f"{server.url}/info", headers=hdr()).status_code == 200
+    pools = requests.get(f"{server.url}/pools", headers=hdr()).json()
+    assert {p["name"] for p in pools} == {"default", "gpu-pool"}
+    settings = requests.get(f"{server.url}/settings", headers=hdr()).json()
+    assert "max-job-mem" in settings
+    reasons = requests.get(f"{server.url}/failure_reasons", headers=hdr()).json()
+    assert any(r["code"] == 1002 and r["mea_culpa"] for r in reasons)
+    metrics = requests.get(f"{server.url}/metrics", headers=hdr())
+    assert "cook_jobs_submitted" in metrics.text
+
+
+def test_dynamic_cluster_endpoint(server):
+    r = requests.get(f"{server.url}/compute-clusters", headers=hdr())
+    configs = r.json()["in-mem-configs"]
+    assert configs[0]["name"] == "mock"
+    assert configs[0]["state"] == "running"
+    # non-admin cannot change state
+    r = requests.post(f"{server.url}/compute-clusters",
+                      json={"name": "mock", "state": "draining"},
+                      headers=hdr("bob"))
+    assert r.status_code == 403
+    r = requests.post(f"{server.url}/compute-clusters",
+                      json={"name": "mock", "state": "draining"},
+                      headers=hdr("admin"))
+    assert r.status_code == 201
+    # draining cluster gives no offers to the matcher
+    assert not server.scheduler.clusters[0].accepts_work
+    r = requests.post(f"{server.url}/compute-clusters",
+                      json={"name": "mock", "state": "running"},
+                      headers=hdr("admin"))
+    assert r.status_code == 201
+
+
+def test_queue_endpoint(server):
+    submit(server, [{"command": "q", "mem": 100, "cpus": 1}])
+    server.scheduler.rank_cycle(server.store.pools["default"])
+    r = requests.get(f"{server.url}/queue", headers=hdr())
+    assert "default" in r.json()
