@@ -1,0 +1,11 @@
+"""Python job client.
+
+Reference: jobclient/python (cookclient `JobClient`,
+/root/reference/jobclient/python/cookclient/__init__.py:46): submit / query
+/ kill / wait over the REST API, with dataclass views of jobs and
+instances.
+"""
+from cook_tpu.client.jobclient import (  # noqa: F401
+    JobClient,
+    JobClientError,
+)

@@ -1,0 +1,248 @@
+"""`cs` — the command-line client.
+
+Reference: cli/ (/root/reference/cli/cook/subcommands/*): submit, show,
+wait, jobs, kill, usage, queue-position; multi-cluster federation — the CLI
+reads a config listing several schedulers and fans queries out to all of
+them, reporting which cluster owns each uuid (cli/cook/querying.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from cook_tpu.client.jobclient import JobClient, JobClientError
+
+DEFAULT_CONFIG_PATHS = (
+    os.path.expanduser("~/.cs.json"),
+    ".cs.json",
+)
+
+
+@dataclass
+class ClusterConfig:
+    name: str
+    url: str
+
+
+def load_config(path: Optional[str] = None) -> list[ClusterConfig]:
+    paths = [path] if path else list(DEFAULT_CONFIG_PATHS)
+    for p in paths:
+        if p and os.path.exists(p):
+            with open(p) as f:
+                data = json.load(f)
+            return [ClusterConfig(c["name"], c["url"])
+                    for c in data.get("clusters", [])]
+    url = os.environ.get("COOK_SCHEDULER_URL", "http://localhost:12321")
+    return [ClusterConfig("default", url)]
+
+
+def _clients(args) -> list[tuple[ClusterConfig, JobClient]]:
+    clusters = load_config(args.config)
+    if args.cluster:
+        clusters = [c for c in clusters if c.name == args.cluster]
+        if not clusters:
+            raise SystemExit(f"no cluster named {args.cluster} in config")
+    user = args.user or os.environ.get("USER", "anonymous")
+    return [(c, JobClient(c.url, user=user)) for c in clusters]
+
+
+def _fan_out_query(args, uuids: Sequence[str]):
+    """Find each uuid on whichever cluster knows it (querying.py)."""
+    found: dict[str, tuple[str, dict]] = {}
+    for cluster, client in _clients(args):
+        remaining = [u for u in uuids if u not in found]
+        if not remaining:
+            break
+        for uuid in remaining:
+            try:
+                job = client.query_one(uuid)
+                found[uuid] = (cluster.name, job)
+            except JobClientError as e:
+                if e.status != 404:
+                    raise
+    return found
+
+
+def cmd_submit(args) -> int:
+    (cluster, client) = _clients(args)[0]
+    command = " ".join(args.command)
+    if not command and not sys.stdin.isatty():
+        command = sys.stdin.read().strip()
+    spec = {"command": command, "mem": args.mem, "cpus": args.cpus}
+    if args.gpus:
+        spec["gpus"] = args.gpus
+    if args.name:
+        spec["name"] = args.name
+    if args.priority is not None:
+        spec["priority"] = args.priority
+    if args.max_retries is not None:
+        spec["max_retries"] = args.max_retries
+    if args.pool:
+        spec["pool"] = args.pool
+    if args.env:
+        spec["env"] = dict(kv.split("=", 1) for kv in args.env)
+    uuids = client.submit([spec] * args.copies)
+    for uuid in uuids:
+        print(uuid)
+    return 0
+
+
+def cmd_show(args) -> int:
+    found = _fan_out_query(args, args.uuid)
+    rc = 0
+    for uuid in args.uuid:
+        if uuid not in found:
+            print(f"{uuid}: not found on any cluster", file=sys.stderr)
+            rc = 1
+            continue
+        cluster_name, job = found[uuid]
+        if args.json:
+            print(json.dumps({"cluster": cluster_name, **job}, indent=2))
+        else:
+            print(f"{job['uuid']}  {job['status']:9s}  {job['name']}  "
+                  f"(cluster {cluster_name}, user {job['user']}, "
+                  f"mem {job['mem']}, cpus {job['cpus']})")
+            for inst in job.get("instances", []):
+                line = (f"  task {inst['task_id']}  {inst['status']:8s}  "
+                        f"host {inst['hostname']}")
+                if "reason_string" in inst:
+                    line += f"  reason: {inst['reason_string']}"
+                print(line)
+    return rc
+
+
+def cmd_wait(args) -> int:
+    found = _fan_out_query(args, args.uuid)
+    missing = [u for u in args.uuid if u not in found]
+    if missing:
+        print(f"not found: {missing}", file=sys.stderr)
+        return 1
+    by_cluster: dict[str, list[str]] = {}
+    for uuid, (cluster_name, _) in found.items():
+        by_cluster.setdefault(cluster_name, []).append(uuid)
+    clients = {c.name: cl for c, cl in _clients(args)}
+    deadline = time.monotonic() + args.timeout
+    for cluster_name, uuids in by_cluster.items():
+        remaining = max(1.0, deadline - time.monotonic())
+        clients[cluster_name].wait(uuids, timeout_s=remaining)
+    print("completed")
+    return 0
+
+
+def cmd_jobs(args) -> int:
+    states = args.state.split(",") if args.state else []
+    for cluster, client in _clients(args):
+        jobs = client.list_jobs(args.lookup_user, states=states,
+                                limit=args.limit)
+        for job in jobs:
+            print(f"{cluster.name}  {job['uuid']}  {job['status']:9s}  "
+                  f"{job['name']}")
+    return 0
+
+
+def cmd_kill(args) -> int:
+    found = _fan_out_query(args, args.uuid)
+    rc = 0
+    clients = {c.name: cl for c, cl in _clients(args)}
+    for uuid in args.uuid:
+        if uuid not in found:
+            print(f"{uuid}: not found", file=sys.stderr)
+            rc = 1
+            continue
+        cluster_name, _ = found[uuid]
+        clients[cluster_name].kill([uuid])
+        print(f"killed {uuid} on {cluster_name}")
+    return rc
+
+
+def cmd_usage(args) -> int:
+    for cluster, client in _clients(args):
+        usage = client.usage(args.lookup_user)
+        total = usage["total_usage"]
+        print(f"{cluster.name}: mem {total['mem']} cpus {total['cpus']} "
+              f"gpus {total['gpus']} jobs {total['jobs']}")
+    return 0
+
+
+def cmd_retry(args) -> int:
+    found = _fan_out_query(args, args.uuid)
+    clients = {c.name: cl for c, cl in _clients(args)}
+    for uuid in args.uuid:
+        if uuid not in found:
+            print(f"{uuid}: not found", file=sys.stderr)
+            return 1
+        cluster_name, _ = found[uuid]
+        clients[cluster_name].retry(uuid, args.retries)
+        print(f"set retries={args.retries} for {uuid}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cs", description="cook-tpu scheduler CLI"
+    )
+    p.add_argument("--config", help="path to cluster config json")
+    p.add_argument("--cluster", help="restrict to one named cluster")
+    p.add_argument("--user", help="requesting user")
+    sub = p.add_subparsers(dest="subcommand", required=True)
+
+    sp = sub.add_parser("submit", help="submit a job")
+    sp.add_argument("command", nargs="*", help="command to run")
+    sp.add_argument("--mem", type=float, default=128.0)
+    sp.add_argument("--cpus", type=float, default=1.0)
+    sp.add_argument("--gpus", type=float, default=0.0)
+    sp.add_argument("--name")
+    sp.add_argument("--priority", type=int)
+    sp.add_argument("--max-retries", type=int, dest="max_retries")
+    sp.add_argument("--pool")
+    sp.add_argument("--env", action="append", metavar="K=V")
+    sp.add_argument("--copies", type=int, default=1)
+    sp.set_defaults(fn=cmd_submit)
+
+    for name, fn, help_ in [
+        ("show", cmd_show, "show jobs"),
+        ("wait", cmd_wait, "wait for jobs to complete"),
+        ("kill", cmd_kill, "kill jobs"),
+    ]:
+        q = sub.add_parser(name, help=help_)
+        q.add_argument("uuid", nargs="+")
+        if name == "show":
+            q.add_argument("--json", action="store_true")
+        if name == "wait":
+            q.add_argument("--timeout", type=float, default=300.0)
+        q.set_defaults(fn=fn)
+
+    q = sub.add_parser("retry", help="update a job's retries")
+    q.add_argument("uuid", nargs="+")
+    q.add_argument("--retries", type=int, required=True)
+    q.set_defaults(fn=cmd_retry)
+
+    q = sub.add_parser("jobs", help="list a user's jobs")
+    q.add_argument("--lookup-user", dest="lookup_user")
+    q.add_argument("--state")
+    q.add_argument("--limit", type=int, default=150)
+    q.set_defaults(fn=cmd_jobs)
+
+    q = sub.add_parser("usage", help="show a user's usage")
+    q.add_argument("--lookup-user", dest="lookup_user")
+    q.set_defaults(fn=cmd_usage)
+
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except JobClientError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,144 @@
+"""JobClient: the programmatic REST client.
+
+Reference behavior: /root/reference/jobclient/python/cookclient/__init__.py
+(submit returns uuids, query returns job dicts, kill, wait-for-completion
+polling loop with backoff) and the Java client's retry semantics
+(jobclient/java JobClient.java).
+"""
+from __future__ import annotations
+
+import time
+import uuid as uuid_mod
+from typing import Any, Callable, Optional, Sequence
+
+import requests
+
+
+class JobClientError(Exception):
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
+
+
+class JobClient:
+    def __init__(
+        self,
+        url: str,
+        *,
+        user: str = "anonymous",
+        session: Optional[requests.Session] = None,
+        retries: int = 3,
+        retry_backoff_s: float = 0.2,
+    ):
+        self.url = url.rstrip("/")
+        self.user = user
+        self.session = session or requests.Session()
+        self.retries = retries
+        self.retry_backoff_s = retry_backoff_s
+
+    # ------------------------------------------------------------- plumbing
+
+    def _headers(self) -> dict:
+        return {"X-Cook-Requesting-User": self.user}
+
+    def _request(self, method: str, path: str, **kw) -> Any:
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.retries):
+            try:
+                resp = self.session.request(
+                    method, f"{self.url}{path}", headers=self._headers(),
+                    timeout=30, **kw,
+                )
+            except requests.ConnectionError as e:
+                last_exc = e
+                time.sleep(self.retry_backoff_s * (2 ** attempt))
+                continue
+            if resp.status_code >= 500:
+                last_exc = JobClientError(resp.text, resp.status_code)
+                time.sleep(self.retry_backoff_s * (2 ** attempt))
+                continue
+            if resp.status_code >= 400:
+                try:
+                    message = resp.json().get("error", resp.text)
+                except Exception:
+                    message = resp.text
+                raise JobClientError(message, resp.status_code)
+            return resp
+        raise JobClientError(f"request failed after {self.retries} tries: "
+                             f"{last_exc}")
+
+    # ------------------------------------------------------------------ api
+
+    def submit(self, jobs: Sequence[dict], *, groups: Sequence[dict] = (),
+               pool: Optional[str] = None) -> list[str]:
+        """Submit jobs; fills in uuids when absent; returns the uuids."""
+        payload = []
+        for job in jobs:
+            job = dict(job)
+            job.setdefault("uuid", str(uuid_mod.uuid4()))
+            if pool is not None:
+                job.setdefault("pool", pool)
+            payload.append(job)
+        body: dict = {"jobs": payload}
+        if groups:
+            body["groups"] = list(groups)
+        resp = self._request("POST", "/jobs", json=body)
+        return resp.json()["jobs"]
+
+    def query(self, uuids: Sequence[str]) -> list[dict]:
+        resp = self._request("GET", "/jobs",
+                             params=[("uuid", u) for u in uuids])
+        return resp.json()
+
+    def query_one(self, uuid: str) -> dict:
+        return self._request("GET", f"/jobs/{uuid}").json()
+
+    def query_instance(self, task_id: str) -> dict:
+        return self._request("GET", f"/instances/{task_id}").json()
+
+    def list_jobs(self, user: Optional[str] = None, *,
+                  states: Sequence[str] = (), start_ms: int = 0,
+                  end_ms: int = 2**62, limit: int = 1000) -> list[dict]:
+        params: list = [("user", user or self.user), ("limit", str(limit)),
+                        ("start-ms", str(start_ms)), ("end-ms", str(end_ms))]
+        for s in states:
+            params.append(("state", s))
+        return self._request("GET", "/list", params=params).json()
+
+    def kill(self, uuids: Sequence[str]) -> None:
+        self._request("DELETE", "/jobs",
+                      params=[("uuid", u) for u in uuids])
+
+    def retry(self, uuid: str, retries: int) -> None:
+        self._request("POST", "/retry", json={"job": uuid, "retries": retries})
+
+    def wait(self, uuids: Sequence[str], *, timeout_s: float = 300.0,
+             poll_s: float = 1.0,
+             sleep: Callable[[float], None] = time.sleep) -> list[dict]:
+        """Poll until every job completes (reference: JobClient listener/
+        wait loops)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            jobs = self.query(uuids)
+            if all(j["status"] == "completed" for j in jobs):
+                return jobs
+            if time.monotonic() > deadline:
+                raise JobClientError(
+                    f"timed out waiting for {[j['uuid'] for j in jobs if j['status'] != 'completed']}"
+                )
+            sleep(poll_s)
+
+    def usage(self, user: Optional[str] = None) -> dict:
+        return self._request("GET", "/usage",
+                             params={"user": user or self.user}).json()
+
+    def unscheduled_reasons(self, uuid: str) -> list[dict]:
+        resp = self._request("GET", "/unscheduled_jobs",
+                             params={"job": uuid})
+        return resp.json()[0]["reasons"]
+
+    def groups(self, uuids: Sequence[str], detailed: bool = False) -> list[dict]:
+        params: list = [("uuid", u) for u in uuids]
+        if detailed:
+            params.append(("detailed", "true"))
+        return self._request("GET", "/group", params=params).json()

@@ -1,0 +1,124 @@
+"""JobClient + CLI tests over a live server (reference: cli/tests +
+jobclient/python/tests)."""
+import json
+
+import pytest
+
+from cook_tpu.client.cli import main as cli_main
+from cook_tpu.client.jobclient import JobClient, JobClientError
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import ServerThread
+from cook_tpu.scheduler.core import Scheduler
+from tests.conftest import FakeClock
+
+
+@pytest.fixture(scope="module")
+def server():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock",
+        [MockHost(node_id=f"n{i}", hostname=f"n{i}", mem=4096, cpus=16)
+         for i in range(2)],
+        clock=clock,
+    )
+    scheduler = Scheduler(store, [cluster])
+    api = CookApi(store, scheduler, ApiConfig())
+    srv = ServerThread(api).start()
+    srv.clock = clock
+    srv.store = store
+    srv.scheduler = scheduler
+    srv.cluster = cluster
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    return JobClient(server.url, user="alice")
+
+
+def test_client_submit_query_kill(client):
+    uuids = client.submit([{"command": "echo 1"}, {"command": "echo 2"}])
+    assert len(uuids) == 2
+    jobs = client.query(uuids)
+    assert all(j["status"] == "waiting" for j in jobs)
+    client.kill(uuids)
+    jobs = client.query(uuids)
+    assert all(j["status"] == "completed" for j in jobs)
+
+
+def test_client_wait(server, client):
+    [uuid] = client.submit([{"command": "w", "expected_runtime": 10_000}])
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+
+    def sleeper(_):
+        server.clock.advance(20_000)
+        server.cluster.advance_to(server.clock.now_ms)
+
+    jobs = client.wait([uuid], timeout_s=10, poll_s=0.01, sleep=sleeper)
+    assert jobs[0]["status"] == "completed"
+
+
+def test_client_error_handling(client):
+    with pytest.raises(JobClientError) as e:
+        client.query_one("nonexistent-uuid")
+    assert e.value.status == 404
+    with pytest.raises(JobClientError):
+        client.submit([{"mem": 100}])  # no command
+
+
+def test_client_retry_and_reasons(server, client):
+    [uuid] = client.submit([{"command": "r", "mem": 500000, "cpus": 1}])
+    client.retry(uuid, 7)
+    assert client.query_one(uuid)["max_retries"] == 7
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+    reasons = client.unscheduled_reasons(uuid)
+    assert reasons
+
+
+def cli(server, *argv, user="alice"):
+    return cli_main(["--config", server.cfg_path, "--user", user, *argv])
+
+
+@pytest.fixture
+def cfg(server, tmp_path):
+    p = tmp_path / "cs.json"
+    p.write_text(json.dumps(
+        {"clusters": [{"name": "c1", "url": server.url}]}
+    ))
+    server.cfg_path = str(p)
+    return str(p)
+
+
+def test_cli_submit_show_kill(server, cfg, capsys):
+    assert cli(server, "submit", "--mem", "64", "echo", "hello") == 0
+    uuid = capsys.readouterr().out.strip()
+    assert cli(server, "show", uuid) == 0
+    out = capsys.readouterr().out
+    assert "waiting" in out and uuid in out
+    assert cli(server, "kill", uuid) == 0
+    capsys.readouterr()
+    assert cli(server, "show", uuid) == 0
+    assert "completed" in capsys.readouterr().out
+
+
+def test_cli_jobs_and_usage(server, cfg, capsys):
+    cli(server, "submit", "sleep 1")
+    capsys.readouterr()
+    assert cli(server, "jobs") == 0
+    assert "c1" in capsys.readouterr().out
+    assert cli(server, "usage") == 0
+    assert "mem" in capsys.readouterr().out
+
+
+def test_cli_unknown_uuid(server, cfg, capsys):
+    assert cli(server, "show", "no-such-uuid") == 1
