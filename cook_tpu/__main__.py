@@ -1,0 +1,47 @@
+"""`python -m cook_tpu --config config.json` — run one scheduler node.
+
+Reference: cook.components/-main (components.clj:345).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from cook_tpu.components import build_process, shutdown, start_leader_duties
+from cook_tpu.utils.config import read_config
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="cook-tpu")
+    parser.add_argument("--config", help="path to config json")
+    parser.add_argument("--port", type=int)
+    parser.add_argument("--no-leader", action="store_true",
+                        help="serve REST only (hot standby)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    overrides = {}
+    if args.port:
+        overrides["port"] = args.port
+    settings = read_config(args.config, overrides)
+    process = build_process(settings)
+    print(f"cook-tpu listening on :{settings.port} "
+          f"(member {process.member_id})", file=sys.stderr)
+    try:
+        if not args.no_leader:
+            start_leader_duties(process)
+        else:
+            import time
+
+            while True:
+                time.sleep(3600)
+    finally:
+        shutdown(process)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

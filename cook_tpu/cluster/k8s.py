@@ -1,0 +1,375 @@
+"""Kubernetes-style compute cluster: synthesized offers + two-map controller.
+
+Reference: cook.kubernetes.{compute-cluster,controller,api}
+(/root/reference/scheduler/src/cook/kubernetes/):
+
+  * K8s has no offer protocol, so offers are SYNTHESIZED from node capacity
+    minus pod consumption (compute_cluster.clj:68-190, api.clj:874-905).
+  * Task lifecycle is driven by a two-map reconciliation controller:
+    `expected_state` (what Cook wants) vs `actual_state` (what the pod
+    watch last reported); every event runs `process(task_id)`, a state
+    machine whose (expected x actual) table decides launch/kill/delete/
+    status-report actions (controller.clj:482-828).
+  * Autoscaling submits SYNTHETIC placeholder pods so the cluster
+    autoscaler provisions nodes (compute_cluster.clj:606), bounded by
+    outstanding/total caps.
+  * A periodic anti-entropy scan re-processes every known task
+    (compute_cluster.clj:199-230).
+
+The `KubeApi` boundary below is the piece a production deployment swaps
+for a real apiserver client (watches + pod CRUD); `FakeKubeApi` is the
+in-memory stand-in used by tests and the simulator.
+"""
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Callable, Optional, Sequence
+
+from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
+from cook_tpu.models.entities import InstanceStatus
+
+
+class PodPhase(enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class KubeNode:
+    name: str
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    pool: str = "default"
+    labels: tuple = ()
+    schedulable: bool = True
+
+
+@dataclass
+class KubePod:
+    name: str
+    node_name: str
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    phase: PodPhase = PodPhase.PENDING
+    synthetic: bool = False
+    failure_reason: str = ""
+
+
+class KubeApi:
+    """The apiserver boundary (api.clj): node/pod listings, pod CRUD, and a
+    pod-event callback (the watch)."""
+
+    def list_nodes(self) -> Sequence[KubeNode]:
+        raise NotImplementedError
+
+    def list_pods(self) -> Sequence[KubePod]:
+        raise NotImplementedError
+
+    def create_pod(self, pod: KubePod) -> None:
+        raise NotImplementedError
+
+    def delete_pod(self, name: str) -> None:
+        raise NotImplementedError
+
+    def set_pod_watch(self, callback: Callable[[str, Optional[KubePod]], None]
+                      ) -> None:
+        raise NotImplementedError
+
+
+class FakeKubeApi(KubeApi):
+    """Deterministic in-memory apiserver.  Pods scheduled onto the emptiest
+    feasible node; `tick()` moves Pending->Running; tests complete/fail pods
+    explicitly."""
+
+    def __init__(self, nodes: Sequence[KubeNode] = ()):
+        self.nodes: dict[str, KubeNode] = {n.name: n for n in nodes}
+        self.pods: dict[str, KubePod] = {}
+        self._watch: Optional[Callable] = None
+        self._lock = threading.RLock()
+
+    def list_nodes(self) -> list[KubeNode]:
+        with self._lock:
+            return list(self.nodes.values())
+
+    def list_pods(self) -> list[KubePod]:
+        with self._lock:
+            return list(self.pods.values())
+
+    def create_pod(self, pod: KubePod) -> None:
+        with self._lock:
+            if pod.name in self.pods:
+                raise ValueError(f"pod {pod.name} exists")
+            self.pods[pod.name] = pod
+        self._notify(pod.name)
+
+    def delete_pod(self, name: str) -> None:
+        with self._lock:
+            self.pods.pop(name, None)
+        self._notify(name)
+
+    def set_pod_watch(self, callback) -> None:
+        self._watch = callback
+
+    def _notify(self, name: str) -> None:
+        if self._watch is not None:
+            self._watch(name, self.pods.get(name))
+
+    # ----- test/simulation controls -----
+
+    def tick(self) -> None:
+        """Start all pending pods (the kubelet's work)."""
+        with self._lock:
+            starting = [p for p in self.pods.values()
+                        if p.phase == PodPhase.PENDING]
+            for pod in starting:
+                self.pods[pod.name] = replace(pod, phase=PodPhase.RUNNING)
+        for pod in starting:
+            self._notify(pod.name)
+
+    def finish_pod(self, name: str, *, failed: bool = False,
+                   reason: str = "") -> None:
+        with self._lock:
+            pod = self.pods.get(name)
+            if pod is None:
+                return
+            self.pods[name] = replace(
+                pod,
+                phase=PodPhase.FAILED if failed else PodPhase.SUCCEEDED,
+                failure_reason=reason,
+            )
+        self._notify(name)
+
+    def remove_node(self, name: str) -> list[str]:
+        with self._lock:
+            self.nodes.pop(name, None)
+            lost = [p.name for p in self.pods.values() if p.node_name == name]
+            for pname in lost:
+                self.pods[pname] = replace(
+                    self.pods[pname], phase=PodPhase.FAILED,
+                    failure_reason="node-removed",
+                )
+        for pname in lost:
+            self._notify(pname)
+        return lost
+
+
+class ExpectedState(enum.Enum):
+    """What Cook wants for a task (controller.clj cook-expected-state)."""
+
+    STARTING = "starting"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    KILLED = "killed"
+    MISSING = "missing"
+
+
+class KubeCluster(ComputeCluster):
+    def __init__(self, name: str, api: KubeApi, clock: Callable[[], int],
+                 *, synthetic_pod_limits: Optional[dict] = None):
+        super().__init__(name)
+        self.api = api
+        self.clock = clock
+        self.expected: dict[str, ExpectedState] = {}
+        self.task_pods: dict[str, KubePod] = {}  # task id -> last actual
+        self.status_callback = None
+        self.synthetic_limits = {
+            "max-pods-outstanding": 128,
+            "max-total-pods": 32_000,
+            **(synthetic_pod_limits or {}),
+        }
+        self._synthetic_seq = 0
+        self._lock = threading.RLock()
+        api.set_pod_watch(self._pod_event)
+
+    # ------------------------------------------------------------- offers
+
+    def pending_offers(self, pool: str) -> list[Offer]:
+        """Synthesize offers: capacity minus consumption per schedulable
+        node (generate-offers)."""
+        consumption: dict[str, list[float]] = {}
+        for pod in self.api.list_pods():
+            if pod.phase in (PodPhase.PENDING, PodPhase.RUNNING):
+                c = consumption.setdefault(pod.node_name, [0.0, 0.0, 0.0])
+                c[0] += pod.mem
+                c[1] += pod.cpus
+                c[2] += pod.gpus
+        offers = []
+        for node in self.api.list_nodes():
+            if not node.schedulable or node.pool != pool:
+                continue
+            used = consumption.get(node.name, [0.0, 0.0, 0.0])
+            offers.append(Offer(
+                node_id=node.name,
+                hostname=node.name,
+                mem=node.mem - used[0],
+                cpus=node.cpus - used[1],
+                gpus=node.gpus - used[2],
+                attributes=node.labels,
+                total_mem=node.mem,
+                total_cpus=node.cpus,
+            ))
+        return offers
+
+    # ----------------------------------------------------- task lifecycle
+
+    def launch_tasks(self, pool: str, specs: Sequence[TaskSpec]) -> None:
+        for spec in specs:
+            with self._lock:
+                self.expected[spec.task_id] = ExpectedState.STARTING
+            try:
+                self.api.create_pod(KubePod(
+                    name=spec.task_id,
+                    node_name=spec.node_id,
+                    mem=spec.mem,
+                    cpus=spec.cpus,
+                    gpus=spec.gpus,
+                ))
+            except Exception:
+                self._report(spec.task_id, InstanceStatus.FAILED,
+                             "pod-submission-api-error")
+                with self._lock:
+                    self.expected.pop(spec.task_id, None)
+
+    def kill_task(self, task_id: str) -> None:
+        with self._lock:
+            self.expected[task_id] = ExpectedState.KILLED
+        self.process(task_id)
+
+    # -------------------------------------------------------- controller
+
+    def _pod_event(self, name: str, pod: Optional[KubePod]) -> None:
+        """pod-update / pod-deleted (controller.clj:752-765)."""
+        if name.startswith("synthetic-"):
+            return
+        with self._lock:
+            if pod is not None:
+                self.task_pods[name] = pod
+            else:
+                self.task_pods.pop(name, None)
+        self.process(name)
+
+    def process(self, task_id: str) -> None:
+        """The (expected x actual) state machine (controller.clj:482)."""
+        with self._lock:
+            expected = self.expected.get(task_id, ExpectedState.MISSING)
+            pod = self.task_pods.get(task_id)
+        phase = pod.phase if pod is not None else None
+
+        if expected == ExpectedState.KILLED:
+            if pod is not None and phase in (PodPhase.PENDING, PodPhase.RUNNING):
+                self.api.delete_pod(task_id)
+            self._report(task_id, InstanceStatus.FAILED, "killed-by-user")
+            with self._lock:
+                self.expected.pop(task_id, None)
+            return
+
+        if expected in (ExpectedState.STARTING, ExpectedState.RUNNING):
+            if pod is None:
+                # pod vanished: mea-culpa failure, scheduler may retry
+                self._report(task_id, InstanceStatus.FAILED,
+                             "could-not-reconstruct-state")
+                with self._lock:
+                    self.expected.pop(task_id, None)
+            elif phase == PodPhase.RUNNING:
+                if expected == ExpectedState.STARTING:
+                    with self._lock:
+                        self.expected[task_id] = ExpectedState.RUNNING
+                    self._report(task_id, InstanceStatus.RUNNING, None)
+            elif phase == PodPhase.SUCCEEDED:
+                self._report(task_id, InstanceStatus.SUCCESS, "normal-exit")
+                with self._lock:
+                    self.expected[task_id] = ExpectedState.COMPLETED
+                self.api.delete_pod(task_id)
+            elif phase == PodPhase.FAILED:
+                reason = pod.failure_reason or "command-executor-failed"
+                self._report(task_id, InstanceStatus.FAILED, reason)
+                with self._lock:
+                    self.expected[task_id] = ExpectedState.COMPLETED
+                self.api.delete_pod(task_id)
+            return
+
+        if expected == ExpectedState.MISSING and pod is not None \
+                and not pod.synthetic:
+            # unknown pod owned by us: kill it (controller's orphan branch)
+            if phase in (PodPhase.PENDING, PodPhase.RUNNING):
+                self.api.delete_pod(task_id)
+
+    def scan_all(self) -> None:
+        """Anti-entropy scan (scan-process, compute_cluster.clj:199-230)."""
+        with self._lock:
+            known = set(self.expected) | set(self.task_pods)
+        for pod in self.api.list_pods():
+            known.add(pod.name)
+            with self._lock:
+                if not pod.synthetic:
+                    self.task_pods[pod.name] = pod
+        for task_id in sorted(known):
+            if not task_id.startswith("synthetic-"):
+                self.process(task_id)
+
+    def determine_expected_state_on_startup(self, live_task_ids: set[str]
+                                            ) -> None:
+        """Failover recovery (compute_cluster.clj:269): rebuild the expected
+        map from the store's live instances."""
+        with self._lock:
+            for task_id in live_task_ids:
+                self.expected.setdefault(task_id, ExpectedState.RUNNING)
+        self.scan_all()
+
+    # -------------------------------------------------------- autoscaling
+
+    def autoscaling(self, pool: str) -> bool:
+        return True
+
+    def autoscale(self, pool: str, pending_demand: Sequence[TaskSpec]) -> None:
+        """Submit synthetic placeholder pods for unmatched demand so the
+        cluster autoscaler provisions capacity (autoscale!,
+        compute_cluster.clj:606)."""
+        outstanding = sum(
+            1
+            for p in self.api.list_pods()
+            if p.synthetic and p.phase == PodPhase.PENDING
+        )
+        budget = self.synthetic_limits["max-pods-outstanding"] - outstanding
+        for spec in list(pending_demand)[: max(budget, 0)]:
+            self._synthetic_seq += 1
+            self.api.create_pod(KubePod(
+                name=f"synthetic-{self._synthetic_seq}",
+                node_name="",  # unschedulable until the autoscaler adds nodes
+                mem=spec.mem,
+                cpus=spec.cpus,
+                gpus=spec.gpus,
+                synthetic=True,
+            ))
+
+    def synthetic_pods(self) -> list[KubePod]:
+        return [p for p in self.api.list_pods() if p.synthetic]
+
+    # ------------------------------------------------------------- misc
+
+    def num_tasks_on_host(self, hostname: str) -> int:
+        return sum(
+            1 for p in self.api.list_pods()
+            if p.node_name == hostname
+            and p.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+            and not p.synthetic
+        )
+
+    @property
+    def running(self):
+        """Task view for reconciliation (Scheduler.reconcile)."""
+        return {
+            p.name: p for p in self.api.list_pods()
+            if not p.synthetic and p.phase in (PodPhase.PENDING,
+                                               PodPhase.RUNNING)
+        }
+
+    def _report(self, task_id, status, reason) -> None:
+        if self.status_callback is not None:
+            self.status_callback(task_id, status, reason)

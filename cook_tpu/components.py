@@ -1,0 +1,261 @@
+"""Process wiring: config -> store/clusters/scheduler/REST/trigger loops.
+
+Reference: cook.components (-main, /root/reference/scheduler/src/cook/
+components.clj:257-365) + the trigger channels (`make-trigger-chans`,
+mesos.clj:89-110) and leadership wiring (mesos.clj:153-328): the REST
+server runs on every node; the scheduling loops run only on the leader;
+losing leadership fail-fast exits.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from cook_tpu.cluster.base import ComputeCluster
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.control.leader import (
+    FileLeaseElector,
+    InMemoryElector,
+    LeaderSelector,
+)
+from cook_tpu.models.entities import DruMode, Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import ServerThread
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.utils.config import Settings
+from cook_tpu.utils.logging import log_info
+from cook_tpu.utils.tracing import span
+
+log = logging.getLogger(__name__)
+
+
+def wall_clock_ms() -> int:
+    return int(time.time() * 1000)
+
+
+CLUSTER_FACTORIES: dict[str, Callable[[dict, Callable[[], int]], ComputeCluster]] = {}
+
+
+def register_cluster_factory(kind: str):
+    def deco(fn):
+        CLUSTER_FACTORIES[kind] = fn
+        return fn
+    return deco
+
+
+@register_cluster_factory("mock")
+def _mock_factory(conf: dict, clock) -> ComputeCluster:
+    hosts = [
+        MockHost(
+            node_id=h["node_id"],
+            hostname=h.get("hostname", h["node_id"]),
+            mem=float(h["mem"]),
+            cpus=float(h["cpus"]),
+            gpus=float(h.get("gpus", 0.0)),
+            pool=h.get("pool", "default"),
+            attributes=tuple(sorted(h.get("attributes", {}).items())),
+        )
+        for h in conf.get("hosts", [])
+    ]
+    return MockCluster(conf["name"], hosts, clock)
+
+
+@register_cluster_factory("k8s")
+def _k8s_factory(conf: dict, clock) -> ComputeCluster:
+    from cook_tpu.cluster.k8s import FakeKubeApi, KubeCluster
+
+    api = conf.get("api") or FakeKubeApi()
+    return KubeCluster(conf["name"], api, clock,
+                       synthetic_pod_limits=conf.get("synthetic_pods", {}))
+
+
+class TriggerLoop:
+    """A periodic trigger thread (chime/trigger-chan analog).  Also
+    manually fireable for tests/simulator."""
+
+    def __init__(self, name: str, interval_s: float, fn: Callable[[], None]):
+        self.name = name
+        self.interval_s = interval_s
+        self.fn = fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "TriggerLoop":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.fn()
+                except Exception:  # noqa: BLE001 — loops must survive
+                    log.exception("trigger %s failed", self.name)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"trigger-{self.name}")
+        self._thread.start()
+        return self
+
+    def fire(self) -> None:
+        self.fn()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+@dataclass
+class CookProcess:
+    """Everything one scheduler process runs."""
+
+    settings: Settings
+    store: JobStore = None
+    clusters: list = field(default_factory=list)
+    scheduler: Scheduler = None
+    api: CookApi = None
+    server: ServerThread = None
+    selector: LeaderSelector = None
+    loops: list = field(default_factory=list)
+    member_id: str = ""
+
+    def is_leader(self) -> bool:
+        return self.selector is not None and self.selector.is_leader
+
+
+def build_process(
+    settings: Settings,
+    *,
+    clock: Callable[[], int] = wall_clock_ms,
+    start_rest: bool = True,
+) -> CookProcess:
+    store = JobStore(mea_culpa_limit=settings.mea_culpa_failure_limit,
+                     clock=clock)
+    for pool_conf in settings.pools:
+        store.set_pool(Pool(
+            name=pool_conf["name"],
+            dru_mode=DruMode(pool_conf.get("dru_mode", "default")),
+        ))
+    clusters = []
+    for conf in settings.clusters:
+        factory = CLUSTER_FACTORIES.get(conf.get("kind", "mock"))
+        if factory is None:
+            raise ValueError(f"unknown cluster kind {conf.get('kind')}")
+        clusters.append(factory(conf, clock))
+    scheduler = Scheduler(
+        store,
+        clusters,
+        SchedulerConfig(match=settings.match, rebalancer=settings.rebalancer),
+    )
+    api = CookApi(store, scheduler, ApiConfig(
+        default_pool=settings.default_pool,
+        admins=settings.admins,
+        submission_rate_per_minute=settings.submission_rate_per_minute,
+    ))
+    api.queue_limits.limits.per_pool = settings.queue_limit_per_pool
+    api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user
+    process = CookProcess(settings=settings, store=store, clusters=clusters,
+                          scheduler=scheduler, api=api,
+                          member_id=str(uuid_mod.uuid4())[:8])
+    if start_rest:
+        process.server = ServerThread(api, port=settings.port).start()
+    return process
+
+
+def start_leader_duties(process: CookProcess,
+                        *, on_loss: Optional[Callable[[], None]] = None,
+                        block: bool = True) -> None:
+    """Acquire leadership, then start the scheduling loops
+    (mesos.clj takeLeadership)."""
+    settings = process.settings
+    if settings.leader_lease_path:
+        elector = FileLeaseElector(settings.leader_lease_path,
+                                   process.member_id)
+    else:
+        elector = InMemoryElector("cook", process.member_id)
+    process.selector = LeaderSelector(elector, on_loss=on_loss)
+    process.selector.wait_for_leadership()
+    log_info("leadership acquired", component="leader",
+             member=process.member_id)
+    process.selector.start_heartbeat_thread()
+
+    scheduler = process.scheduler
+    store = process.store
+
+    def pools():
+        return [p for p in store.pools.values() if p.schedules_jobs]
+
+    def rank_all():
+        for pool in pools():
+            with span("rank-cycle", pool=pool.name):
+                scheduler.rank_cycle(pool)
+
+    # round-robin match dispatch (scheduler.clj:2508)
+    pool_cycle = itertools.cycle([None])
+
+    def match_next():
+        ps = pools()
+        if not ps:
+            return
+        # rebuild the cycle if pools changed
+        nonlocal pool_cycle
+        current = getattr(match_next, "_pools", None)
+        if current != [p.name for p in ps]:
+            match_next._pools = [p.name for p in ps]
+            pool_cycle = itertools.cycle(ps)
+        pool = next(pool_cycle)
+        with span("match-cycle", pool=pool.name):
+            scheduler.match_cycle(pool)
+
+    def rebalance_all():
+        for pool in pools():
+            with span("rebalance-cycle", pool=pool.name):
+                scheduler.rebalance_cycle(pool)
+
+    process.loops = [
+        TriggerLoop("rank", settings.rank_interval_s, rank_all).start(),
+        TriggerLoop("match",
+                    max(settings.match_interval_s / max(len(pools()), 1),
+                        0.05),
+                    match_next).start(),
+        TriggerLoop("rebalancer", settings.rebalancer_interval_s,
+                    rebalance_all).start(),
+        TriggerLoop("lingering", settings.lingering_interval_s,
+                    lambda: scheduler.kill_lingering_tasks(store.clock())
+                    ).start(),
+        TriggerLoop("straggler", settings.straggler_interval_s,
+                    lambda: scheduler.kill_stragglers(store.clock())).start(),
+        TriggerLoop("cancelled", settings.cancelled_interval_s,
+                    scheduler.kill_cancelled_tasks).start(),
+    ]
+    if settings.optimizer_interval_s > 0:
+        from cook_tpu.scheduler.optimizer import OptimizerCycle
+
+        cycle = OptimizerCycle()
+
+        def run_optimizer():
+            for pool in pools():
+                queue = scheduler.pool_queues.get(pool.name)
+                cycle.run(queue.jobs if queue else [],
+                          store.running_jobs(pool.name), {})
+
+        process.loops.append(
+            TriggerLoop("optimizer", settings.optimizer_interval_s,
+                        run_optimizer).start()
+        )
+    if block:
+        try:
+            while process.selector.is_leader:
+                time.sleep(1.0)
+        except KeyboardInterrupt:
+            pass
+
+
+def shutdown(process: CookProcess) -> None:
+    for loop in process.loops:
+        loop.stop()
+    if process.selector is not None:
+        process.selector.stop()
+    if process.server is not None:
+        process.server.stop()

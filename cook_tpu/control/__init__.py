@@ -1,0 +1,7 @@
+"""Control plane: leader election."""
+from cook_tpu.control.leader import (  # noqa: F401
+    FileLeaseElector,
+    InMemoryElector,
+    LeaderElector,
+    LeaderSelector,
+)

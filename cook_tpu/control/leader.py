@@ -1,0 +1,169 @@
+"""Leader election: single active scheduler with hot standbys.
+
+Reference: Curator/ZooKeeper LeaderSelector
+(/root/reference/scheduler/src/cook/mesos.clj:153-328 +
+components.clj:154): one instance leads and runs the scheduling loops;
+standbys wait; on leadership loss the process fail-fast exits so a
+supervisor restarts it clean (mesos.clj:296-313 — restarting state is
+error-prone, a fresh process is safer).
+
+Implementations:
+  * InMemoryElector — single-process/tests.
+  * FileLeaseElector — multi-process on one filesystem: an O_EXCL lease
+    file with heartbeat timestamps; standbys take over when the lease
+    goes stale.  (The production analog would be an etcd/ZK lease; the
+    protocol boundary is what matters here.)
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Callable, Optional
+
+
+class LeaderElector(ABC):
+    """`start` runs until leadership is lost, then calls on_loss — the
+    caller is expected to exit the process (fail-fast)."""
+
+    @abstractmethod
+    def try_acquire(self) -> bool: ...
+
+    @abstractmethod
+    def heartbeat(self) -> bool:
+        """Refresh the lease; False if leadership was lost."""
+
+    @abstractmethod
+    def release(self) -> None: ...
+
+    @abstractmethod
+    def current_leader(self) -> Optional[str]: ...
+
+
+class InMemoryElector(LeaderElector):
+    _leaders: dict[str, str] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, group: str, member_id: str):
+        self.group = group
+        self.member_id = member_id
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            if self._leaders.get(self.group) in (None, self.member_id):
+                self._leaders[self.group] = self.member_id
+                return True
+            return False
+
+    def heartbeat(self) -> bool:
+        with self._lock:
+            return self._leaders.get(self.group) == self.member_id
+
+    def release(self) -> None:
+        with self._lock:
+            if self._leaders.get(self.group) == self.member_id:
+                del self._leaders[self.group]
+
+    def current_leader(self) -> Optional[str]:
+        with self._lock:
+            return self._leaders.get(self.group)
+
+
+class FileLeaseElector(LeaderElector):
+    def __init__(self, lease_path: str, member_id: str,
+                 *, ttl_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.lease_path = lease_path
+        self.member_id = member_id
+        self.ttl_s = ttl_s
+        self.clock = clock
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.lease_path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def _write(self) -> None:
+        tmp = f"{self.lease_path}.{self.member_id}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"leader": self.member_id, "t": self.clock()}, f)
+        os.replace(tmp, self.lease_path)
+
+    def try_acquire(self) -> bool:
+        lease = self._read()
+        now = self.clock()
+        if lease is None or lease["leader"] == self.member_id \
+                or now - lease["t"] > self.ttl_s:
+            self._write()
+            # re-read to detect a concurrent writer that beat us
+            lease = self._read()
+            return lease is not None and lease["leader"] == self.member_id
+        return False
+
+    def heartbeat(self) -> bool:
+        lease = self._read()
+        if lease is None or lease["leader"] != self.member_id:
+            return False
+        self._write()
+        return True
+
+    def release(self) -> None:
+        lease = self._read()
+        if lease is not None and lease["leader"] == self.member_id:
+            try:
+                os.unlink(self.lease_path)
+            except FileNotFoundError:
+                pass
+
+    def current_leader(self) -> Optional[str]:
+        lease = self._read()
+        if lease is None or self.clock() - lease["t"] > self.ttl_s:
+            return None
+        return lease["leader"]
+
+
+class LeaderSelector:
+    """Blocks until leadership, runs `on_leadership`, watches the lease, and
+    invokes `on_loss` (default: os._exit — the reference's System/exit 0)
+    when it goes away."""
+
+    def __init__(
+        self,
+        elector: LeaderElector,
+        *,
+        poll_s: float = 1.0,
+        on_loss: Optional[Callable[[], None]] = None,
+    ):
+        self.elector = elector
+        self.poll_s = poll_s
+        self.on_loss = on_loss or (lambda: os._exit(0))
+        self._stop = threading.Event()
+        self.is_leader = False
+
+    def wait_for_leadership(self) -> None:
+        while not self._stop.is_set():
+            if self.elector.try_acquire():
+                self.is_leader = True
+                return
+            self._stop.wait(self.poll_s)
+
+    def start_heartbeat_thread(self) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                if not self.elector.heartbeat():
+                    self.is_leader = False
+                    self.on_loss()
+                    return
+                self._stop.wait(self.poll_s)
+
+        t = threading.Thread(target=loop, daemon=True, name="leader-heartbeat")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.elector.release()

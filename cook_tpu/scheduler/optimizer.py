@@ -1,0 +1,77 @@
+"""Pluggable optimizer: a planning loop above the matcher.
+
+Reference: cook.scheduler.optimizer (/root/reference/scheduler/src/cook/
+scheduler/optimizer.clj + docs/optimizer.md): protocols `HostFeed`
+(purchasable host types) and `Optimizer` (`produce_schedule(queue, running,
+available, host_infos)` -> {time-offset -> {:suggested-matches ...}}), with
+no-op defaults, driven by a periodic cycle.  The output's consumers are
+intentionally unspecified (the reference never wired one in production);
+autoscaling hints are the natural consumer here.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+from cook_tpu.models.entities import Job
+
+
+@dataclass(frozen=True)
+class HostInfo:
+    host_type: str
+    count: int
+    cpus: float
+    mem: float
+    gpus: float = 0.0
+
+
+class HostFeed(ABC):
+    @abstractmethod
+    def get_available_host_info(self) -> Sequence[HostInfo]: ...
+
+
+class Optimizer(ABC):
+    @abstractmethod
+    def produce_schedule(
+        self,
+        queue: Sequence[Job],
+        running: Sequence[Job],
+        available: dict[str, Any],
+        host_infos: Sequence[HostInfo],
+    ) -> dict[int, dict]:
+        """Returns {seconds-from-now: {"suggested-matches": ...,
+        "suggested-purchases": ...}}."""
+
+
+class NoOpHostFeed(HostFeed):
+    def get_available_host_info(self) -> Sequence[HostInfo]:
+        return []
+
+
+class NoOpOptimizer(Optimizer):
+    def produce_schedule(self, queue, running, available, host_infos):
+        return {0: {"suggested-matches": {}, "suggested-purchases": {}}}
+
+
+@dataclass
+class OptimizerCycle:
+    """optimizer-cycle! (optimizer.clj:90): gather inputs, call the
+    optimizer, sanity-check the output shape, publish the latest plan."""
+
+    host_feed: HostFeed = field(default_factory=NoOpHostFeed)
+    optimizer: Optimizer = field(default_factory=NoOpOptimizer)
+    latest_schedule: dict = field(default_factory=dict)
+
+    def run(self, queue: Sequence[Job], running: Sequence[Job],
+            available: dict[str, Any]) -> dict:
+        host_infos = self.host_feed.get_available_host_info()
+        schedule = self.optimizer.produce_schedule(
+            queue, running, available, host_infos
+        )
+        if not isinstance(schedule, dict) or not all(
+            isinstance(k, int) for k in schedule
+        ):
+            raise ValueError(f"malformed optimizer schedule: {schedule!r}")
+        self.latest_schedule = schedule
+        return schedule

@@ -1,0 +1,120 @@
+"""Config file handling: JSON -> validated settings dataclasses.
+
+Reference: cook.config (/root/reference/scheduler/src/cook/config.clj —
+EDN + prismatic-schema validation, docs/configuration.adoc) including the
+pool-regex-scoped scheduler configs (`pool-schedulers`, regexp_tools.clj)
+and runtime-mutable sections.
+"""
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from cook_tpu.scheduler.matcher import MatchConfig
+from cook_tpu.scheduler.rebalancer import RebalancerParams
+
+
+@dataclass
+class PoolSchedulerConfig:
+    """Per-pool-regex matcher knobs (reference `pool-schedulers`)."""
+
+    pool_regex: str
+    match: MatchConfig = field(default_factory=MatchConfig)
+
+    def matches(self, pool_name: str) -> bool:
+        return re.fullmatch(self.pool_regex, pool_name) is not None
+
+
+@dataclass
+class Settings:
+    port: int = 12321
+    default_pool: str = "default"
+    mea_culpa_failure_limit: int = 5
+    rank_interval_s: float = 5.0
+    match_interval_s: float = 1.0
+    rebalancer_interval_s: float = 20.0
+    lingering_interval_s: float = 60.0
+    straggler_interval_s: float = 60.0
+    cancelled_interval_s: float = 3.0
+    optimizer_interval_s: float = 0.0   # 0 = disabled
+    rebalancer: RebalancerParams = field(default_factory=RebalancerParams)
+    match: MatchConfig = field(default_factory=MatchConfig)
+    pool_schedulers: list[PoolSchedulerConfig] = field(default_factory=list)
+    pools: list[dict] = field(default_factory=lambda: [{"name": "default"}])
+    clusters: list[dict] = field(default_factory=list)
+    leader_lease_path: str = ""
+    admins: tuple = ("admin",)
+    queue_limit_per_pool: int = 1_000_000
+    queue_limit_per_user: int = 100_000
+    submission_rate_per_minute: float = 0.0
+
+    def match_config_for_pool(self, pool_name: str) -> MatchConfig:
+        for ps in self.pool_schedulers:
+            if ps.matches(pool_name):
+                return ps.match
+        return self.match
+
+
+def _match_config(d: dict) -> MatchConfig:
+    return MatchConfig(
+        max_jobs_considered=int(d.get("max_jobs_considered", 1000)),
+        scaleback=float(d.get("scaleback", 0.95)),
+        chunk=int(d.get("chunk", 0)),
+        chunk_rounds=int(d.get("chunk_rounds", 6)),
+    )
+
+
+def read_config(path: Optional[str] = None,
+                overrides: Optional[dict] = None) -> Settings:
+    data: dict[str, Any] = {}
+    if path:
+        with open(path) as f:
+            data = json.load(f)
+    if overrides:
+        data.update(overrides)
+    settings = Settings()
+    for key in ("port", "default_pool", "mea_culpa_failure_limit",
+                "rank_interval_s", "match_interval_s",
+                "rebalancer_interval_s", "optimizer_interval_s",
+                "leader_lease_path", "queue_limit_per_pool",
+                "queue_limit_per_user", "submission_rate_per_minute"):
+        if key in data:
+            setattr(settings, key, data[key])
+    if "admins" in data:
+        settings.admins = tuple(data["admins"])
+    if "pools" in data:
+        settings.pools = data["pools"]
+    if "clusters" in data:
+        settings.clusters = data["clusters"]
+    if "rebalancer" in data:
+        rb = data["rebalancer"]
+        settings.rebalancer = RebalancerParams(
+            safe_dru_threshold=float(rb.get("safe_dru_threshold", 1.0)),
+            min_dru_diff=float(rb.get("min_dru_diff", 0.5)),
+            max_preemption=int(rb.get("max_preemption", 100)),
+        )
+    if "match" in data:
+        settings.match = _match_config(data["match"])
+    for ps in data.get("pool_schedulers", []):
+        settings.pool_schedulers.append(
+            PoolSchedulerConfig(
+                pool_regex=ps["pool_regex"],
+                match=_match_config(ps.get("match", {})),
+            )
+        )
+    _validate(settings)
+    return settings
+
+
+def _validate(s: Settings) -> None:
+    if not (0 < s.port < 65536):
+        raise ValueError(f"bad port {s.port}")
+    if s.match.scaleback <= 0 or s.match.scaleback > 1:
+        raise ValueError(f"bad scaleback {s.match.scaleback}")
+    if not s.pools:
+        raise ValueError("at least one pool required")
+    names = [p["name"] for p in s.pools]
+    if len(names) != len(set(names)):
+        raise ValueError("duplicate pool names")

@@ -1,0 +1,175 @@
+"""Leader election, config, and full-process wiring tests (reference:
+components.clj startup + mesos.clj leadership + test_master_slave.py)."""
+import json
+import threading
+import time
+
+import pytest
+import requests
+
+from cook_tpu.components import build_process, shutdown, start_leader_duties
+from cook_tpu.control.leader import (
+    FileLeaseElector,
+    InMemoryElector,
+    LeaderSelector,
+)
+from cook_tpu.utils.config import read_config
+
+
+class TestElectors:
+    def test_in_memory_single_leader(self):
+        a = InMemoryElector("g1", "a")
+        b = InMemoryElector("g1", "b")
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert a.heartbeat()
+        assert not b.heartbeat()
+        a.release()
+        assert b.try_acquire()
+        assert b.current_leader() == "b"
+
+    def test_file_lease_takeover_on_staleness(self, tmp_path):
+        now = [0.0]
+        clock = lambda: now[0]
+        path = str(tmp_path / "lease")
+        a = FileLeaseElector(path, "a", ttl_s=10, clock=clock)
+        b = FileLeaseElector(path, "b", ttl_s=10, clock=clock)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        now[0] += 5
+        assert a.heartbeat()
+        assert not b.try_acquire()
+        now[0] += 11  # lease goes stale (leader died)
+        assert b.try_acquire()
+        assert not a.heartbeat()  # old leader lost
+        assert b.current_leader() == "b"
+
+    def test_selector_fail_fast_on_loss(self):
+        elector = InMemoryElector("g2", "x")
+        lost = threading.Event()
+        sel = LeaderSelector(elector, poll_s=0.01, on_loss=lost.set)
+        sel.wait_for_leadership()
+        assert sel.is_leader
+        t = sel.start_heartbeat_thread()
+        # usurp leadership out from under it
+        InMemoryElector._leaders["g2"] = "usurper"
+        assert lost.wait(timeout=2)
+        t.join(timeout=2)
+        sel.stop()
+        InMemoryElector._leaders.pop("g2", None)
+
+
+class TestConfig:
+    def test_defaults(self):
+        s = read_config(None)
+        assert s.port == 12321
+        assert s.match.max_jobs_considered == 1000
+
+    def test_file_and_pool_schedulers(self, tmp_path):
+        p = tmp_path / "c.json"
+        p.write_text(json.dumps({
+            "port": 4242,
+            "pools": [{"name": "a"}, {"name": "b", "dru_mode": "gpu"}],
+            "match": {"max_jobs_considered": 77},
+            "pool_schedulers": [
+                {"pool_regex": "b.*", "match": {"max_jobs_considered": 5}},
+            ],
+            "rebalancer": {"max_preemption": 9},
+        }))
+        s = read_config(str(p))
+        assert s.port == 4242
+        assert s.match_config_for_pool("a").max_jobs_considered == 77
+        assert s.match_config_for_pool("bxx").max_jobs_considered == 5
+        assert s.rebalancer.max_preemption == 9
+
+    def test_validation(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"port": -1}))
+        with pytest.raises(ValueError):
+            read_config(str(p))
+        p.write_text(json.dumps({"pools": [{"name": "x"}, {"name": "x"}]}))
+        with pytest.raises(ValueError):
+            read_config(str(p))
+
+
+def test_full_process_end_to_end(tmp_path):
+    """Boot a whole node from config: REST + leader loops + mock cluster;
+    submit through HTTP; watch the job complete as virtual cycles fire."""
+    cfg = tmp_path / "config.json"
+    cfg.write_text(json.dumps({
+        "port": 0,  # replaced below
+        "pools": [{"name": "default"}],
+        "clusters": [{
+            "kind": "mock",
+            "name": "m1",
+            "hosts": [{"node_id": "h1", "mem": 4000, "cpus": 8},
+                      {"node_id": "h2", "mem": 4000, "cpus": 8}],
+        }],
+        "rank_interval_s": 3600,   # fire manually
+        "match_interval_s": 3600,
+    }))
+    from cook_tpu.rest.server import free_port
+
+    settings = read_config(str(cfg), {"port": free_port()})
+    process = build_process(settings)
+    try:
+        # standby: not leader yet
+        url = f"http://127.0.0.1:{settings.port}"
+        r = requests.post(f"{url}/jobs", json={"jobs": [
+            {"command": "x", "mem": 100, "cpus": 1, "expected_runtime": 1000}
+        ]}, headers={"X-Cook-Requesting-User": "u1"})
+        assert r.status_code == 201, r.text
+        uuid = r.json()["jobs"][0]
+
+        start_leader_duties(process, block=False,
+                            on_loss=lambda: None)
+        assert process.is_leader()
+        # fire the cycles manually (loops are on 1h timers)
+        loops = {l.name: l for l in process.loops}
+        loops["rank"].fire()
+        loops["match"].fire()
+        r = requests.get(f"{url}/jobs/{uuid}",
+                         headers={"X-Cook-Requesting-User": "u1"})
+        assert r.json()["status"] == "running"
+        # complete on the mock backend
+        process.clusters[0].advance_to(process.store.clock() + 10_000_000)
+        r = requests.get(f"{url}/jobs/{uuid}",
+                         headers={"X-Cook-Requesting-User": "u1"})
+        assert r.json()["status"] == "completed"
+    finally:
+        shutdown(process)
+
+
+def test_two_processes_one_leader(tmp_path):
+    """Hot standby: second process does not become leader while the first
+    holds the lease (reference: test_master_slave)."""
+    lease = str(tmp_path / "lease")
+    from cook_tpu.rest.server import free_port
+    from cook_tpu.utils.config import Settings
+
+    s1 = Settings(port=free_port(), leader_lease_path=lease,
+                  clusters=[], pools=[{"name": "default"}])
+    s2 = Settings(port=free_port(), leader_lease_path=lease,
+                  clusters=[], pools=[{"name": "default"}])
+    p1 = build_process(s1, start_rest=False)
+    p2 = build_process(s2, start_rest=False)
+    try:
+        start_leader_duties(p1, block=False, on_loss=lambda: None)
+        assert p1.is_leader()
+        got_leadership = threading.Event()
+
+        def try2():
+            p2.selector_thread_started = True
+            start_leader_duties(p2, block=False, on_loss=lambda: None)
+            got_leadership.set()
+
+        t = threading.Thread(target=try2, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert not got_leadership.is_set()  # standby waits
+        shutdown(p1)  # leader releases
+        assert got_leadership.wait(timeout=15)
+        assert p2.is_leader()
+    finally:
+        shutdown(p2)
+        shutdown(p1)

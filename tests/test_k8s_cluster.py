@@ -1,0 +1,146 @@
+"""K8s backend tests (reference: test/cook/test/kubernetes/{api,controller,
+compute_cluster}.clj): synthesized offers, controller state machine,
+autoscaling, anti-entropy, failover recovery."""
+import pytest
+
+from cook_tpu.cluster.base import TaskSpec
+from cook_tpu.cluster.k8s import (
+    ExpectedState,
+    FakeKubeApi,
+    KubeCluster,
+    KubeNode,
+    KubePod,
+    PodPhase,
+)
+from cook_tpu.models.entities import InstanceStatus, JobState, Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.core import Scheduler
+from tests.conftest import FakeClock, make_job
+
+
+def make_cluster(n_nodes=2, mem=4000.0, cpus=8.0):
+    clock = FakeClock()
+    api = FakeKubeApi([
+        KubeNode(name=f"node{i}", mem=mem, cpus=cpus) for i in range(n_nodes)
+    ])
+    cluster = KubeCluster("k8s", api, clock)
+    return clock, api, cluster
+
+
+def spec(task_id, node, mem=100.0, cpus=1.0):
+    return TaskSpec(task_id=task_id, job_uuid="j", user="u", command="c",
+                    mem=mem, cpus=cpus, gpus=0.0, node_id=node, hostname=node)
+
+
+def test_synthesized_offers_subtract_consumption():
+    clock, api, cluster = make_cluster()
+    offers = {o.node_id: o for o in cluster.pending_offers("default")}
+    assert offers["node0"].mem == 4000.0
+    cluster.launch_tasks("default", [spec("t1", "node0", mem=1000, cpus=2)])
+    offers = {o.node_id: o for o in cluster.pending_offers("default")}
+    assert offers["node0"].mem == 3000.0
+    assert offers["node0"].cpus == 6.0
+    assert offers["node0"].total_mem == 4000.0
+    assert offers["node1"].mem == 4000.0
+
+
+def test_controller_lifecycle_success():
+    clock, api, cluster = make_cluster()
+    events = []
+    cluster.status_callback = lambda t, s, r: events.append((t, s, r))
+    cluster.launch_tasks("default", [spec("t1", "node0")])
+    assert cluster.expected["t1"] == ExpectedState.STARTING
+    api.tick()  # pod starts running
+    assert ("t1", InstanceStatus.RUNNING, None) in events
+    assert cluster.expected["t1"] == ExpectedState.RUNNING
+    api.finish_pod("t1")
+    assert ("t1", InstanceStatus.SUCCESS, "normal-exit") in events
+    # terminal pod is deleted
+    assert api.pods.get("t1") is None
+
+
+def test_controller_kill_deletes_pod():
+    clock, api, cluster = make_cluster()
+    events = []
+    cluster.status_callback = lambda t, s, r: events.append((t, s, r))
+    cluster.launch_tasks("default", [spec("t1", "node0")])
+    api.tick()
+    cluster.kill_task("t1")
+    assert api.pods.get("t1") is None
+    assert ("t1", InstanceStatus.FAILED, "killed-by-user") in events
+
+
+def test_controller_pod_failure_reports_reason():
+    clock, api, cluster = make_cluster()
+    events = []
+    cluster.status_callback = lambda t, s, r: events.append((t, s, r))
+    cluster.launch_tasks("default", [spec("t1", "node0")])
+    api.tick()
+    api.finish_pod("t1", failed=True, reason="container-limitation-memory")
+    assert ("t1", InstanceStatus.FAILED, "container-limitation-memory") in events
+
+
+def test_node_loss_is_mea_culpa_failure():
+    clock, api, cluster = make_cluster()
+    events = []
+    cluster.status_callback = lambda t, s, r: events.append((t, s, r))
+    cluster.launch_tasks("default", [spec("t1", "node0")])
+    api.tick()
+    api.remove_node("node0")
+    assert ("t1", InstanceStatus.FAILED, "node-removed") in events
+
+
+def test_orphan_pod_killed_by_scan():
+    clock, api, cluster = make_cluster()
+    api.create_pod(KubePod(name="orphan", node_name="node0", mem=1, cpus=1,
+                           phase=PodPhase.RUNNING))
+    cluster.scan_all()
+    assert api.pods.get("orphan") is None
+
+
+def test_failover_recovery():
+    # a pod from the previous leader exists BEFORE this leader's cluster
+    # object attaches its watches (the real failover ordering:
+    # initialize-cluster reconstructs expected state, then starts watches)
+    clock = FakeClock()
+    api = FakeKubeApi([KubeNode(name="node0", mem=4000, cpus=8)])
+    api.create_pod(KubePod(name="t9", node_name="node0", mem=1, cpus=1,
+                           phase=PodPhase.RUNNING))
+    cluster = KubeCluster("k8s", api, clock)
+    events = []
+    cluster.status_callback = lambda t, s, r: events.append((t, s, r))
+    cluster.determine_expected_state_on_startup({"t9"})
+    assert cluster.expected["t9"] == ExpectedState.RUNNING
+    api.finish_pod("t9")
+    assert ("t9", InstanceStatus.SUCCESS, "normal-exit") in events
+
+
+def test_autoscale_synthetic_pods_bounded():
+    clock, api, cluster = make_cluster()
+    demand = [spec(f"p{i}", "", mem=100, cpus=1) for i in range(200)]
+    cluster.autoscale("default", demand)
+    synth = cluster.synthetic_pods()
+    assert len(synth) == 128  # max-pods-outstanding cap
+    cluster.autoscale("default", demand)
+    assert len(cluster.synthetic_pods()) == 128  # still capped
+
+
+def test_end_to_end_with_scheduler():
+    """Full stack on the k8s backend: submit -> match -> pod -> success."""
+    clock = FakeClock()
+    api = FakeKubeApi([KubeNode(name="node0", mem=4000, cpus=8)])
+    cluster = KubeCluster("k8s", api, clock)
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    scheduler = Scheduler(store, [cluster])
+    job = make_job(mem=100, cpus=1)
+    store.submit_jobs([job])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 1
+    api.tick()
+    assert store.jobs[job.uuid].state == JobState.RUNNING
+    [task_id] = [i.task_id for i in store.job_instances(job.uuid)]
+    api.finish_pod(task_id)
+    assert store.jobs[job.uuid].state == JobState.COMPLETED
