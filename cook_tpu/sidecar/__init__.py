@@ -1,0 +1,3 @@
+"""Pod sidecar: sandbox file server + progress reporting
+(reference: sidecar/)."""
+from cook_tpu.sidecar.fileserver import FileServer  # noqa: F401

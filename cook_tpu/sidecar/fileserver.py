@@ -1,0 +1,97 @@
+"""Sandbox file server: the pod sidecar for `cs ls/cat/tail`.
+
+Reference: sidecar/ (/root/reference/sidecar/file_server.py:45-233 — a
+small HTTP server replicating the Mesos `files/` API inside the pod:
+/files/browse, /files/read, /files/download, rooted at COOK_WORKDIR).
+Serves the same three endpoints with path traversal protection.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from aiohttp import web
+
+
+class FileServer:
+    def __init__(self, workdir: str):
+        self.workdir = os.path.abspath(workdir)
+
+    def _resolve(self, path: str) -> Optional[str]:
+        """Resolve a requested path inside the sandbox; None if it escapes."""
+        if not path:
+            return None
+        full = os.path.abspath(
+            path if os.path.isabs(path) else os.path.join(self.workdir, path)
+        )
+        if full != self.workdir and not full.startswith(self.workdir + os.sep):
+            return None
+        return full
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/files/browse", self.browse)
+        app.router.add_get("/files/read", self.read)
+        app.router.add_get("/files/download", self.download)
+        return app
+
+    async def browse(self, request: web.Request) -> web.Response:
+        path = self._resolve(request.query.get("path", self.workdir))
+        if path is None or not os.path.exists(path):
+            return web.json_response({"error": "no such path"}, status=404)
+        if not os.path.isdir(path):
+            return web.json_response({"error": "not a directory"}, status=400)
+        entries = []
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            stat = os.stat(full)
+            entries.append({
+                "path": full,
+                "size": stat.st_size,
+                "nlink": stat.st_nlink,
+                "mtime": int(stat.st_mtime),
+                "mode": ("d" if os.path.isdir(full) else "-"),
+            })
+        return web.json_response(entries)
+
+    async def read(self, request: web.Request) -> web.Response:
+        """Mesos-style paged read: ?path=&offset=&length=.
+        offset=-1 returns just the file size (how `cs tail` seeks)."""
+        path = self._resolve(request.query.get("path", ""))
+        if path is None or not os.path.isfile(path):
+            return web.json_response({"error": "no such file"}, status=404)
+        size = os.path.getsize(path)
+        offset = int(request.query.get("offset", 0))
+        if offset == -1:
+            return web.json_response({"offset": size, "data": ""})
+        length = min(int(request.query.get("length", 64 * 1024)), 1024 * 1024)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(length)
+        return web.json_response({
+            "offset": offset,
+            "data": data.decode(errors="replace"),
+        })
+
+    async def download(self, request: web.Request) -> web.Response:
+        path = self._resolve(request.query.get("path", ""))
+        if path is None or not os.path.isfile(path):
+            return web.json_response({"error": "no such file"}, status=404)
+        return web.FileResponse(path)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="cook-sidecar-fileserver")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--workdir",
+                        default=os.environ.get("COOK_WORKDIR", "."))
+    args = parser.parse_args(argv)
+    web.run_app(FileServer(args.workdir).build_app(), port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,142 @@
+"""Executor + sidecar tests (reference: executor/tests, sidecar tests)."""
+import asyncio
+import threading
+import time
+
+import pytest
+import requests
+
+from cook_tpu.executor.runner import ExecutorConfig, TaskRunner, TaskUpdate
+from cook_tpu.sidecar.fileserver import FileServer
+
+
+class Sink:
+    def __init__(self):
+        self.updates = []
+
+    def __call__(self, u: TaskUpdate):
+        self.updates.append(u)
+
+    def of_kind(self, kind):
+        return [u for u in self.updates if u.kind == kind]
+
+
+def test_executor_success(tmp_path):
+    sink = Sink()
+    runner = TaskRunner(
+        "t1", "echo out1 && echo err1 >&2 && exit 0", sink,
+        ExecutorConfig(sandbox_dir=str(tmp_path / "sb")),
+    )
+    code = runner.run()
+    assert code == 0
+    statuses = [u.status for u in sink.of_kind("status")]
+    assert statuses == ["running", "success"]
+    [exit_update] = sink.of_kind("exit-code")
+    assert exit_update.exit_code == 0
+    assert (tmp_path / "sb" / "stdout").read_text() == "out1\n"
+    assert (tmp_path / "sb" / "stderr").read_text() == "err1\n"
+    [sandbox] = sink.of_kind("sandbox")
+    assert sandbox.sandbox.endswith("sb")
+
+
+def test_executor_failure_exit_code(tmp_path):
+    sink = Sink()
+    runner = TaskRunner("t2", "exit 3", sink,
+                        ExecutorConfig(sandbox_dir=str(tmp_path)))
+    assert runner.run() == 3
+    assert sink.of_kind("status")[-1].status == "failed"
+    assert sink.of_kind("exit-code")[0].exit_code == 3
+
+
+def test_executor_progress_scraping(tmp_path):
+    sink = Sink()
+    runner = TaskRunner(
+        "t3",
+        "echo 'progress: 25 quarter done'; echo 'progress: 50 half'; "
+        "echo not progress; echo 'progress: 100'",
+        sink,
+        ExecutorConfig(sandbox_dir=str(tmp_path),
+                       progress_sample_interval_s=0.0),
+    )
+    runner.run()
+    progresses = [(u.progress, u.progress_message)
+                  for u in sink.of_kind("progress")]
+    assert (25, "quarter done") in progresses
+    assert progresses[-1][0] == 100
+    # monotone
+    values = [p for p, _ in progresses]
+    assert values == sorted(values)
+
+
+def test_executor_kill(tmp_path):
+    sink = Sink()
+    runner = TaskRunner("t4", "sleep 30", sink,
+                        ExecutorConfig(sandbox_dir=str(tmp_path),
+                                       shutdown_grace_s=0.2))
+    t = threading.Thread(target=runner.run)
+    t.start()
+    for _ in range(100):
+        if runner.proc is not None:
+            break
+        time.sleep(0.01)
+    runner.kill()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert sink.of_kind("status")[-1].status == "failed"
+
+
+@pytest.fixture
+def fileserver(tmp_path):
+    (tmp_path / "stdout").write_text("hello sandbox\n" * 10)
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "data.txt").write_text("nested")
+    server = FileServer(str(tmp_path))
+    # run aiohttp app on a thread
+    from cook_tpu.rest.server import free_port
+
+    port = free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(server.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    assert started.wait(5)
+    yield f"http://127.0.0.1:{port}", tmp_path
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_fileserver_browse_read_download(fileserver):
+    url, tmp_path = fileserver
+    entries = requests.get(f"{url}/files/browse").json()
+    names = [e["path"].rsplit("/", 1)[-1] for e in entries]
+    assert "stdout" in names and "sub" in names
+    # read with offset paging
+    r = requests.get(f"{url}/files/read",
+                     params={"path": "stdout", "offset": 6, "length": 7}).json()
+    assert r["data"] == "sandbox"
+    # offset=-1 returns the size (tail seeks with this)
+    r = requests.get(f"{url}/files/read",
+                     params={"path": "stdout", "offset": -1}).json()
+    assert r["offset"] == len("hello sandbox\n") * 10
+    # download
+    r = requests.get(f"{url}/files/download", params={"path": "sub/data.txt"})
+    assert r.text == "nested"
+
+
+def test_fileserver_blocks_traversal(fileserver):
+    url, _ = fileserver
+    r = requests.get(f"{url}/files/read", params={"path": "../../etc/passwd"})
+    assert r.status_code == 404
+    r = requests.get(f"{url}/files/read", params={"path": "/etc/passwd"})
+    assert r.status_code == 404
