@@ -261,7 +261,28 @@ def match_pool(
         with cluster.kill_lock.read():
             cluster.launch_tasks(pool.name, specs)
 
-    # 4. head-of-queue backoff
+    # 4. autoscaling: surface unmatched demand to autoscaling clusters
+    # (trigger-autoscaling!, scheduler.clj:1178,1509)
+    if outcome.unmatched:
+        demand = [
+            TaskSpec(
+                task_id=f"pending-{job.uuid}",
+                job_uuid=job.uuid,
+                user=job.user,
+                command=job.command,
+                mem=job.resources.mem,
+                cpus=job.resources.cpus,
+                gpus=job.resources.gpus,
+                node_id="",
+                hostname="",
+            )
+            for job in outcome.unmatched
+        ]
+        for cluster in clusters:
+            if cluster.accepts_work and cluster.autoscaling(pool.name):
+                cluster.autoscale(pool.name, demand)
+
+    # 5. head-of-queue backoff
     head = considerable[0]
     outcome.head_matched = any(j.uuid == head.uuid for j, _ in outcome.matched)
     _apply_backoff(config, state, outcome.head_matched)

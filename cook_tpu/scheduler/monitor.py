@@ -1,0 +1,72 @@
+"""Periodic stats gauges: per-user/pool usage, waiting counts, starvation.
+
+Reference: cook.monitor (/root/reference/scheduler/src/cook/monitor.clj):
+`set-stats-counters!` publishes per-pool gauges of running/waiting users
+and resources, total utilization, and "starved" users — users below their
+share who have waiting work (monitor.clj:177).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cook_tpu.models.entities import Resources
+from cook_tpu.models.store import JobStore
+from cook_tpu.utils.metrics import global_registry
+
+
+@dataclass
+class PoolStats:
+    running_jobs: int
+    waiting_jobs: int
+    running_users: int
+    waiting_users: int
+    starved_users: int
+    used: Resources
+    waiting_demand: Resources
+
+
+def collect_pool_stats(store: JobStore, pool: str) -> PoolStats:
+    running = store.running_jobs(pool)
+    waiting = store.pending_jobs(pool)
+    usage = store.user_usage(pool)
+    waiting_users = {j.user for j in waiting}
+    used = Resources()
+    for r in usage.values():
+        used = used + r
+    demand = Resources()
+    for job in waiting:
+        demand = demand + job.resources
+
+    starved = 0
+    for user in waiting_users:
+        share = store.get_share(user, pool)
+        u = usage.get(user, Resources())
+        # starved: waiting work while using less than their share
+        if (u.mem < share.mem and u.cpus < share.cpus) or not usage.get(user):
+            starved += 1
+
+    stats = PoolStats(
+        running_jobs=len(running),
+        waiting_jobs=len(waiting),
+        running_users=len(usage),
+        waiting_users=len(waiting_users),
+        starved_users=starved,
+        used=used,
+        waiting_demand=demand,
+    )
+    labels = {"pool": pool}
+    g = global_registry.gauge
+    g("monitor.running_jobs").set(stats.running_jobs, labels)
+    g("monitor.waiting_jobs").set(stats.waiting_jobs, labels)
+    g("monitor.running_users").set(stats.running_users, labels)
+    g("monitor.waiting_users").set(stats.waiting_users, labels)
+    g("monitor.starved_users").set(stats.starved_users, labels)
+    g("monitor.used_mem").set(stats.used.mem, labels)
+    g("monitor.used_cpus").set(stats.used.cpus, labels)
+    g("monitor.waiting_mem").set(stats.waiting_demand.mem, labels)
+    g("monitor.waiting_cpus").set(stats.waiting_demand.cpus, labels)
+    return stats
+
+
+def collect_all(store: JobStore) -> dict[str, PoolStats]:
+    return {pool: collect_pool_stats(store, pool) for pool in store.pools}

@@ -1,0 +1,167 @@
+"""Aux subsystem tests: progress aggregation, heartbeats, monitor gauges,
+sandbox publishing, autoscale wiring, lingering/straggler killers."""
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import (
+    DEFAULT_USER,
+    Group,
+    InstanceStatus,
+    JobState,
+    Pool,
+    Resources,
+    Share,
+    StragglerHandling,
+)
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.core import Scheduler
+from cook_tpu.scheduler.heartbeat import HeartbeatMonitor
+from cook_tpu.scheduler.monitor import collect_pool_stats
+from cook_tpu.scheduler.progress import ProgressAggregator, ProgressUpdate
+from cook_tpu.scheduler.sandbox import SandboxPublisher
+from tests.conftest import FakeClock, make_job
+
+
+def setup(n_hosts=2, cpus=8.0):
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock",
+        [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=cpus)
+         for i in range(n_hosts)],
+        clock=clock,
+    )
+    scheduler = Scheduler(store, [cluster])
+    return clock, store, cluster, scheduler
+
+
+def run_job(store, scheduler, job):
+    store.submit_jobs([job])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    return store.job_instances(job.uuid)[-1]
+
+
+class TestProgress:
+    def test_newest_sequence_wins_and_batch_publish(self):
+        clock, store, cluster, scheduler = setup()
+        inst = run_job(store, scheduler, make_job())
+        agg = ProgressAggregator(store)
+        assert agg.handle(ProgressUpdate(inst.task_id, 2, 40, "later"))
+        assert not agg.handle(ProgressUpdate(inst.task_id, 1, 99, "stale"))
+        assert agg.publish() == 1
+        assert store.instances[inst.task_id].progress == 40
+
+    def test_pending_cap_drops(self):
+        clock, store, *_ = setup()
+        agg = ProgressAggregator(store, max_pending=2)
+        assert agg.handle(ProgressUpdate("a", 1, 1))
+        assert agg.handle(ProgressUpdate("b", 1, 1))
+        assert not agg.handle(ProgressUpdate("c", 1, 1))
+        assert agg.dropped == 1
+        # updating an existing key is always allowed
+        assert agg.handle(ProgressUpdate("a", 2, 2))
+
+
+class TestHeartbeat:
+    def test_silent_task_killed_mea_culpa(self):
+        clock, store, cluster, scheduler = setup()
+        job = make_job(max_retries=2)
+        inst = run_job(store, scheduler, job)
+        killed_by_backend = []
+        hb = HeartbeatMonitor(store, killed_by_backend.append,
+                              timeout_ms=60_000)
+        hb.track(inst.task_id)
+        clock.advance(30_000)
+        hb.notify(inst.task_id)
+        assert hb.check() == []
+        clock.advance(61_000)
+        assert hb.check() == [inst.task_id]
+        assert killed_by_backend == [inst.task_id]
+        # mea-culpa: job went back to waiting without using its retry
+        assert store.jobs[job.uuid].state == JobState.WAITING
+
+
+class TestMonitorGauges:
+    def test_starved_user_detection(self):
+        clock, store, cluster, scheduler = setup(n_hosts=1, cpus=4.0)
+        store.set_share(Share(user=DEFAULT_USER, pool="default",
+                              resources=Resources(mem=2000, cpus=4, gpus=1)))
+        # hog fills the cluster; starved user waits
+        for i in range(2):
+            run_job(store, scheduler, make_job(user="hog", cpus=2))
+        store.submit_jobs([make_job(user="starved", cpus=2)])
+        stats = collect_pool_stats(store, "default")
+        assert stats.running_jobs == 2
+        assert stats.waiting_jobs == 1
+        assert stats.starved_users == 1
+        assert stats.used.cpus == 4
+
+
+class TestSandboxPublisher:
+    def test_batched_publish(self):
+        clock, store, cluster, scheduler = setup()
+        inst = run_job(store, scheduler, make_job())
+        pub = SandboxPublisher(store)
+        pub.record_sandbox(inst.task_id, "/sandbox/t1")
+        pub.record_exit_code(inst.task_id, 0)
+        assert pub.pending_count == 1
+        assert pub.publish() == 1
+        assert store.instances[inst.task_id].sandbox_directory == "/sandbox/t1"
+        assert store.instances[inst.task_id].exit_code == 0
+
+
+class TestAutoscaleWiring:
+    def test_unmatched_demand_reaches_autoscaler(self):
+        from cook_tpu.cluster.k8s import FakeKubeApi, KubeCluster, KubeNode
+
+        clock = FakeClock()
+        api = FakeKubeApi([KubeNode(name="n0", mem=100, cpus=1)])
+        cluster = KubeCluster("k8s", api, clock)
+        store = JobStore(clock=clock)
+        store.set_pool(Pool(name="default"))
+        scheduler = Scheduler(store, [cluster])
+        # demand far beyond capacity
+        store.submit_jobs([make_job(mem=5000, cpus=4) for _ in range(3)])
+        pool = store.pools["default"]
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+        synth = cluster.synthetic_pods()
+        assert len(synth) == 3
+        assert all(p.mem == 5000 for p in synth)
+
+
+class TestKillers:
+    def test_lingering_task_killed_at_max_runtime(self):
+        clock, store, cluster, scheduler = setup()
+        job = make_job(max_runtime_ms=50_000, max_retries=5)
+        inst = run_job(store, scheduler, job)
+        clock.advance(60_000)
+        killed = scheduler.kill_lingering_tasks(clock())
+        assert killed == [inst.task_id]
+        # max-runtime is NOT mea-culpa: consumed the only retry path check
+        final = store.instances[inst.task_id]
+        assert final.reason_code == 2003
+
+    def test_straggler_killed_by_quantile_rule(self):
+        clock, store, cluster, scheduler = setup(n_hosts=4)
+        group = Group(
+            uuid="g1",
+            straggler_handling=StragglerHandling(
+                type="quantile-deviation", quantile=0.5, multiplier=2.0),
+        )
+        jobs = [make_job(group_uuid="g1", max_retries=5) for _ in range(4)]
+        store.submit_jobs(jobs, [group])
+        pool = store.pools["default"]
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+        insts = [store.job_instances(j.uuid)[0] for j in jobs]
+        # three complete quickly, one straggles
+        for inst in insts[:3]:
+            clock.advance(10_000)
+            store.update_instance_state(inst.task_id, InstanceStatus.SUCCESS,
+                                        "normal-exit")
+        clock.advance(100_000)  # straggler now way past 2x median
+        killed = scheduler.kill_stragglers(clock())
+        assert killed == [insts[3].task_id]
+        assert store.instances[insts[3].task_id].reason_code == 2004
