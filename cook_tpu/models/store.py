@@ -320,6 +320,27 @@ class JobStore:
             )
             return job
 
+    def move_job_pool(self, job_uuid: str, new_pool: str) -> bool:
+        """Move a WAITING job to another pool (reference:
+        plugins/pool_mover.clj — only pending jobs may move)."""
+        with self._lock:
+            job = self.jobs.get(job_uuid)
+            if job is None or job.state != JobState.WAITING:
+                return False
+            if new_pool not in self.pools:
+                return False
+            old_pool = job.pool
+            self._pool_pending.get(old_pool, set()).discard(job_uuid)
+            job = job.with_(pool=new_pool)
+            self.jobs[job_uuid] = job
+            self._index_job(job, None)
+            self._fan_out([
+                self._emit("job/pool-moved",
+                           {"uuid": job_uuid, "from": old_pool,
+                            "to": new_pool})
+            ])
+            return True
+
     def update_instance_progress(
         self, task_id: str, progress: int, message: str = ""
     ) -> bool:

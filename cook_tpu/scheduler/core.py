@@ -67,6 +67,9 @@ class Scheduler:
         self.pool_match_state: dict[str, PoolMatchState] = {}
         self.last_unmatched_offers: dict[str, dict[str, Resources]] = {}
         self.placement_failures: dict[str, str] = {}  # job uuid -> reason text
+        # rebalancer host reservations: hostname -> reserving job uuid
+        # (reserve-hosts!, rebalancer.clj:419)
+        self.host_reservations: dict[str, str] = {}
         self.metrics: dict[str, float] = {}
         store.add_watcher(self._on_event)
         for cluster in self.clusters:
@@ -131,10 +134,17 @@ class Scheduler:
             state,
             make_task_id=self._make_task_id,
             record_placement_failure=self._record_placement_failure,
+            host_reservations=self.host_reservations,
         )
         # cache spare resources for the rebalancer (view-incubating-offers,
         # scheduler.clj:1537): offers minus what this cycle just placed
         matched_uuids = {j.uuid for j, _ in outcome.matched}
+        # launched jobs release their host reservations
+        if self.host_reservations:
+            self.host_reservations = {
+                host: uuid for host, uuid in self.host_reservations.items()
+                if uuid not in matched_uuids
+            }
         queue.jobs = [j for j in queue.jobs if j.uuid not in matched_uuids]
         self._cache_spare(pool)
         self.metrics[f"match.{pool.name}.matched"] = len(outcome.matched)
@@ -160,6 +170,10 @@ class Scheduler:
         )
         for decision in decisions:
             self._transact_preemption(decision)
+            if len(decision.task_ids) > 1:
+                # multi-task preemptions reserve the host for the job they
+                # made room for, so the next match sends it there
+                self.host_reservations[decision.hostname] = decision.job.uuid
         self.metrics[f"rebalance.{pool.name}.preempted"] = sum(
             len(d.task_ids) for d in decisions
         )

@@ -164,6 +164,7 @@ def match_pool(
     make_task_id: Callable[[Job], str],
     launch_filter: Optional[Callable[[Job], bool]] = None,
     record_placement_failure: Optional[Callable[[Job, str], None]] = None,
+    host_reservations: Optional[dict[str, str]] = None,
 ) -> MatchOutcome:
     """One pool's match cycle end to end."""
     outcome = MatchOutcome()
@@ -198,6 +199,15 @@ def match_pool(
         group_attr_value=group_attr_value,
         groups=groups,
     )
+    if host_reservations:
+        # rebalancer reservations (constraints.clj:242 + reserve-hosts!,
+        # rebalancer.clj:419): a reserved host only accepts its reserving job
+        reserved_for = np.array(
+            [host_reservations.get(o.hostname, "") for o in nodes.offers]
+        )
+        has_reservation = reserved_for != ""
+        for ji, job in enumerate(considerable):
+            feasible[ji] &= ~has_reservation | (reserved_for == job.uuid)
 
     # 2. the solve
     problem = build_match_problem(considerable, nodes, feasible,

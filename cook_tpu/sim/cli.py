@@ -1,0 +1,150 @@
+"""Simulator CLI: trace replay from the command line.
+
+Reference: the zz_simulator entry points + docs/simulator.md — JSON trace
+in, CSV run-trace out, plus `compare` for determinism/equivalence checking
+between two run traces (`traces-equivalent?`, zz_simulator.clj:714).
+
+    python -m cook_tpu.sim.cli run --trace trace.json --out run.csv
+    python -m cook_tpu.sim.cli synth --jobs 1000 --hosts 100 --out trace.json
+    python -m cook_tpu.sim.cli compare run1.csv run2.csv
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+
+from cook_tpu.scheduler.core import SchedulerConfig
+from cook_tpu.scheduler.matcher import MatchConfig
+from cook_tpu.scheduler.rebalancer import RebalancerParams
+from cook_tpu.sim.simulator import (
+    SimConfig,
+    Simulator,
+    load_trace,
+    synth_trace,
+)
+
+
+def cmd_run(args) -> int:
+    jobs, hosts = load_trace(args.trace)
+    config = SimConfig(
+        cycle_ms=args.cycle_ms,
+        rebalance_every=args.rebalance_every,
+        max_cycles=args.max_cycles,
+        scheduler=SchedulerConfig(
+            match=MatchConfig(chunk=args.chunk,
+                              max_jobs_considered=args.considerable),
+            rebalancer=RebalancerParams(),
+        ),
+    )
+    sim = Simulator(jobs, hosts, config)
+    result = sim.run()
+    with open(args.out, "w") as f:
+        f.write(result.to_csv())
+    completed = sum(1 for r in result.rows if r["status"] == "success")
+    p50 = (sorted(result.cycle_wall_s)[len(result.cycle_wall_s) // 2] * 1000
+           if result.cycle_wall_s else 0.0)
+    print(json.dumps({
+        "cycles": result.cycles,
+        "virtual_ms": result.virtual_ms,
+        "jobs": len(jobs),
+        "completed": completed,
+        "utilization": round(result.utilization(hosts), 4),
+        "cycle_wall_p50_ms": round(p50, 2),
+        "phase_wall_s": {k: round(v, 3)
+                         for k, v in result.phase_wall_s.items()},
+    }))
+    return 0
+
+
+def cmd_synth(args) -> int:
+    jobs, hosts = synth_trace(
+        args.jobs, args.hosts, n_users=args.users, seed=args.seed,
+        mean_runtime_ms=args.mean_runtime_ms,
+        submit_span_ms=args.submit_span_ms,
+    )
+    with open(args.out, "w") as f:
+        json.dump({
+            "jobs": [vars(j) for j in jobs],
+            "hosts": [
+                {k: (dict(v) if k == "attributes" else v)
+                 for k, v in vars(h).items()}
+                for h in hosts
+            ],
+        }, f)
+    print(f"wrote {len(jobs)} jobs / {len(hosts)} hosts to {args.out}")
+    return 0
+
+
+def load_rows(path: str) -> list[dict]:
+    with open(path) as f:
+        return list(csv.DictReader(f))
+
+
+def traces_equivalent(rows1: list[dict], rows2: list[dict],
+                      *, keys=("job_uuid", "start_ms", "host", "status")
+                      ) -> tuple[bool, list[str]]:
+    """Order-insensitive equality on the decision-relevant columns."""
+    def norm(rows):
+        return sorted(tuple(r.get(k, "") for k in keys) for r in rows)
+
+    n1, n2 = norm(rows1), norm(rows2)
+    if n1 == n2:
+        return True, []
+    diffs = []
+    s1, s2 = set(n1), set(n2)
+    for row in list(s1 - s2)[:10]:
+        diffs.append(f"only in first:  {row}")
+    for row in list(s2 - s1)[:10]:
+        diffs.append(f"only in second: {row}")
+    return False, diffs
+
+
+def cmd_compare(args) -> int:
+    ok, diffs = traces_equivalent(load_rows(args.trace1),
+                                  load_rows(args.trace2))
+    if ok:
+        print("traces equivalent")
+        return 0
+    print("traces DIFFER:")
+    for d in diffs:
+        print(" ", d)
+    return 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cook-tpu-sim")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("run", help="replay a trace")
+    r.add_argument("--trace", required=True)
+    r.add_argument("--out", default="run.csv")
+    r.add_argument("--cycle-ms", type=int, default=30_000)
+    r.add_argument("--rebalance-every", type=int, default=0)
+    r.add_argument("--max-cycles", type=int, default=10_000)
+    r.add_argument("--chunk", type=int, default=0)
+    r.add_argument("--considerable", type=int, default=1000)
+    r.set_defaults(fn=cmd_run)
+
+    s = sub.add_parser("synth", help="generate a synthetic trace")
+    s.add_argument("--jobs", type=int, default=1000)
+    s.add_argument("--hosts", type=int, default=100)
+    s.add_argument("--users", type=int, default=10)
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--mean-runtime-ms", type=int, default=120_000)
+    s.add_argument("--submit-span-ms", type=int, default=300_000)
+    s.add_argument("--out", default="trace.json")
+    s.set_defaults(fn=cmd_synth)
+
+    c = sub.add_parser("compare", help="diff two run traces")
+    c.add_argument("trace1")
+    c.add_argument("trace2")
+    c.set_defaults(fn=cmd_compare)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

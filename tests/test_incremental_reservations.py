@@ -1,0 +1,115 @@
+"""Incremental config rollout, rebalancer host reservations, pool moves."""
+import numpy as np
+
+from cook_tpu.models.entities import (
+    DEFAULT_USER,
+    JobState,
+    Pool,
+    Resources,
+    Share,
+)
+from cook_tpu.utils.incremental import (
+    resolve_incremental,
+    select_from_values,
+    write_incremental,
+)
+from tests.conftest import make_job
+
+
+def test_select_from_values_distribution():
+    values = [{"value": "a", "portion": 0.3}, {"value": "b", "portion": 0.7}]
+    picks = [select_from_values(values, f"entity-{i}") for i in range(2000)]
+    frac_a = picks.count("a") / len(picks)
+    assert 0.25 < frac_a < 0.35
+    # deterministic per entity
+    assert select_from_values(values, "x") == select_from_values(values, "x")
+
+
+def test_incremental_roundtrip(store):
+    write_incremental(store, "container-default",
+                      [{"value": "img:v2", "portion": 1.0}])
+    assert resolve_incremental(store, "container-default", "job-1") == "img:v2"
+    assert resolve_incremental(store, "missing", "job-1", "fallback") == "fallback"
+
+
+def test_pool_move(store):
+    store.set_pool(Pool(name="other"))
+    job = make_job()
+    store.submit_jobs([job])
+    assert store.move_job_pool(job.uuid, "other")
+    assert store.jobs[job.uuid].pool == "other"
+    assert store.pending_jobs("other")[0].uuid == job.uuid
+    assert not store.pending_jobs("default")
+    # running jobs may not move
+    store.create_instance(job.uuid, "t1", hostname="h1")
+    assert not store.move_job_pool(job.uuid, "default")
+
+
+def test_reservation_steers_matcher():
+    """A host reserved for job X must reject other jobs and accept X."""
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler
+    from tests.conftest import FakeClock
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m", [MockHost(node_id="h0", hostname="h0", mem=1000, cpus=8),
+              MockHost(node_id="h1", hostname="h1", mem=1000, cpus=8)],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    target = make_job(user="vip", cpus=1)
+    other = make_job(user="other", cpus=1, priority=99)  # would match first
+    store.submit_jobs([target, other])
+    scheduler.host_reservations["h0"] = target.uuid
+    scheduler.host_reservations["h1"] = target.uuid  # reserve everything
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    matched = {j.uuid: o.hostname for j, o in outcome.matched}
+    assert target.uuid in matched
+    assert other.uuid not in matched
+    # reservation released once the job launched
+    assert not scheduler.host_reservations
+
+
+def test_rebalancer_multi_task_decision_creates_reservation():
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from cook_tpu.scheduler.rebalancer import RebalancerParams
+    from tests.conftest import FakeClock
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=400, cpus=4, gpus=1)))
+    # the starved user has a large share, so its pending dru is low and the
+    # hog's tasks exceed it by more than min-dru-diff
+    store.set_share(Share(user="starved", pool="default",
+                          resources=Resources(mem=1600, cpus=16, gpus=1)))
+    cluster = MockCluster(
+        "m", [MockHost(node_id="h0", hostname="h0", mem=800, cpus=8)],
+        clock=clock)
+    scheduler = Scheduler(
+        store, [cluster],
+        SchedulerConfig(rebalancer=RebalancerParams(
+            safe_dru_threshold=0.0, min_dru_diff=0.01, max_preemption=5)),
+    )
+    pool = store.pools["default"]
+    # hog runs two tasks filling the host
+    for i in range(2):
+        job = make_job(user="hog", mem=400, cpus=4)
+        store.submit_jobs([job])
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+    # starved user's big job needs BOTH slots -> multi-task preemption
+    big = make_job(user="starved", mem=800, cpus=8)
+    store.submit_jobs([big])
+    scheduler.rank_cycle(pool)
+    decisions = scheduler.rebalance_cycle(pool)
+    assert decisions and len(decisions[0].task_ids) == 2
+    assert scheduler.host_reservations == {"h0": big.uuid}
