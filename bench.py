@@ -1,13 +1,16 @@
 """Benchmark: scheduling-cycle latency at the BASELINE.md north-star scale.
 
-Measures the TPU match solve (the Fenzo replacement) on the headline config
-— 100k pending jobs x 10k nodes, one cycle — against the reference-faithful
-CPU greedy baseline (same decisions, numpy-vectorized inner loop), plus
-packing-efficiency parity on a smaller exactly-comparable config.
+Measures the TPU solves against the strongest honest CPU baseline (the C++
+sequential greedy in native/cook_native.cc — identical decisions to the
+reference-style Fenzo greedy; numpy fallback when no toolchain):
+
+  * headline: match cycle, 100k pending jobs x 10k nodes (BASELINE config 5
+    problem size), p50 over repeated runs, plus packing-efficiency parity;
+  * secondary (stderr): DRU ranking 110k tasks (config 2 scaled up) and
+    rebalancer victim search 100k x 10k (config 4).
 
 Prints ONE JSON line:
   {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": speedup}
-All supporting detail goes to stderr.
 """
 import json
 import sys
@@ -41,39 +44,30 @@ def make_problem(j, n, seed=0):
     return demands, avail, totals
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
+def time_fn(fn, repeats=5):
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append((time.perf_counter() - t0) * 1000)
+    return float(np.percentile(times, 50)), times
 
+
+def cpu_greedy(demands, avail, totals):
+    from cook_tpu.ops import cpu_reference as ref
+    from cook_tpu.ops import native
+
+    if native.available():
+        return native.greedy_match(demands.astype(np.float64),
+                                   avail.astype(np.float64),
+                                   totals.astype(np.float64)), "c++"
+    return ref.np_greedy_match(demands, avail, totals), "numpy"
+
+
+def bench_match(jax, jnp):
     from cook_tpu.ops import cpu_reference as ref
     from cook_tpu.ops.match import MatchProblem, chunked_match
 
-    platform = jax.devices()[0].platform
-    log(f"device: {jax.devices()[0]} ({platform})")
-
-    # ---- parity check on an exactly-comparable config (1k x 1k) ----
-    d_s, a_s, t_s = make_problem(1024, 1024, seed=1)
-    small = MatchProblem(
-        demands=jnp.asarray(d_s),
-        job_valid=jnp.ones(1024, dtype=bool),
-        avail=jnp.asarray(a_s),
-        totals=jnp.asarray(t_s),
-        node_valid=jnp.ones(1024, dtype=bool),
-        feasible=None,
-    )
-    t0 = time.perf_counter()
-    cpu_small = ref.np_greedy_match(d_s, a_s, t_s)
-    cpu_small_ms = (time.perf_counter() - t0) * 1000
-    tpu_small = np.asarray(chunked_match(small, chunk=256, rounds=6, kc=128).assignment)
-    q_cpu = ref.packing_quality(d_s, cpu_small)
-    q_tpu = ref.packing_quality(d_s, tpu_small)
-    packing_eff = (q_tpu["cpus_placed"] / q_cpu["cpus_placed"]
-                   if q_cpu["cpus_placed"] else 1.0)
-    log(f"parity 1k x 1k: cpu placed {q_cpu['num_placed']}, "
-        f"tpu placed {q_tpu['num_placed']}, packing efficiency "
-        f"{packing_eff:.4f} (target >= 0.99); cpu greedy {cpu_small_ms:.1f} ms")
-
-    # ---- headline config: 100k x 10k ----
     J, N = 131072, 16384  # padded buckets over 100k x 10k
     j_real, n_real = 100_000, 10_000
     demands, avail, totals = make_problem(J, N, seed=2)
@@ -89,45 +83,127 @@ def main():
         node_valid=jnp.asarray(node_valid),
         feasible=None,
     )
-    solve = lambda: chunked_match(problem, chunk=1024, rounds=6, kc=128)
+
+    def solve():
+        return jax.block_until_ready(
+            chunked_match(problem, chunk=1024, rounds=8, kc=128)
+        )
+
     t0 = time.perf_counter()
     result = solve()
-    result.assignment.block_until_ready()
-    compile_ms = (time.perf_counter() - t0) * 1000
-    log(f"headline compile+first run: {compile_ms:.0f} ms")
+    log(f"match compile+first run: {(time.perf_counter()-t0)*1000:.0f} ms")
+    p50, times = time_fn(solve)
+    tpu_assign = np.asarray(result.assignment[:j_real])
 
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        result = solve()
-        result.assignment.block_until_ready()
-        times.append((time.perf_counter() - t0) * 1000)
-    p50 = float(np.percentile(times, 50))
-    placed = int(np.asarray(jnp.sum(result.assignment >= 0)))
-    log(f"headline 100k x 10k: p50 {p50:.1f} ms over {len(times)} runs "
-        f"(all: {[f'{t:.0f}' for t in times]}), placed {placed}")
-
-    # ---- CPU baseline on the same headline config ----
     t0 = time.perf_counter()
-    cpu_big = ref.np_greedy_match(
+    cpu_assign, baseline_kind = cpu_greedy(
         demands[:j_real], avail[:n_real], totals[:n_real]
     )
-    cpu_big_ms = (time.perf_counter() - t0) * 1000
-    q_cpu_big = ref.packing_quality(demands[:j_real], cpu_big)
-    tpu_big = np.asarray(result.assignment[:j_real])
-    q_tpu_big = ref.packing_quality(demands[:j_real], tpu_big)
-    big_eff = (q_tpu_big["cpus_placed"] / q_cpu_big["cpus_placed"]
-               if q_cpu_big["cpus_placed"] else 1.0)
-    log(f"cpu baseline 100k x 10k: {cpu_big_ms:.0f} ms, "
-        f"placed {q_cpu_big['num_placed']}; tpu placed "
-        f"{q_tpu_big['num_placed']}; packing efficiency {big_eff:.4f}")
+    cpu_ms = (time.perf_counter() - t0) * 1000
+    q_cpu = ref.packing_quality(demands[:j_real], cpu_assign)
+    q_tpu = ref.packing_quality(demands[:j_real], tpu_assign)
+    eff = (q_tpu["cpus_placed"] / q_cpu["cpus_placed"]
+           if q_cpu["cpus_placed"] else 1.0)
+    log(f"match 100k x 10k: tpu p50 {p50:.1f} ms "
+        f"(all {[f'{t:.0f}' for t in times]}); cpu[{baseline_kind}] "
+        f"{cpu_ms:.0f} ms; placed tpu {q_tpu['num_placed']} vs cpu "
+        f"{q_cpu['num_placed']}; packing efficiency {eff:.4f}")
+    return p50, cpu_ms, eff
+
+
+def bench_dru(jax, jnp):
+    from cook_tpu.ops.common import BIG
+    from cook_tpu.ops.dru import DruTasks, dru_rank
+
+    T, U = 131072, 64
+    t_real = 110_000
+    rng = np.random.default_rng(3)
+    user = rng.integers(0, U, T).astype(np.int32)
+    mem = rng.uniform(100, 8000, T).astype(np.float32)
+    cpus = rng.uniform(0.5, 8, T).astype(np.float32)
+    order = rng.permutation(T).astype(np.float32)
+    valid = np.zeros(T, bool)
+    valid[:t_real] = True
+    tasks = DruTasks(
+        user=jnp.asarray(user), mem=jnp.asarray(mem), cpus=jnp.asarray(cpus),
+        gpus=jnp.zeros(T, jnp.float32), order_key=jnp.asarray(order),
+        valid=jnp.asarray(valid),
+    )
+    div = jnp.asarray(rng.uniform(100, 1000, U).astype(np.float32))
+
+    def solve():
+        return jax.block_until_ready(dru_rank(tasks, div, div, div))
+
+    solve()
+    p50, _ = time_fn(solve)
+
+    from cook_tpu.ops import native
+    if native.available():
+        t0 = time.perf_counter()
+        native.dru_rank(user[:t_real], mem[:t_real], cpus[:t_real],
+                        np.zeros(t_real), order[:t_real],
+                        np.asarray(div, np.float64), np.asarray(div, np.float64),
+                        np.asarray(div, np.float64))
+        cpu_ms = (time.perf_counter() - t0) * 1000
+    else:
+        cpu_ms = float("nan")
+    log(f"dru rank 110k tasks/64 users: tpu p50 {p50:.1f} ms; "
+        f"cpu[c++] {cpu_ms:.1f} ms")
+    return p50
+
+
+def bench_rebalance(jax, jnp):
+    from cook_tpu.ops.rebalance import RebalanceState, find_preemption_decision
+
+    T, H = 131072, 16384
+    t_real, h_real = 100_000, 10_000
+    rng = np.random.default_rng(4)
+    state = RebalanceState(
+        task_host=jnp.asarray(rng.integers(0, h_real, T).astype(np.int32)),
+        task_dru=jnp.asarray(rng.uniform(0, 5, T).astype(np.float32)),
+        task_res=jnp.asarray(np.stack([
+            rng.uniform(100, 8000, T), rng.uniform(0.5, 8, T),
+            np.zeros(T)], axis=-1).astype(np.float32)),
+        task_eligible=jnp.asarray(
+            (np.arange(T) < t_real) & (rng.uniform(size=T) > 0.2)),
+        spare=jnp.asarray(np.stack([
+            rng.uniform(0, 4000, H), rng.uniform(0, 4, H), np.zeros(H)],
+            axis=-1).astype(np.float32)),
+        host_ok=jnp.asarray(np.arange(H) < h_real),
+    )
+    demand = jnp.asarray([8000.0, 16.0, 0.0], dtype=jnp.float32)
+
+    def solve():
+        return jax.block_until_ready(
+            find_preemption_decision(state, demand, 0.3, 1.0, 0.5)
+        )
+
+    solve()
+    p50, _ = time_fn(solve)
+    log(f"rebalance victim search 100k x 10k: tpu p50 {p50:.1f} ms")
+    return p50
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    log(f"device: {jax.devices()[0]} ({platform})")
+
+    match_p50, cpu_ms, eff = bench_match(jax, jnp)
+    dru_p50 = bench_dru(jax, jnp)
+    reb_p50 = bench_rebalance(jax, jnp)
+    log(f"full-cycle estimate (rank+match+rebalance): "
+        f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
 
     print(json.dumps({
         "metric": "match-cycle p50 latency, 100k jobs x 10k nodes "
-                  f"(packing_eff={big_eff:.4f}, platform={platform})",
-        "value": round(p50, 2),
+                  f"(packing_eff={eff:.4f}, dru_ms={dru_p50:.1f}, "
+                  f"rebalance_ms={reb_p50:.1f}, platform={platform})",
+        "value": round(match_p50, 2),
         "unit": "ms",
-        "vs_baseline": round(cpu_big_ms / p50, 2),
+        "vs_baseline": round(cpu_ms / match_p50, 2),
     }))
 
 

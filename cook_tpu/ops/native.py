@@ -1,0 +1,142 @@
+"""ctypes bindings for the C++ host-side solvers (native/cook_native.cc).
+
+Auto-builds the shared library on first use when a toolchain is present;
+callers fall back to the numpy implementations in `cpu_reference` when the
+library is unavailable (`available()`).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libcook_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    d = ctypes.POINTER(ctypes.c_double)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    lib.greedy_match.argtypes = [d, ctypes.c_int64, d, d, ctypes.c_int64,
+                                 u8, i64]
+    lib.greedy_match.restype = None
+    lib.dru_rank.argtypes = [i32, d, d, d, d, ctypes.c_int64, d, d, d,
+                             ctypes.c_int64, ctypes.c_int32, d, i64]
+    lib.dru_rank.restype = None
+    lib.find_preemption.argtypes = [i32, d, d, u8, ctypes.c_int64, d, u8,
+                                    ctypes.c_int64, d, ctypes.c_double,
+                                    ctypes.c_double, ctypes.c_double,
+                                    i64, i64]
+    lib.find_preemption.restype = ctypes.c_int64
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def greedy_match(demands: np.ndarray, avail: np.ndarray, totals: np.ndarray,
+                 feasible: Optional[np.ndarray] = None) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    j, n = len(demands), len(avail)
+    demands = np.ascontiguousarray(demands, dtype=np.float64)
+    avail = np.ascontiguousarray(avail, dtype=np.float64)
+    totals = np.ascontiguousarray(totals, dtype=np.float64)
+    out = np.empty(j, dtype=np.int64)
+    feas_ptr = None
+    if feasible is not None:
+        feasible = np.ascontiguousarray(feasible, dtype=np.uint8)
+        feas_ptr = _ptr(feasible, ctypes.c_uint8)
+    lib.greedy_match(
+        _ptr(demands, ctypes.c_double), j,
+        _ptr(avail, ctypes.c_double),
+        _ptr(totals, ctypes.c_double), n,
+        feas_ptr, _ptr(out, ctypes.c_int64),
+    )
+    return out
+
+
+def dru_rank(user: np.ndarray, mem: np.ndarray, cpus: np.ndarray,
+             gpus: np.ndarray, order_key: np.ndarray,
+             mem_div: np.ndarray, cpu_div: np.ndarray, gpu_div: np.ndarray,
+             gpu_mode: bool = False) -> tuple[np.ndarray, np.ndarray]:
+    lib = _load()
+    assert lib is not None
+    t, u = len(user), len(mem_div)
+    user = np.ascontiguousarray(user, dtype=np.int32)
+    arrays = [np.ascontiguousarray(a, dtype=np.float64)
+              for a in (mem, cpus, gpus, order_key, mem_div, cpu_div,
+                        gpu_div)]
+    out_dru = np.empty(t, dtype=np.float64)
+    out_order = np.empty(t, dtype=np.int64)
+    lib.dru_rank(
+        _ptr(user, ctypes.c_int32),
+        *[_ptr(a, ctypes.c_double) for a in arrays[:4]],
+        t,
+        *[_ptr(a, ctypes.c_double) for a in arrays[4:]],
+        u, int(gpu_mode),
+        _ptr(out_dru, ctypes.c_double), _ptr(out_order, ctypes.c_int64),
+    )
+    return out_dru, out_order
+
+
+def find_preemption(task_host, task_dru, task_res, eligible, spare, host_ok,
+                    demand, pending_dru, safe_dru_threshold, min_dru_diff):
+    lib = _load()
+    assert lib is not None
+    t, h = len(task_host), len(spare)
+    task_host = np.ascontiguousarray(task_host, dtype=np.int32)
+    task_dru = np.ascontiguousarray(task_dru, dtype=np.float64)
+    task_res = np.ascontiguousarray(task_res, dtype=np.float64)
+    eligible = np.ascontiguousarray(eligible, dtype=np.uint8)
+    spare = np.ascontiguousarray(spare, dtype=np.float64)
+    host_ok = np.ascontiguousarray(host_ok, dtype=np.uint8)
+    demand = np.ascontiguousarray(demand, dtype=np.float64)
+    out_tasks = np.empty(t, dtype=np.int64)
+    out_n = np.zeros(1, dtype=np.int64)
+    host = lib.find_preemption(
+        _ptr(task_host, ctypes.c_int32), _ptr(task_dru, ctypes.c_double),
+        _ptr(task_res, ctypes.c_double), _ptr(eligible, ctypes.c_uint8), t,
+        _ptr(spare, ctypes.c_double), _ptr(host_ok, ctypes.c_uint8), h,
+        _ptr(demand, ctypes.c_double), float(pending_dru),
+        float(safe_dru_threshold), float(min_dru_diff),
+        _ptr(out_tasks, ctypes.c_int64), _ptr(out_n, ctypes.c_int64),
+    )
+    if host < 0:
+        return None
+    return int(host), out_tasks[: out_n[0]].tolist()

@@ -1,0 +1,185 @@
+// cook_native: C++ implementations of the host-side sequential solvers.
+//
+// Two roles (see cook_tpu/ops/cpu_reference.py for the Python/numpy
+// equivalents):
+//   1. the strongest honest CPU baseline for the benchmarks — the same
+//      sequential greedy decisions as Fenzo-style scheduleOnce
+//      (reference behavior: scheduler.clj:617-687) at native speed;
+//   2. a production fallback path for deployments without accelerators.
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in this image).
+//
+// Build: make -C native   (produces libcook_native.so)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// Sequential greedy match, cpuMemBinPacker fitness.
+//   demands:  [j, 3] (mem, cpus, gpus) in schedule order
+//   avail:    [n, 3] available resources (mutated copy internally)
+//   totals:   [n, 2] (mem, cpus) capacities
+//   feasible: [j, n] uint8 constraint mask, may be null
+//   out:      [j] chosen node index or -1
+void greedy_match(const double* demands, int64_t j, const double* avail_in,
+                  const double* totals, int64_t n, const uint8_t* feasible,
+                  int64_t* out) {
+  std::vector<double> avail(avail_in, avail_in + n * 3);
+  std::vector<double> used(n * 2);
+  for (int64_t i = 0; i < n; ++i) {
+    used[i * 2 + 0] = totals[i * 2 + 0] - avail[i * 3 + 0];
+    used[i * 2 + 1] = totals[i * 2 + 1] - avail[i * 3 + 1];
+  }
+  for (int64_t a = 0; a < j; ++a) {
+    const double dm = demands[a * 3 + 0];
+    const double dc = demands[a * 3 + 1];
+    const double dg = demands[a * 3 + 2];
+    double best_fit = -1.0;
+    int64_t best = -1;
+    for (int64_t i = 0; i < n; ++i) {
+      if (feasible != nullptr && !feasible[a * n + i]) continue;
+      if (avail[i * 3 + 0] < dm || avail[i * 3 + 1] < dc ||
+          avail[i * 3 + 2] < dg)
+        continue;
+      const double tm = totals[i * 2 + 0];
+      const double tc = totals[i * 2 + 1];
+      const double fit_mem = tm > 0 ? (used[i * 2 + 0] + dm) / tm : 0.0;
+      const double fit_cpu = tc > 0 ? (used[i * 2 + 1] + dc) / tc : 0.0;
+      const double fit = 0.5 * (fit_mem + fit_cpu);
+      if (fit > best_fit) {
+        best_fit = fit;
+        best = i;
+      }
+    }
+    out[a] = best;
+    if (best >= 0) {
+      avail[best * 3 + 0] -= dm;
+      avail[best * 3 + 1] -= dc;
+      avail[best * 3 + 2] -= dg;
+      used[best * 2 + 0] += dm;
+      used[best * 2 + 1] += dc;
+    }
+  }
+}
+
+// DRU scoring + global fair-share order (reference dru.clj semantics):
+// per-user cumulative max(mem/mem_div, cpus/cpu_div) over tasks sorted by
+// order_key, then a global stable sort by (dru, order_key).
+//   user:      [t] user index
+//   mem/cpus/gpus: [t]
+//   order_key: [t]
+//   *_div:     [u]
+//   out_dru:   [t]
+//   out_order: [t] task indices in schedule order
+void dru_rank(const int32_t* user, const double* mem, const double* cpus,
+              const double* gpus, const double* order_key, int64_t t,
+              const double* mem_div, const double* cpu_div,
+              const double* gpu_div, int64_t u, int32_t gpu_mode,
+              double* out_dru, int64_t* out_order) {
+  std::vector<int64_t> idx(t);
+  std::iota(idx.begin(), idx.end(), 0);
+  std::stable_sort(idx.begin(), idx.end(), [&](int64_t a, int64_t b) {
+    if (user[a] != user[b]) return user[a] < user[b];
+    return order_key[a] < order_key[b];
+  });
+  double cm = 0, cc = 0, cg = 0;
+  int32_t current = -1;
+  for (int64_t k = 0; k < t; ++k) {
+    const int64_t i = idx[k];
+    if (user[i] != current) {
+      current = user[i];
+      cm = cc = cg = 0;
+    }
+    cm += mem[i];
+    cc += cpus[i];
+    cg += gpus[i];
+    const int32_t uu = user[i] < u ? user[i] : (int32_t)(u - 1);
+    if (gpu_mode) {
+      out_dru[i] = cg / gpu_div[uu];
+    } else {
+      const double a = cm / mem_div[uu];
+      const double b = cc / cpu_div[uu];
+      out_dru[i] = a > b ? a : b;
+    }
+  }
+  std::iota(out_order, out_order + t, 0);
+  std::stable_sort(out_order, out_order + t, [&](int64_t a, int64_t b) {
+    if (out_dru[a] != out_dru[b]) return out_dru[a] < out_dru[b];
+    return order_key[a] < order_key[b];
+  });
+}
+
+// Preemption victim search (reference rebalancer.clj:320-407 semantics):
+// per host, tasks in descending dru accumulate on top of spare; first
+// feasible prefix per host is that host's candidate (score = min dru in
+// prefix; spare-only scores +inf); best candidate across hosts wins.
+//   returns chosen host or -1; out_tasks/out_ntasks receive the victim
+//   task indices.
+int64_t find_preemption(const int32_t* task_host, const double* task_dru,
+                        const double* task_res /*[t,3]*/,
+                        const uint8_t* eligible, int64_t t,
+                        const double* spare /*[h,3]*/,
+                        const uint8_t* host_ok, int64_t h,
+                        const double* demand /*[3]*/, double pending_dru,
+                        double safe_dru_threshold, double min_dru_diff,
+                        int64_t* out_tasks, int64_t* out_ntasks) {
+  *out_ntasks = 0;
+  const double dm = demand[0], dc = demand[1], dg = demand[2];
+  // group eligible tasks by host
+  std::vector<std::vector<int64_t>> by_host(h);
+  for (int64_t i = 0; i < t; ++i) {
+    const int32_t hh = task_host[i];
+    if (hh < 0 || hh >= h || !eligible[i]) continue;
+    if (task_dru[i] < safe_dru_threshold) continue;
+    if (task_dru[i] - pending_dru <= min_dru_diff) continue;
+    by_host[hh].push_back(i);
+  }
+  double best_score = -1.0;
+  int64_t best_host = -1;
+  std::vector<int64_t> best_tasks;
+  bool best_is_spare = false;
+  for (int64_t hh = 0; hh < h; ++hh) {
+    if (!host_ok[hh]) continue;
+    double cm = spare[hh * 3 + 0], cc = spare[hh * 3 + 1],
+           cg = spare[hh * 3 + 2];
+    if (cm >= dm && cc >= dc && cg >= dg) {
+      if (!best_is_spare) {  // +inf beats every finite score; first wins
+        best_is_spare = true;
+        best_host = hh;
+        best_tasks.clear();
+      }
+      continue;
+    }
+    if (best_is_spare) continue;
+    auto& tasks = by_host[hh];
+    std::stable_sort(tasks.begin(), tasks.end(), [&](int64_t a, int64_t b) {
+      if (task_dru[a] != task_dru[b]) return task_dru[a] > task_dru[b];
+      return a < b;
+    });
+    std::vector<int64_t> chosen;
+    for (int64_t i : tasks) {
+      cm += task_res[i * 3 + 0];
+      cc += task_res[i * 3 + 1];
+      cg += task_res[i * 3 + 2];
+      chosen.push_back(i);
+      if (cm >= dm && cc >= dc && cg >= dg) {
+        const double score = task_dru[i];
+        if (score > best_score) {
+          best_score = score;
+          best_host = hh;
+          best_tasks = chosen;
+        }
+        break;
+      }
+    }
+  }
+  for (size_t k = 0; k < best_tasks.size(); ++k) out_tasks[k] = best_tasks[k];
+  *out_ntasks = (int64_t)best_tasks.size();
+  return best_host;
+}
+
+}  // extern "C"
