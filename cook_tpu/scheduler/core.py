@@ -151,6 +151,43 @@ class Scheduler:
         self.metrics[f"match.{pool.name}.offers"] = outcome.offers_total
         return outcome
 
+    def match_cycle_all_pools(self, mesh=None) -> dict[str, MatchOutcome]:
+        """Batched multi-pool match: every active pool's problem solved in
+        one device call, optionally sharded over `mesh` (the config-5
+        path; see matcher.match_pools_batched)."""
+        from cook_tpu.scheduler.matcher import match_pools_batched
+
+        pools = [p for p in self.store.pools.values() if p.schedules_jobs]
+        for pool in pools:
+            if pool.name not in self.pool_queues:
+                self.rank_cycle(pool)
+            self.pool_match_state.setdefault(
+                pool.name,
+                PoolMatchState(
+                    num_considerable=self.config.match.max_jobs_considered),
+            )
+        outcomes = match_pools_batched(
+            self.store, pools, self.pool_queues, self.clusters,
+            self.config.match, self.pool_match_state,
+            make_task_id=self._make_task_id,
+            record_placement_failure=self._record_placement_failure,
+            host_reservations=self.host_reservations,
+            mesh=mesh,
+        )
+        for pool in pools:
+            outcome = outcomes[pool.name]
+            matched_uuids = {j.uuid for j, _ in outcome.matched}
+            queue = self.pool_queues[pool.name]
+            queue.jobs = [j for j in queue.jobs if j.uuid not in matched_uuids]
+            if self.host_reservations:
+                self.host_reservations = {
+                    host: uuid
+                    for host, uuid in self.host_reservations.items()
+                    if uuid not in matched_uuids
+                }
+            self._cache_spare(pool)
+        return outcomes
+
     def _cache_spare(self, pool: Pool) -> None:
         spare: dict[str, Resources] = {}
         for cluster in self.clusters:

@@ -153,7 +153,27 @@ def previous_failed_hosts(store: JobStore, jobs: Sequence[Job]) -> dict[str, set
     return out
 
 
-def match_pool(
+@dataclass
+class PreparedPool:
+    """Host-side encoding of one pool's match problem, ready to solve."""
+
+    pool: Pool
+    outcome: MatchOutcome
+    considerable: list = field(default_factory=list)
+    cluster_offers: list = field(default_factory=list)
+    nodes: Optional[EncodedNodes] = None
+    groups: dict = field(default_factory=dict)
+    group_used_hosts: dict = field(default_factory=dict)
+    group_attr_value: dict = field(default_factory=dict)
+    feasible: Optional[np.ndarray] = None
+    problem: Optional[MatchProblem] = None
+
+    @property
+    def solvable(self) -> bool:
+        return self.problem is not None
+
+
+def prepare_pool_problem(
     store: JobStore,
     pool: Pool,
     queue: RankedQueue,
@@ -161,43 +181,38 @@ def match_pool(
     config: MatchConfig,
     state: PoolMatchState,
     *,
-    make_task_id: Callable[[Job], str],
     launch_filter: Optional[Callable[[Job], bool]] = None,
-    record_placement_failure: Optional[Callable[[Job, str], None]] = None,
     host_reservations: Optional[dict[str, str]] = None,
-) -> MatchOutcome:
-    """One pool's match cycle end to end."""
-    outcome = MatchOutcome()
+) -> PreparedPool:
+    """Gather offers + considerable jobs and encode the tensor problem."""
+    prepared = PreparedPool(pool=pool, outcome=MatchOutcome())
 
-    # 1. offers from every running cluster (scheduler.clj:1574-1585)
-    cluster_offers: list[tuple[ComputeCluster, Offer]] = []
+    # offers from every running cluster (scheduler.clj:1574-1585)
     for cluster in clusters:
         if not cluster.accepts_work:
             continue
         for offer in cluster.pending_offers(pool.name):
-            cluster_offers.append((cluster, offer))
-    outcome.offers_total = len(cluster_offers)
+            prepared.cluster_offers.append((cluster, offer))
+    prepared.outcome.offers_total = len(prepared.cluster_offers)
 
-    considerable = select_considerable(
+    prepared.considerable = select_considerable(
         store, pool, queue, state.num_considerable, launch_filter=launch_filter
     )
-    if not considerable or not cluster_offers:
-        outcome.unmatched = considerable
-        outcome.head_matched = not considerable
-        _apply_backoff(config, state, outcome.head_matched)
-        return outcome
+    considerable = prepared.considerable
+    if not considerable or not prepared.cluster_offers:
+        return prepared
 
-    nodes = encode_nodes([o for _, o in cluster_offers])
-    groups, group_used_hosts, group_attr_value = gather_group_context(
-        store, considerable
-    )
+    nodes = encode_nodes([o for _, o in prepared.cluster_offers])
+    prepared.nodes = nodes
+    (prepared.groups, prepared.group_used_hosts,
+     prepared.group_attr_value) = gather_group_context(store, considerable)
     feasible = feasibility_mask(
         considerable,
         nodes,
         previous_hosts=previous_failed_hosts(store, considerable),
-        group_used_hosts=group_used_hosts,
-        group_attr_value=group_attr_value,
-        groups=groups,
+        group_used_hosts=prepared.group_used_hosts,
+        group_attr_value=prepared.group_attr_value,
+        groups=prepared.groups,
     )
     if host_reservations:
         # rebalancer reservations (constraints.clj:242 + reserve-hosts!,
@@ -208,22 +223,42 @@ def match_pool(
         has_reservation = reserved_for != ""
         for ji, job in enumerate(considerable):
             feasible[ji] &= ~has_reservation | (reserved_for == job.uuid)
+    prepared.feasible = feasible
+    prepared.problem = build_match_problem(considerable, nodes, feasible,
+                                           chunk=config.chunk)
+    return prepared
 
-    # 2. the solve
-    problem = build_match_problem(considerable, nodes, feasible,
-                                  chunk=config.chunk)
-    if config.chunk:
-        result = chunked_match(problem, chunk=config.chunk,
-                               rounds=config.chunk_rounds)
-    else:
-        result = greedy_match(problem)
-    assignment = np.asarray(result.assignment[: len(considerable)])
+
+def finalize_pool_match(
+    store: JobStore,
+    prepared: PreparedPool,
+    assignment: np.ndarray,
+    config: MatchConfig,
+    state: PoolMatchState,
+    clusters: Sequence[ComputeCluster],
+    *,
+    make_task_id: Callable[[Job], str],
+    record_placement_failure: Optional[Callable[[Job, str], None]] = None,
+) -> MatchOutcome:
+    """Apply a solved assignment: group validation, launch transactions,
+    backend launches, autoscaling, head-of-queue backoff."""
+    outcome = prepared.outcome
+    considerable = prepared.considerable
+    pool = prepared.pool
+    if not prepared.solvable:
+        outcome.unmatched = considerable
+        outcome.head_matched = not considerable
+        _apply_backoff(config, state, outcome.head_matched)
+        return outcome
+    nodes = prepared.nodes
+    cluster_offers = prepared.cluster_offers
+    feasible = prepared.feasible
     assignment = validate_group_assignments(
-        considerable, assignment, nodes, groups, group_used_hosts,
-        group_attr_value,
+        considerable, assignment, nodes, prepared.groups,
+        prepared.group_used_hosts, prepared.group_attr_value,
     )
 
-    # 3. transact + launch (scheduler.clj:790-1048)
+    # transact + launch (scheduler.clj:790-1048)
     launches_per_cluster: dict[str, list[TaskSpec]] = {}
     cluster_by_name = {}
     for ji, job in enumerate(considerable):
@@ -297,6 +332,126 @@ def match_pool(
     outcome.head_matched = any(j.uuid == head.uuid for j, _ in outcome.matched)
     _apply_backoff(config, state, outcome.head_matched)
     return outcome
+
+
+def match_pool(
+    store: JobStore,
+    pool: Pool,
+    queue: RankedQueue,
+    clusters: Sequence[ComputeCluster],
+    config: MatchConfig,
+    state: PoolMatchState,
+    *,
+    make_task_id: Callable[[Job], str],
+    launch_filter: Optional[Callable[[Job], bool]] = None,
+    record_placement_failure: Optional[Callable[[Job, str], None]] = None,
+    host_reservations: Optional[dict[str, str]] = None,
+) -> MatchOutcome:
+    """One pool's match cycle end to end (prepare -> solve -> finalize)."""
+    prepared = prepare_pool_problem(
+        store, pool, queue, clusters, config, state,
+        launch_filter=launch_filter, host_reservations=host_reservations,
+    )
+    assignment = np.empty(0, dtype=np.int32)
+    if prepared.solvable:
+        if config.chunk:
+            result = chunked_match(prepared.problem, chunk=config.chunk,
+                                   rounds=config.chunk_rounds)
+        else:
+            result = greedy_match(prepared.problem)
+        assignment = np.asarray(
+            result.assignment[: len(prepared.considerable)]
+        )
+    return finalize_pool_match(
+        store, prepared, assignment, config, state, clusters,
+        make_task_id=make_task_id,
+        record_placement_failure=record_placement_failure,
+    )
+
+
+def match_pools_batched(
+    store: JobStore,
+    pools: Sequence[Pool],
+    queues: dict[str, RankedQueue],
+    clusters: Sequence[ComputeCluster],
+    config: MatchConfig,
+    states: dict[str, PoolMatchState],
+    *,
+    make_task_id: Callable[[Job], str],
+    record_placement_failure: Optional[Callable[[Job, str], None]] = None,
+    host_reservations: Optional[dict[str, str]] = None,
+    mesh=None,
+) -> dict[str, MatchOutcome]:
+    """Solve EVERY pool's match problem in one batched device call.
+
+    This is the BASELINE config-5 path (SURVEY §2.4): pools become the
+    leading batch axis of a single pjit'd solve, sharded across the mesh so
+    each device handles a slice of pools concurrently — where the reference
+    round-robins pools on one thread (scheduler.clj:2508-2517).  All pools'
+    problems are padded to shared (J, N) buckets; per-pool transactions and
+    launches then run host-side exactly as in the per-pool path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cook_tpu.parallel.mesh import pool_sharded_match, shard_pools
+
+    prepared_list = [
+        prepare_pool_problem(
+            store, pool, queues[pool.name], clusters, config,
+            states[pool.name], host_reservations=host_reservations,
+        )
+        for pool in pools
+    ]
+    solvable = [p for p in prepared_list if p.solvable]
+    if solvable:
+        # pad every pool's problem to shared buckets and stack
+        max_j = max(p.problem.demands.shape[0] for p in solvable)
+        max_n = max(p.problem.avail.shape[0] for p in solvable)
+
+        def pad_problem(problem: MatchProblem) -> MatchProblem:
+            j, n = problem.demands.shape[0], problem.avail.shape[0]
+            return MatchProblem(
+                demands=jnp.pad(problem.demands, ((0, max_j - j), (0, 0))),
+                job_valid=jnp.pad(problem.job_valid, (0, max_j - j)),
+                avail=jnp.pad(problem.avail, ((0, max_n - n), (0, 0))),
+                totals=jnp.pad(problem.totals, ((0, max_n - n), (0, 0))),
+                node_valid=jnp.pad(problem.node_valid, (0, max_n - n)),
+                feasible=jnp.pad(problem.feasible,
+                                 ((0, max_j - j), (0, max_n - n))),
+            )
+
+        stacked = jax.tree.map(
+            lambda *leaves: jnp.stack(leaves),
+            *[pad_problem(p.problem) for p in solvable],
+        )
+        if mesh is not None and len(solvable) % mesh.devices.size == 0:
+            stacked = shard_pools(mesh, stacked)
+            result = pool_sharded_match(mesh, stacked,
+                                        chunk=config.chunk or 0)
+        elif config.chunk:
+            result = jax.vmap(
+                lambda p: chunked_match(p, chunk=config.chunk,
+                                        rounds=config.chunk_rounds)
+            )(stacked)
+        else:
+            result = jax.vmap(greedy_match)(stacked)
+        assignments = np.asarray(result.assignment)
+
+    outcomes: dict[str, MatchOutcome] = {}
+    solve_idx = 0
+    for prepared in prepared_list:
+        assignment = np.empty(0, dtype=np.int32)
+        if prepared.solvable:
+            assignment = assignments[solve_idx][: len(prepared.considerable)]
+            solve_idx += 1
+        outcomes[prepared.pool.name] = finalize_pool_match(
+            store, prepared, assignment, config, states[prepared.pool.name],
+            clusters,
+            make_task_id=make_task_id,
+            record_placement_failure=record_placement_failure,
+        )
+    return outcomes
 
 
 def _apply_backoff(config: MatchConfig, state: PoolMatchState,
