@@ -160,6 +160,95 @@ def cmd_kill(args) -> int:
     return rc
 
 
+def _instance_output_url(args, uuid: str) -> Optional[tuple[str, dict]]:
+    """Resolve a job/instance uuid to its sandbox file-server URL."""
+    found = _fan_out_query(args, [uuid])
+    if uuid not in found:
+        print(f"{uuid}: not found", file=sys.stderr)
+        return None
+    _, job = found[uuid]
+    insts = job.get("instances", [])
+    if not insts:
+        print(f"{uuid}: no instances yet", file=sys.stderr)
+        return None
+    inst = insts[-1]
+    url = inst.get("output_url")
+    if not url:
+        print(f"{uuid}: no sandbox file server available", file=sys.stderr)
+        return None
+    return url, inst
+
+
+def cmd_ls(args) -> int:
+    import requests
+
+    resolved = _instance_output_url(args, args.uuid)
+    if resolved is None:
+        return 1
+    url, _ = resolved
+    params = {"path": args.path} if args.path else {}
+    r = requests.get(f"{url}/files/browse", params=params, timeout=30)
+    if r.status_code != 200:
+        print(f"error: {r.text}", file=sys.stderr)
+        return 1
+    for entry in r.json():
+        print(f"{entry['mode']} {entry['size']:>12}  {entry['path']}")
+    return 0
+
+
+def cmd_cat(args) -> int:
+    import requests
+
+    resolved = _instance_output_url(args, args.uuid)
+    if resolved is None:
+        return 1
+    url, _ = resolved
+    offset = 0
+    while True:
+        r = requests.get(f"{url}/files/read",
+                         params={"path": args.path, "offset": offset,
+                                 "length": 65536}, timeout=30)
+        if r.status_code != 200:
+            print(f"error: {r.text}", file=sys.stderr)
+            return 1
+        data = r.json()["data"]
+        if not data:
+            return 0
+        sys.stdout.write(data)
+        offset += len(data.encode())
+
+
+def cmd_tail(args) -> int:
+    import requests
+
+    resolved = _instance_output_url(args, args.uuid)
+    if resolved is None:
+        return 1
+    url, _ = resolved
+    # seek to the end (offset=-1 returns the size), back off `lines`-ish
+    r = requests.get(f"{url}/files/read",
+                     params={"path": args.path, "offset": -1}, timeout=30)
+    if r.status_code != 200:
+        print(f"error: {r.text}", file=sys.stderr)
+        return 1
+    size = r.json()["offset"]
+    offset = max(0, size - args.bytes)
+    while True:
+        r = requests.get(f"{url}/files/read",
+                         params={"path": args.path, "offset": offset,
+                                 "length": 65536}, timeout=30)
+        data = r.json().get("data", "")
+        if data:
+            sys.stdout.write(data)
+            sys.stdout.flush()
+            offset += len(data.encode())
+        if not args.follow:
+            if not data:
+                return 0
+        else:
+            time.sleep(args.sleep_interval)
+
+
 def cmd_usage(args) -> int:
     for cluster, client in _clients(args):
         usage = client.usage(args.lookup_user)
@@ -231,6 +320,24 @@ def build_parser() -> argparse.ArgumentParser:
     q = sub.add_parser("usage", help="show a user's usage")
     q.add_argument("--lookup-user", dest="lookup_user")
     q.set_defaults(fn=cmd_usage)
+
+    q = sub.add_parser("ls", help="list a job's sandbox files")
+    q.add_argument("uuid")
+    q.add_argument("path", nargs="?", default="")
+    q.set_defaults(fn=cmd_ls)
+
+    q = sub.add_parser("cat", help="print a sandbox file")
+    q.add_argument("uuid")
+    q.add_argument("path")
+    q.set_defaults(fn=cmd_cat)
+
+    q = sub.add_parser("tail", help="tail a sandbox file")
+    q.add_argument("uuid")
+    q.add_argument("path")
+    q.add_argument("--bytes", type=int, default=2048)
+    q.add_argument("--follow", "-f", action="store_true")
+    q.add_argument("--sleep-interval", type=float, default=1.0)
+    q.set_defaults(fn=cmd_tail)
 
     return p
 

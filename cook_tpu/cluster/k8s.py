@@ -171,8 +171,10 @@ class ExpectedState(enum.Enum):
 
 class KubeCluster(ComputeCluster):
     def __init__(self, name: str, api: KubeApi, clock: Callable[[], int],
-                 *, synthetic_pod_limits: Optional[dict] = None):
+                 *, synthetic_pod_limits: Optional[dict] = None,
+                 file_server_port: int = 8000):
         super().__init__(name)
+        self.file_server_port = file_server_port
         self.api = api
         self.clock = clock
         self.expected: dict[str, ExpectedState] = {}
@@ -360,6 +362,14 @@ class KubeCluster(ComputeCluster):
             and p.phase in (PodPhase.PENDING, PodPhase.RUNNING)
             and not p.synthetic
         )
+
+    def retrieve_sandbox_url_path(self, task_id: str) -> str:
+        """The pod sidecar file-server URL (reference: the sidecar serves
+        the Mesos files/ API on a well-known port inside each pod)."""
+        pod = self.task_pods.get(task_id)
+        if pod is None or not pod.node_name:
+            return ""
+        return f"http://{pod.node_name}:{self.file_server_port}"
 
     @property
     def running(self):

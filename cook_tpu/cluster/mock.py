@@ -43,7 +43,8 @@ class MockCluster(ComputeCluster):
 
     def __init__(self, name: str, hosts: Sequence[MockHost],
                  clock: Callable[[], int], *,
-                 default_runtime_ms: int = 60_000):
+                 default_runtime_ms: int = 60_000,
+                 sandbox_url_fn: Optional[Callable[[str], str]] = None):
         super().__init__(name)
         self.hosts = {h.node_id: h for h in hosts}
         self.clock = clock
@@ -52,6 +53,12 @@ class MockCluster(ComputeCluster):
         self.status_callback: Optional[StatusCallback] = None
         self.launched_count = 0
         self.killed_count = 0
+        self.sandbox_url_fn = sandbox_url_fn
+
+    def retrieve_sandbox_url_path(self, task_id: str) -> str:
+        if self.sandbox_url_fn is not None:
+            return self.sandbox_url_fn(task_id)
+        return ""
 
     # ------------------------------------------------------------- offers
 

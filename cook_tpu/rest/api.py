@@ -364,6 +364,12 @@ class CookApi:
             d["sandbox_directory"] = inst.sandbox_directory
         if inst.progress_message:
             d["progress_message"] = inst.progress_message
+        if self.scheduler is not None:
+            cluster = self.scheduler.cluster_by_name(inst.compute_cluster)
+            if cluster is not None:
+                url = cluster.retrieve_sandbox_url_path(inst.task_id)
+                if url:
+                    d["output_url"] = url
         return d
 
     async def delete_jobs(self, request: web.Request) -> web.Response:

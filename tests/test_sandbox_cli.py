@@ -1,0 +1,106 @@
+"""End-to-end sandbox access: executor writes files -> sidecar serves them
+-> scheduler exposes output_url -> `cs ls/cat/tail` reads them
+(reference: cs ls/cat/tail + sidecar file server integration)."""
+import asyncio
+import json
+import threading
+
+import pytest
+
+from cook_tpu.client.cli import main as cli_main
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.executor.runner import ExecutorConfig, TaskRunner
+from cook_tpu.models.entities import Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import ServerThread, free_port
+from cook_tpu.scheduler.core import Scheduler
+from cook_tpu.sidecar.fileserver import FileServer
+from tests.conftest import FakeClock, make_job
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """Scheduler + mock cluster whose sandbox URLs point at a real sidecar
+    file server over the executor's real sandbox."""
+    sandbox = tmp_path / "sandbox"
+
+    # run the job's command with the real executor
+    sink_updates = []
+    runner = TaskRunner(
+        "task-x", "echo line one && echo line two", sink_updates.append,
+        ExecutorConfig(sandbox_dir=str(sandbox)),
+    )
+    runner.run()
+
+    # sidecar file server over that sandbox
+    fs_port = free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run_fs():
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        app_runner = web.AppRunner(FileServer(str(sandbox)).build_app())
+        loop.run_until_complete(app_runner.setup())
+        site = web.TCPSite(app_runner, "127.0.0.1", fs_port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run_fs, daemon=True).start()
+    assert started.wait(5)
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock", [MockHost(node_id="h0", hostname="h0", mem=4000, cpus=8)],
+        clock=clock,
+        sandbox_url_fn=lambda tid: f"http://127.0.0.1:{fs_port}",
+    )
+    scheduler = Scheduler(store, [cluster])
+    api = CookApi(store, scheduler, ApiConfig())
+    srv = ServerThread(api).start()
+
+    job = make_job()
+    store.submit_jobs([job])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+
+    cfg = tmp_path / "cs.json"
+    cfg.write_text(json.dumps(
+        {"clusters": [{"name": "c1", "url": srv.url}]}))
+    yield srv, job, str(cfg)
+    srv.stop()
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def cli(cfg, *argv):
+    return cli_main(["--config", cfg, "--user", "alice", *argv])
+
+
+def test_cli_ls(stack, capsys):
+    srv, job, cfg = stack
+    assert cli(cfg, "ls", job.uuid) == 0
+    out = capsys.readouterr().out
+    assert "stdout" in out and "stderr" in out
+
+
+def test_cli_cat(stack, capsys):
+    srv, job, cfg = stack
+    assert cli(cfg, "cat", job.uuid, "stdout") == 0
+    assert capsys.readouterr().out == "line one\nline two\n"
+
+
+def test_cli_tail(stack, capsys):
+    srv, job, cfg = stack
+    assert cli(cfg, "tail", job.uuid, "stdout", "--bytes", "9") == 0
+    assert capsys.readouterr().out == "line two\n"
+
+
+def test_cli_cat_missing_file(stack, capsys):
+    srv, job, cfg = stack
+    assert cli(cfg, "cat", job.uuid, "nope") == 1
