@@ -1,0 +1,6 @@
+"""Framework integrations: run Dask/Spark-style worker fleets as jobs."""
+from cook_tpu.integrations.workerpool import (  # noqa: F401
+    DaskCookCluster,
+    WorkerPool,
+    WorkerSpec,
+)

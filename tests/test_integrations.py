@@ -1,0 +1,62 @@
+"""Worker-pool integration adapter over a live server."""
+import pytest
+
+from cook_tpu.client.jobclient import JobClient
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.integrations.workerpool import WorkerPool, WorkerSpec
+from cook_tpu.models.entities import Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import ServerThread
+from cook_tpu.scheduler.core import Scheduler
+from tests.conftest import FakeClock
+
+
+@pytest.fixture
+def server():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock",
+        [MockHost(node_id=f"n{i}", hostname=f"n{i}", mem=32000, cpus=16)
+         for i in range(4)],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    srv = ServerThread(CookApi(store, scheduler, ApiConfig())).start()
+    srv.store, srv.scheduler = store, scheduler
+    yield srv
+    srv.stop()
+
+
+def test_worker_pool_scale_up_down(server):
+    client = JobClient(server.url, user="dask-user")
+    pool = WorkerPool(
+        client,
+        WorkerSpec(command_template="worker --join {address} --cpus {cpus}",
+                   mem=1000, cpus=2),
+        "tcp://scheduler:8786",
+    )
+    uuids = pool.scale(6)
+    assert len(uuids) == 6
+    jobs = client.query(uuids)
+    assert all(j["status"] == "waiting" for j in jobs)
+    assert all("tcp://scheduler:8786" in j["command"] for j in jobs)
+    # all workers share one group
+    groups = {g for j in jobs for g in j.get("groups", [])}
+    assert len(groups) == 1
+
+    # let the scheduler place them
+    p = server.store.pools["default"]
+    server.scheduler.rank_cycle(p)
+    server.scheduler.match_cycle(p)
+    assert pool.status() == {"running": 6}
+
+    # scale down kills the surplus
+    pool.scale(2)
+    assert len(pool.worker_uuids) == 2
+    status = pool.status()
+    assert status.get("running") == 2
+
+    pool.close()
+    assert pool.worker_uuids == []
