@@ -58,10 +58,15 @@ class Scheduler:
         store: JobStore,
         clusters: Sequence[ComputeCluster],
         config: Optional[SchedulerConfig] = None,
+        plugins=None,
     ):
+        from cook_tpu.scheduler.plugins import PluginRegistry
+
         self.store = store
         self.clusters = list(clusters)
         self.config = config or SchedulerConfig()
+        self.plugins = plugins or PluginRegistry()
+        self._launch_filter_cache: dict = {}
         self._task_seq = itertools.count()
         self.pool_queues: dict[str, RankedQueue] = {}
         self.pool_match_state: dict[str, PoolMatchState] = {}
@@ -97,9 +102,16 @@ class Scheduler:
         self.store.update_instance_state(task_id, status, reason)
 
     def _on_event(self, event: Event) -> None:
-        """Store event feed consumer: when a job completes while instances
-        are still live, kill them (monitor-tx-report-queue,
-        scheduler.clj:378)."""
+        """Store event feed consumer: kill-on-complete fan-out
+        (monitor-tx-report-queue, scheduler.clj:378) and instance-completion
+        plugin dispatch (plugins/definitions.clj:44)."""
+        if event.kind == "instance/status" and event.data["status"] in (
+            "success", "failed"
+        ):
+            job = self.store.jobs.get(event.data["job"])
+            inst = self.store.instances.get(event.data["task_id"])
+            if job is not None and inst is not None:
+                self.plugins.on_completion(job, inst)
         if event.kind != "job/state" or event.data.get("state") != "completed":
             return
         job_uuid = event.data["uuid"]
@@ -133,6 +145,7 @@ class Scheduler:
             self.config.match,
             state,
             make_task_id=self._make_task_id,
+            launch_filter=self._launch_filter,
             record_placement_failure=self._record_placement_failure,
             host_reservations=self.host_reservations,
         )
@@ -231,6 +244,14 @@ class Scheduler:
 
     def _record_placement_failure(self, job: Job, reason: str) -> None:
         self.placement_failures[job.uuid] = reason
+
+    def _launch_filter(self, job: Job) -> bool:
+        """JobLaunchFilter plugins with TTL cache (plugins/launch.clj)."""
+        if not self.plugins.launch_filters:
+            return True
+        return self.plugins.check_launch(
+            job, self.store.clock(), self._launch_filter_cache
+        )
 
     # ------------------------------------------------------------ monitors
 

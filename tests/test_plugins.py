@@ -1,0 +1,138 @@
+"""Plugin seam tests (reference: plugins/{definitions,submission,launch}
++ pool plugin): submission validate/modify, launch filter with TTL cache,
+completion handler, pool selection, plugin loading."""
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import JobState, Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import ServerThread
+from cook_tpu.scheduler.core import Scheduler
+from cook_tpu.scheduler.plugins import (
+    PluginRegistry,
+    PluginResult,
+    load_plugin,
+)
+from tests.conftest import FakeClock, make_job
+
+import requests
+
+
+class RejectBigJobs:
+    def check_job_submission(self, spec, user, pool):
+        if float(spec.get("mem", 0)) > 1000:
+            return PluginResult(False, "too big for this cluster")
+        return PluginResult(True)
+
+
+class AddLabel:
+    def modify_job(self, spec, user, pool):
+        labels = dict(spec.get("labels", {}))
+        labels["injected"] = "yes"
+        return {**spec, "labels": labels}
+
+
+class HoldUser:
+    """Launch filter: holds a specific user's jobs back."""
+
+    def __init__(self, user="held"):
+        self.user = user
+        self.calls = 0
+
+    def check_job_launch(self, job):
+        self.calls += 1
+        if job.user == self.user:
+            return PluginResult(False, "held")  # default TTL (60s)
+        return PluginResult(True)
+
+
+class RecordCompletions:
+    def __init__(self):
+        self.seen = []
+
+    def on_instance_completion(self, job, instance):
+        self.seen.append((job.uuid, instance.status.value))
+
+
+def test_submission_plugins_via_api():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    plugins = PluginRegistry()
+    plugins.submission_validators.append(RejectBigJobs())
+    plugins.submission_modifiers.append(AddLabel())
+    api = CookApi(store, None, ApiConfig(), plugins)
+    srv = ServerThread(api).start()
+    try:
+        h = {"X-Cook-Requesting-User": "u"}
+        r = requests.post(f"{srv.url}/jobs",
+                          json={"jobs": [{"command": "x", "mem": 5000}]},
+                          headers=h)
+        assert r.status_code == 400
+        assert "too big" in r.json()["error"]
+        r = requests.post(f"{srv.url}/jobs",
+                          json={"jobs": [{"command": "x", "mem": 100}]},
+                          headers=h)
+        assert r.status_code == 201
+        uuid = r.json()["jobs"][0]
+        job = requests.get(f"{srv.url}/jobs/{uuid}", headers=h).json()
+        assert job["labels"]["injected"] == "yes"
+    finally:
+        srv.stop()
+
+
+def test_launch_filter_holds_jobs_with_cache():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m", [MockHost(node_id="h0", hostname="h0", mem=4000, cpus=8)],
+        clock=clock)
+    plugins = PluginRegistry()
+    holder = HoldUser()
+    plugins.launch_filters.append(holder)
+    scheduler = Scheduler(store, [cluster], plugins=plugins)
+    held = make_job(user="held")
+    free = make_job(user="free")
+    store.submit_jobs([held, free])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    matched = {j.uuid for j, _ in outcome.matched}
+    assert free.uuid in matched and held.uuid not in matched
+    calls_before = holder.calls
+    # second cycle within the TTL: cached, no new plugin call for held
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    assert holder.calls == calls_before
+    # after TTL expiry the plugin is consulted again
+    clock.advance(70_000)
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    assert holder.calls > calls_before
+
+
+def test_completion_handler_fires():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m", [MockHost(node_id="h0", hostname="h0", mem=4000, cpus=8)],
+        clock=clock)
+    plugins = PluginRegistry()
+    recorder = RecordCompletions()
+    plugins.completion_handlers.append(recorder)
+    scheduler = Scheduler(store, [cluster], plugins=plugins)
+    job = make_job()
+    store.submit_jobs([job])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    cluster.advance_to(10_000_000)
+    assert (job.uuid, "success") in recorder.seen
+
+
+def test_load_plugin_dotted_path():
+    plugin = load_plugin("tests.test_plugins:RejectBigJobs")
+    assert plugin.check_job_submission({"mem": 9999}, "u", "p").accepted is False
+    fn = load_plugin("tests.test_plugins.RecordCompletions")
+    assert isinstance(fn, RecordCompletions)
