@@ -125,17 +125,24 @@ def _segment_rank(keys, order):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("chunk", "rounds", "kc", "use_approx")
+    jax.jit, static_argnames=("chunk", "rounds", "kc", "use_approx", "passes")
 )
 def chunked_match(
     problem: MatchProblem,
     *,
     chunk: int = 1024,
-    rounds: int = 6,
+    rounds: int = 4,
     kc: int = 128,
     use_approx: bool = True,
+    passes: int = 2,
 ) -> MatchResult:
-    """Fast chunked greedy matcher (see module docstring for the scheme)."""
+    """Fast chunked greedy matcher (see module docstring for the scheme).
+
+    `passes` controls how many times per chunk the [K, N] fitness pass and
+    top-kc candidate lists are recomputed against updated availability;
+    between recomputes, `rounds` cheap [K, kc] conflict-resolution rounds
+    run.  passes=2 recovers the placements that candidate-list truncation
+    would otherwise lose when >kc jobs contend for the same nodes."""
     j, n = problem.demands.shape[0], problem.avail.shape[0]
     assert j % chunk == 0, "pad jobs to a multiple of chunk"
     kc = min(kc, n)
@@ -153,24 +160,25 @@ def chunked_match(
 
     def chunk_step(avail, inputs):
         d, ok, fr = inputs  # [K,3], [K], [K,N]|[1,1]
-        # one full fitness pass against the chunk-start snapshot
-        fits = jnp.all(avail[None, :, :] >= d[:, None, :], axis=-1)
-        feasible = fits & node_valid[None, :] & fr & ok[:, None]
-        used0 = totals[:, 0] - avail[:, 0]
-        used1 = totals[:, 1] - avail[:, 1]
-        fit = ((used0[None, :] + d[:, 0:1]) / denom[None, :, 0]
-               + (used1[None, :] + d[:, 1:2]) / denom[None, :, 1]) * 0.5
-        score = jnp.where(feasible, fit, -BIG)
-        if use_approx:
-            cand_val, cand_idx = jax.lax.approx_max_k(
-                score, kc, recall_target=0.95
-            )
-        else:
-            cand_val, cand_idx = jax.lax.top_k(score, kc)
-        cand_ok = cand_val > -BIG  # [K,kc]
+
+        def candidate_pass(avail, assignment):
+            # full fitness pass for still-unplaced jobs vs current avail
+            unplaced = assignment < 0
+            fits = jnp.all(avail[None, :, :] >= d[:, None, :], axis=-1)
+            feasible = (fits & node_valid[None, :] & fr
+                        & (ok & unplaced)[:, None])
+            used0 = totals[:, 0] - avail[:, 0]
+            used1 = totals[:, 1] - avail[:, 1]
+            fit = ((used0[None, :] + d[:, 0:1]) / denom[None, :, 0]
+                   + (used1[None, :] + d[:, 1:2]) / denom[None, :, 1]) * 0.5
+            score = jnp.where(feasible, fit, -BIG)
+            if use_approx:
+                return jax.lax.approx_max_k(score, kc, recall_target=0.95)
+            return jax.lax.top_k(score, kc)
 
         def round_step(carry, _):
-            avail, assignment = carry
+            avail, assignment, cand_val, cand_idx = carry
+            cand_ok = cand_val > -BIG  # [K,kc]
             unplaced = assignment < 0
             # candidate feasibility vs CURRENT availability (tiny gather)
             avail_cand = avail[cand_idx]  # [K,kc,3]
@@ -221,12 +229,15 @@ def chunked_match(
                 .at[jnp.where(accept, pick, n - 1)]
                 .add(jnp.where(accept[:, None], d, 0.0))
             )
-            return (avail - delta, assignment), None
+            return (avail - delta, assignment, cand_val, cand_idx), None
 
         assignment = jnp.full((chunk,), -1, jnp.int32)
-        (avail, assignment), _ = jax.lax.scan(
-            round_step, (avail, assignment), None, length=rounds
-        )
+        for _ in range(passes):
+            cand_val, cand_idx = candidate_pass(avail, assignment)
+            (avail, assignment, _, _), _ = jax.lax.scan(
+                round_step, (avail, assignment, cand_val, cand_idx),
+                None, length=rounds,
+            )
         return avail, assignment
 
     new_avail, assignment = jax.lax.scan(

@@ -46,6 +46,7 @@ class MatchConfig:
     floor_iterations_before_reset: int = 1000000
     chunk: int = 0           # 0 = exact sequential greedy kernel
     chunk_rounds: int = 4
+    chunk_passes: int = 2    # candidate recomputes per chunk
 
 
 @dataclass
@@ -356,7 +357,8 @@ def match_pool(
     if prepared.solvable:
         if config.chunk:
             result = chunked_match(prepared.problem, chunk=config.chunk,
-                                   rounds=config.chunk_rounds)
+                                   rounds=config.chunk_rounds,
+                                   passes=config.chunk_passes)
         else:
             result = greedy_match(prepared.problem)
         assignment = np.asarray(
@@ -432,7 +434,8 @@ def match_pools_batched(
         elif config.chunk:
             result = jax.vmap(
                 lambda p: chunked_match(p, chunk=config.chunk,
-                                        rounds=config.chunk_rounds)
+                                        rounds=config.chunk_rounds,
+                                        passes=config.chunk_passes)
             )(stacked)
         else:
             result = jax.vmap(greedy_match)(stacked)
