@@ -119,6 +119,9 @@ class CookProcess:
     selector: LeaderSelector = None
     loops: list = field(default_factory=list)
     member_id: str = ""
+    progress_aggregator: object = None
+    heartbeats: object = None
+    sandbox_publisher: object = None
 
     def is_leader(self) -> bool:
         return self.selector is not None and self.selector.is_leader
@@ -213,8 +216,34 @@ def start_leader_duties(process: CookProcess,
             with span("rebalance-cycle", pool=pool.name):
                 scheduler.rebalance_cycle(pool)
 
+    # aux publishers/monitors (progress.clj, heartbeat.clj, sandbox.clj,
+    # monitor.clj equivalents)
+    from cook_tpu.scheduler.heartbeat import HeartbeatMonitor
+    from cook_tpu.scheduler.monitor import collect_all
+    from cook_tpu.scheduler.progress import ProgressAggregator
+    from cook_tpu.scheduler.sandbox import SandboxPublisher
+
+    process.progress_aggregator = ProgressAggregator(store)
+    process.sandbox_publisher = SandboxPublisher(store)
+
+    def kill_via_cluster(task_id: str) -> None:
+        inst = store.instances.get(task_id)
+        if inst is None:
+            return
+        cluster = scheduler.cluster_by_name(inst.compute_cluster)
+        if cluster is not None:
+            cluster.safe_kill_task(task_id)
+
+    process.heartbeats = HeartbeatMonitor(store, kill_via_cluster)
+
     process.loops = [
         TriggerLoop("rank", settings.rank_interval_s, rank_all).start(),
+        TriggerLoop("progress-publish", 2.0,
+                    process.progress_aggregator.publish).start(),
+        TriggerLoop("sandbox-publish", 5.0,
+                    process.sandbox_publisher.publish).start(),
+        TriggerLoop("heartbeats", 30.0, process.heartbeats.check).start(),
+        TriggerLoop("monitor", 30.0, lambda: collect_all(store)).start(),
         TriggerLoop("match",
                     max(settings.match_interval_s / max(len(pools()), 1),
                         0.05),

@@ -1,0 +1,98 @@
+"""Multi-cluster behavior (reference: test_multi_cluster.py +
+compute_cluster.clj dynamic state machine): matching across clusters,
+draining, deletion, reconciliation."""
+import pytest
+
+from cook_tpu.cluster.base import ClusterState
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import InstanceStatus, JobState, Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.core import Scheduler
+from tests.conftest import FakeClock, make_job
+
+
+def setup_two_clusters():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    c1 = MockCluster("east",
+                     [MockHost(node_id="e0", hostname="e0", mem=2000, cpus=4)],
+                     clock=clock)
+    c2 = MockCluster("west",
+                     [MockHost(node_id="w0", hostname="w0", mem=2000, cpus=4)],
+                     clock=clock)
+    scheduler = Scheduler(store, [c1, c2])
+    return clock, store, c1, c2, scheduler
+
+
+def test_jobs_spread_across_clusters():
+    clock, store, c1, c2, scheduler = setup_two_clusters()
+    jobs = [make_job(mem=1500, cpus=3) for _ in range(2)]
+    store.submit_jobs(jobs)
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 2
+    clusters_used = {store.instances[t].compute_cluster
+                     for t in outcome.launched_task_ids}
+    assert clusters_used == {"east", "west"}
+
+
+def test_draining_cluster_gets_no_new_work():
+    clock, store, c1, c2, scheduler = setup_two_clusters()
+    c1.set_state(ClusterState.DRAINING)
+    jobs = [make_job(mem=500, cpus=1) for _ in range(3)]
+    store.submit_jobs(jobs)
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    used = {store.instances[t].compute_cluster
+            for t in outcome.launched_task_ids}
+    assert used == {"west"}
+    # draining can resume
+    c1.set_state(ClusterState.RUNNING)
+    # deleted is terminal
+    c1.set_state(ClusterState.DRAINING)
+    c1.set_state(ClusterState.DELETED)
+    with pytest.raises(ValueError):
+        c1.set_state(ClusterState.RUNNING)
+
+
+def test_running_to_deleted_is_invalid():
+    clock, store, c1, c2, scheduler = setup_two_clusters()
+    with pytest.raises(ValueError):
+        c1.set_state(ClusterState.DELETED)
+
+
+def test_kill_routes_to_owning_cluster():
+    clock, store, c1, c2, scheduler = setup_two_clusters()
+    job = make_job(mem=1500, cpus=3)
+    store.submit_jobs([job])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    [inst] = store.job_instances(job.uuid)
+    owner = inst.compute_cluster
+    store.kill_jobs([job.uuid])
+    killed_on = c1 if owner == "east" else c2
+    other = c2 if owner == "east" else c1
+    assert killed_on.killed_count == 1
+    assert other.killed_count == 0
+    assert store.instances[inst.task_id].status == InstanceStatus.FAILED
+
+
+def test_reconcile_fails_unknown_tasks():
+    clock, store, c1, c2, scheduler = setup_two_clusters()
+    job = make_job(max_retries=3)
+    store.submit_jobs([job])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    [inst] = store.job_instances(job.uuid)
+    # backend loses the task without reporting (e.g. agent wipe)
+    c1.running.pop(inst.task_id, None)
+    c2.running.pop(inst.task_id, None)
+    fixed = scheduler.reconcile()
+    assert fixed == [inst.task_id]
+    # task-unknown is not mea-culpa but the job had retries
+    assert store.jobs[job.uuid].state == JobState.WAITING
