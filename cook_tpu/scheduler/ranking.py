@@ -47,23 +47,30 @@ def _quota_cap(
     for job in store.running_jobs(pool):
         running_counts[job.user] = running_counts.get(job.user, 0) + 1
     kept, capped = [], []
-    cum_res: dict[str, Resources] = {}
-    cum_count: dict[str, int] = {}
+    # per-user cumulative (mem, cpus, gpus, count) as plain tuples, and a
+    # per-user quota cache — this loop runs once per pending job
+    quotas: dict[str, tuple[float, float, float, int]] = {}
+    cum: dict[str, tuple[float, float, float, int]] = {}
     for job in pending:
-        quota = store.get_quota(job.user, pool)
-        res = cum_res.get(job.user, usage.get(job.user, Resources()))
-        count = cum_count.get(job.user, running_counts.get(job.user, 0))
-        new_res = res + job.resources
-        new_count = count + 1
-        if (
-            new_count <= quota.count
-            and new_res.mem <= quota.resources.mem
-            and new_res.cpus <= quota.resources.cpus
-            and new_res.gpus <= quota.resources.gpus
-        ):
+        user = job.user
+        q = quotas.get(user)
+        if q is None:
+            quota = store.get_quota(user, pool)
+            q = (quota.resources.mem, quota.resources.cpus,
+                 quota.resources.gpus, quota.count)
+            quotas[user] = q
+        state = cum.get(user)
+        if state is None:
+            u = usage.get(user)
+            state = ((u.mem, u.cpus, u.gpus) if u is not None
+                     else (0.0, 0.0, 0.0)) + (running_counts.get(user, 0),)
+        r = job.resources
+        new_state = (state[0] + r.mem, state[1] + r.cpus,
+                     state[2] + r.gpus, state[3] + 1)
+        if (new_state[3] <= q[3] and new_state[0] <= q[0]
+                and new_state[1] <= q[1] and new_state[2] <= q[2]):
             kept.append(job)
-            cum_res[job.user] = new_res
-            cum_count[job.user] = new_count
+            cum[user] = new_state
         else:
             capped.append(job.uuid)
     return kept, capped
