@@ -1,0 +1,98 @@
+"""Randomized torture test of the store + state machine: thousands of
+random operations must never violate the core invariants (the role of the
+reference's schema property tests)."""
+import numpy as np
+
+from cook_tpu.models.entities import InstanceStatus, JobState, Pool
+from cook_tpu.models.reasons import REASONS_BY_CODE
+from cook_tpu.models.state import attempts_consumed
+from cook_tpu.models.store import JobStore, TransactionVetoed
+from tests.conftest import FakeClock, make_job
+
+
+def check_invariants(store: JobStore):
+    for job in store.jobs.values():
+        insts = store.job_instances(job.uuid)
+        live = [i for i in insts if not i.status.terminal]
+        # at most one live instance per job
+        assert len(live) <= 1, job.uuid
+        if job.state == JobState.WAITING:
+            assert not live
+        if job.state == JobState.RUNNING:
+            assert live
+        if job.state == JobState.COMPLETED and any(
+            i.status == InstanceStatus.SUCCESS for i in insts
+        ):
+            pass  # success is terminal regardless of attempts
+        # a WAITING job's consumed attempts never exceed its budget
+        # (== is reachable: retries may legally shrink to exactly the
+        # consumed count on a waiting job, matching the reference's
+        # update-retry-count semantics)
+        if job.state == JobState.WAITING and insts:
+            assert attempts_consumed(job, insts) <= job.max_retries
+    # index consistency
+    for pool, ids in store._pool_pending.items():
+        for uuid in ids:
+            assert store.jobs[uuid].state == JobState.WAITING
+    for pool, ids in store._pool_running.items():
+        for uuid in ids:
+            assert store.jobs[uuid].state == JobState.RUNNING
+
+
+def test_store_fuzz():
+    rng = np.random.default_rng(1234)
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    job_ids: list[str] = []
+    task_seq = [0]
+    reasons = list(REASONS_BY_CODE)
+
+    def random_live_task():
+        live = [t for t, i in store.instances.items() if not i.status.terminal]
+        return live[rng.integers(len(live))] if live else None
+
+    for step in range(4000):
+        op = rng.integers(0, 100)
+        try:
+            if op < 20 or not job_ids:
+                job = make_job(user=f"u{rng.integers(5)}",
+                               max_retries=int(rng.integers(1, 4)))
+                store.submit_jobs([job])
+                job_ids.append(job.uuid)
+            elif op < 45:
+                uuid = job_ids[rng.integers(len(job_ids))]
+                task_seq[0] += 1
+                store.create_instance(uuid, f"ft{task_seq[0]}",
+                                      hostname=f"h{rng.integers(8)}")
+            elif op < 60:
+                t = random_live_task()
+                if t:
+                    store.update_instance_state(t, InstanceStatus.RUNNING)
+            elif op < 80:
+                t = random_live_task()
+                if t:
+                    status = (InstanceStatus.SUCCESS
+                              if rng.uniform() < 0.4 else InstanceStatus.FAILED)
+                    store.update_instance_state(
+                        t, status, int(reasons[rng.integers(len(reasons))])
+                    )
+            elif op < 90:
+                uuid = job_ids[rng.integers(len(job_ids))]
+                store.kill_jobs([uuid])
+                # fan-out: fail any live instances (scheduler's job normally)
+                for inst in store.live_instances_of_job(uuid):
+                    store.update_instance_state(
+                        inst.task_id, InstanceStatus.FAILED, 1001)
+            else:
+                uuid = job_ids[rng.integers(len(job_ids))]
+                store.retry_job(uuid, int(rng.integers(1, 6)))
+        except (TransactionVetoed, ValueError):
+            pass  # rejected ops are fine; invariants must still hold
+        if step % 200 == 0:
+            check_invariants(store)
+    check_invariants(store)
+    # sanity: the fuzz actually exercised all op kinds
+    assert len(job_ids) > 100
+    assert any(j.state == JobState.COMPLETED for j in store.jobs.values())
+    assert any(j.state == JobState.RUNNING for j in store.jobs.values())
