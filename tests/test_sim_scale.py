@@ -1,0 +1,41 @@
+"""Moderate-scale simulator runs (BASELINE config 1 shape, 1k jobs x 100
+nodes): exact-kernel vs fast-chunked-kernel replay must agree on packing
+quality, and both must keep the cluster busy."""
+import numpy as np
+
+from cook_tpu.models.entities import JobState
+from cook_tpu.scheduler.core import SchedulerConfig
+from cook_tpu.scheduler.matcher import MatchConfig
+from cook_tpu.sim.simulator import SimConfig, Simulator, synth_trace
+
+
+def run_once(chunk: int):
+    jobs, hosts = synth_trace(
+        2000, 100, n_users=20, seed=7,
+        mean_runtime_ms=90_000, submit_span_ms=240_000,
+    )
+    config = SimConfig(
+        cycle_ms=30_000,
+        max_cycles=400,
+        scheduler=SchedulerConfig(
+            match=MatchConfig(chunk=chunk, max_jobs_considered=1000)
+        ),
+    )
+    sim = Simulator(jobs, hosts, config)
+    result = sim.run()
+    assert all(
+        sim.store.jobs[j.uuid].state == JobState.COMPLETED for j in jobs
+    )
+    return result, hosts
+
+
+def test_config1_exact_vs_chunked_parity():
+    exact, hosts = run_once(chunk=0)
+    fast, _ = run_once(chunk=256)
+    u_exact = exact.utilization(hosts)
+    u_fast = fast.utilization(hosts)
+    # both complete all jobs; utilization (packing quality proxy) within 1%
+    assert u_exact > 0.05
+    assert abs(u_fast - u_exact) / u_exact < 0.01
+    # makespan parity: the chunked kernel shouldn't stretch the schedule
+    assert fast.virtual_ms <= exact.virtual_ms * 1.05
