@@ -127,8 +127,12 @@ class ComputeCluster(abc.ABC):
     name: str
     state: ClusterState
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, location: str = ""):
         self.name = name
+        # physical location (e.g. region/zone); checkpoint-locality steers
+        # restarted jobs to clusters co-located with their checkpoint
+        # (reference: constraints.clj:218, job->acceptable-compute-clusters)
+        self.location = location
         self.state = ClusterState.RUNNING
         self.kill_lock = KillLock()
 

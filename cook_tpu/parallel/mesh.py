@@ -127,7 +127,7 @@ def node_sharded_greedy_match(mesh: Mesh, problem: MatchProblem) -> MatchResult:
 
     j = problem.demands.shape[0]
     feas = (problem.feasible if problem.feasible is not None
-            else jnp.ones((j, n), dtype=bool))
+            else jnp.ones((j, ndev), dtype=bool))  # [J,1] per shard
     shmapped = jax.shard_map(
         local_solve, mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis), P(None, axis)),

@@ -83,6 +83,7 @@ def feasibility_mask(
     groups: Optional[dict[str, Group]] = None,
     tasks_on_host: Optional[dict[str, int]] = None,
     max_tasks_per_host: int = 0,
+    offer_locations: Optional[Sequence[str]] = None,
 ) -> np.ndarray:
     """Build the [J, N] mask.
 
@@ -113,7 +114,14 @@ def feasibility_mask(
         )
         mask &= ~full[None, :]
 
+    loc_arr = (np.array(offer_locations) if offer_locations is not None
+               else None)
     for ji, job in enumerate(jobs):
+        # checkpoint locality (constraints.clj:218): a job restarting from a
+        # checkpoint only runs where its checkpoint is reachable
+        if (job.checkpoint is not None and job.checkpoint.location
+                and loc_arr is not None):
+            mask[ji, :] &= loc_arr == job.checkpoint.location
         # novel-host: never revisit a host this job failed on
         if previous_hosts:
             for hostname in previous_hosts.get(job.uuid, ()):

@@ -126,7 +126,21 @@ class Scheduler:
     # -------------------------------------------------------------- cycles
 
     def rank_cycle(self, pool: Pool) -> RankedQueue:
-        queue = rank_pool(self.store, pool)
+        # offensive-job filter: quarantine jobs no host in the pool could
+        # ever hold (scheduler.clj:2198-2257)
+        from cook_tpu.scheduler.ranking import offensive_job_filter
+
+        max_mem = max_cpus = max_gpus = 0.0
+        for cluster in self.clusters:
+            if not cluster.accepts_work:
+                continue
+            for offer in cluster.pending_offers(pool.name):
+                max_mem = max(max_mem, offer.total_mem or offer.mem)
+                max_cpus = max(max_cpus, offer.total_cpus or offer.cpus)
+                max_gpus = max(max_gpus, offer.gpus)
+        filt = (offensive_job_filter(max_mem, max_cpus, max_gpus)
+                if max_mem > 0 else None)
+        queue = rank_pool(self.store, pool, offensive_job_filter=filt)
         self.pool_queues[pool.name] = queue
         self.metrics[f"rank.{pool.name}.queue_len"] = len(queue.jobs)
         return queue

@@ -214,6 +214,7 @@ def prepare_pool_problem(
         group_used_hosts=prepared.group_used_hosts,
         group_attr_value=prepared.group_attr_value,
         groups=prepared.groups,
+        offer_locations=[c.location for c, _ in prepared.cluster_offers],
     )
     if host_reservations:
         # rebalancer reservations (constraints.clj:242 + reserve-hosts!,

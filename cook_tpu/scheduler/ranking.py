@@ -76,6 +76,21 @@ def _quota_cap(
     return kept, capped
 
 
+def offensive_job_filter(
+    max_mem: float, max_cpus: float, max_gpus: float
+):
+    """Filter for jobs that can never be matched — demands beyond any host
+    in the pool (reference: the offensive-job filter at
+    scheduler.clj:2198-2257, which quarantines such jobs out of the queue
+    instead of letting them clog the head)."""
+
+    def accept(job: Job) -> bool:
+        r = job.resources
+        return r.mem <= max_mem and r.cpus <= max_cpus and r.gpus <= max_gpus
+
+    return accept
+
+
 def rank_pool(
     store: JobStore,
     pool: Pool,

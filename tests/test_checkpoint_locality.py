@@ -1,0 +1,62 @@
+"""Checkpoint-locality steering + offensive-job quarantine."""
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import Checkpoint, JobState, Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.core import Scheduler
+from tests.conftest import FakeClock, make_job
+
+
+def two_region_setup():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    east = MockCluster(
+        "east", [MockHost(node_id="e0", hostname="e0", mem=4000, cpus=8)],
+        clock=clock)
+    east.location = "us-east"
+    west = MockCluster(
+        "west", [MockHost(node_id="w0", hostname="w0", mem=4000, cpus=8)],
+        clock=clock)
+    west.location = "us-west"
+    scheduler = Scheduler(store, [east, west])
+    return clock, store, scheduler
+
+
+def test_checkpointed_job_pinned_to_its_region():
+    clock, store, scheduler = two_region_setup()
+    job = make_job(
+        checkpoint=Checkpoint(mode="auto", location="us-west"))
+    store.submit_jobs([job])
+    pool = store.pools["default"]
+    for _ in range(3):  # repeated cycles must keep choosing west
+        scheduler.rank_cycle(pool)
+        outcome = scheduler.match_cycle(pool)
+        if outcome.matched:
+            break
+    [inst] = store.job_instances(job.uuid)
+    assert inst.compute_cluster == "west"
+
+
+def test_uncheckpointed_job_unrestricted():
+    clock, store, scheduler = two_region_setup()
+    jobs = [make_job(mem=3000, cpus=6) for _ in range(2)]
+    store.submit_jobs(jobs)
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 2  # spread over both regions
+
+
+def test_offensive_job_quarantined_from_queue():
+    clock, store, scheduler = two_region_setup()
+    monster = make_job(mem=999_999, cpus=1)  # larger than any host
+    normal = make_job(mem=100, cpus=1)
+    store.submit_jobs([monster, normal])
+    pool = store.pools["default"]
+    queue = scheduler.rank_cycle(pool)
+    queued = {j.uuid for j in queue.jobs}
+    assert normal.uuid in queued
+    assert monster.uuid not in queued  # never clogs the queue head
+    outcome = scheduler.match_cycle(pool)
+    assert {j.uuid for j, _ in outcome.matched} == {normal.uuid}
+    assert store.jobs[monster.uuid].state == JobState.WAITING
