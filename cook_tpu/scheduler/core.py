@@ -131,16 +131,24 @@ class Scheduler:
         from cook_tpu.scheduler.ranking import offensive_job_filter
 
         max_mem = max_cpus = max_gpus = 0.0
+        autoscales = False
         for cluster in self.clusters:
             if not cluster.accepts_work:
                 continue
+            # an autoscaling cluster can grow capacity, so nothing is
+            # offensive relative to its current nodes
+            autoscales = autoscales or cluster.autoscaling(pool.name)
             for offer in cluster.pending_offers(pool.name):
                 max_mem = max(max_mem, offer.total_mem or offer.mem)
                 max_cpus = max(max_cpus, offer.total_cpus or offer.cpus)
                 max_gpus = max(max_gpus, offer.gpus)
         filt = (offensive_job_filter(max_mem, max_cpus, max_gpus)
-                if max_mem > 0 else None)
+                if max_mem > 0 and not autoscales else None)
         queue = rank_pool(self.store, pool, offensive_job_filter=filt)
+        for uuid in queue.quarantined:
+            self.placement_failures[uuid] = (
+                "The job's resource demands exceed every host in the pool."
+            )
         self.pool_queues[pool.name] = queue
         self.metrics[f"rank.{pool.name}.queue_len"] = len(queue.jobs)
         return queue

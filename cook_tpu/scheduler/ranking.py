@@ -31,6 +31,11 @@ class RankedQueue:
     jobs: list[Job]          # pending jobs in fair-share order
     dru: dict[str, float]    # job uuid -> queue dru
     capped: list[str]        # job uuids dropped by quota capping
+    quarantined: list[str] = None  # dropped by the offensive-job filter
+
+    def __post_init__(self):
+        if self.quarantined is None:
+            self.quarantined = []
 
 
 def _quota_cap(
@@ -100,8 +105,15 @@ def rank_pool(
     """Rank one pool's pending jobs by cumulative DRU."""
     pool_name = pool.name
     pending = store.pending_jobs(pool_name)
+    quarantined: list[str] = []
     if offensive_job_filter is not None:
-        pending = [j for j in pending if offensive_job_filter(j)]
+        kept = []
+        for j in pending:
+            if offensive_job_filter(j):
+                kept.append(j)
+            else:
+                quarantined.append(j.uuid)
+        pending = kept
 
     # order pending per user by (-priority, submit-time, uuid) — the
     # pending-job part of task->feature-vector (tools.clj:614-641)
@@ -116,7 +128,7 @@ def rank_pool(
 
     t_total = len(running) + len(pending)
     if t_total == 0 or not pending:
-        return RankedQueue(jobs=[], dru={}, capped=capped)
+        return RankedQueue(jobs=[], dru={}, capped=capped, quarantined=quarantined)
 
     users = sorted(
         {j.user for j in pending} | {j.user for j, _ in running}
@@ -194,4 +206,4 @@ def rank_pool(
         job = job_refs[pos]
         ranked_jobs.append(job)
         dru_map[job.uuid] = float(dru[pos])
-    return RankedQueue(jobs=ranked_jobs, dru=dru_map, capped=capped)
+    return RankedQueue(jobs=ranked_jobs, dru=dru_map, capped=capped, quarantined=quarantined)
