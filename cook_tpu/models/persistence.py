@@ -1,0 +1,229 @@
+"""Store durability: snapshots + append-only event journal.
+
+The reference delegates durability to Datomic (state survives leader
+failover; the new leader reads the DB and reconstructs backend expectations
+— kubernetes/compute_cluster.clj:269).  Here the JobStore persists itself:
+
+  * `JournalWriter` appends every committed event as a JSON line (the
+    transaction log); fsync policy is the caller's choice.
+  * `snapshot` / `load_snapshot` serialize full store state; a snapshot +
+    the journal suffix after it reconstructs the store exactly.
+  * `attach_journal` wires a live store to a journal file; `recover`
+    rebuilds a store from snapshot+journal at startup.
+
+Entities serialize via dataclasses.asdict with enum-aware encoding.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+from typing import Any, Optional
+
+from cook_tpu.models.entities import (
+    Checkpoint,
+    ConstraintOperator,
+    Container,
+    DruMode,
+    Group,
+    GroupPlacementType,
+    HostPlacement,
+    Instance,
+    InstanceStatus,
+    Job,
+    JobConstraint,
+    JobState,
+    Pool,
+    Quota,
+    Resources,
+    Share,
+    StragglerHandling,
+)
+from cook_tpu.models.store import Event, JobStore
+
+
+def _encode(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: _encode(v)
+                for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, float) and obj == float("inf"):
+        return "Infinity"
+    return obj
+
+
+def _dec_float(x):
+    return float("inf") if x == "Infinity" else x
+
+
+def _dec_resources(d: dict) -> Resources:
+    return Resources(
+        mem=_dec_float(d["mem"]), cpus=_dec_float(d["cpus"]),
+        gpus=_dec_float(d["gpus"]), disk=_dec_float(d.get("disk", 0.0)),
+        ports=int(d.get("ports", 0)),
+    )
+
+
+def _dec_job(d: dict) -> Job:
+    return Job(
+        uuid=d["uuid"],
+        user=d["user"],
+        command=d["command"],
+        name=d["name"],
+        priority=d["priority"],
+        max_retries=d["max_retries"],
+        max_runtime_ms=d["max_runtime_ms"],
+        expected_runtime_ms=d["expected_runtime_ms"],
+        resources=_dec_resources(d["resources"]),
+        pool=d["pool"],
+        state=JobState(d["state"]),
+        submit_time_ms=d["submit_time_ms"],
+        user_provided_env=tuple(map(tuple, d["user_provided_env"])),
+        labels=tuple(map(tuple, d["labels"])),
+        constraints=tuple(
+            JobConstraint(attribute=c["attribute"],
+                          operator=ConstraintOperator(c["operator"]),
+                          pattern=c["pattern"])
+            for c in d["constraints"]
+        ),
+        group_uuid=d["group_uuid"],
+        container=(Container(**{**d["container"],
+                                "volumes": tuple(d["container"]["volumes"]),
+                                "ports": tuple(d["container"]["ports"]),
+                                "env": tuple(map(tuple, d["container"]["env"]))})
+                   if d["container"] else None),
+        application=None,
+        checkpoint=(Checkpoint(
+            mode=d["checkpoint"]["mode"],
+            periodic_sec=d["checkpoint"]["periodic_sec"],
+            preserve_paths=tuple(d["checkpoint"]["preserve_paths"]),
+            location=d["checkpoint"]["location"],
+        ) if d["checkpoint"] else None),
+        disable_mea_culpa_retries=d["disable_mea_culpa_retries"],
+        instance_ids=tuple(d["instance_ids"]),
+        custom_executor=d["custom_executor"],
+        last_waiting_start_time_ms=d["last_waiting_start_time_ms"],
+        last_fenzo_placement_failure=d["last_fenzo_placement_failure"],
+    )
+
+
+def _dec_instance(d: dict) -> Instance:
+    d = dict(d)
+    d["status"] = InstanceStatus(d["status"])
+    return Instance(**d)
+
+
+def _dec_group(d: dict) -> Group:
+    return Group(
+        uuid=d["uuid"],
+        name=d["name"],
+        host_placement=HostPlacement(
+            type=GroupPlacementType(d["host_placement"]["type"]),
+            attribute=d["host_placement"]["attribute"],
+            minimum=d["host_placement"]["minimum"],
+        ),
+        straggler_handling=StragglerHandling(**d["straggler_handling"]),
+        job_uuids=tuple(d["job_uuids"]),
+    )
+
+
+def snapshot(store: JobStore, path: str) -> None:
+    """Write full store state atomically."""
+    with store._lock:
+        state = {
+            "seq": store._events[-1].seq if store._events else 0,
+            "jobs": {k: _encode(v) for k, v in store.jobs.items()},
+            "instances": {k: _encode(v) for k, v in store.instances.items()},
+            "groups": {k: _encode(v) for k, v in store.groups.items()},
+            "pools": {k: _encode(v) for k, v in store.pools.items()},
+            "shares": [
+                _encode(v) for v in store.shares.values()
+            ],
+            "quotas": [
+                _encode(v) for v in store.quotas.values()
+            ],
+            "dynamic_config": store.dynamic_config,
+        }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_snapshot(path: str, *, clock=None) -> JobStore:
+    with open(path) as f:
+        state = json.load(f)
+    store = JobStore(clock=clock)
+    for k, v in state["pools"].items():
+        store.pools[k] = Pool(name=v["name"], purpose=v["purpose"],
+                              state=v["state"],
+                              dru_mode=DruMode(v["dru_mode"]))
+    for k, v in state["jobs"].items():
+        job = _dec_job(v)
+        store.jobs[k] = job
+        store._index_job(job, None)
+    for k, v in state["instances"].items():
+        store.instances[k] = _dec_instance(v)
+    for k, v in state["groups"].items():
+        store.groups[k] = _dec_group(v)
+    for v in state["shares"]:
+        store.shares[(v["user"], v["pool"])] = Share(
+            user=v["user"], pool=v["pool"],
+            resources=_dec_resources(v["resources"]), reason=v["reason"])
+    for v in state["quotas"]:
+        store.quotas[(v["user"], v["pool"])] = Quota(
+            user=v["user"], pool=v["pool"],
+            resources=_dec_resources(v["resources"]),
+            count=v["count"], reason=v["reason"])
+    store.dynamic_config = state.get("dynamic_config", {})
+    # resume event sequence numbering after the snapshot point
+    import itertools
+
+    store._seq = itertools.count(state["seq"] + 1)
+    return store
+
+
+class JournalWriter:
+    """Append-only event journal (one JSON line per committed event)."""
+
+    def __init__(self, path: str, *, fsync_every: int = 0):
+        self.path = path
+        self.fsync_every = fsync_every
+        self._count = 0
+        self._f = open(path, "a")
+
+    def __call__(self, event: Event) -> None:
+        self._f.write(event.to_json() + "\n")
+        self._f.flush()
+        self._count += 1
+        if self.fsync_every and self._count % self.fsync_every == 0:
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def attach_journal(store: JobStore, path: str, **kw) -> JournalWriter:
+    writer = JournalWriter(path, **kw)
+    store.add_watcher(writer)
+    return writer
+
+
+def read_journal(path: str) -> list[dict]:
+    events = []
+    if not os.path.exists(path):
+        return events
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
