@@ -133,8 +133,29 @@ def build_process(
     clock: Callable[[], int] = wall_clock_ms,
     start_rest: bool = True,
 ) -> CookProcess:
-    store = JobStore(mea_culpa_limit=settings.mea_culpa_failure_limit,
-                     clock=clock)
+    store = None
+    if settings.data_dir:
+        # failover recovery: load the last snapshot, then journal onward
+        import os
+
+        from cook_tpu.models import persistence
+
+        os.makedirs(settings.data_dir, exist_ok=True)
+        snap_path = os.path.join(settings.data_dir, "snapshot.json")
+        if os.path.exists(snap_path):
+            store = persistence.load_snapshot(snap_path, clock=clock)
+            store.mea_culpa_limit = settings.mea_culpa_failure_limit
+            log_info("recovered store from snapshot", component="startup",
+                     jobs=len(store.jobs))
+    if store is None:
+        store = JobStore(mea_culpa_limit=settings.mea_culpa_failure_limit,
+                         clock=clock)
+    if settings.data_dir:
+        from cook_tpu.models import persistence
+
+        persistence.attach_journal(
+            store, os.path.join(settings.data_dir, "journal.jsonl")
+        )
     for pool_conf in settings.pools:
         store.set_pool(Pool(
             name=pool_conf["name"],
@@ -244,6 +265,19 @@ def start_leader_duties(process: CookProcess,
                     process.sandbox_publisher.publish).start(),
         TriggerLoop("heartbeats", 30.0, process.heartbeats.check).start(),
         TriggerLoop("monitor", 30.0, lambda: collect_all(store)).start(),
+    ]
+    if settings.data_dir:
+        import os as _os
+
+        from cook_tpu.models import persistence as _persistence
+
+        snap_path = _os.path.join(settings.data_dir, "snapshot.json")
+        process.loops.append(
+            TriggerLoop("snapshot", settings.snapshot_interval_s,
+                        lambda: _persistence.snapshot(store, snap_path)
+                        ).start()
+        )
+    process.loops += [
         TriggerLoop("match",
                     max(settings.match_interval_s / max(len(pools()), 1),
                         0.05),

@@ -45,6 +45,8 @@ class Settings:
     pools: list[dict] = field(default_factory=lambda: [{"name": "default"}])
     clusters: list[dict] = field(default_factory=list)
     leader_lease_path: str = ""
+    data_dir: str = ""                  # "" = in-memory only
+    snapshot_interval_s: float = 300.0
     admins: tuple = ("admin",)
     queue_limit_per_pool: int = 1_000_000
     queue_limit_per_user: int = 100_000
@@ -78,7 +80,8 @@ def read_config(path: Optional[str] = None,
     for key in ("port", "default_pool", "mea_culpa_failure_limit",
                 "rank_interval_s", "match_interval_s",
                 "rebalancer_interval_s", "optimizer_interval_s",
-                "leader_lease_path", "queue_limit_per_pool",
+                "leader_lease_path", "data_dir", "snapshot_interval_s",
+                "queue_limit_per_pool",
                 "queue_limit_per_user", "submission_rate_per_minute"):
         if key in data:
             setattr(settings, key, data[key])
