@@ -1,0 +1,118 @@
+"""Pallas TPU kernel: fused feasibility + binpacking fitness + argmax.
+
+The innermost operation of every matcher variant is "for a block of jobs,
+find each job's best feasible node": feasibility compare, fitness compute,
+masked argmax over the node axis.  Done with stock XLA ops this makes
+multiple passes over the [K, N] intermediates; this kernel fuses them into
+one pass with the score tile resident in VMEM and a running (max, argmax)
+accumulator — the node axis is the grid's inner dimension, so each job
+block streams through all node tiles without ever materializing [K, N] in
+HBM.
+
+Used as an optional backend for the matchers (`best_node(...)`); the
+default path keeps the pure-XLA implementation (which the compiler already
+fuses well) — this kernel exists for the tuning headroom on real v5e
+hardware and runs under `interpret=True` on CPU for tests.
+
+Layout notes (pallas_guide.md): f32 tiles are (8, 128) minimum; iota must
+be >=1D via broadcasted_iota; scalars live in SMEM-shaped (1, 1) refs.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from cook_tpu.ops.common import BIG
+
+
+def _best_node_kernel(d_ref, avail_ref, totals_ref, valid_ref,
+                      best_val_ref, best_idx_ref):
+    """Grid = (jobs/BK, nodes/BN); node axis is innermost (sequential), so
+    (best_val, best_idx) accumulate across node tiles."""
+    n_tile = pl.program_id(1)
+    bn = avail_ref.shape[0]
+
+    d = d_ref[:]                      # [BK, 3]
+    avail = avail_ref[:]              # [BN, 3]
+    totals = totals_ref[:]            # [BN, 2]
+    valid = valid_ref[:]              # [BN]
+
+    # feasibility: every resource fits  -> [BK, BN]
+    fits = jnp.all(avail[None, :, :] >= d[:, None, :], axis=-1)
+    feasible = fits & (valid[None, :] > 0)
+    # cpuMemBinPacker fitness
+    denom0 = jnp.maximum(totals[:, 0], 1e-30)
+    denom1 = jnp.maximum(totals[:, 1], 1e-30)
+    used0 = totals[:, 0] - avail[:, 0]
+    used1 = totals[:, 1] - avail[:, 1]
+    fit = ((used0[None, :] + d[:, 0:1]) / denom0[None, :]
+           + (used1[None, :] + d[:, 1:2]) / denom1[None, :]) * 0.5
+    score = jnp.where(feasible, fit, -BIG)          # [BK, BN]
+
+    local_best = jnp.max(score, axis=1)             # [BK]
+    col = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1)
+    local_idx = jnp.max(
+        jnp.where(score == local_best[:, None], bn - col, 0), axis=1
+    )
+    # first-index tie-break: largest (bn - col) = smallest col
+    local_idx = (bn - local_idx) + n_tile * bn       # global node index
+
+    @pl.when(n_tile == 0)
+    def _init():
+        best_val_ref[:] = local_best
+        best_idx_ref[:] = local_idx.astype(jnp.int32)
+
+    @pl.when(n_tile > 0)
+    def _accum():
+        prev_val = best_val_ref[:]
+        prev_idx = best_idx_ref[:]
+        take_new = local_best > prev_val  # strict: earlier tile wins ties
+        best_val_ref[:] = jnp.where(take_new, local_best, prev_val)
+        best_idx_ref[:] = jnp.where(
+            take_new, local_idx.astype(jnp.int32), prev_idx
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("block_jobs", "block_nodes",
+                                             "interpret"))
+def best_node(
+    demands: jnp.ndarray,     # [K, 3]
+    avail: jnp.ndarray,       # [N, 3]
+    totals: jnp.ndarray,      # [N, 2]
+    node_valid: jnp.ndarray,  # [N] (bool or int)
+    *,
+    block_jobs: int = 256,
+    block_nodes: int = 512,
+    interpret: bool = False,
+):
+    """Per-job best feasible node: returns (best_score [K], best_idx [K]);
+    best_idx is -1 (and score -BIG) when no node is feasible."""
+    k, n = demands.shape[0], avail.shape[0]
+    assert k % block_jobs == 0 and n % block_nodes == 0
+    valid_i = node_valid.astype(jnp.int32)
+
+    best_val, best_idx = pl.pallas_call(
+        _best_node_kernel,
+        grid=(k // block_jobs, n // block_nodes),
+        in_specs=[
+            pl.BlockSpec((block_jobs, 3), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_nodes, 3), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_nodes, 2), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_nodes,), lambda i, j: (j,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
+            pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k,), jnp.float32),
+            jax.ShapeDtypeStruct((k,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(demands.astype(jnp.float32), avail.astype(jnp.float32),
+      totals.astype(jnp.float32), valid_i)
+    found = best_val > -BIG
+    return best_val, jnp.where(found, best_idx, -1)
