@@ -257,6 +257,16 @@ def start_leader_duties(process: CookProcess,
 
     process.heartbeats = HeartbeatMonitor(store, kill_via_cluster)
 
+    # k8s-style clusters: failover recovery + periodic anti-entropy scans
+    # (determine-expected-state-on-startup + scan-process)
+    scannable = [c for c in process.clusters if hasattr(c, "scan_all")]
+    for cluster in scannable:
+        cluster.determine_expected_state_on_startup({
+            i.task_id for i in store.instances.values()
+            if not i.status.terminal
+            and i.compute_cluster == cluster.name
+        })
+
     process.loops = [
         TriggerLoop("rank", settings.rank_interval_s, rank_all).start(),
         TriggerLoop("progress-publish", 2.0,
@@ -266,6 +276,11 @@ def start_leader_duties(process: CookProcess,
         TriggerLoop("heartbeats", 30.0, process.heartbeats.check).start(),
         TriggerLoop("monitor", 30.0, lambda: collect_all(store)).start(),
     ]
+    if scannable:
+        process.loops.append(
+            TriggerLoop("k8s-scan", 30.0,
+                        lambda: [c.scan_all() for c in scannable]).start()
+        )
     if settings.data_dir:
         import os as _os
 
