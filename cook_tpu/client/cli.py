@@ -271,6 +271,51 @@ def cmd_retry(args) -> int:
     return 0
 
 
+def cmd_admin_set_share(args) -> int:
+    import requests
+
+    for cluster, client in _clients(args):
+        r = requests.post(
+            f"{cluster.url}/share",
+            json={"user": args.target_user, "pool": args.pool,
+                  "share": {"mem": args.mem, "cpus": args.cpus,
+                            "gpus": args.gpus},
+                  "reason": args.reason},
+            headers=client._headers(), timeout=30)
+        print(f"{cluster.name}: {r.status_code}")
+    return 0
+
+
+def cmd_admin_set_quota(args) -> int:
+    import requests
+
+    quota = {}
+    for key in ("mem", "cpus", "gpus", "count"):
+        value = getattr(args, key)
+        if value is not None:
+            quota[key] = value
+    for cluster, client in _clients(args):
+        r = requests.post(
+            f"{cluster.url}/quota",
+            json={"user": args.target_user, "pool": args.pool,
+                  "quota": quota, "reason": args.reason},
+            headers=client._headers(), timeout=30)
+        print(f"{cluster.name}: {r.status_code}")
+    return 0
+
+
+def cmd_admin_drain(args) -> int:
+    import requests
+
+    for cluster, client in _clients(args):
+        r = requests.post(
+            f"{cluster.url}/compute-clusters",
+            json={"name": args.name, "state": "draining"},
+            headers=client._headers(), timeout=30)
+        print(f"{cluster.name}: {r.status_code} {r.text.strip()}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cs", description="cook-tpu scheduler CLI"
@@ -330,6 +375,29 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("uuid")
     q.add_argument("path")
     q.set_defaults(fn=cmd_cat)
+
+    q = sub.add_parser("admin", help="admin operations")
+    asub = q.add_subparsers(dest="admin_cmd", required=True)
+    aq = asub.add_parser("set-share")
+    aq.add_argument("--for-user", required=True, dest="target_user")
+    aq.add_argument("--pool", default="default")
+    aq.add_argument("--mem", type=float, default=0)
+    aq.add_argument("--cpus", type=float, default=0)
+    aq.add_argument("--gpus", type=float, default=0)
+    aq.add_argument("--reason", default="")
+    aq.set_defaults(fn=cmd_admin_set_share)
+    aq = asub.add_parser("set-quota")
+    aq.add_argument("--for-user", required=True, dest="target_user")
+    aq.add_argument("--pool", default="default")
+    aq.add_argument("--mem", type=float)
+    aq.add_argument("--cpus", type=float)
+    aq.add_argument("--gpus", type=float)
+    aq.add_argument("--count", type=int)
+    aq.add_argument("--reason", default="")
+    aq.set_defaults(fn=cmd_admin_set_quota)
+    aq = asub.add_parser("drain-cluster")
+    aq.add_argument("name")
+    aq.set_defaults(fn=cmd_admin_drain)
 
     q = sub.add_parser("tail", help="tail a sandbox file")
     q.add_argument("uuid")

@@ -138,7 +138,17 @@ class CookApi:
         r.add_get("/incremental-config", self.get_incremental_config)
         r.add_post("/incremental-config", self.post_incremental_config)
         r.add_post("/shutdown-leader", self.post_shutdown_leader)
+        r.add_get("/debug", self.get_debug)
         return app
+
+    async def get_debug(self, request: web.Request) -> web.Response:
+        """Health endpoint (reference components.clj:141): 200 when the
+        process serves; includes leadership so load balancers can route
+        writes to the leader."""
+        return web.json_response({
+            "healthy": True,
+            "leader": bool(self.scheduler) and self.leader,
+        })
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
@@ -150,11 +160,17 @@ class CookApi:
             user = impersonate
         request["user"] = user
         try:
-            return await handler(request)
+            response = await handler(request)
         except web.HTTPException:
             raise
         except TransactionVetoed as e:
             return _err(400, str(e))
+        # permissive CORS for browser dashboards (reference: cors middleware)
+        origin = request.headers.get("Origin")
+        if origin:
+            response.headers["Access-Control-Allow-Origin"] = origin
+            response.headers["Access-Control-Allow-Credentials"] = "true"
+        return response
 
     # ------------------------------------------------------------------ jobs
 

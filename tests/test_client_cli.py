@@ -122,3 +122,24 @@ def test_cli_jobs_and_usage(server, cfg, capsys):
 
 def test_cli_unknown_uuid(server, cfg, capsys):
     assert cli(server, "show", "no-such-uuid") == 1
+
+
+def test_cli_admin_share_and_quota(server, cfg, capsys):
+    assert cli_main(["--config", server.cfg_path, "--user", "admin",
+                     "admin", "set-share", "--for-user", "zed",
+                     "--mem", "500", "--cpus", "5"]) == 0
+    capsys.readouterr()
+    assert cli_main(["--config", server.cfg_path, "--user", "admin",
+                     "admin", "set-quota", "--for-user", "zed",
+                     "--count", "4"]) == 0
+    capsys.readouterr()
+    assert server.store.get_share("zed", "default").mem == 500
+    assert server.store.get_quota("zed", "default").count == 4
+
+
+def test_debug_endpoint(server):
+    import requests
+
+    r = requests.get(f"{server.url}/debug")
+    assert r.status_code == 200
+    assert r.json()["healthy"] is True
