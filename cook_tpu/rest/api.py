@@ -26,6 +26,9 @@ from aiohttp import web
 
 from cook_tpu.cluster.base import ClusterState
 from cook_tpu.models.entities import (
+    Application,
+    Checkpoint,
+    Container,
     Group,
     GroupPlacementType,
     HostPlacement,
@@ -108,6 +111,7 @@ class CookApi:
         r.add_get("/jobs/{uuid}", self.get_job)
         r.add_get("/instances/{uuid}", self.get_instance)
         r.add_get("/instances", self.get_instances)
+        r.add_delete("/instances", self.delete_instances)
         r.add_get("/group", self.get_groups)
         r.add_delete("/group", self.delete_groups)
         r.add_get("/share", self.get_share)
@@ -260,6 +264,33 @@ class CookApi:
                 and group_uuid not in self.store.groups:
             # implicit group creation (reference: make-default-host-placement)
             groups[group_uuid] = Group(uuid=group_uuid)
+        container = None
+        cspec = spec.get("container")
+        if cspec:
+            docker = cspec.get("docker", cspec)
+            container = Container(
+                image=docker.get("image", ""),
+                kind=cspec.get("type", "docker").lower(),
+                env=tuple(sorted(docker.get("env", {}).items())),
+            )
+        application = None
+        aspec = spec.get("application")
+        if aspec:
+            application = Application(
+                name=aspec.get("name", ""),
+                version=aspec.get("version", ""),
+                workload_class=aspec.get("workload-class", ""),
+                workload_id=aspec.get("workload-id", ""),
+            )
+        checkpoint = None
+        ckpt = spec.get("checkpoint")
+        if ckpt:
+            checkpoint = Checkpoint(
+                mode=ckpt.get("mode", "auto"),
+                periodic_sec=int(ckpt.get("periodic-sec", 0)),
+                preserve_paths=tuple(ckpt.get("preserve-paths", ())),
+                location=ckpt.get("location", ""),
+            )
         job = Job(
             uuid=uuid,
             user=user,
@@ -276,6 +307,9 @@ class CookApi:
             labels=tuple(sorted(spec.get("labels", {}).items())),
             constraints=tuple(constraints),
             group_uuid=group_uuid,
+            container=container,
+            application=application,
+            checkpoint=checkpoint,
             disable_mea_culpa_retries=bool(
                 spec.get("disable_mea_culpa_retries", False)),
         )
@@ -422,6 +456,24 @@ class CookApi:
                 return _err(404, f"unknown instance {uuid}")
             out.append(self._instance_json(inst))
         return web.json_response(out)
+
+    async def delete_instances(self, request: web.Request) -> web.Response:
+        """Cancel specific instances (the job may retry elsewhere); the
+        cancelled-task-killer reaps them (scheduler.clj:2000)."""
+        uuids = request.query.getall("instance", [])
+        user = request["user"]
+        for uuid in uuids:
+            inst = self.store.instances.get(uuid)
+            if inst is None:
+                return _err(404, f"unknown instance {uuid}")
+            job = self.store.jobs[inst.job_uuid]
+            if job.user != user and user not in self.config.admins:
+                return _err(403, f"not authorized to cancel {uuid}")
+        for uuid in uuids:
+            self.store.mark_instance_cancelled(uuid)
+        if self.scheduler is not None:
+            self.scheduler.kill_cancelled_tasks()
+        return web.Response(status=204)
 
     # ---------------------------------------------------------------- groups
 

@@ -240,3 +240,36 @@ def test_queue_endpoint(server):
     server.scheduler.rank_cycle(server.store.pools["default"])
     r = requests.get(f"{server.url}/queue", headers=hdr())
     assert "default" in r.json()
+
+
+def test_container_application_checkpoint_parsing(server):
+    out = submit(server, [{
+        "command": "x",
+        "container": {"type": "DOCKER",
+                      "docker": {"image": "repo/img:v1"}},
+        "application": {"name": "spark", "version": "3.0"},
+        "checkpoint": {"mode": "auto", "location": "us-east"},
+    }])
+    job = server.store.jobs[out["jobs"][0]]
+    assert job.container.image == "repo/img:v1"
+    assert job.application.name == "spark"
+    assert job.checkpoint.location == "us-east"
+
+
+def test_cancel_instance_endpoint(server):
+    uuid = submit(server, [{"command": "c", "mem": 100, "cpus": 1,
+                            "max_retries": 3}])["jobs"][0]
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+    [inst] = server.store.job_instances(uuid)
+    # another user may not cancel
+    r = requests.delete(f"{server.url}/instances",
+                        params={"instance": inst.task_id}, headers=hdr("eve"))
+    assert r.status_code == 403
+    r = requests.delete(f"{server.url}/instances",
+                        params={"instance": inst.task_id}, headers=hdr())
+    assert r.status_code == 204
+    assert server.store.instances[inst.task_id].status.value == "failed"
+    # the job retries (cancel kills the instance, not the job)
+    assert server.store.jobs[uuid].state.value == "waiting"
