@@ -98,6 +98,7 @@ class CookApi:
         else:
             self.submission_limiter = UnlimitedRateLimiter()
         self.leader = True
+        self.leader_url = ""  # set on standbys for leader proxying
 
     # ------------------------------------------------------------ app wiring
 
@@ -648,6 +649,12 @@ class CookApi:
     # ------------------------------------------------------------- queue etc
 
     async def get_queue(self, request: web.Request) -> web.Response:
+        if not self.leader and self.leader_url:
+            # non-leader nodes send queue queries to the leader
+            # (reference: leader proxying, rest/api.clj:2408)
+            raise web.HTTPTemporaryRedirect(
+                f"{self.leader_url}/queue"
+            )
         if self.scheduler is None:
             return _err(503, "no scheduler attached")
         out = {}
