@@ -47,6 +47,10 @@ class SchedulerConfig:
     match: MatchConfig = field(default_factory=MatchConfig)
     rebalancer: RebalancerParams = field(default_factory=RebalancerParams)
     max_runtime_check: bool = True
+    # per-user-per-pool launch rate (token bucket); 0 = unlimited
+    # (reference: create-per-user-per-pool-launch-rate-limiter, quota.clj:118)
+    user_launch_rate_per_minute: float = 0.0
+    user_launch_burst: float = 0.0
 
 
 class Scheduler:
@@ -67,6 +71,17 @@ class Scheduler:
         self.config = config or SchedulerConfig()
         self.plugins = plugins or PluginRegistry()
         self._launch_filter_cache: dict = {}
+        self.launch_rate_limiter = None
+        if self.config.user_launch_rate_per_minute > 0:
+            from cook_tpu.scheduler.ratelimit import TokenBucketRateLimiter
+
+            self.launch_rate_limiter = TokenBucketRateLimiter(
+                tokens_replenished_per_minute=(
+                    self.config.user_launch_rate_per_minute),
+                bucket_size=(self.config.user_launch_burst
+                             or self.config.user_launch_rate_per_minute),
+                clock=store.clock,
+            )
         self._task_seq = itertools.count()
         self.pool_queues: dict[str, RankedQueue] = {}
         self.pool_match_state: dict[str, PoolMatchState] = {}
@@ -167,10 +182,14 @@ class Scheduler:
             self.config.match,
             state,
             make_task_id=self._make_task_id,
-            launch_filter=self._launch_filter,
+            launch_filter=self._make_launch_filter(),
             record_placement_failure=self._record_placement_failure,
             host_reservations=self.host_reservations,
         )
+        # charge launches against the per-user rate limiter (spend-through)
+        if self.launch_rate_limiter is not None:
+            for job, _ in outcome.matched:
+                self.launch_rate_limiter.spend((job.user, job.pool))
         # cache spare resources for the rebalancer (view-incubating-offers,
         # scheduler.clj:1537): offers minus what this cycle just placed
         matched_uuids = {j.uuid for j, _ in outcome.matched}
@@ -267,13 +286,33 @@ class Scheduler:
     def _record_placement_failure(self, job: Job, reason: str) -> None:
         self.placement_failures[job.uuid] = reason
 
-    def _launch_filter(self, job: Job) -> bool:
-        """JobLaunchFilter plugins with TTL cache (plugins/launch.clj)."""
-        if not self.plugins.launch_filters:
-            return True
-        return self.plugins.check_launch(
-            job, self.store.clock(), self._launch_filter_cache
-        )
+    def _make_launch_filter(self):
+        """Considerable-job filters: per-user launch rate limit
+        (pending-jobs->considerable-jobs, scheduler.clj:729) + the
+        JobLaunchFilter plugins with TTL cache (plugins/launch.clj).
+        Returns a per-cycle closure: the rate budget is snapshotted at
+        cycle start and debited as jobs are selected, so one cycle can't
+        select more launches than the bucket holds."""
+        budget: dict = {}
+
+        def launch_filter(job: Job) -> bool:
+            if self.launch_rate_limiter is not None:
+                key = (job.user, job.pool)
+                remaining = budget.get(key)
+                if remaining is None:
+                    bucket = self.launch_rate_limiter._refill(key)
+                    remaining = bucket.tokens
+                if remaining < 1.0:
+                    budget[key] = remaining
+                    return False
+                budget[key] = remaining - 1.0
+            if not self.plugins.launch_filters:
+                return True
+            return self.plugins.check_launch(
+                job, self.store.clock(), self._launch_filter_cache
+            )
+
+        return launch_filter
 
     # ------------------------------------------------------------ monitors
 

@@ -1,0 +1,37 @@
+"""Per-user launch rate limiting in the match cycle."""
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from tests.conftest import FakeClock, make_job
+
+
+def test_user_launch_rate_limited():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m", [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=8000, cpus=32)
+              for i in range(4)],
+        clock=clock)
+    scheduler = Scheduler(
+        store, [cluster],
+        SchedulerConfig(user_launch_rate_per_minute=60.0,
+                        user_launch_burst=3.0),
+    )
+    jobs = [make_job(user="burster", mem=100, cpus=1) for _ in range(10)]
+    store.submit_jobs(jobs)
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    # burst of 3 launches, the rest rate-limited
+    assert len(outcome.matched) == 3
+    # immediately rerunning: bucket empty, nothing launches
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 0
+    # tokens refill at 1/s but the bucket caps at the burst size (3)
+    clock.advance(10_000)
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 3
