@@ -12,8 +12,8 @@ from __future__ import annotations
 import abc
 import enum
 import threading
-from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from dataclasses import dataclass
+from typing import Sequence
 
 
 @dataclass(frozen=True)

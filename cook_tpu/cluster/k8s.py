@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import enum
 import threading
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Callable, Optional, Sequence
 
 from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec

@@ -19,7 +19,7 @@ import dataclasses
 import enum
 import json
 import os
-from typing import Any, Optional
+from typing import Any
 
 from cook_tpu.models.entities import (
     Checkpoint,

@@ -17,10 +17,9 @@ honored for configured admins (rest/impersonation.clj).
 from __future__ import annotations
 
 import base64
-import json
 import statistics
-from dataclasses import dataclass, field
-from typing import Any, Optional
+from dataclasses import dataclass
+from typing import Optional
 
 from aiohttp import web
 
@@ -36,7 +35,6 @@ from cook_tpu.models.entities import (
     Job,
     JobConstraint,
     ConstraintOperator,
-    Pool,
     Quota,
     Resources,
     Share,

@@ -14,18 +14,16 @@ import itertools
 import logging
 import statistics
 from dataclasses import dataclass, field
-from typing import Callable, Optional, Sequence
+from typing import Optional, Sequence
 
 from cook_tpu.cluster.base import ComputeCluster
 from cook_tpu.models.entities import (
     InstanceStatus,
     Job,
-    JobState,
     Pool,
     Resources,
 )
 from cook_tpu.models.store import Event, JobStore
-from cook_tpu.models.reasons import get_reason
 from cook_tpu.scheduler.matcher import (
     MatchConfig,
     MatchOutcome,

@@ -8,7 +8,7 @@ killed, so a wedged node can't strand work forever.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Optional
+from typing import Callable
 
 from cook_tpu.models.entities import InstanceStatus
 from cook_tpu.models.store import JobStore

@@ -9,9 +9,8 @@ for a TTL like the reference's caching wrappers.
 from __future__ import annotations
 
 import importlib
-import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Optional, Protocol, runtime_checkable
+from typing import Any, Protocol, runtime_checkable
 
 from cook_tpu.models.entities import Job
 

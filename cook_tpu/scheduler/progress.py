@@ -10,8 +10,6 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Optional
-
 from cook_tpu.models.store import JobStore
 from cook_tpu.utils.metrics import global_registry
 

@@ -7,7 +7,6 @@ Serves the same three endpoints with path traversal protection.
 """
 from __future__ import annotations
 
-import json
 import os
 from typing import Optional
 

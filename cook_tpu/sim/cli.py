@@ -13,7 +13,6 @@ from __future__ import annotations
 import argparse
 import csv
 import json
-import sys
 
 from cook_tpu.scheduler.core import SchedulerConfig
 from cook_tpu.scheduler.matcher import MatchConfig

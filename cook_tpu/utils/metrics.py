@@ -12,7 +12,6 @@ import math
 import threading
 import time
 from contextlib import contextmanager
-from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
 

@@ -12,8 +12,6 @@ import collections
 import threading
 import time
 from contextlib import contextmanager
-from typing import Optional
-
 from cook_tpu.utils.metrics import global_registry
 
 _trace_ring: collections.deque = collections.deque(maxlen=4096)
