@@ -263,6 +263,9 @@ def finalize_pool_match(
     # transact + launch (scheduler.clj:790-1048)
     launches_per_cluster: dict[str, list[TaskSpec]] = {}
     cluster_by_name = {}
+    # per-cluster launch budgets this cycle (max-launchable +
+    # filter-matches-for-ratelimit, scheduler.clj:887)
+    cluster_budget: dict[str, int] = {}
     for ji, job in enumerate(considerable):
         node_idx = int(assignment[ji])
         if node_idx < 0:
@@ -271,6 +274,13 @@ def finalize_pool_match(
                 record_placement_failure(job, _failure_reason(job, nodes, feasible[ji]))
             continue
         cluster, offer = cluster_offers[node_idx]
+        budget = cluster_budget.get(cluster.name)
+        if budget is None:
+            budget = cluster.max_launchable()
+        if budget <= 0:
+            outcome.unmatched.append(job)  # over the cluster's launch cap
+            continue
+        cluster_budget[cluster.name] = budget - 1
         task_id = make_task_id(job)
         try:
             store.create_instance(

@@ -49,3 +49,17 @@ def span(name: str, **tags):
 def recent_spans(limit: int = 100) -> list[dict]:
     with _lock:
         return list(_trace_ring)[-limit:]
+
+
+@contextmanager
+def device_trace(log_dir: str):
+    """Capture a device-side profile of the wrapped section with
+    jax.profiler (view with TensorBoard/XProf).  Layered over `span` for
+    end-to-end cycle investigations on real hardware."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -96,3 +96,19 @@ def test_reconcile_fails_unknown_tasks():
     assert fixed == [inst.task_id]
     # task-unknown is not mea-culpa but the job had retries
     assert store.jobs[job.uuid].state == JobState.WAITING
+
+
+def test_cluster_launch_cap_respected():
+    """A cluster's max_launchable bounds launches per cycle; surplus
+    matches wait (filter-matches-for-ratelimit semantics)."""
+    clock, store, c1, c2, scheduler = setup_two_clusters()
+    c1.max_launchable = lambda: 1
+    c2.max_launchable = lambda: 1
+    jobs = [make_job(mem=100, cpus=1) for _ in range(6)]
+    store.submit_jobs(jobs)
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 2  # one per cluster
+    running = [j for j in jobs if store.jobs[j.uuid].state == JobState.RUNNING]
+    assert len(running) == 2
