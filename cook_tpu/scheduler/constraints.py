@@ -175,6 +175,8 @@ def validate_group_assignments(
     assignment = assignment.copy()
     used: dict[str, set[str]] = {g: set(h) for g, h in group_used_hosts.items()}
     pinned: dict[str, tuple[str, str]] = dict(group_attr_value)
+    # balanced: per-group count of members per attribute value
+    balance_counts: dict[str, dict[str, int]] = {}
     for ji, job in enumerate(jobs):
         node_idx = int(assignment[ji])
         if node_idx < 0 or not job.group_uuid:
@@ -201,4 +203,20 @@ def validate_group_assignments(
                 pinned[job.group_uuid] = (attr, value)
             elif prev != (attr, value):
                 assignment[ji] = -1
+        elif ptype == GroupPlacementType.BALANCED:
+            # spread across attribute values with bounded skew
+            # (balanced-host constraint, constraints.clj:600)
+            attr = group.host_placement.attribute
+            max_skew = max(group.host_placement.minimum, 1)
+            value = dict(nodes.offers[node_idx].attributes).get(attr)
+            if value is None:
+                assignment[ji] = -1
+                continue
+            counts = balance_counts.setdefault(job.group_uuid, {})
+            new_count = counts.get(value, 0) + 1
+            floor = min(counts.values()) if counts else 0
+            if new_count - floor > max_skew:
+                assignment[ji] = -1
+                continue
+            counts[value] = new_count
     return assignment

@@ -109,3 +109,39 @@ def test_gpu_pool_dru_mode_end_to_end():
     # gpu jobs only land on gpu hosts (they did; now verify accounting)
     offers = cluster.pending_offers("gpu")
     assert all(o.gpus == 0 for o in offers)
+
+
+def test_balanced_group_placement():
+    """`balanced` host placement bounds the per-attribute-value skew
+    within a cycle (constraints.clj:600)."""
+    from cook_tpu.models.entities import (
+        Group,
+        GroupPlacementType,
+        HostPlacement,
+    )
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    hosts = []
+    for rack, names in [("r1", ["a1", "a2"]), ("r2", ["b1", "b2"])]:
+        for name in names:
+            hosts.append(MockHost(node_id=name, hostname=name, mem=8000,
+                                  cpus=32, attributes=(("rack", rack),)))
+    cluster = MockCluster("m", hosts, clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    group = Group(
+        uuid="bal",
+        host_placement=HostPlacement(type=GroupPlacementType.BALANCED,
+                                     attribute="rack", minimum=1),
+    )
+    jobs = [make_job(group_uuid="bal", mem=100, cpus=1) for _ in range(6)]
+    store.submit_jobs(jobs, [group])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    racks = {}
+    for j, offer in outcome.matched:
+        rack = dict(offer.attributes)["rack"]
+        racks[rack] = racks.get(rack, 0) + 1
+    assert racks and max(racks.values()) - min(racks.values()) <= 1
