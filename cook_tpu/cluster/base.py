@@ -49,6 +49,7 @@ class TaskSpec:
     gpus: float
     node_id: str
     hostname: str
+    disk: float = 0.0
     env: tuple = ()
     container_image: str = ""
     expected_runtime_ms: int = 0

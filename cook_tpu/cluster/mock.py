@@ -23,6 +23,7 @@ class MockHost:
     mem: float
     cpus: float
     gpus: float = 0.0
+    disk: float = 0.0
     attributes: tuple = ()
     pool: str = "default"
 
@@ -62,21 +63,22 @@ class MockCluster(ComputeCluster):
 
     # ------------------------------------------------------------- offers
 
-    def _host_used(self, node_id: str) -> tuple[float, float, float]:
-        mem = cpus = gpus = 0.0
+    def _host_used(self, node_id: str) -> tuple[float, float, float, float]:
+        mem = cpus = gpus = disk = 0.0
         for rt in self.running.values():
             if rt.spec.node_id == node_id:
                 mem += rt.spec.mem
                 cpus += rt.spec.cpus
                 gpus += rt.spec.gpus
-        return mem, cpus, gpus
+                disk += rt.spec.disk
+        return mem, cpus, gpus, disk
 
     def pending_offers(self, pool: str) -> list[Offer]:
         offers = []
         for h in self.hosts.values():
             if h.pool != pool:
                 continue
-            um, uc, ug = self._host_used(h.node_id)
+            um, uc, ug, ud = self._host_used(h.node_id)
             offers.append(
                 Offer(
                     node_id=h.node_id,
@@ -84,6 +86,7 @@ class MockCluster(ComputeCluster):
                     mem=h.mem - um,
                     cpus=h.cpus - uc,
                     gpus=h.gpus - ug,
+                    disk=h.disk - ud,
                     attributes=h.attributes,
                     total_mem=h.mem,
                     total_cpus=h.cpus,

@@ -55,9 +55,9 @@ from cook_tpu.ops.common import BIG
 class MatchProblem(NamedTuple):
     """One pool's padded matching problem."""
 
-    demands: jnp.ndarray     # [J, 3] (mem, cpus, gpus) in schedule order
+    demands: jnp.ndarray     # [J, R] (mem, cpus, gpus[, disk...]) in schedule order
     job_valid: jnp.ndarray   # [J] bool
-    avail: jnp.ndarray       # [N, 3] currently-available (offered) resources
+    avail: jnp.ndarray       # [N, R] currently-available (offered) resources
     totals: jnp.ndarray      # [N, 2] (mem, cpus) capacity — fitness denominators
     node_valid: jnp.ndarray  # [N] bool
     feasible: Optional[jnp.ndarray] = None  # [J, N] bool constraint mask
@@ -65,7 +65,7 @@ class MatchProblem(NamedTuple):
 
 class MatchResult(NamedTuple):
     assignment: jnp.ndarray  # [J] int32 node index or -1
-    new_avail: jnp.ndarray   # [N, 3] availability after placements
+    new_avail: jnp.ndarray   # [N, R] availability after placements
 
 
 def _job_step(avail, totals, node_valid, demand, job_ok, feas_row):
@@ -146,7 +146,8 @@ def chunked_match(
     j, n = problem.demands.shape[0], problem.avail.shape[0]
     assert j % chunk == 0, "pad jobs to a multiple of chunk"
     kc = min(kc, n)
-    demands_c = problem.demands.reshape(j // chunk, chunk, 3)
+    n_res = problem.demands.shape[-1]  # (mem, cpus, gpus[, disk...])
+    demands_c = problem.demands.reshape(j // chunk, chunk, n_res)
     ok_c = problem.job_valid.reshape(j // chunk, chunk)
     if problem.feasible is not None:
         feas_c = problem.feasible.reshape(j // chunk, chunk, n)
@@ -225,7 +226,7 @@ def chunked_match(
             accept = jnp.zeros(chunk, bool).at[perm2].set(accept2)
             assignment = jnp.where(accept, pick, assignment).astype(jnp.int32)
             delta = (
-                jnp.zeros((n, 3), d.dtype)
+                jnp.zeros((n, n_res), d.dtype)
                 .at[jnp.where(accept, pick, n - 1)]
                 .add(jnp.where(accept[:, None], d, 0.0))
             )

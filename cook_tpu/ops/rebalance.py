@@ -27,9 +27,9 @@ class RebalanceState(NamedTuple):
 
     task_host: jnp.ndarray      # [T] int32 host index
     task_dru: jnp.ndarray       # [T] f32
-    task_res: jnp.ndarray       # [T, 3] (mem, cpus, gpus)
+    task_res: jnp.ndarray       # [T, R] (mem, cpus, gpus[, disk...])
     task_eligible: jnp.ndarray  # [T] bool (valid & quota/user filters & not preempted)
-    spare: jnp.ndarray          # [H, 3] spare resources per host
+    spare: jnp.ndarray          # [H, R] spare resources per host
     host_ok: jnp.ndarray        # [H] bool (constraints pass for the pending job)
 
 
@@ -37,13 +37,13 @@ class PreemptionDecision(NamedTuple):
     host: jnp.ndarray          # int32 chosen host, -1 if none
     score: jnp.ndarray         # f32 min-preempted-dru of the decision (BIG = spare-only)
     preempt_mask: jnp.ndarray  # [T] bool — tasks to preempt
-    freed: jnp.ndarray         # [3] resources freed on the chosen host (spare + preempted)
+    freed: jnp.ndarray         # [R] resources freed on the chosen host (spare + preempted)
 
 
 @jax.jit
 def find_preemption_decision(
     state: RebalanceState,
-    demand: jnp.ndarray,        # [3] pending job (mem, cpus, gpus)
+    demand: jnp.ndarray,        # [R] pending job resources
     pending_dru: jnp.ndarray,   # scalar
     safe_dru_threshold: jnp.ndarray,
     min_dru_diff: jnp.ndarray,
@@ -120,7 +120,7 @@ def find_preemption_decision(
 
     freed_amount = jnp.where(
         none_found,
-        jnp.zeros(3),
+        jnp.zeros_like(demand),
         jnp.where(
             use_spare,
             state.spare[jnp.clip(best_spare_host, 0, h - 1)],

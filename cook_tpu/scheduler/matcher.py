@@ -99,13 +99,14 @@ def build_match_problem(
         pad_j = max(pad_j, chunk)
         pad_j += (-pad_j) % chunk
     pad_n = bucket_size(max(n, 1))
-    demands = np.zeros((j, 3), dtype=np.float32)
+    demands = np.zeros((j, 4), dtype=np.float32)
     for i, job in enumerate(jobs):
-        demands[i] = (job.resources.mem, job.resources.cpus, job.resources.gpus)
-    avail = np.zeros((n, 3), dtype=np.float32)
+        r = job.resources
+        demands[i] = (r.mem, r.cpus, r.gpus, r.disk)
+    avail = np.zeros((n, 4), dtype=np.float32)
     totals = np.zeros((n, 2), dtype=np.float32)
     for i, o in enumerate(nodes.offers):
-        avail[i] = (o.mem, o.cpus, o.gpus)
+        avail[i] = (o.mem, o.cpus, o.gpus, o.disk)
         totals[i] = (o.total_mem or o.mem, o.total_cpus or o.cpus)
     feas = np.zeros((pad_j, pad_n), dtype=bool)
     feas[:j, :n] = feasible
@@ -303,6 +304,7 @@ def finalize_pool_match(
             gpus=job.resources.gpus,
             node_id=offer.node_id,
             hostname=offer.hostname,
+            disk=job.resources.disk,
             env=job.user_provided_env,
             container_image=(job.container.image if job.container else ""),
             expected_runtime_ms=job.expected_runtime_ms,
@@ -332,6 +334,7 @@ def finalize_pool_match(
                 gpus=job.resources.gpus,
                 node_id="",
                 hostname="",
+                disk=job.resources.disk,
             )
             for job in outcome.unmatched
         ]

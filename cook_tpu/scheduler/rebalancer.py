@@ -83,10 +83,10 @@ class RebalanceCycle:
         )
         self.host_idx = {h: i for i, h in enumerate(self.hostnames)}
         h = len(self.hostnames)
-        self.spare = np.zeros((max(h, 1), 3), dtype=np.float64)
+        self.spare = np.zeros((max(h, 1), 4), dtype=np.float64)
         for hostname, res in host_spare.items():
             i = self.host_idx[hostname]
-            self.spare[i] = (res.mem, res.cpus, res.gpus)
+            self.spare[i] = (res.mem, res.cpus, res.gpus, res.disk)
 
         # per-user ordered running tasks
         self.users: dict[str, _UserTasks] = {}
@@ -99,7 +99,8 @@ class RebalanceCycle:
                 ut.keys.append(self._task_key(job, inst))
                 ut.ids.append(inst.task_id)
                 ut.res.append(
-                    (job.resources.mem, job.resources.cpus, job.resources.gpus)
+                    (job.resources.mem, job.resources.cpus,
+                     job.resources.gpus, job.resources.disk)
                 )
                 self.task_info[inst.task_id] = (job.user, inst.hostname)
         for user, ut in self.users.items():
@@ -130,7 +131,7 @@ class RebalanceCycle:
         md, cd, gd = self._divisors(user)
         cum_m = cum_c = cum_g = 0.0
         ut.dru = []
-        for mem, cpus, gpus in ut.res:
+        for mem, cpus, gpus, *_ in ut.res:
             cum_m += mem
             cum_c += cpus
             cum_g += gpus
@@ -155,7 +156,7 @@ class RebalanceCycle:
         t = max(len(ids), 1)
         task_host = np.full(t, -1, dtype=np.int32)
         task_dru = np.zeros(t, dtype=np.float32)
-        task_res = np.zeros((t, 3), dtype=np.float32)
+        task_res = np.zeros((t, 4), dtype=np.float32)
         task_elig = np.zeros(t, dtype=bool)
         for i in range(len(ids)):
             task_host[i] = hosts[i]
@@ -233,7 +234,7 @@ class RebalanceCycle:
         r = job.resources
         decision = find_preemption_decision(
             state,
-            jnp.asarray([r.mem, r.cpus, r.gpus], dtype=jnp.float32),
+            jnp.asarray([r.mem, r.cpus, r.gpus, r.disk], dtype=jnp.float32),
             jnp.float32(pending_dru),
             jnp.float32(self.params.safe_dru_threshold),
             jnp.float32(self.params.min_dru_diff),
@@ -271,13 +272,13 @@ class RebalanceCycle:
         ut.keys.insert(pos, key)
         ut.ids.insert(pos, sim_id)
         ut.res.insert(pos, (job.resources.mem, job.resources.cpus,
-                            job.resources.gpus))
+                            job.resources.gpus, job.resources.disk))
         self.task_info[sim_id] = (job.user, self.hostnames[host])
         for user in changed:
             self._rescore(user)
         r = job.resources
         self.spare[host] = np.maximum(
-            freed - np.array([r.mem, r.cpus, r.gpus]), 0.0
+            freed - np.array([r.mem, r.cpus, r.gpus, r.disk]), 0.0
         )
 
 

@@ -60,3 +60,33 @@ def test_offensive_job_quarantined_from_queue():
     outcome = scheduler.match_cycle(pool)
     assert {j.uuid for j, _ in outcome.matched} == {normal.uuid}
     assert store.jobs[monster.uuid].state == JobState.WAITING
+
+
+def test_disk_constrained_matching():
+    """Disk is a packed resource: a job needing disk only lands on hosts
+    with enough of it (constraints.clj disk constraint)."""
+    from cook_tpu.models.entities import Resources
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m",
+        [MockHost(node_id="small", hostname="small", mem=4000, cpus=8,
+                  disk=10.0),
+         MockHost(node_id="big", hostname="big", mem=4000, cpus=8,
+                  disk=500.0)],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    job = make_job()
+    job = job.with_(resources=Resources(mem=100, cpus=1, disk=100.0))
+    store.submit_jobs([job])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 1
+    [inst] = store.job_instances(job.uuid)
+    assert inst.hostname == "big"
+    # disk accounting shows in offers
+    offers = {o.hostname: o for o in cluster.pending_offers("default")}
+    assert offers["big"].disk == 400.0
