@@ -169,6 +169,7 @@ def load_snapshot(path: str, *, clock=None) -> JobStore:
     for k, v in state["jobs"].items():
         job = _dec_job(v)
         store.jobs[k] = job
+        store.job_seq[k] = len(store.job_seq)  # snapshot preserves order
         store._index_job(job, None)
     for k, v in state["instances"].items():
         store.instances[k] = _dec_instance(v)

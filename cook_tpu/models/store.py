@@ -67,6 +67,9 @@ class JobStore:
         self.clock = clock or (lambda: 0)
 
         self.jobs: dict[str, Job] = {}
+        # submission order per job — the deterministic tie-breaker the
+        # reference gets from :db/id entity ids (tools.clj:614-641)
+        self.job_seq: dict[str, int] = {}
         self.instances: dict[str, Instance] = {}
         self.groups: dict[str, Group] = {}
         self.pools: dict[str, Pool] = {}
@@ -140,6 +143,7 @@ class JobStore:
                     job = job.with_(submit_time_ms=now)
                 job = job.with_(last_waiting_start_time_ms=now)
                 self.jobs[job.uuid] = job
+                self.job_seq[job.uuid] = len(self.job_seq)
                 self._index_job(job, None)
                 if job.group_uuid and job.group_uuid in self.groups:
                     g = self.groups[job.group_uuid]

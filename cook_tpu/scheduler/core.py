@@ -49,6 +49,8 @@ class SchedulerConfig:
     # (reference: create-per-user-per-pool-launch-rate-limiter, quota.clj:118)
     user_launch_rate_per_minute: float = 0.0
     user_launch_burst: float = 0.0
+    # columnar host-side state: O(delta) rank-cycle encoding
+    use_columnar_index: bool = True
 
 
 class Scheduler:
@@ -81,6 +83,11 @@ class Scheduler:
                 clock=store.clock,
             )
         self._task_seq = itertools.count()
+        self.columnar = None
+        if self.config.use_columnar_index:
+            from cook_tpu.models.columnar import ColumnarJobIndex
+
+            self.columnar = ColumnarJobIndex(store)
         self.pool_queues: dict[str, RankedQueue] = {}
         self.pool_match_state: dict[str, PoolMatchState] = {}
         self.last_unmatched_offers: dict[str, dict[str, Resources]] = {}
@@ -155,9 +162,19 @@ class Scheduler:
                 max_mem = max(max_mem, offer.total_mem or offer.mem)
                 max_cpus = max(max_cpus, offer.total_cpus or offer.cpus)
                 max_gpus = max(max_gpus, offer.gpus)
-        filt = (offensive_job_filter(max_mem, max_cpus, max_gpus)
-                if max_mem > 0 and not autoscales else None)
-        queue = rank_pool(self.store, pool, offensive_job_filter=filt)
+        limits_active = max_mem > 0 and not autoscales
+        if self.columnar is not None:
+            from cook_tpu.scheduler.ranking_columnar import rank_pool_columnar
+
+            queue = rank_pool_columnar(
+                self.store, self.columnar, pool,
+                capacity_limits=((max_mem, max_cpus, max_gpus)
+                                 if limits_active else None),
+            )
+        else:
+            filt = (offensive_job_filter(max_mem, max_cpus, max_gpus)
+                    if limits_active else None)
+            queue = rank_pool(self.store, pool, offensive_job_filter=filt)
         for uuid in queue.quarantined:
             self.placement_failures[uuid] = (
                 "The job's resource demands exceed every host in the pool."

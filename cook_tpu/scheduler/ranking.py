@@ -53,11 +53,17 @@ def _quota_cap(
         running_counts[job.user] = running_counts.get(job.user, 0) + 1
     kept, capped = [], []
     # per-user cumulative (mem, cpus, gpus, count) as plain tuples, and a
-    # per-user quota cache — this loop runs once per pending job
+    # per-user quota cache — this loop runs once per pending job.
+    # Semantics: take-while per user — the first over-quota job closes the
+    # user's queue for this cycle (a later smaller job must not jump it).
     quotas: dict[str, tuple[float, float, float, int]] = {}
     cum: dict[str, tuple[float, float, float, int]] = {}
+    closed: set[str] = set()
     for job in pending:
         user = job.user
+        if user in closed:
+            capped.append(job.uuid)
+            continue
         q = quotas.get(user)
         if q is None:
             quota = store.get_quota(user, pool)
@@ -78,6 +84,7 @@ def _quota_cap(
             cum[user] = new_state
         else:
             capped.append(job.uuid)
+            closed.add(user)
     return kept, capped
 
 
@@ -115,9 +122,12 @@ def rank_pool(
                 quarantined.append(j.uuid)
         pending = kept
 
-    # order pending per user by (-priority, submit-time, uuid) — the
-    # pending-job part of task->feature-vector (tools.clj:614-641)
-    pending.sort(key=lambda j: (-j.priority, j.submit_time_ms, j.uuid))
+    # order pending per user by (-priority, submit-time, insertion order) —
+    # the pending-job part of task->feature-vector (tools.clj:614-641; the
+    # reference's final tie-break is the :db/id entity id, i.e. insertion)
+    seq = store.job_seq
+    pending.sort(key=lambda j: (-j.priority, j.submit_time_ms,
+                                seq.get(j.uuid, 0)))
     pending, capped = _quota_cap(store, pool_name, pending)
 
     running = []

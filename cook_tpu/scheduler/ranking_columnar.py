@@ -1,0 +1,164 @@
+"""Vectorized rank cycle over the columnar job index.
+
+Same semantics as `ranking.rank_pool` (per-user (-priority, start, id)
+order, take-while quota capping, DRU kernel, global fairness order) with
+all host-side encoding as numpy column operations — O(total jobs)
+vectorized instead of O(jobs) Python, which is what keeps 100k-job rank
+cycles in tens of milliseconds of host time.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from cook_tpu.models.columnar import ColumnarJobIndex
+from cook_tpu.models.entities import DruMode, Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.ops.common import BIG, bucket_size, pad_to
+from cook_tpu.ops.dru import DruTasks, dru_rank
+from cook_tpu.scheduler.ranking import RankedQueue
+
+
+def _seg_cumsum(values: np.ndarray, seg: np.ndarray) -> np.ndarray:
+    """Cumulative sum restarting at each new value of sorted `seg`."""
+    total = np.cumsum(values)
+    starts = np.empty(len(seg), bool)
+    if len(seg):
+        starts[0] = True
+        starts[1:] = seg[1:] != seg[:-1]
+    idx = np.arange(len(seg))
+    seg_first = np.maximum.accumulate(np.where(starts, idx, 0))
+    base = np.where(seg_first > 0, total[np.maximum(seg_first - 1, 0)], 0.0)
+    return total - base
+
+
+def rank_pool_columnar(
+    store: JobStore,
+    index: ColumnarJobIndex,
+    pool: Pool,
+    *,
+    capacity_limits=None,  # (max_mem, max_cpus, max_gpus) offensive filter
+) -> RankedQueue:
+    pending, inst_sel = index.pool_view(pool.name)
+    n_idx = index._n
+
+    quarantined: list[str] = []
+    if capacity_limits is not None and len(pending):
+        max_mem, max_cpus, max_gpus = capacity_limits
+        ok = (
+            (index.mem[pending] <= max_mem)
+            & (index.cpus[pending] <= max_cpus)
+            & (index.gpus[pending] <= max_gpus)
+        )
+        quarantined = [index.uuids[r] for r in pending[~ok]]
+        pending = pending[ok]
+
+    if len(pending) == 0:
+        return RankedQueue(jobs=[], dru={}, capped=[],
+                           quarantined=quarantined)
+
+    # per-user priority order: (user, -priority, submit, row)
+    u = index.user_code[pending]
+    order = np.lexsort((pending, index.submit_ms[pending],
+                        -index.priority[pending], u))
+    p_sorted = pending[order]
+    us = index.user_code[p_sorted]
+
+    # running usage per user (live instances of this pool)
+    inst_jobs = index.inst_job_row[inst_sel]
+    iu = index.user_code[inst_jobs]
+    n_users = len(index.users.names)
+    usage_mem = np.bincount(iu, weights=index.mem[inst_jobs],
+                            minlength=n_users)
+    usage_cpu = np.bincount(iu, weights=index.cpus[inst_jobs],
+                            minlength=n_users)
+    usage_gpu = np.bincount(iu, weights=index.gpus[inst_jobs],
+                            minlength=n_users)
+    usage_cnt = np.bincount(iu, minlength=n_users).astype(np.float64)
+
+    # quota columns for the users present
+    qmem = np.full(n_users, np.inf)
+    qcpu = np.full(n_users, np.inf)
+    qgpu = np.full(n_users, np.inf)
+    qcnt = np.full(n_users, np.inf)
+    for code in np.unique(us):
+        quota = store.get_quota(index.users.names[code], pool.name)
+        qmem[code] = quota.resources.mem
+        qcpu[code] = quota.resources.cpus
+        qgpu[code] = quota.resources.gpus
+        qcnt[code] = quota.count
+
+    # take-while quota cap via segmented cumsums
+    cmem = _seg_cumsum(index.mem[p_sorted].astype(np.float64), us) + usage_mem[us]
+    ccpu = _seg_cumsum(index.cpus[p_sorted].astype(np.float64), us) + usage_cpu[us]
+    cgpu = _seg_cumsum(index.gpus[p_sorted].astype(np.float64), us) + usage_gpu[us]
+    ccnt = _seg_cumsum(np.ones(len(p_sorted)), us) + usage_cnt[us]
+    fits = ((cmem <= qmem[us]) & (ccpu <= qcpu[us])
+            & (cgpu <= qgpu[us]) & (ccnt <= qcnt[us]))
+    # prefix-AND within each user segment (first failure closes the user)
+    over = _seg_cumsum((~fits).astype(np.float64), us)
+    keep = over == 0
+    capped = [index.uuids[r] for r in p_sorted[~keep]]
+    kept = p_sorted[keep]
+    if len(kept) == 0:
+        return RankedQueue(jobs=[], dru={}, capped=capped,
+                           quarantined=quarantined)
+
+    # DRU kernel input: running instances first, then kept pending
+    n_run = len(inst_jobs)
+    n = n_run + len(kept)
+    user = np.concatenate([index.user_code[inst_jobs],
+                           index.user_code[kept]]).astype(np.int32)
+    mem = np.concatenate([index.mem[inst_jobs], index.mem[kept]])
+    cpus = np.concatenate([index.cpus[inst_jobs], index.cpus[kept]])
+    gpus = np.concatenate([index.gpus[inst_jobs], index.gpus[kept]])
+    neg_prio = np.concatenate([
+        -index.priority[inst_jobs], -index.priority[kept]
+    ]).astype(np.int64)
+    start = np.concatenate([
+        index.inst_start[inst_sel],
+        np.full(len(kept), 2**62, np.int64),  # pending after running
+    ])
+    perm = np.lexsort((np.arange(n), start, neg_prio, user))
+    order_key = np.empty(n, np.float32)
+    order_key[perm] = np.arange(n, dtype=np.float32)
+
+    present = np.unique(user)
+    mem_div = np.ones(n_users, np.float32)
+    cpu_div = np.ones(n_users, np.float32)
+    gpu_div = np.ones(n_users, np.float32)
+    for code in present:
+        share = store.get_share(index.users.names[code], pool.name)
+        mem_div[code] = min(share.mem, BIG)
+        cpu_div[code] = min(share.cpus, BIG)
+        gpu_div[code] = min(share.gpus, BIG)
+
+    pad_t = bucket_size(n)
+    tasks = DruTasks(
+        user=jnp.asarray(pad_to(user, pad_t)),
+        mem=jnp.asarray(pad_to(mem.astype(np.float32), pad_t)),
+        cpus=jnp.asarray(pad_to(cpus.astype(np.float32), pad_t)),
+        gpus=jnp.asarray(pad_to(gpus.astype(np.float32), pad_t)),
+        order_key=jnp.asarray(pad_to(order_key, pad_t, fill=BIG)),
+        valid=jnp.asarray(pad_to(np.ones(n, bool), pad_t, fill=False)),
+    )
+    result = dru_rank(
+        tasks,
+        jnp.asarray(mem_div), jnp.asarray(cpu_div), jnp.asarray(gpu_div),
+        gpu_mode=(pool.dru_mode == DruMode.GPU),
+    )
+    kernel_order = np.asarray(result.order)
+    dru = np.asarray(result.dru)
+
+    # pending positions in kernel order -> job objects
+    pend_positions = kernel_order[(kernel_order >= n_run)
+                                  & (kernel_order < n)]
+    rows_in_order = kept[pend_positions - n_run]
+    ranked_jobs = [store.jobs[index.uuids[r]] for r in rows_in_order]
+    dru_map = {
+        job.uuid: float(dru[pos])
+        for job, pos in zip(ranked_jobs, pend_positions)
+    }
+    return RankedQueue(jobs=ranked_jobs, dru=dru_map, capped=capped,
+                       quarantined=quarantined)
