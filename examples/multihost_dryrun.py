@@ -1,0 +1,91 @@
+"""Multi-host dry run: the DCN scale-out path on CPU processes.
+
+Launches N processes (jax.distributed + a coordinator), forms one global
+mesh spanning all processes' devices, and runs the pool-sharded match solve
+across it — the exact recipe a multi-slice TPU deployment uses, with DCN
+standing in for the cross-process axis (docs/tpu-design.md "Multi-host").
+
+    python examples/multihost_dryrun.py            # spawns 2 workers
+    python examples/multihost_dryrun.py --workers 4
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def worker(process_id: int, num_processes: int, coordinator: str) -> int:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from cook_tpu.ops.match import MatchProblem
+    from cook_tpu.parallel.mesh import pool_sharded_match
+
+    devices = np.array(jax.devices())  # all processes' devices
+    mesh = Mesh(devices, ("pool",))
+    n_pools = devices.size
+    rng = np.random.default_rng(0)
+    j, n = 32, 16
+    demands = rng.uniform(1, 100, (n_pools, j, 3)).astype(np.float32)
+    demands[:, :, 2] = 0.0
+    totals = rng.uniform(500, 1000, (n_pools, n, 2)).astype(np.float32)
+    avail = np.concatenate(
+        [totals, np.zeros((n_pools, n, 1), np.float32)], axis=-1)
+
+    def make_global(x):
+        sharding = NamedSharding(mesh, P("pool"))
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    problems = MatchProblem(
+        demands=make_global(demands),
+        job_valid=make_global(np.ones((n_pools, j), bool)),
+        avail=make_global(avail),
+        totals=make_global(totals),
+        node_valid=make_global(np.ones((n_pools, n), bool)),
+        feasible=make_global(np.ones((n_pools, j, n), bool)),
+    )
+    result = pool_sharded_match(mesh, problems)
+    local = [s.data for s in result.assignment.addressable_shards]
+    placed = int(sum((np.asarray(x) >= 0).sum() for x in local))
+    print(f"[proc {process_id}] mesh {devices.size} devices across "
+          f"{num_processes} processes; local shards placed {placed} jobs",
+          flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--worker-id", type=int, default=None)
+    parser.add_argument("--coordinator", default="127.0.0.1:29400")
+    args = parser.parse_args()
+    if args.worker_id is not None:
+        return worker(args.worker_id, args.workers, args.coordinator)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, __file__, "--workers", str(args.workers),
+             "--worker-id", str(i), "--coordinator", args.coordinator],
+        )
+        for i in range(args.workers)
+    ]
+    rc = 0
+    for p in procs:
+        rc |= p.wait(timeout=300)
+    print("multihost dryrun", "OK" if rc == 0 else f"FAILED rc={rc}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
