@@ -222,6 +222,10 @@ def start_leader_duties(process: CookProcess,
         ps = pools()
         if not ps:
             return
+        if settings.batched_match and len(ps) > 1:
+            with span("match-cycle-batched", pools=len(ps)):
+                scheduler.match_cycle_all_pools()
+            return
         # rebuild the cycle if pools changed
         nonlocal pool_cycle
         current = getattr(match_next, "_pools", None)

@@ -44,6 +44,9 @@ class Settings:
     pool_schedulers: list[PoolSchedulerConfig] = field(default_factory=list)
     pools: list[dict] = field(default_factory=lambda: [{"name": "default"}])
     clusters: list[dict] = field(default_factory=list)
+    # one batched device call for all pools per match tick instead of
+    # round-robin one-pool-per-tick (docs/tpu-design.md pool sharding)
+    batched_match: bool = False
     leader_lease_path: str = ""
     data_dir: str = ""                  # "" = in-memory only
     snapshot_interval_s: float = 300.0
@@ -81,6 +84,7 @@ def read_config(path: Optional[str] = None,
                 "rank_interval_s", "match_interval_s",
                 "rebalancer_interval_s", "optimizer_interval_s",
                 "leader_lease_path", "data_dir", "snapshot_interval_s",
+                "batched_match",
                 "queue_limit_per_pool",
                 "queue_limit_per_user", "submission_rate_per_minute"):
         if key in data:
