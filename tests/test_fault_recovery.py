@@ -1,0 +1,99 @@
+"""Elastic-recovery end-to-end: node loss, task faults, heartbeat loss —
+work still completes via mea-culpa retries (reference: failure-detection
+subsystems, SURVEY §5)."""
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import InstanceStatus, JobState, Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.core import Scheduler
+from cook_tpu.sim.simulator import SimConfig, Simulator, TraceHost, TraceJob
+from tests.conftest import FakeClock, make_job
+
+
+def test_node_loss_mid_run_recovers():
+    jobs = [
+        TraceJob(uuid=f"j{i}", user=f"u{i % 3}", submit_time_ms=0,
+                 runtime_ms=120_000, mem=200, cpus=2)
+        for i in range(12)
+    ]
+    hosts = [TraceHost(node_id=f"n{i}", hostname=f"n{i}", mem=2000, cpus=8)
+             for i in range(4)]
+    sim = Simulator(jobs, hosts, SimConfig(cycle_ms=15_000, max_cycles=200))
+    # run some cycles, then kill a node with work on it
+    steps = 0
+    original_run = sim.run
+
+    # drive manually: advance 3 cycles, remove a node, then finish
+    sim.cluster.advance_to(sim.now_ms)
+    submitted = 0
+    pool = sim.store.pools["default"]
+    for cycle in range(3):
+        while (submitted < len(sim.trace_jobs)
+               and sim.trace_jobs[submitted].submit_time_ms <= sim.now_ms):
+            tj = sim.trace_jobs[submitted]
+            from cook_tpu.models.entities import Job, Resources
+
+            sim.store.submit_jobs([Job(
+                uuid=tj.uuid, user=tj.user, pool=tj.pool,
+                resources=Resources(mem=tj.mem, cpus=tj.cpus),
+                expected_runtime_ms=tj.runtime_ms, command="sim",
+                max_retries=5,
+            )])
+            submitted += 1
+        sim.scheduler.rank_cycle(pool)
+        sim.scheduler.match_cycle(pool)
+        sim.now_ms += 15_000
+        sim.cluster.advance_to(sim.now_ms)
+
+    victims = sim.cluster.remove_host("n0")
+    assert victims, "expected tasks on the removed node"
+    # mea-culpa: victims' jobs back to waiting, no retry consumed
+    for tid in victims:
+        job = sim.store.jobs[sim.store.instances[tid].job_uuid]
+        assert job.state == JobState.WAITING
+        assert sim.store.instances[tid].reason_code == 4000
+
+    # keep simulating to completion on the remaining 3 nodes
+    while sim.now_ms < 3_000_000:
+        sim.scheduler.rank_cycle(pool)
+        sim.scheduler.match_cycle(pool)
+        sim.now_ms += 15_000
+        sim.cluster.advance_to(sim.now_ms)
+        if all(sim.store.jobs[j.uuid].state == JobState.COMPLETED
+               for j in jobs):
+            break
+    assert all(sim.store.jobs[j.uuid].state == JobState.COMPLETED
+               for j in jobs)
+    # the victims retried on surviving nodes
+    for tid in victims:
+        job_uuid = sim.store.instances[tid].job_uuid
+        insts = sim.store.job_instances(job_uuid)
+        assert len(insts) >= 2
+        assert insts[-1].status == InstanceStatus.SUCCESS
+        assert insts[-1].hostname != "n0"
+
+
+def test_repeated_flaky_failures_eventually_exhaust():
+    """Non-mea-culpa failures consume retries and complete the job failed."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m", [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=8)
+              for i in range(4)],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    job = make_job(max_retries=3)
+    store.submit_jobs([job])
+    pool = store.pools["default"]
+    hosts_used = []
+    for attempt in range(3):
+        scheduler.rank_cycle(pool)
+        outcome = scheduler.match_cycle(pool)
+        assert len(outcome.matched) == 1
+        [tid] = outcome.launched_task_ids
+        hosts_used.append(store.instances[tid].hostname)
+        cluster.fail_task(tid, "command-executor-failed")
+    assert store.jobs[job.uuid].state == JobState.COMPLETED
+    assert len(store.job_instances(job.uuid)) == 3
+    # novel-host: every retry went to a fresh host
+    assert len(set(hosts_used)) == 3
