@@ -342,6 +342,13 @@ class CookApi:
 
     async def get_jobs(self, request: web.Request) -> web.Response:
         uuids = request.query.getall("job", []) + request.query.getall("uuid", [])
+        # resolve instance uuids to their jobs (reference: rawscheduler
+        # accepts instance ids too)
+        for inst_uuid in request.query.getall("instance", []):
+            inst = self.store.instances.get(inst_uuid)
+            if inst is None:
+                return _err(404, f"unknown instance {inst_uuid}")
+            uuids.append(inst.job_uuid)
         user = request.query.get("user")
         states = set(
             s for q in request.query.getall("state", []) for s in q.split("+")
