@@ -8,10 +8,13 @@ maximizing the minimum preempted DRU, guarded by `safe-dru-threshold` and
 fairness picture; then transact the preemptions and kill the victims.
 
 The victim search itself is the `ops.rebalance.find_preemption_decision`
-kernel (one call scans all tasks x hosts); this module keeps the host-side
-incremental state (`next-state`, rebalancer.clj:270-318): preempted tasks
-drop out, the simulated launch joins the user's task list, and only changed
-users are re-scored (dru.clj:128 `next-task->scored-task`).
+kernel (one call scans all tasks x hosts).  This module keeps the
+incremental state (`next-state`, rebalancer.clj:270-318) with a fixed-row
+layout: every task owns a row in device-resident tensors for the whole
+cycle; preemptions flip an eligibility bit, simulated launches fill
+preallocated slack rows, and only changed users' DRU rows are rescored and
+scattered back (dru.clj:128 `next-task->scored-task`) — so the ≤
+max_preemption kernel calls per cycle ship O(changed) bytes, not O(tasks).
 """
 from __future__ import annotations
 
@@ -51,14 +54,15 @@ class Decision:
 class _UserTasks:
     """One user's running tasks in feature-vector order."""
 
-    keys: list[tuple] = field(default_factory=list)      # sort keys
-    ids: list[str] = field(default_factory=list)         # task ids ("" = simulated)
-    res: list[tuple] = field(default_factory=list)       # (mem, cpus, gpus)
+    keys: list[tuple] = field(default_factory=list)  # sort keys
+    ids: list[str] = field(default_factory=list)     # task ids (sim-* = simulated)
+    res: list[tuple] = field(default_factory=list)   # (mem, cpus, gpus, disk)
+    rows: list[int] = field(default_factory=list)    # fixed tensor rows
     dru: list[float] = field(default_factory=list)
 
 
 class RebalanceCycle:
-    """Host-side state for one pool's rebalance cycle."""
+    """State for one pool's rebalance cycle (fixed-row tensor layout)."""
 
     def __init__(
         self,
@@ -83,10 +87,10 @@ class RebalanceCycle:
         )
         self.host_idx = {h: i for i, h in enumerate(self.hostnames)}
         h = len(self.hostnames)
-        self.spare = np.zeros((max(h, 1), 4), dtype=np.float64)
+        spare = np.zeros((max(h, 1), 4), dtype=np.float32)
         for hostname, res in host_spare.items():
             i = self.host_idx[hostname]
-            self.spare[i] = (res.mem, res.cpus, res.gpus, res.disk)
+            spare[i] = (res.mem, res.cpus, res.gpus, res.disk)
 
         # per-user ordered running tasks
         self.users: dict[str, _UserTasks] = {}
@@ -103,12 +107,41 @@ class RebalanceCycle:
                      job.resources.gpus, job.resources.disk)
                 )
                 self.task_info[inst.task_id] = (job.user, inst.hostname)
-        for user, ut in self.users.items():
+
+        # fixed-row flat layout: all tasks + slack rows for simulated launches
+        n_tasks = sum(len(ut.ids) for ut in self.users.values())
+        total = n_tasks + params.max_preemption
+        self.row_ids: list[str] = [""] * total
+        host_np = np.full(total, -1, np.int32)
+        res_np = np.zeros((total, 4), np.float32)
+        self._dru_np = np.zeros(total, np.float32)
+        self._elig_np = np.zeros(total, bool)
+        row = 0
+        for user in sorted(self.users):
+            ut = self.users[user]
             order = sorted(range(len(ut.keys)), key=lambda i: ut.keys[i])
             ut.keys = [ut.keys[i] for i in order]
             ut.ids = [ut.ids[i] for i in order]
             ut.res = [ut.res[i] for i in order]
+            ut.rows = list(range(row, row + len(ut.ids)))
+            for k, tid in enumerate(ut.ids):
+                self.row_ids[row] = tid
+                host = self.task_info[tid][1]
+                hidx = self.host_idx.get(host, -1)
+                host_np[row] = hidx
+                res_np[row] = ut.res[k]
+                self._elig_np[row] = hidx >= 0
+                row += 1
             self._rescore(user)
+        self._next_slack = n_tasks
+
+        # device-resident tensors; per-iteration updates are small scatters
+        self._dev_host = jnp.asarray(host_np)
+        self._dev_res = jnp.asarray(res_np)
+        self._dev_dru = jnp.asarray(self._dru_np)
+        self._dev_elig = jnp.asarray(self._elig_np)
+        self._dev_spare = jnp.asarray(spare)
+        self._dev_host_ok = jnp.ones(len(spare), dtype=bool)
         self.preempted: set[str] = set()
 
     # ------------------------------------------------------------ internals
@@ -123,55 +156,34 @@ class RebalanceCycle:
         share = self.store.get_share(user, self.pool.name)
         return (min(share.mem, BIG), min(share.cpus, BIG), min(share.gpus, BIG))
 
-    def _rescore(self, user: str) -> None:
-        """Recompute the user's cumulative DRUs (only-changed-users rescore)."""
+    def _rescore(self, user: str) -> list[int]:
+        """Recompute the user's cumulative DRUs into the flat dru column
+        (only-changed-users rescore); returns the touched rows."""
         ut = self.users.get(user)
         if ut is None:
-            return
+            return []
         md, cd, gd = self._divisors(user)
         cum_m = cum_c = cum_g = 0.0
         ut.dru = []
-        for mem, cpus, gpus, *_ in ut.res:
+        for k, (mem, cpus, gpus, *_rest) in enumerate(ut.res):
             cum_m += mem
             cum_c += cpus
             cum_g += gpus
-            if self.gpu_mode:
-                ut.dru.append(cum_g / gd)
-            else:
-                ut.dru.append(max(cum_m / md, cum_c / cd))
+            value = (cum_g / gd if self.gpu_mode
+                     else max(cum_m / md, cum_c / cd))
+            ut.dru.append(value)
+            self._dru_np[ut.rows[k]] = value
+        return list(ut.rows)
 
-    def _flat_state(self) -> tuple[RebalanceState, list[str]]:
-        """Flatten per-user state into kernel tensors."""
-        ids, hosts, drus, res, elig = [], [], [], [], []
-        for user, ut in sorted(self.users.items()):
-            for k, tid in enumerate(ut.ids):
-                if tid in self.preempted:
-                    continue
-                host = self.task_info.get(tid, (user, ""))[1] if tid else ""
-                ids.append(tid)
-                hosts.append(self.host_idx.get(host, -1))
-                drus.append(ut.dru[k])
-                res.append(ut.res[k])
-                elig.append(bool(tid) and host in self.host_idx)
-        t = max(len(ids), 1)
-        task_host = np.full(t, -1, dtype=np.int32)
-        task_dru = np.zeros(t, dtype=np.float32)
-        task_res = np.zeros((t, 4), dtype=np.float32)
-        task_elig = np.zeros(t, dtype=bool)
-        for i in range(len(ids)):
-            task_host[i] = hosts[i]
-            task_dru[i] = drus[i]
-            task_res[i] = res[i]
-            task_elig[i] = elig[i]
-        state = RebalanceState(
-            task_host=jnp.asarray(task_host),
-            task_dru=jnp.asarray(task_dru),
-            task_res=jnp.asarray(task_res),
-            task_eligible=jnp.asarray(task_elig),
-            spare=jnp.asarray(self.spare.astype(np.float32)),
-            host_ok=jnp.ones(len(self.spare), dtype=bool),
+    def _device_state(self) -> RebalanceState:
+        return RebalanceState(
+            task_host=self._dev_host,
+            task_dru=self._dev_dru,
+            task_res=self._dev_res,
+            task_eligible=self._dev_elig,
+            spare=self._dev_spare,
+            host_ok=self._dev_host_ok,
         )
-        return state, ids
 
     def pending_job_dru(self, job: Job) -> float:
         """compute-pending-default-job-dru / -gpu (rebalancer.clj:157-205):
@@ -197,9 +209,7 @@ class RebalanceCycle:
         mem = cpus = gpus = 0.0
         count = 0
         if ut is not None:
-            for k, tid in enumerate(ut.ids):
-                if tid in self.preempted:
-                    continue
+            for k in range(len(ut.ids)):
                 mem += ut.res[k][0]
                 cpus += ut.res[k][1]
                 gpus += ut.res[k][2]
@@ -215,21 +225,19 @@ class RebalanceCycle:
     # ----------------------------------------------------------- main loop
 
     def compute_decision(self, job: Job) -> Optional[Decision]:
-        state, ids = self._flat_state()
+        state = self._device_state()
         pending_dru = self.pending_job_dru(job)
-        below_quota = self.user_below_quota(job)
-        if not below_quota:
+        if not self.user_below_quota(job):
             # over-quota users may only preempt their own tasks
             # (rebalancer.clj:339-346)
-            own = set()
             ut = self.users.get(job.user)
-            if ut is not None:
-                own = {tid for tid in ut.ids if tid}
-            elig = np.array([tid in own for tid in ids], dtype=bool)
-            if len(elig) < state.task_eligible.shape[0]:
-                elig = np.pad(elig, (0, state.task_eligible.shape[0] - len(elig)))
+            own_rows = np.asarray(ut.rows if ut else [], dtype=np.int32)
+            allowed = (
+                jnp.zeros(state.task_eligible.shape[0], bool)
+                .at[jnp.asarray(own_rows)].set(True)
+            )
             state = state._replace(
-                task_eligible=jnp.asarray(elig) & state.task_eligible
+                task_eligible=state.task_eligible & allowed
             )
         r = job.resources
         decision = find_preemption_decision(
@@ -243,7 +251,7 @@ class RebalanceCycle:
         if host < 0:
             return None
         mask = np.asarray(decision.preempt_mask)
-        task_ids = [ids[i] for i in np.where(mask[: len(ids)])[0]]
+        task_ids = [self.row_ids[i] for i in np.where(mask)[0]]
         self._apply(job, host, task_ids, np.asarray(decision.freed))
         return Decision(
             job=job,
@@ -255,31 +263,59 @@ class RebalanceCycle:
     def _apply(self, job: Job, host: int, task_ids: list[str],
                freed: np.ndarray) -> None:
         """next-state (rebalancer.clj:270-318): remove victims, add the
-        simulated launch, rescore changed users, update host spare."""
+        simulated launch, rescore changed users, update host spare —
+        all as small scatters into the device-resident tensors."""
         changed = {job.user}
+        dead_rows = []
         for tid in task_ids:
             self.preempted.add(tid)
             user, _ = self.task_info[tid]
             ut = self.users[user]
             k = ut.ids.index(tid)
-            del ut.keys[k], ut.ids[k], ut.res[k]
+            dead_rows.append(ut.rows[k])
+            del ut.keys[k], ut.ids[k], ut.res[k], ut.rows[k]
             changed.add(user)
-        # simulated launch of the pending job on the chosen host
+        # simulated launch of the pending job on the chosen host: it joins
+        # the fairness state (and may itself be preempted by later
+        # decisions), living in a preallocated slack row
         ut = self.users.setdefault(job.user, _UserTasks())
         key = self._task_key(job, None)
         pos = bisect.bisect_right(ut.keys, key)
         sim_id = f"sim-{job.uuid}"
+        sim_row = self._next_slack
+        self._next_slack += 1
+        res = (job.resources.mem, job.resources.cpus,
+               job.resources.gpus, job.resources.disk)
         ut.keys.insert(pos, key)
         ut.ids.insert(pos, sim_id)
-        ut.res.insert(pos, (job.resources.mem, job.resources.cpus,
-                            job.resources.gpus, job.resources.disk))
+        ut.res.insert(pos, res)
+        ut.rows.insert(pos, sim_row)
+        self.row_ids[sim_row] = sim_id
         self.task_info[sim_id] = (job.user, self.hostnames[host])
+
+        touched = []
         for user in changed:
-            self._rescore(user)
+            touched.extend(self._rescore(user))
+        for row in dead_rows:
+            self._elig_np[row] = False
+        self._elig_np[sim_row] = True
+
+        # device scatters: O(changed rows)
+        rows = np.asarray(sorted(set(touched + dead_rows + [sim_row])),
+                          dtype=np.int32)
+        dev_rows = jnp.asarray(rows)
+        self._dev_dru = self._dev_dru.at[dev_rows].set(
+            jnp.asarray(self._dru_np[rows]))
+        self._dev_elig = self._dev_elig.at[dev_rows].set(
+            jnp.asarray(self._elig_np[rows]))
+        self._dev_host = self._dev_host.at[sim_row].set(host)
+        self._dev_res = self._dev_res.at[sim_row].set(
+            jnp.asarray(np.asarray(res, np.float32)))
         r = job.resources
-        self.spare[host] = np.maximum(
+        new_spare = np.maximum(
             freed - np.array([r.mem, r.cpus, r.gpus, r.disk]), 0.0
-        )
+        ).astype(np.float32)
+        self._dev_spare = self._dev_spare.at[host].set(jnp.asarray(new_spare))
 
 
 def rebalance_pool(
