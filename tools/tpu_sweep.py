@@ -1,0 +1,106 @@
+"""TPU tuning sweep for the match kernel at the headline config.
+
+Run when a real device is attached; writes JSON lines to tpu_sweep.jsonl
+so results survive short device windows:
+
+    python tools/tpu_sweep.py [--out tpu_sweep.jsonl]
+"""
+import argparse
+import itertools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="tpu_sweep.jsonl")
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from bench import make_problem
+    from cook_tpu.ops import cpu_reference as ref
+    from cook_tpu.ops.match import MatchProblem, chunked_match
+
+    platform = jax.devices()[0].platform
+    print(f"device: {jax.devices()[0]}", file=sys.stderr)
+
+    J, N = 131072, 16384
+    j_real, n_real = 100_000, 10_000
+    demands, avail, totals = make_problem(J, N, seed=2)
+    job_valid = np.zeros(J, bool)
+    job_valid[:j_real] = True
+    node_valid = np.zeros(N, bool)
+    node_valid[:n_real] = True
+    problem = MatchProblem(
+        demands=jnp.asarray(demands),
+        job_valid=jnp.asarray(job_valid),
+        avail=jnp.asarray(avail),
+        totals=jnp.asarray(totals),
+        node_valid=jnp.asarray(node_valid),
+        feasible=None,
+    )
+    from cook_tpu.ops import native
+    t0 = time.perf_counter()
+    cpu_assign, kind = (
+        (native.greedy_match(demands[:j_real].astype(np.float64),
+                             avail[:n_real].astype(np.float64),
+                             totals[:n_real].astype(np.float64)), "c++")
+        if native.available()
+        else (ref.np_greedy_match(demands[:j_real], avail[:n_real],
+                                  totals[:n_real]), "numpy"))
+    cpu_ms = (time.perf_counter() - t0) * 1000
+    q_cpu = ref.packing_quality(demands[:j_real], cpu_assign)
+    print(f"cpu[{kind}] {cpu_ms:.0f} ms placed {q_cpu['num_placed']}",
+          file=sys.stderr)
+
+    grid = list(itertools.product(
+        [512, 1024, 2048],      # chunk
+        [2, 3],                 # passes
+        [3, 4, 6],              # rounds
+        [64, 128],              # kc
+    ))
+    with open(args.out, "a") as out:
+        for chunk, passes, rounds, kc in grid:
+            try:
+                solve = lambda: jax.block_until_ready(chunked_match(
+                    problem, chunk=chunk, rounds=rounds, kc=kc,
+                    passes=passes))
+                t0 = time.perf_counter()
+                result = solve()
+                compile_ms = (time.perf_counter() - t0) * 1000
+                times = []
+                for _ in range(args.repeats):
+                    t0 = time.perf_counter()
+                    result = solve()
+                    times.append((time.perf_counter() - t0) * 1000)
+                a = np.asarray(result.assignment[:j_real])
+                q = ref.packing_quality(demands[:j_real], a)
+                eff = (q["cpus_placed"] / q_cpu["cpus_placed"]
+                       if q_cpu["cpus_placed"] else 1.0)
+                record = {
+                    "platform": platform,
+                    "chunk": chunk, "passes": passes, "rounds": rounds,
+                    "kc": kc,
+                    "p50_ms": round(float(np.percentile(times, 50)), 1),
+                    "compile_ms": round(compile_ms),
+                    "placed": q["num_placed"],
+                    "packing_eff": round(eff, 4),
+                    "cpu_ms": round(cpu_ms),
+                }
+            except Exception as e:  # noqa: BLE001 — record and continue
+                record = {"chunk": chunk, "passes": passes,
+                          "rounds": rounds, "kc": kc, "error": str(e)[:200]}
+            print(json.dumps(record), flush=True)
+            out.write(json.dumps(record) + "\n")
+            out.flush()
+
+
+if __name__ == "__main__":
+    main()
