@@ -91,6 +91,7 @@ class TaskRunner:
                 out.write(raw)
                 out.flush()
                 self._scrape_progress(raw)
+            self.proc.stdout.close()
             code = self.proc.wait()
         self._flush_progress(force=True)
         self.sink(TaskUpdate(self.task_id, "exit-code", exit_code=code))
