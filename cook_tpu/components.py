@@ -63,7 +63,9 @@ def _mock_factory(conf: dict, clock) -> ComputeCluster:
         )
         for h in conf.get("hosts", [])
     ]
-    return MockCluster(conf["name"], hosts, clock)
+    return MockCluster(conf["name"], hosts, clock,
+                       default_runtime_ms=int(
+                           conf.get("default_runtime_ms", 60_000)))
 
 
 @register_cluster_factory("k8s")
@@ -284,6 +286,16 @@ def start_leader_duties(process: CookProcess,
         process.loops.append(
             TriggerLoop("k8s-scan", 30.0,
                         lambda: [c.scan_all() for c in scannable]).start()
+        )
+    # mock clusters complete tasks by virtual time; in a live service the
+    # wall clock drives them (the simulator drives advance_to itself)
+    advanceable = [c for c in process.clusters if hasattr(c, "advance_to")]
+    if advanceable:
+        process.loops.append(
+            TriggerLoop(
+                "mock-advance", 0.5,
+                lambda: [c.advance_to(store.clock()) for c in advanceable],
+            ).start()
         )
     if settings.data_dir:
         import os as _os

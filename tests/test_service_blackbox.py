@@ -1,0 +1,102 @@
+"""Blackbox service test: boot `python -m cook_tpu` as a subprocess with a
+mock cluster config, drive it purely over HTTP/CLI, watch a job run to
+completion on real (wall-clock) trigger loops."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from cook_tpu.rest.server import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def service(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("svc")
+    port = free_port()
+    config = {
+        "port": port,
+        "pools": [{"name": "default"}],
+        "rank_interval_s": 0.2,
+        "match_interval_s": 0.2,
+        "rebalancer_interval_s": 3600,
+        "clusters": [{
+            "kind": "mock",
+            "name": "local",
+            "default_runtime_ms": 500,
+            "hosts": [{"node_id": "h0", "mem": 8000, "cpus": 16},
+                      {"node_id": "h1", "mem": 8000, "cpus": 16}],
+        }],
+    }
+    cfg = tmp / "config.json"
+    cfg.write_text(json.dumps(config))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "PYTHONPATH": REPO}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cook_tpu", "--config", str(cfg)],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    url = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(300):
+            try:
+                if requests.get(f"{url}/debug", timeout=1).ok:
+                    break
+            except requests.ConnectionError:
+                time.sleep(0.2)
+        else:
+            raise RuntimeError("service did not come up")
+        yield url
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_job_runs_via_real_service(service):
+    url = service
+    h = {"X-Cook-Requesting-User": "bb"}
+    r = requests.post(f"{url}/jobs", json={"jobs": [
+        {"command": "blackbox", "mem": 100, "cpus": 1,
+         "expected_runtime": 1}
+    ]}, headers=h)
+    assert r.status_code == 201, r.text
+    uuid = r.json()["jobs"][0]
+    # real trigger loops pick it up within a few hundred ms; the mock
+    # cluster completes it when wall-clock passes its runtime
+    deadline = time.time() + 30
+    status = None
+    while time.time() < deadline:
+        status = requests.get(f"{url}/jobs/{uuid}", headers=h).json()["status"]
+        if status == "completed":
+            break
+        time.sleep(0.3)
+    assert status == "completed", status
+    # metrics endpoint reflects the work
+    metrics = requests.get(f"{url}/metrics", headers=h).text
+    assert "cook_jobs_submitted" in metrics
+
+
+def test_cli_against_real_service(service, tmp_path, capsys):
+    from cook_tpu.client.cli import main as cli_main
+
+    cfg = tmp_path / "cs.json"
+    cfg.write_text(json.dumps(
+        {"clusters": [{"name": "svc", "url": service}]}))
+    rc = cli_main(["--config", str(cfg), "--user", "bb",
+                   "submit", "--mem", "64", "cli job"])
+    assert rc == 0
+    uuid = capsys.readouterr().out.strip()
+    rc = cli_main(["--config", str(cfg), "--user", "bb",
+                   "wait", uuid, "--timeout", "30"])
+    assert rc == 0
