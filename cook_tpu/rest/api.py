@@ -868,7 +868,22 @@ class CookApi:
             return _err(503, "no scheduler attached")
         cluster = self.scheduler.cluster_by_name(name)
         if cluster is None:
-            return _err(404, f"unknown cluster {name}")
+            if "kind" not in body:
+                return _err(404, f"unknown cluster {name}")
+            # dynamic cluster creation (compute-clusters CRUD,
+            # rest/api.clj:3914 + compute_cluster.clj:450-530)
+            from cook_tpu.components import CLUSTER_FACTORIES
+
+            factory = CLUSTER_FACTORIES.get(body["kind"])
+            if factory is None:
+                return _err(400, f"unknown cluster kind {body['kind']}")
+            try:
+                cluster = factory(body, self.store.clock)
+                self.scheduler.add_cluster(cluster)
+            except (ValueError, KeyError) as e:
+                return _err(400, str(e))
+            return web.json_response(
+                {"name": name, "state": cluster.state.value}, status=201)
         try:
             cluster.set_state(ClusterState(new_state))
         except ValueError as e:

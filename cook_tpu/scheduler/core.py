@@ -109,6 +109,15 @@ class Scheduler:
                 return c
         return None
 
+    def add_cluster(self, cluster: ComputeCluster) -> None:
+        """Attach a dynamically-created compute cluster (reference: dynamic
+        cluster config insertion, compute_cluster.clj:450-530)."""
+        if self.cluster_by_name(cluster.name) is not None:
+            raise ValueError(f"cluster {cluster.name} already exists")
+        if hasattr(cluster, "status_callback"):
+            cluster.status_callback = self.handle_status_update
+        self.clusters.append(cluster)
+
     def _make_task_id(self, job: Job) -> str:
         return f"task-{job.uuid[:8]}-{next(self._task_seq)}"
 

@@ -273,3 +273,29 @@ def test_cancel_instance_endpoint(server):
     assert server.store.instances[inst.task_id].status.value == "failed"
     # the job retries (cancel kills the instance, not the job)
     assert server.store.jobs[uuid].state.value == "waiting"
+
+
+def test_dynamic_cluster_creation(server):
+    """POST /compute-clusters with a kind creates and attaches a new
+    cluster whose offers join the next match cycle."""
+    r = requests.post(f"{server.url}/compute-clusters", json={
+        "kind": "mock",
+        "name": "burst-cluster",
+        "hosts": [{"node_id": "bx0", "mem": 9000, "cpus": 64}],
+    }, headers=hdr("admin"))
+    assert r.status_code == 201, r.text
+    names = [c["name"] for c in requests.get(
+        f"{server.url}/compute-clusters", headers=hdr()).json()["in-mem-configs"]]
+    assert "burst-cluster" in names
+    # a huge job only the new cluster can hold
+    uuid = submit(server, [{"command": "big", "mem": 8500, "cpus": 48}])["jobs"][0]
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+    [inst] = server.store.job_instances(uuid)
+    assert inst.compute_cluster == "burst-cluster"
+    # duplicate creation rejected
+    r = requests.post(f"{server.url}/compute-clusters", json={
+        "kind": "mock", "name": "burst-cluster", "hosts": []},
+        headers=hdr("admin"))
+    assert r.status_code in (201, 400)
