@@ -268,20 +268,27 @@ class Scheduler:
 
     def _cache_spare(self, pool: Pool) -> None:
         spare: dict[str, Resources] = {}
+        host_info: dict[str, tuple[dict, str]] = {}  # host -> (attrs, location)
         for cluster in self.clusters:
             if not cluster.accepts_work:
                 continue
             for offer in cluster.pending_offers(pool.name):
                 spare[offer.hostname] = Resources(
-                    mem=offer.mem, cpus=offer.cpus, gpus=offer.gpus
+                    mem=offer.mem, cpus=offer.cpus, gpus=offer.gpus,
+                    disk=offer.disk,
                 )
+                host_info[offer.hostname] = (dict(offer.attributes),
+                                             cluster.location)
         self.last_unmatched_offers[pool.name] = spare
+        self.last_host_info = getattr(self, "last_host_info", {})
+        self.last_host_info[pool.name] = host_info
 
     def rebalance_cycle(self, pool: Pool) -> list[Decision]:
         queue = self.pool_queues.get(pool.name) or self.rank_cycle(pool)
         spare = self.last_unmatched_offers.get(pool.name, {})
         decisions = rebalance_pool(
-            self.store, pool, queue.jobs, spare, self.config.rebalancer
+            self.store, pool, queue.jobs, spare, self.config.rebalancer,
+            host_info=getattr(self, "last_host_info", {}).get(pool.name),
         )
         for decision in decisions:
             self._transact_preemption(decision)

@@ -70,10 +70,12 @@ class RebalanceCycle:
         pool: Pool,
         host_spare: dict[str, Resources],
         params: RebalancerParams,
+        host_info: Optional[dict[str, tuple[dict, str]]] = None,
     ):
         self.store = store
         self.pool = pool
         self.params = params
+        self.host_info = host_info or {}  # hostname -> (attrs, location)
         self.gpu_mode = pool.dru_mode == DruMode.GPU
 
         # hosts
@@ -224,8 +226,40 @@ class RebalanceCycle:
 
     # ----------------------------------------------------------- main loop
 
+    def _host_ok_for(self, job: Job) -> Optional[np.ndarray]:
+        """Per-host constraint pass for the pending job (reference:
+        make-rebalancer-job-constraints, constraints.clj:504): novel-host,
+        user attribute EQUALS, checkpoint locality."""
+        failed_hosts = {
+            inst.hostname
+            for inst in self.store.job_instances(job.uuid)
+            if inst.status.terminal and inst.hostname
+        }
+        need_attrs = {c.attribute: c.pattern for c in job.constraints}
+        need_location = (job.checkpoint.location
+                         if job.checkpoint is not None else "")
+        if not failed_hosts and not need_attrs and not need_location:
+            return None
+        ok = np.ones(max(len(self.hostnames), 1), dtype=bool)
+        for i, hostname in enumerate(self.hostnames):
+            if hostname in failed_hosts:
+                ok[i] = False
+                continue
+            attrs, location = self.host_info.get(hostname, ({}, ""))
+            if need_location and location != need_location:
+                ok[i] = False
+                continue
+            for attr, want in need_attrs.items():
+                if attrs.get(attr) != want:
+                    ok[i] = False
+                    break
+        return ok
+
     def compute_decision(self, job: Job) -> Optional[Decision]:
         state = self._device_state()
+        host_ok = self._host_ok_for(job)
+        if host_ok is not None:
+            state = state._replace(host_ok=jnp.asarray(host_ok))
         pending_dru = self.pending_job_dru(job)
         if not self.user_below_quota(job):
             # over-quota users may only preempt their own tasks
@@ -324,10 +358,12 @@ def rebalance_pool(
     pending_in_dru_order: Sequence[Job],
     host_spare: dict[str, Resources],
     params: RebalancerParams,
+    host_info: Optional[dict] = None,
 ) -> list[Decision]:
     """One pool's rebalance cycle: returns the preemption decisions
     (rebalancer.clj:434-479 `rebalance`).  The caller transacts + kills."""
-    cycle = RebalanceCycle(store, pool, host_spare, params)
+    cycle = RebalanceCycle(store, pool, host_spare, params,
+                           host_info=host_info)
     decisions = []
     for job in list(pending_in_dru_order)[: params.max_preemption]:
         decision = cycle.compute_decision(job)

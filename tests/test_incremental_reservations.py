@@ -113,3 +113,44 @@ def test_rebalancer_multi_task_decision_creates_reservation():
     decisions = scheduler.rebalance_cycle(pool)
     assert decisions and len(decisions[0].task_ids) == 2
     assert scheduler.host_reservations == {"h0": big.uuid}
+
+
+def test_rebalancer_respects_novel_host():
+    """A pending job that already failed on a host never preempts there
+    (make-rebalancer-job-constraints includes novel-host)."""
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.entities import InstanceStatus
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from cook_tpu.scheduler.rebalancer import RebalancerParams
+    from tests.conftest import FakeClock
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=400, cpus=4, gpus=1)))
+    store.set_share(Share(user="starved", pool="default",
+                          resources=Resources(mem=1600, cpus=16, gpus=1)))
+    cluster = MockCluster(
+        "m", [MockHost(node_id="h0", hostname="h0", mem=800, cpus=8)],
+        clock=clock)
+    scheduler = Scheduler(
+        store, [cluster],
+        SchedulerConfig(rebalancer=RebalancerParams(
+            safe_dru_threshold=0.0, min_dru_diff=0.01, max_preemption=5)),
+    )
+    pool = store.pools["default"]
+    for i in range(2):
+        job = make_job(user="hog", mem=400, cpus=4)
+        store.submit_jobs([job])
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+    big = make_job(user="starved", mem=800, cpus=8)
+    store.submit_jobs([big])
+    # big already failed on h0 -> novel-host forbids preempting there
+    store.create_instance(big.uuid, "prior", hostname="h0")
+    store.update_instance_state("prior", InstanceStatus.FAILED, 99000)
+    scheduler.rank_cycle(pool)
+    decisions = scheduler.rebalance_cycle(pool)
+    assert decisions == []
