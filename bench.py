@@ -157,6 +157,51 @@ def bench_dru(jax, jnp):
     return p50
 
 
+def bench_multipool(jax, jnp):
+    """BASELINE config 3: multi-pool cpu+mem+gpu bin-packing, pools as the
+    batch axis of one vmapped solve."""
+    from cook_tpu.ops.match import MatchProblem, chunked_match
+
+    P, J, N = 8, 16384, 2048
+    rng = np.random.default_rng(5)
+    demands = np.stack([
+        rng.choice([512, 1024, 2048, 4096], (P, J)).astype(np.float32),
+        rng.choice([0.5, 1, 2, 4], (P, J)).astype(np.float32),
+        (rng.uniform(size=(P, J)) < 0.1).astype(np.float32)
+        * rng.integers(1, 4, (P, J)).astype(np.float32),
+    ], axis=-1)
+    totals = np.stack([
+        np.full((P, N), 65536.0, np.float32),
+        np.full((P, N), 32.0, np.float32),
+    ], axis=-1)
+    gpus = np.where(rng.uniform(size=(P, N, 1)) < 0.2, 8.0, 0.0)
+    avail = np.concatenate(
+        [totals * rng.uniform(0.2, 1.0, (P, N, 1)).astype(np.float32),
+         gpus.astype(np.float32)], axis=-1)
+    problems = MatchProblem(
+        demands=jnp.asarray(demands),
+        job_valid=jnp.ones((P, J), bool),
+        avail=jnp.asarray(avail),
+        totals=jnp.asarray(totals),
+        node_valid=jnp.ones((P, N), bool),
+        feasible=None,
+    )
+    solve = jax.vmap(
+        lambda p: chunked_match(p, chunk=1024, rounds=4, kc=128, passes=2)
+    )
+
+    def run():
+        return jax.block_until_ready(solve(problems))
+
+    run()
+    p50, _ = time_fn(run)
+    result = run()
+    placed = int(np.asarray((result.assignment >= 0).sum()))
+    log(f"multi-pool 8 x (16k x 2k) cpu+mem+gpu: p50 {p50:.1f} ms, "
+        f"placed {placed}/{P * J}")
+    return p50
+
+
 def bench_rebalance(jax, jnp):
     from cook_tpu.ops.rebalance import RebalanceState, find_preemption_decision
 
@@ -220,6 +265,7 @@ def main():
     if platform != "cpu":
         dru_p50 = bench_dru(jax, jnp)
         reb_p50 = bench_rebalance(jax, jnp)
+        bench_multipool(jax, jnp)
         log(f"full-cycle estimate (rank+match+rebalance): "
             f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
         extra = f", dru_ms={dru_p50:.1f}, rebalance_ms={reb_p50:.1f}"
