@@ -17,6 +17,7 @@ honored for configured admins (rest/impersonation.clj).
 from __future__ import annotations
 
 import base64
+import json
 import statistics
 from dataclasses import dataclass
 from typing import Optional
@@ -168,6 +169,8 @@ class CookApi:
             raise
         except TransactionVetoed as e:
             return _err(400, str(e))
+        except json.JSONDecodeError as e:
+            return _err(400, f"malformed JSON body: {e}")
         # permissive CORS for browser dashboards (reference: cors middleware)
         origin = request.headers.get("Origin")
         if origin:

@@ -299,3 +299,9 @@ def test_dynamic_cluster_creation(server):
         "kind": "mock", "name": "burst-cluster", "hosts": []},
         headers=hdr("admin"))
     assert r.status_code in (201, 400)
+
+
+def test_malformed_json_is_400(server):
+    r = requests.post(f"{server.url}/jobs", data="{bad", headers=hdr())
+    assert r.status_code == 400
+    assert "malformed" in r.json()["error"]
