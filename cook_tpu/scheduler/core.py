@@ -248,6 +248,7 @@ class Scheduler:
             self.store, pools, self.pool_queues, self.clusters,
             self.config.match, self.pool_match_state,
             make_task_id=self._make_task_id,
+            launch_filter=self._make_launch_filter(),
             record_placement_failure=self._record_placement_failure,
             host_reservations=self.host_reservations,
             mesh=mesh,

@@ -394,6 +394,7 @@ def match_pools_batched(
     states: dict[str, PoolMatchState],
     *,
     make_task_id: Callable[[Job], str],
+    launch_filter: Optional[Callable[[Job], bool]] = None,
     record_placement_failure: Optional[Callable[[Job, str], None]] = None,
     host_reservations: Optional[dict[str, str]] = None,
     mesh=None,
@@ -415,7 +416,8 @@ def match_pools_batched(
     prepared_list = [
         prepare_pool_problem(
             store, pool, queues[pool.name], clusters, config,
-            states[pool.name], host_reservations=host_reservations,
+            states[pool.name], launch_filter=launch_filter,
+            host_reservations=host_reservations,
         )
         for pool in pools
     ]
