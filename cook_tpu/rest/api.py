@@ -143,7 +143,34 @@ class CookApi:
         r.add_post("/incremental-config", self.post_incremental_config)
         r.add_post("/shutdown-leader", self.post_shutdown_leader)
         r.add_get("/debug", self.get_debug)
+        r.add_get("/swagger-docs", self.get_swagger_docs)
+        r.add_get("/swagger-ui", self.get_swagger_ui)
+        self._openapi = _build_openapi(app)
         return app
+
+    async def get_swagger_docs(self, request: web.Request) -> web.Response:
+        """Machine-readable API description (reference serves swagger at
+        the same paths, rest/api.clj:3650)."""
+        return web.json_response(self._openapi)
+
+    async def get_swagger_ui(self, request: web.Request) -> web.Response:
+        rows = []
+        for path, methods in sorted(self._openapi["paths"].items()):
+            for method, info in sorted(methods.items()):
+                rows.append(
+                    f"<tr><td><code>{method.upper()}</code></td>"
+                    f"<td><code>{path}</code></td>"
+                    f"<td>{info.get('summary', '')}</td></tr>"
+                )
+        html = (
+            "<html><head><title>cook-tpu API</title></head><body>"
+            "<h1>cook-tpu REST API</h1>"
+            "<p>Machine-readable spec: <a href='/swagger-docs'>"
+            "/swagger-docs</a></p>"
+            "<table border=1 cellpadding=4><tr><th>Method</th><th>Path</th>"
+            "<th>Handler</th></tr>" + "".join(rows) + "</table></body></html>"
+        )
+        return web.Response(text=html, content_type="text/html")
 
     async def get_debug(self, request: web.Request) -> web.Response:
         """Health endpoint (reference components.clj:141): 200 when the
@@ -934,6 +961,29 @@ def _res_json(res: Resources) -> dict:
 
 def _err(status: int, message: str) -> web.Response:
     return web.json_response({"error": message}, status=status)
+
+
+def _build_openapi(app: web.Application) -> dict:
+    """Minimal OpenAPI 3 doc generated from the registered routes."""
+    paths: dict = {}
+    for route in app.router.routes():
+        if route.method == "HEAD" or route.resource is None:
+            continue
+        path = route.resource.canonical
+        if path in ("/swagger-docs", "/swagger-ui"):
+            continue
+        handler_doc = (route.handler.__doc__ or "").strip().splitlines()
+        summary = handler_doc[0] if handler_doc else route.handler.__name__
+        paths.setdefault(path, {})[route.method.lower()] = {
+            "summary": summary,
+            "operationId": route.handler.__name__,
+            "responses": {"200": {"description": "success"}},
+        }
+    return {
+        "openapi": "3.0.0",
+        "info": {"title": "cook-tpu scheduler API", "version": "0.1.0"},
+        "paths": paths,
+    }
 
 
 def run_server(api: CookApi, host: str = "127.0.0.1", port: int = 12321):

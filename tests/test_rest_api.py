@@ -305,3 +305,12 @@ def test_malformed_json_is_400(server):
     r = requests.post(f"{server.url}/jobs", data="{bad", headers=hdr())
     assert r.status_code == 400
     assert "malformed" in r.json()["error"]
+
+
+def test_swagger_endpoints(server):
+    spec = requests.get(f"{server.url}/swagger-docs", headers=hdr()).json()
+    assert spec["openapi"].startswith("3.")
+    assert "/jobs" in spec["paths"]
+    assert "post" in spec["paths"]["/jobs"]
+    ui = requests.get(f"{server.url}/swagger-ui", headers=hdr())
+    assert ui.status_code == 200 and "/jobs" in ui.text
