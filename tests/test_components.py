@@ -173,3 +173,24 @@ def test_two_processes_one_leader(tmp_path):
     finally:
         shutdown(p2)
         shutdown(p1)
+
+
+def test_standby_proxies_queue_to_leader():
+    from cook_tpu.models.entities import Pool
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.rest.api import ApiConfig, CookApi
+    from cook_tpu.rest.server import ServerThread
+
+    store = JobStore(clock=lambda: 0)
+    store.set_pool(Pool(name="default"))
+    api = CookApi(store, None, ApiConfig())
+    api.leader = False
+    api.leader_url = "http://leader.example:12321"
+    srv = ServerThread(api).start()
+    try:
+        r = requests.get(f"{srv.url}/queue", allow_redirects=False,
+                         headers={"X-Cook-Requesting-User": "u"})
+        assert r.status_code == 307
+        assert r.headers["Location"] == "http://leader.example:12321/queue"
+    finally:
+        srv.stop()
