@@ -76,6 +76,27 @@ def pool_sharded_dru(mesh: Mesh, tasks: DruTasks, mem_div, cpu_div, gpu_div):
     return shmapped(tasks, mem_div, cpu_div, gpu_div)
 
 
+def task_sharded_dru(mesh: Mesh, tasks: DruTasks, mem_div, cpu_div, gpu_div,
+                     *, gpu_mode: bool = False):
+    """DRU ranking with the TASK axis sharded across the mesh.
+
+    This is the problem-size scale axis SURVEY §5 maps to the reference's
+    long-context story: when one pool's task tensor outgrows a chip, shard
+    T across devices and let XLA parallelize the sorts/cumsums with the
+    collectives it chooses (all-to-all sort exchanges over ICI).  Plain
+    jit + shardings — no shard_map needed, since every op in the kernel is
+    collective-friendly.
+    """
+    axis = mesh.axis_names[0]
+    spec = P(axis)
+    sharded = DruTasks(*[
+        jax.device_put(leaf, NamedSharding(mesh, spec)) for leaf in tasks
+    ])
+    divs = [jax.device_put(d, NamedSharding(mesh, P())) for d in
+            (mem_div, cpu_div, gpu_div)]
+    return dru_rank(sharded, *divs, gpu_mode=gpu_mode)
+
+
 def node_sharded_greedy_match(mesh: Mesh, problem: MatchProblem) -> MatchResult:
     """Sequential greedy match with the NODE axis sharded across the mesh.
 

@@ -103,3 +103,30 @@ def test_node_sharded_match_parity(mesh):
         np.asarray(got.new_avail), np.asarray(want.new_avail),
         rtol=1e-5, atol=1e-4,
     )
+
+
+def test_task_sharded_dru_parity(mesh):
+    """Task-axis sharding: XLA distributes the sort/cumsum; results must
+    match the single-device kernel exactly."""
+    from cook_tpu.ops.dru import DruTasks, dru_rank
+    from cook_tpu.parallel.mesh import task_sharded_dru
+
+    rng = np.random.default_rng(77)
+    t, u = 1024, 16
+    user, mem, cpus, gpus, order_key, md, cd, gd = random_dru_problem(
+        rng, t=t, u=u)
+    tasks = DruTasks(
+        user=jnp.asarray(user.astype(np.int32)),
+        mem=jnp.asarray(mem.astype(np.float32)),
+        cpus=jnp.asarray(cpus.astype(np.float32)),
+        gpus=jnp.asarray(gpus.astype(np.float32)),
+        order_key=jnp.asarray(order_key.astype(np.float32)),
+        valid=jnp.ones(t, dtype=bool),
+    )
+    md, cd, gd = (jnp.asarray(x.astype(np.float32)) for x in (md, cd, gd))
+    want = dru_rank(tasks, md, cd, gd)
+    got = task_sharded_dru(mesh, tasks, md, cd, gd)
+    np.testing.assert_allclose(np.asarray(got.dru), np.asarray(want.dru),
+                               rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got.order),
+                                  np.asarray(want.order))
