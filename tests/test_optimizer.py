@@ -1,0 +1,60 @@
+"""Optimizer seam: cycle validation + the backlog purchase planner."""
+import pytest
+
+from cook_tpu.scheduler.optimizer import (
+    BacklogPurchaseOptimizer,
+    HostInfo,
+    NoOpHostFeed,
+    NoOpOptimizer,
+    OptimizerCycle,
+)
+from tests.conftest import make_job
+
+
+def test_noop_cycle_shape():
+    cycle = OptimizerCycle()
+    out = cycle.run([], [], {})
+    assert out == {0: {"suggested-matches": {}, "suggested-purchases": {}}}
+    assert cycle.latest_schedule == out
+
+
+def test_malformed_schedule_rejected():
+    class Bad(NoOpOptimizer):
+        def produce_schedule(self, *a):
+            return {"not-an-int": {}}
+
+    cycle = OptimizerCycle(optimizer=Bad())
+    with pytest.raises(ValueError):
+        cycle.run([], [], {})
+
+
+def test_backlog_purchase_sizing():
+    class Feed(NoOpHostFeed):
+        def get_available_host_info(self):
+            return [
+                HostInfo("small", count=100, cpus=8, mem=16000),
+                HostInfo("gpu-box", count=10, cpus=32, mem=64000, gpus=8),
+            ]
+
+    queue = [make_job(mem=16000, cpus=8) for _ in range(5)]
+    queue += [make_job(mem=1000, cpus=1, gpus=4)]
+    cycle = OptimizerCycle(host_feed=Feed(),
+                           optimizer=BacklogPurchaseOptimizer())
+    out = cycle.run(queue, [], {"mem": 16000.0, "cpus": 8.0})
+    purchases = out[0]["suggested-purchases"]
+    # mem gap = 5*16000 + 1000 - 16000 spare = 65000 -> ceil = 5 smalls,
+    # plus a gpu box for the gpu job
+    assert purchases["small"] == 5
+    assert purchases["gpu-box"] == 1
+
+
+def test_no_purchases_when_capacity_covers():
+    class Feed(NoOpHostFeed):
+        def get_available_host_info(self):
+            return [HostInfo("small", count=10, cpus=8, mem=16000)]
+
+    queue = [make_job(mem=100, cpus=1)]
+    cycle = OptimizerCycle(host_feed=Feed(),
+                           optimizer=BacklogPurchaseOptimizer())
+    out = cycle.run(queue, [], {"mem": 99999.0, "cpus": 999.0})
+    assert out[0]["suggested-purchases"] == {}
