@@ -271,6 +271,36 @@ def cmd_retry(args) -> int:
     return 0
 
 
+def cmd_config(args) -> int:
+    """Show or edit the federation config (reference: cs config)."""
+    path = args.config or next(
+        (p for p in DEFAULT_CONFIG_PATHS if os.path.exists(p)),
+        DEFAULT_CONFIG_PATHS[0],
+    )
+    data = {"clusters": []}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    changed = False
+    if args.add_cluster:
+        name, url = args.add_cluster
+        data["clusters"] = [c for c in data.get("clusters", [])
+                            if c["name"] != name]
+        data["clusters"].append({"name": name, "url": url})
+        changed = True
+    if args.remove_cluster:
+        data["clusters"] = [c for c in data.get("clusters", [])
+                            if c["name"] != args.remove_cluster]
+        changed = True
+    if changed:
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2)
+        print(f"wrote {path}")
+    for c in data.get("clusters", []):
+        print(f"{c['name']}\t{c['url']}")
+    return 0
+
+
 def cmd_admin_set_share(args) -> int:
     import requests
 
@@ -365,6 +395,11 @@ def build_parser() -> argparse.ArgumentParser:
     q = sub.add_parser("usage", help="show a user's usage")
     q.add_argument("--lookup-user", dest="lookup_user")
     q.set_defaults(fn=cmd_usage)
+
+    q = sub.add_parser("config", help="show or edit the federation config")
+    q.add_argument("--add-cluster", nargs=2, metavar=("NAME", "URL"))
+    q.add_argument("--remove-cluster", metavar="NAME")
+    q.set_defaults(fn=cmd_config)
 
     q = sub.add_parser("ls", help="list a job's sandbox files")
     q.add_argument("uuid")

@@ -143,3 +143,21 @@ def test_debug_endpoint(server):
     r = requests.get(f"{server.url}/debug")
     assert r.status_code == 200
     assert r.json()["healthy"] is True
+
+
+def test_cli_config_management(tmp_path, capsys):
+    path = str(tmp_path / "fed.json")
+    assert cli_main(["--config", path, "config",
+                     "--add-cluster", "east", "http://e:1"]) == 0
+    capsys.readouterr()
+    assert cli_main(["--config", path, "config",
+                     "--add-cluster", "west", "http://w:1"]) == 0
+    capsys.readouterr()
+    assert cli_main(["--config", path, "config"]) == 0
+    out = capsys.readouterr().out
+    assert "east" in out and "west" in out
+    assert cli_main(["--config", path, "config",
+                     "--remove-cluster", "east"]) == 0
+    capsys.readouterr()
+    assert cli_main(["--config", path, "config"]) == 0
+    assert "east" not in capsys.readouterr().out
