@@ -46,11 +46,13 @@ def shard_pools(mesh: Mesh, tree, axis: str = "pool"):
 
 
 def pool_sharded_match(mesh: Mesh, problems: MatchProblem, *,
-                       chunk: int = 0) -> MatchResult:
+                       chunk: int = 0, rounds: int = 4,
+                       passes: int = 2) -> MatchResult:
     """Solve P pools' match problems concurrently, one shard of pools per
     device.  `problems` leaves have leading axis P (divisible by mesh size).
     chunk=0 selects the exact sequential-greedy kernel."""
-    fn = (functools.partial(chunked_match, chunk=chunk) if chunk
+    fn = (functools.partial(chunked_match, chunk=chunk, rounds=rounds,
+                            passes=passes) if chunk
           else greedy_match)
     mapped = jax.vmap(fn)
     spec = P("pool")
