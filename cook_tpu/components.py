@@ -158,6 +158,9 @@ def build_process(
         persistence.attach_journal(
             store, os.path.join(settings.data_dir, "journal.jsonl")
         )
+    from cook_tpu.utils.logging import attach_passport
+
+    attach_passport(store)
     for pool_conf in settings.pools:
         store.set_pool(Pool(
             name=pool_conf["name"],

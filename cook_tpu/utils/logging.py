@@ -61,3 +61,32 @@ def passport(event_type: str, **data: Any) -> None:
     passport_logger.info(
         json.dumps({"event-type": event_type, **data}, default=str)
     )
+
+
+_STORE_EVENT_TO_PASSPORT = {
+    "job/created": JOB_CREATED,
+    "instance/created": JOB_LAUNCHED,
+    "instance/status": INSTANCE_COMPLETED,   # terminal statuses only
+    "job/state": JOB_COMPLETED,              # completed transitions only
+}
+
+
+def attach_passport(store) -> None:
+    """Bridge the store's transaction feed onto the passport audit stream
+    (the reference sprinkles passport calls through the code; the event
+    log lets us derive the same audit trail in one place)."""
+
+    def on_event(event) -> None:
+        kind = event.kind
+        mapped = _STORE_EVENT_TO_PASSPORT.get(kind)
+        if mapped is None:
+            return
+        if kind == "instance/status" and event.data.get("status") not in (
+            "success", "failed"
+        ):
+            return
+        if kind == "job/state" and event.data.get("state") != "completed":
+            return
+        passport(mapped, seq=event.seq, **event.data)
+
+    store.add_watcher(on_event)

@@ -165,3 +165,24 @@ class TestKillers:
         killed = scheduler.kill_stragglers(clock())
         assert killed == [insts[3].task_id]
         assert store.instances[insts[3].task_id].reason_code == 2004
+
+
+class TestPassport:
+    def test_store_events_become_audit_events(self, caplog):
+        import logging
+
+        from cook_tpu.utils.logging import attach_passport
+
+        clock, store, cluster, scheduler = setup()
+        attach_passport(store)
+        with caplog.at_level(logging.INFO, logger="cook_tpu.passport"):
+            inst = run_job(store, scheduler, make_job())
+            store.update_instance_state(inst.task_id, InstanceStatus.SUCCESS,
+                                        1000)
+        events = [r.message for r in caplog.records
+                  if r.name == "cook_tpu.passport"]
+        joined = "\n".join(events)
+        assert "job-created" in joined
+        assert "job-launched" in joined
+        assert "instance-completed" in joined
+        assert "job-completed" in joined
