@@ -227,6 +227,20 @@ class Scheduler:
         self._cache_spare(pool)
         self.metrics[f"match.{pool.name}.matched"] = len(outcome.matched)
         self.metrics[f"match.{pool.name}.offers"] = outcome.offers_total
+        # per-cycle summary line (handle-match-cycle-metrics,
+        # scheduler.clj:1210)
+        from cook_tpu.utils.logging import log_info
+
+        log_info(
+            "match cycle",
+            component="matcher",
+            pool=pool.name,
+            matched=len(outcome.matched),
+            unmatched=len(outcome.unmatched),
+            offers=outcome.offers_total,
+            head_matched=outcome.head_matched,
+            considerable_window=state.num_considerable,
+        )
         return outcome
 
     def match_cycle_all_pools(self, mesh=None) -> dict[str, MatchOutcome]:
