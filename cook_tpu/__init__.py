@@ -13,3 +13,25 @@ dense-tensor solves in JAX (see `cook_tpu.ops`), sharded over the TPU ICI mesh
 """
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    """Lazy convenience exports (kept lazy so `import cook_tpu` stays
+    cheap and JAX-free for clients that only need the REST client)."""
+    if name in ("JobStore", "Job", "Instance", "Pool", "Resources"):
+        from cook_tpu import models
+
+        return getattr(models, name)
+    if name == "Scheduler":
+        from cook_tpu.scheduler import Scheduler
+
+        return Scheduler
+    if name == "JobClient":
+        from cook_tpu.client import JobClient
+
+        return JobClient
+    if name == "Simulator":
+        from cook_tpu.sim import Simulator
+
+        return Simulator
+    raise AttributeError(f"module 'cook_tpu' has no attribute {name!r}")
