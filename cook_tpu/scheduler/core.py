@@ -31,6 +31,7 @@ from cook_tpu.scheduler.matcher import (
     match_pool,
 )
 from cook_tpu.scheduler.ranking import RankedQueue, rank_pool
+from cook_tpu.utils.metrics import global_registry
 from cook_tpu.scheduler.rebalancer import (
     Decision,
     RebalancerParams,
@@ -190,6 +191,8 @@ class Scheduler:
             )
         self.pool_queues[pool.name] = queue
         self.metrics[f"rank.{pool.name}.queue_len"] = len(queue.jobs)
+        global_registry.gauge("rank.queue_len").set(
+            len(queue.jobs), {"pool": pool.name})
         return queue
 
     def match_cycle(self, pool: Pool) -> MatchOutcome:
@@ -227,6 +230,10 @@ class Scheduler:
         self._cache_spare(pool)
         self.metrics[f"match.{pool.name}.matched"] = len(outcome.matched)
         self.metrics[f"match.{pool.name}.offers"] = outcome.offers_total
+        global_registry.counter("match.matched").inc(
+            len(outcome.matched), {"pool": pool.name})
+        global_registry.gauge("match.offers").set(
+            outcome.offers_total, {"pool": pool.name})
         # per-cycle summary line (handle-match-cycle-metrics,
         # scheduler.clj:1210)
         from cook_tpu.utils.logging import log_info
@@ -311,9 +318,10 @@ class Scheduler:
                 # multi-task preemptions reserve the host for the job they
                 # made room for, so the next match sends it there
                 self.host_reservations[decision.hostname] = decision.job.uuid
-        self.metrics[f"rebalance.{pool.name}.preempted"] = sum(
-            len(d.task_ids) for d in decisions
-        )
+        n_preempted = sum(len(d.task_ids) for d in decisions)
+        self.metrics[f"rebalance.{pool.name}.preempted"] = n_preempted
+        global_registry.counter("rebalance.preempted").inc(
+            n_preempted, {"pool": pool.name})
         return decisions
 
     def _transact_preemption(self, decision: Decision) -> None:
