@@ -1,0 +1,68 @@
+"""Trace replay over the k8s-style backend: the expected-vs-actual
+controller handles the full workload (synthesized offers, pod lifecycle,
+deletes) driven by the real scheduler cycles."""
+from cook_tpu.cluster.k8s import FakeKubeApi, KubeCluster, KubeNode, PodPhase
+from cook_tpu.models.entities import JobState, Pool, Resources, Job
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.core import Scheduler
+from cook_tpu.sim.simulator import synth_trace
+from tests.conftest import FakeClock
+
+
+def test_k8s_trace_replay():
+    jobs, hosts = synth_trace(150, 0, n_users=8, seed=3,
+                              mean_runtime_ms=60_000,
+                              submit_span_ms=120_000)
+    clock = FakeClock()
+    api = FakeKubeApi([
+        KubeNode(name=f"n{i}", mem=64000, cpus=32) for i in range(10)
+    ])
+    cluster = KubeCluster("k8s", api, clock)
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    scheduler = Scheduler(store, [cluster])
+    pool = store.pools["default"]
+
+    submitted = 0
+    trace = sorted(jobs, key=lambda j: (j.submit_time_ms, j.uuid))
+    ends: dict[str, int] = {}
+    for cycle in range(300):
+        # pod lifecycle: pending pods start; running pods past their
+        # job's runtime finish
+        api.tick()
+        for pod in list(api.list_pods()):
+            if pod.phase == PodPhase.RUNNING:
+                end = ends.get(pod.name)
+                if end is not None and end <= clock():
+                    api.finish_pod(pod.name)
+        # submissions
+        while (submitted < len(trace)
+               and trace[submitted].submit_time_ms <= clock()):
+            tj = trace[submitted]
+            store.submit_jobs([Job(
+                uuid=tj.uuid, user=tj.user, pool="default",
+                resources=Resources(mem=tj.mem, cpus=tj.cpus),
+                expected_runtime_ms=tj.runtime_ms, command="sim",
+                max_retries=5,
+            )])
+            submitted += 1
+        scheduler.rank_cycle(pool)
+        outcome = scheduler.match_cycle(pool)
+        for job, _offer in outcome.matched:
+            [tid] = [i.task_id for i in store.job_instances(job.uuid)
+                     if not i.status.terminal]
+            ends[tid] = clock() + job.expected_runtime_ms
+        clock.advance(15_000)
+        if submitted == len(trace) and all(
+            store.jobs[j.uuid].state == JobState.COMPLETED for j in jobs
+        ):
+            break
+    assert all(
+        store.jobs[j.uuid].state == JobState.COMPLETED for j in jobs
+    ), {store.jobs[j.uuid].state for j in jobs}
+    # the backend is clean: no task pods left
+    assert not [p for p in api.list_pods() if not p.synthetic]
+    # controller agreed with store throughout: no stranded expectations
+    live_expected = {t for t, s in cluster.expected.items()
+                     if s.value in ("starting", "running")}
+    assert not live_expected
