@@ -89,6 +89,7 @@ class SimConfig:
     max_cycles: int = 10_000
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     pools: tuple = (("default", "default"),)  # (name, dru_mode)
+    batched_match: bool = False      # one device call for all pools
 
 
 @dataclass
@@ -193,17 +194,31 @@ class Simulator:
                 submitted += 1
             # 3. rank -> match (-> rebalance) per pool
             t_cycle = time.perf_counter()
-            for pool in pools:
+            if cfg.batched_match and len(pools) > 1:
                 t0 = time.perf_counter()
-                self.scheduler.rank_cycle(pool)
+                for pool in pools:
+                    self.scheduler.rank_cycle(pool)
                 t1 = time.perf_counter()
-                self.scheduler.match_cycle(pool)
+                self.scheduler.match_cycle_all_pools()
                 t2 = time.perf_counter()
                 phase_wall["rank"] += t1 - t0
                 phase_wall["match"] += t2 - t1
                 if cfg.rebalance_every and cycle % cfg.rebalance_every == 0:
-                    self.scheduler.rebalance_cycle(pool)
+                    for pool in pools:
+                        self.scheduler.rebalance_cycle(pool)
                     phase_wall["rebalance"] += time.perf_counter() - t2
+            else:
+                for pool in pools:
+                    t0 = time.perf_counter()
+                    self.scheduler.rank_cycle(pool)
+                    t1 = time.perf_counter()
+                    self.scheduler.match_cycle(pool)
+                    t2 = time.perf_counter()
+                    phase_wall["rank"] += t1 - t0
+                    phase_wall["match"] += t2 - t1
+                    if cfg.rebalance_every and cycle % cfg.rebalance_every == 0:
+                        self.scheduler.rebalance_cycle(pool)
+                        phase_wall["rebalance"] += time.perf_counter() - t2
             cycle_wall.append(time.perf_counter() - t_cycle)
             # 4. advance virtual time
             self.now_ms += cfg.cycle_ms
