@@ -134,6 +134,14 @@ class Simulator:
         self.config = config or SimConfig()
         self.now_ms = 0
 
+        # pools: configured list extended by any pool the trace mentions
+        pool_names = {name for name, _ in self.config.pools}
+        extra = sorted(
+            ({j.pool for j in jobs} | {h.pool for h in hosts}) - pool_names
+        )
+        self.config.pools = tuple(self.config.pools) + tuple(
+            (name, "default") for name in extra
+        )
         self.store = JobStore(clock=lambda: self.now_ms)
         for name, mode in self.config.pools:
             self.store.set_pool(Pool(name=name, dru_mode=DruMode(mode)))
