@@ -308,20 +308,27 @@ class JobStore:
             new_state = state_mod.retry_job_state(
                 job, insts, retries, mea_culpa_limit=self.mea_culpa_limit
             )
+            old_state = job.state
             job = job.with_(max_retries=retries, state=new_state)
             if new_state == JobState.WAITING:
                 job = job.with_(last_waiting_start_time_ms=self.clock())
             self.jobs[job_uuid] = job
             self._index_job(job, None)
-            self._fan_out(
-                [
-                    self._emit(
-                        "job/retried",
-                        {"uuid": job_uuid, "retries": retries,
-                         "state": job.state.value},
-                    )
-                ]
-            )
+            events = [
+                self._emit(
+                    "job/retried",
+                    {"uuid": job_uuid, "retries": retries,
+                     "state": job.state.value},
+                )
+            ]
+            if new_state != old_state:
+                # state-change consumers (columnar index, kill fan-out...)
+                # key off job/state events; a revived job must emit one
+                events.append(
+                    self._emit("job/state",
+                               {"uuid": job_uuid, "state": new_state.value})
+                )
+            self._fan_out(events)
             return job
 
     def move_job_pool(self, job_uuid: str, new_pool: str) -> bool:

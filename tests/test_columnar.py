@@ -130,3 +130,22 @@ def test_columnar_rank_speed(clock):
     loop_s = time.perf_counter() - t0
     assert len(col_q.jobs) == len(loop_q.jobs) == 20000
     assert col_s < loop_s, (col_s, loop_s)
+
+
+def test_index_tracks_retry_revival(clock):
+    """A job revived via retry must re-enter the columnar pending view
+    (regression: retry emitted no job/state event, stranding the index)."""
+    from cook_tpu.models.entities import InstanceStatus
+
+    store, jobs = build_store(clock, n_jobs=3, with_running=False)
+    index = ColumnarJobIndex(store)
+    job = jobs[0]
+    store.create_instance(job.uuid, "rt1", hostname="h1")
+    store.update_instance_state("rt1", InstanceStatus.FAILED, 99000)
+    assert store.jobs[job.uuid].state.value == "completed"
+    assert index.consistent_with_store()
+    store.retry_job(job.uuid, 5)
+    assert store.jobs[job.uuid].state.value == "waiting"
+    assert index.consistent_with_store()
+    pending, _ = index.pool_view("default")
+    assert job.uuid in {index.uuids[r] for r in pending}
