@@ -1,0 +1,77 @@
+"""Concurrency: parallel REST clients + scheduler cycles against one store
+must preserve the state-machine invariants and columnar consistency."""
+import threading
+import time
+
+import requests
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import ServerThread
+from cook_tpu.scheduler.core import Scheduler
+from tests.conftest import FakeClock
+from tests.test_state_fuzz import check_invariants
+
+
+def test_concurrent_clients_and_cycles():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m",
+        [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=16000, cpus=32)
+         for i in range(4)],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    srv = ServerThread(CookApi(store, scheduler, ApiConfig())).start()
+    stop = threading.Event()
+    errors: list = []
+
+    def client(n):
+        session = requests.Session()
+        headers = {"X-Cook-Requesting-User": f"user{n}"}
+        mine = []
+        while not stop.is_set():
+            try:
+                r = session.post(
+                    f"{srv.url}/jobs",
+                    json={"jobs": [{"command": "x", "mem": 100, "cpus": 1,
+                                    "expected_runtime": 2000}]},
+                    headers=headers, timeout=5)
+                assert r.status_code == 201, r.text
+                mine.append(r.json()["jobs"][0])
+                if len(mine) % 3 == 0:
+                    session.delete(f"{srv.url}/jobs",
+                                   params={"job": mine[-1]},
+                                   headers=headers, timeout=5)
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errors.append(repr(e))
+                return
+
+    def cycles():
+        pool = store.pools["default"]
+        while not stop.is_set():
+            try:
+                scheduler.rank_cycle(pool)
+                scheduler.match_cycle(pool)
+                clock.advance(500)
+                cluster.advance_to(clock())
+            except Exception as e:  # noqa: BLE001
+                errors.append("cycle:" + repr(e))
+                return
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    threads.append(threading.Thread(target=cycles))
+    for t in threads:
+        t.start()
+    time.sleep(4)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    srv.stop()
+    assert not errors, errors[:3]
+    check_invariants(store)
+    assert scheduler.columnar.consistent_with_store()
+    assert len(store.jobs) > 50  # the hammer actually hammered
