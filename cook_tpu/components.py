@@ -265,6 +265,7 @@ def start_leader_duties(process: CookProcess,
             cluster.safe_kill_task(task_id)
 
     process.heartbeats = HeartbeatMonitor(store, kill_via_cluster)
+    scheduler.heartbeats = process.heartbeats  # REST /heartbeat delivery
 
     # k8s-style clusters: failover recovery + periodic anti-entropy scans
     # (determine-expected-state-on-startup + scan-process)

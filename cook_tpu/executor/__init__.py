@@ -1,6 +1,7 @@
 """Task executor (reference: executor/)."""
 from cook_tpu.executor.runner import (  # noqa: F401
     ExecutorConfig,
+    HeartbeatSender,
     RestUpdateSink,
     TaskRunner,
     TaskUpdate,

@@ -173,3 +173,34 @@ class RestUpdateSink:
                 )
             except Exception:  # noqa: BLE001 — progress is best-effort
                 pass
+
+
+class HeartbeatSender:
+    """Background liveness beats to the scheduler while a task runs
+    (reference: the executor's heartbeat framework messages)."""
+
+    def __init__(self, base_url: str, task_id: str, *,
+                 interval_s: float = 30.0, session=None):
+        import requests
+
+        self.url = f"{base_url.rstrip('/')}/heartbeat/{task_id}"
+        self.interval_s = interval_s
+        self.session = session or requests.Session()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HeartbeatSender":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.session.post(self.url, timeout=10)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

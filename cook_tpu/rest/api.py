@@ -135,6 +135,7 @@ class CookApi:
         r.add_get("/failure_reasons", self.get_failure_reasons)
         r.add_get("/progress/{uuid}", self.get_progress)
         r.add_post("/progress/{uuid}", self.post_progress)
+        r.add_post("/heartbeat/{uuid}", self.post_heartbeat)
         r.add_get("/metrics", self.get_metrics)
         r.add_get("/compute-clusters", self.get_compute_clusters)
         r.add_post("/compute-clusters", self.post_compute_cluster)
@@ -868,6 +869,17 @@ class CookApi:
         if not ok and task_id not in self.store.instances:
             return _err(404, "unknown instance")
         return web.json_response({"accepted": ok}, status=202 if ok else 200)
+
+    async def post_heartbeat(self, request: web.Request) -> web.Response:
+        """Executor liveness beat (reference: heartbeat framework messages,
+        mesos/heartbeat.clj; here the executor POSTs over HTTP)."""
+        task_id = request.match_info["uuid"]
+        if task_id not in self.store.instances:
+            return _err(404, "unknown instance")
+        if self.scheduler is not None and \
+                getattr(self.scheduler, "heartbeats", None) is not None:
+            self.scheduler.heartbeats.notify(task_id)
+        return web.json_response({"accepted": True}, status=202)
 
     # --------------------------------------------------------------- metrics
 

@@ -186,3 +186,46 @@ class TestPassport:
         assert "job-launched" in joined
         assert "instance-completed" in joined
         assert "job-completed" in joined
+
+
+class TestHeartbeatEndToEnd:
+    def test_rest_heartbeats_feed_the_monitor(self):
+        from cook_tpu.rest.api import ApiConfig, CookApi
+        from cook_tpu.rest.server import ServerThread
+        import requests
+
+        clock, store, cluster, scheduler = setup()
+        killed = []
+        scheduler.heartbeats = HeartbeatMonitor(store, killed.append,
+                                                timeout_ms=60_000)
+        srv = ServerThread(CookApi(store, scheduler, ApiConfig())).start()
+        try:
+            inst = run_job(store, scheduler, make_job(max_retries=3))
+            h = {"X-Cook-Requesting-User": "u"}
+            r = requests.post(f"{srv.url}/heartbeat/{inst.task_id}", headers=h)
+            assert r.status_code == 202
+            r = requests.post(f"{srv.url}/heartbeat/nope", headers=h)
+            assert r.status_code == 404
+            clock.advance(61_000)
+            assert scheduler.heartbeats.check() == [inst.task_id]
+            assert killed == [inst.task_id]
+        finally:
+            srv.stop()
+
+    def test_heartbeat_sender_thread(self):
+        from cook_tpu.executor.runner import HeartbeatSender
+
+        beats = []
+
+        class FakeSession:
+            def post(self, url, timeout=None):
+                beats.append(url)
+
+        sender = HeartbeatSender("http://x", "t9", interval_s=0.05,
+                                 session=FakeSession()).start()
+        import time
+
+        time.sleep(0.3)
+        sender.stop()
+        assert len(beats) >= 3
+        assert beats[0].endswith("/heartbeat/t9")
