@@ -9,3 +9,4 @@ from cook_tpu.client.jobclient import (  # noqa: F401
     JobClient,
     JobClientError,
 )
+from cook_tpu.client.models import InstanceView, JobView  # noqa: F401

@@ -13,6 +13,8 @@ from typing import Any, Callable, Optional, Sequence
 
 import requests
 
+from cook_tpu.client.models import InstanceView, JobView
+
 
 class JobClientError(Exception):
     def __init__(self, message: str, status: Optional[int] = None):
@@ -89,6 +91,13 @@ class JobClient:
         resp = self._request("GET", "/jobs",
                              params=[("uuid", u) for u in uuids])
         return resp.json()
+
+    def query_views(self, uuids: Sequence[str]) -> list[JobView]:
+        """Typed views over `query` (reference cookclient dataclasses)."""
+        return [JobView(d) for d in self.query(uuids)]
+
+    def query_instance_view(self, task_id: str) -> InstanceView:
+        return InstanceView(self.query_instance(task_id))
 
     def query_one(self, uuid: str) -> dict:
         return self._request("GET", f"/jobs/{uuid}").json()

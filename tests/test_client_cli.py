@@ -161,3 +161,18 @@ def test_cli_config_management(tmp_path, capsys):
     capsys.readouterr()
     assert cli_main(["--config", path, "config"]) == 0
     assert "east" not in capsys.readouterr().out
+
+
+def test_typed_views(server, client):
+    [uuid] = client.submit([{"command": "v", "expected_runtime": 5_000}])
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+    server.clock.advance(10_000)
+    server.cluster.advance_to(server.clock.now_ms)
+    [view] = client.query_views([uuid])
+    assert view.uuid == uuid
+    assert view.completed and view.succeeded
+    assert view.last_instance.status == "success"
+    assert view.last_instance.hostname.startswith("n")
+    assert view.retries_remaining == 0
