@@ -199,12 +199,19 @@ def start_leader_duties(process: CookProcess,
     (mesos.clj takeLeadership)."""
     settings = process.settings
     if settings.leader_lease_path:
-        elector = FileLeaseElector(settings.leader_lease_path,
-                                   process.member_id)
+        elector = FileLeaseElector(
+            settings.leader_lease_path, process.member_id,
+            advertised_url=f"http://127.0.0.1:{settings.port}")
     else:
         elector = InMemoryElector("cook", process.member_id)
     process.selector = LeaderSelector(elector, on_loss=on_loss)
+    # while standing by, surface the current leader for REST proxying
+    process.api.leader = False
+    if hasattr(elector, "current_leader_url"):
+        process.api.leader_url = elector.current_leader_url()
     process.selector.wait_for_leadership()
+    process.api.leader = True
+    process.api.leader_url = ""
     log_info("leadership acquired", component="leader",
              member=process.member_id)
     process.selector.start_heartbeat_thread()

@@ -74,11 +74,14 @@ class InMemoryElector(LeaderElector):
 class FileLeaseElector(LeaderElector):
     def __init__(self, lease_path: str, member_id: str,
                  *, ttl_s: float = 10.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 advertised_url: str = ""):
         self.lease_path = lease_path
         self.member_id = member_id
         self.ttl_s = ttl_s
         self.clock = clock
+        # published in the lease so standbys can proxy to the leader
+        self.advertised_url = advertised_url
 
     def _read(self) -> Optional[dict]:
         try:
@@ -90,8 +93,15 @@ class FileLeaseElector(LeaderElector):
     def _write(self) -> None:
         tmp = f"{self.lease_path}.{self.member_id}.tmp"
         with open(tmp, "w") as f:
-            json.dump({"leader": self.member_id, "t": self.clock()}, f)
+            json.dump({"leader": self.member_id, "t": self.clock(),
+                       "url": self.advertised_url}, f)
         os.replace(tmp, self.lease_path)
+
+    def current_leader_url(self) -> str:
+        lease = self._read()
+        if lease is None or self.clock() - lease["t"] > self.ttl_s:
+            return ""
+        return lease.get("url", "")
 
     def try_acquire(self) -> bool:
         lease = self._read()
