@@ -792,12 +792,17 @@ class CookApi:
         end = int(request.query.get("end-ms", 2**62))
         durations = []
         by_status: dict[str, int] = {}
+        by_reason: dict[str, int] = {}
         for inst in self.store.instances.values():
             if not inst.status.terminal:
                 continue
             if not (start <= inst.end_time_ms <= end):
                 continue
             by_status[inst.status.value] = by_status.get(inst.status.value, 0) + 1
+            if inst.status.value == "failed":
+                reason = REASONS_BY_CODE.get(inst.reason_code)
+                key = reason.name if reason else "unknown"
+                by_reason[key] = by_reason.get(key, 0) + 1
             durations.append(inst.end_time_ms - inst.start_time_ms)
         percentiles = {}
         if durations:
@@ -807,6 +812,7 @@ class CookApi:
                            "99": qs[98], "100": max(durations)}
         return web.json_response({
             "by-status": by_status,
+            "by-reason": by_reason,
             "run-time-ms": {"percentiles": percentiles,
                             "count": len(durations)},
         })

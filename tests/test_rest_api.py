@@ -314,3 +314,18 @@ def test_swagger_endpoints(server):
     assert "post" in spec["paths"]["/jobs"]
     ui = requests.get(f"{server.url}/swagger-ui", headers=hdr())
     assert ui.status_code == 200 and "/jobs" in ui.text
+
+
+def test_instance_stats_by_reason(server):
+    uuid = submit(server, [{"command": "s", "mem": 100, "cpus": 1,
+                            "max_retries": 1}])["jobs"][0]
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+    [inst] = server.store.job_instances(uuid)
+    server.clock.advance(5000)
+    server.cluster.fail_task(inst.task_id, "container-limitation-memory")
+    stats = requests.get(f"{server.url}/stats/instances", headers=hdr()).json()
+    assert stats["by-reason"].get("container-limitation-memory", 0) >= 1
+    assert stats["by-status"].get("failed", 0) >= 1
+    assert "percentiles" in stats["run-time-ms"]
