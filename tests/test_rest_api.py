@@ -324,7 +324,8 @@ def test_instance_stats_by_reason(server):
     server.scheduler.match_cycle(pool)
     [inst] = server.store.job_instances(uuid)
     server.clock.advance(5000)
-    server.cluster.fail_task(inst.task_id, "container-limitation-memory")
+    owner = server.scheduler.cluster_by_name(inst.compute_cluster)
+    owner.fail_task(inst.task_id, "container-limitation-memory")
     stats = requests.get(f"{server.url}/stats/instances", headers=hdr()).json()
     assert stats["by-reason"].get("container-limitation-memory", 0) >= 1
     assert stats["by-status"].get("failed", 0) >= 1
