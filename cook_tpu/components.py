@@ -124,6 +124,7 @@ class CookProcess:
     progress_aggregator: object = None
     heartbeats: object = None
     sandbox_publisher: object = None
+    journal: object = None
 
     def is_leader(self) -> bool:
         return self.selector is not None and self.selector.is_leader
@@ -152,10 +153,11 @@ def build_process(
     if store is None:
         store = JobStore(mea_culpa_limit=settings.mea_culpa_failure_limit,
                          clock=clock)
+    journal = None
     if settings.data_dir:
         from cook_tpu.models import persistence
 
-        persistence.attach_journal(
+        journal = persistence.attach_journal(
             store, os.path.join(settings.data_dir, "journal.jsonl")
         )
     from cook_tpu.utils.logging import attach_passport
@@ -185,7 +187,7 @@ def build_process(
     api.queue_limits.limits.per_pool = settings.queue_limit_per_pool
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user
     process = CookProcess(settings=settings, store=store, clusters=clusters,
-                          scheduler=scheduler, api=api,
+                          scheduler=scheduler, api=api, journal=journal,
                           member_id=str(uuid_mod.uuid4())[:8])
     if start_rest:
         process.server = ServerThread(api, port=settings.port).start()
@@ -314,10 +316,15 @@ def start_leader_duties(process: CookProcess,
         from cook_tpu.models import persistence as _persistence
 
         snap_path = _os.path.join(settings.data_dir, "snapshot.json")
+
+        def snapshot_and_rotate():
+            _persistence.snapshot(store, snap_path)
+            if process.journal is not None:
+                process.journal.rotate()
+
         process.loops.append(
             TriggerLoop("snapshot", settings.snapshot_interval_s,
-                        lambda: _persistence.snapshot(store, snap_path)
-                        ).start()
+                        snapshot_and_rotate).start()
         )
     process.loops += [
         TriggerLoop("match",

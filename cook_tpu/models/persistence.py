@@ -199,17 +199,31 @@ class JournalWriter:
         self.path = path
         self.fsync_every = fsync_every
         self._count = 0
+        import threading
+
+        self._lock = threading.Lock()
         self._f = open(path, "a")
 
     def __call__(self, event: Event) -> None:
-        self._f.write(event.to_json() + "\n")
-        self._f.flush()
-        self._count += 1
-        if self.fsync_every and self._count % self.fsync_every == 0:
-            os.fsync(self._f.fileno())
+        with self._lock:
+            self._f.write(event.to_json() + "\n")
+            self._f.flush()
+            self._count += 1
+            if self.fsync_every and self._count % self.fsync_every == 0:
+                os.fsync(self._f.fileno())
+
+    def rotate(self) -> None:
+        """After a snapshot, the journal prefix is redundant: move it aside
+        and start fresh (the snapshot + new journal reconstruct state)."""
+        with self._lock:
+            self._f.close()
+            if os.path.exists(self.path):
+                os.replace(self.path, self.path + ".1")
+            self._f = open(self.path, "a")
 
     def close(self) -> None:
-        self._f.close()
+        with self._lock:
+            self._f.close()
 
 
 def attach_journal(store: JobStore, path: str, **kw) -> JournalWriter:

@@ -95,3 +95,24 @@ def test_snapshot_plus_new_events(tmp_path, clock):
     restored.kill_jobs([j3.uuid])
     old_last = store.snapshot_events()[-1].seq
     assert seen[0].seq == old_last + 1
+
+
+def test_journal_rotation(tmp_path, clock):
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    jpath = str(tmp_path / "j.jsonl")
+    writer = attach_journal(store, jpath)
+    store.submit_jobs([make_job()])
+    assert read_journal(jpath)
+    snapshot(store, str(tmp_path / "snap.json"))
+    writer.rotate()
+    assert read_journal(jpath) == []          # fresh journal
+    assert read_journal(jpath + ".1")         # prefix preserved aside
+    job2 = make_job()
+    store.submit_jobs([job2])                 # writer still live post-rotate
+    events = read_journal(jpath)
+    assert events and events[0]["kind"] == "job/created"
+    writer.close()
+    # snapshot + fresh journal reconstruct: snapshot has job1, journal job2
+    restored = load_snapshot(str(tmp_path / "snap.json"), clock=clock)
+    assert len(restored.jobs) == 1
