@@ -57,8 +57,10 @@ def test_process_failover_via_snapshot(tmp_path):
         # the new leader keeps scheduling
         start_leader_duties(p2, block=False, on_loss=lambda: None)
         assert p2.is_leader()
-        # journal exists and has events from both processes
-        events = persistence.read_journal(f"{data_dir}/journal.jsonl")
+        # journal (incl. the segment rotated aside at snapshot time) has
+        # the submission events
+        events = (persistence.read_journal(f"{data_dir}/journal.jsonl")
+                  + persistence.read_journal(f"{data_dir}/journal.jsonl.1"))
         assert any(e["kind"] == "job/created" for e in events)
     finally:
         shutdown(p2)
