@@ -31,10 +31,15 @@ def cmd_run(args) -> int:
         cycle_ms=args.cycle_ms,
         rebalance_every=args.rebalance_every,
         max_cycles=args.max_cycles,
+        batched_match=args.batched,
         scheduler=SchedulerConfig(
             match=MatchConfig(chunk=args.chunk,
                               max_jobs_considered=args.considerable),
-            rebalancer=RebalancerParams(),
+            rebalancer=RebalancerParams(
+                safe_dru_threshold=args.safe_dru_threshold,
+                min_dru_diff=args.min_dru_diff,
+                max_preemption=args.max_preemption,
+            ),
         ),
     )
     sim = Simulator(jobs, hosts, config)
@@ -124,6 +129,11 @@ def main(argv=None) -> int:
     r.add_argument("--max-cycles", type=int, default=10_000)
     r.add_argument("--chunk", type=int, default=0)
     r.add_argument("--considerable", type=int, default=1000)
+    r.add_argument("--batched", action="store_true",
+                   help="one device call for all pools")
+    r.add_argument("--safe-dru-threshold", type=float, default=1.0)
+    r.add_argument("--min-dru-diff", type=float, default=0.5)
+    r.add_argument("--max-preemption", type=int, default=100)
     r.set_defaults(fn=cmd_run)
 
     s = sub.add_parser("synth", help="generate a synthetic trace")
