@@ -249,6 +249,25 @@ def cmd_tail(args) -> int:
             time.sleep(args.sleep_interval)
 
 
+def cmd_why(args) -> int:
+    """Explain why a job isn't running (unscheduled_jobs)."""
+    found = _fan_out_query(args, [args.uuid])
+    if args.uuid not in found:
+        print(f"{args.uuid}: not found", file=sys.stderr)
+        return 1
+    cluster_name, job = found[args.uuid]
+    clients = {c.name: cl for c, cl in _clients(args)}
+    print(f"{args.uuid} is {job['status']} (cluster {cluster_name})")
+    if job["status"] == "waiting":
+        for reason in clients[cluster_name].unscheduled_reasons(args.uuid):
+            line = f"  - {reason['reason']}"
+            data = reason.get("data")
+            if data:
+                line += f"  {data}"
+            print(line)
+    return 0
+
+
 def cmd_usage(args) -> int:
     for cluster, client in _clients(args):
         usage = client.usage(args.lookup_user)
@@ -395,6 +414,10 @@ def build_parser() -> argparse.ArgumentParser:
     q = sub.add_parser("usage", help="show a user's usage")
     q.add_argument("--lookup-user", dest="lookup_user")
     q.set_defaults(fn=cmd_usage)
+
+    q = sub.add_parser("why", help="explain why a job isn't running")
+    q.add_argument("uuid")
+    q.set_defaults(fn=cmd_why)
 
     q = sub.add_parser("config", help="show or edit the federation config")
     q.add_argument("--add-cluster", nargs=2, metavar=("NAME", "URL"))

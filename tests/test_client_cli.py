@@ -176,3 +176,18 @@ def test_typed_views(server, client):
     assert view.last_instance.status == "success"
     assert view.last_instance.hostname.startswith("n")
     assert view.retries_remaining == 0
+
+
+def test_cli_why(server, cfg, capsys):
+    # a job too big for any current host waits with an explanation
+    [uuid] = JobClient(server.url, user="alice").submit(
+        [{"command": "big", "mem": 9999, "cpus": 15}])
+    for _ in range(2):
+        pool = server.store.pools["default"]
+        server.scheduler.rank_cycle(pool)
+        server.scheduler.match_cycle(pool)
+    assert cli_main(["--config", server.cfg_path, "--user", "alice",
+                     "why", uuid]) == 0
+    out = capsys.readouterr().out
+    assert "waiting" in out
+    assert "-" in out  # at least one reason line
