@@ -305,11 +305,29 @@ class Scheduler:
         self.last_host_info = getattr(self, "last_host_info", {})
         self.last_host_info[pool.name] = host_info
 
+    def _rebalancer_params(self) -> RebalancerParams:
+        """Config-file defaults overridden by runtime-mutable dynamic
+        config (reference: Datomic-resident `:rebalancer/config`,
+        rebalancer.clj:535-557 — tuning preemption must not need a
+        restart).  `POST /incremental-config {"rebalancer": {...}}`."""
+        overrides = self.store.dynamic_config.get("rebalancer")
+        base = self.config.rebalancer
+        if not isinstance(overrides, dict):
+            return base
+        return RebalancerParams(
+            safe_dru_threshold=float(overrides.get(
+                "safe_dru_threshold", base.safe_dru_threshold)),
+            min_dru_diff=float(overrides.get(
+                "min_dru_diff", base.min_dru_diff)),
+            max_preemption=int(overrides.get(
+                "max_preemption", base.max_preemption)),
+        )
+
     def rebalance_cycle(self, pool: Pool) -> list[Decision]:
         queue = self.pool_queues.get(pool.name) or self.rank_cycle(pool)
         spare = self.last_unmatched_offers.get(pool.name, {})
         decisions = rebalance_pool(
-            self.store, pool, queue.jobs, spare, self.config.rebalancer,
+            self.store, pool, queue.jobs, spare, self._rebalancer_params(),
             host_info=getattr(self, "last_host_info", {}).get(pool.name),
         )
         for decision in decisions:

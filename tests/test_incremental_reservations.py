@@ -154,3 +154,28 @@ def test_rebalancer_respects_novel_host():
     scheduler.rank_cycle(pool)
     decisions = scheduler.rebalance_cycle(pool)
     assert decisions == []
+
+
+def test_rebalancer_params_runtime_mutable():
+    """Dynamic-config overrides take effect without restart (reference:
+    Datomic-stored rebalancer config)."""
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from cook_tpu.scheduler.rebalancer import RebalancerParams
+    from tests.conftest import FakeClock
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    scheduler = Scheduler(
+        store, [MockCluster("m", [], clock=clock)],
+        SchedulerConfig(rebalancer=RebalancerParams(max_preemption=100)),
+    )
+    assert scheduler._rebalancer_params().max_preemption == 100
+    store.dynamic_config["rebalancer"] = {"max_preemption": 7,
+                                          "min_dru_diff": 0.25}
+    params = scheduler._rebalancer_params()
+    assert params.max_preemption == 7
+    assert params.min_dru_diff == 0.25
+    assert params.safe_dru_threshold == 1.0  # untouched default
