@@ -1,0 +1,76 @@
+"""The driver-facing gates in __graft_entry__ must not hang on a wedged
+accelerator tunnel (round-1 failure: MULTICHIP_r01 rc=124 because
+dryrun_multichip called jax.devices() in-process before any CPU fallback)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+import __graft_entry__ as graft
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _FakeProbe:
+    """Stand-in for subprocess.run inside _pin_usable_platform."""
+
+    def __init__(self, stdout=None, exc=None):
+        self.stdout = stdout
+        self.exc = exc
+
+    def __call__(self, *a, **kw):
+        if self.exc is not None:
+            raise self.exc
+        class R:
+            stdout = self.stdout
+        return R()
+
+
+def _forced_platform(monkeypatch, probe):
+    calls = []
+    monkeypatch.setattr(graft, "_pin_usable_platform", graft._pin_usable_platform)
+    import jax
+
+    monkeypatch.setattr(subprocess, "run", probe)
+    monkeypatch.setattr(jax.config, "update",
+                        lambda k, v: calls.append((k, v)))
+    graft._pin_usable_platform(8)
+    return calls
+
+
+def test_pin_forces_cpu_when_probe_hangs(monkeypatch):
+    probe = _FakeProbe(exc=subprocess.TimeoutExpired(cmd="jax", timeout=120))
+    calls = _forced_platform(monkeypatch, probe)
+    assert ("jax_platforms", "cpu") in calls
+
+
+def test_pin_forces_cpu_when_accelerator_has_too_few_chips(monkeypatch):
+    calls = _forced_platform(monkeypatch, _FakeProbe(stdout="1 tpu\n"))
+    assert ("jax_platforms", "cpu") in calls
+
+
+def test_pin_keeps_accelerator_when_probe_shows_enough_chips(monkeypatch):
+    calls = _forced_platform(monkeypatch, _FakeProbe(stdout="8 tpu\n"))
+    assert calls == []
+
+
+def test_pin_forces_cpu_when_probe_reports_cpu(monkeypatch):
+    calls = _forced_platform(monkeypatch, _FakeProbe(stdout="8 cpu\n"))
+    assert ("jax_platforms", "cpu") in calls
+
+
+def test_dryrun_multichip_subprocess_end_to_end():
+    """The full 8-device gate, exactly as the driver invokes it, must pass in
+    a fresh process with no accelerator reachable (axon disabled)."""
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # disable accelerator registration
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8); print('OK')"],
+        cwd=REPO, env=env, timeout=600, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-4000:]
+    assert "OK" in r.stdout
