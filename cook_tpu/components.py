@@ -138,18 +138,19 @@ def build_process(
 ) -> CookProcess:
     store = None
     if settings.data_dir:
-        # failover recovery: load the last snapshot, then journal onward
+        # failover recovery: load the last snapshot, then replay the journal
+        # suffix after it (every acknowledged write survives)
         import os
 
         from cook_tpu.models import persistence
 
         os.makedirs(settings.data_dir, exist_ok=True)
-        snap_path = os.path.join(settings.data_dir, "snapshot.json")
-        if os.path.exists(snap_path):
-            store = persistence.load_snapshot(snap_path, clock=clock)
+        store = persistence.recover(settings.data_dir, clock=clock)
+        if store is not None:
             store.mea_culpa_limit = settings.mea_culpa_failure_limit
-            log_info("recovered store from snapshot", component="startup",
-                     jobs=len(store.jobs))
+            log_info("recovered store from snapshot+journal",
+                     component="startup", jobs=len(store.jobs),
+                     **store.recovered_stats)
     if store is None:
         store = JobStore(mea_culpa_limit=settings.mea_culpa_failure_limit,
                          clock=clock)
@@ -183,6 +184,7 @@ def build_process(
         default_pool=settings.default_pool,
         admins=settings.admins,
         submission_rate_per_minute=settings.submission_rate_per_minute,
+        cors_origins=settings.cors_origins,
     ))
     api.queue_limits.limits.per_pool = settings.queue_limit_per_pool
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user

@@ -5,148 +5,47 @@ failover; the new leader reads the DB and reconstructs backend expectations
 — kubernetes/compute_cluster.clj:269).  Here the JobStore persists itself:
 
   * `JournalWriter` appends every committed event as a JSON line (the
-    transaction log); fsync policy is the caller's choice.
+    transaction log).  Events carry the full post-transaction entity
+    payloads (`Event.entities`), so the journal alone reconstructs every
+    acknowledged write — the role Datomic's transaction log plays.
   * `snapshot` / `load_snapshot` serialize full store state; a snapshot +
     the journal suffix after it reconstructs the store exactly.
   * `attach_journal` wires a live store to a journal file; `recover`
     rebuilds a store from snapshot+journal at startup.
 
-Entities serialize via dataclasses.asdict with enum-aware encoding.
+Entity (de)serialization lives in `cook_tpu.models.codec`.
 """
 from __future__ import annotations
 
-import dataclasses
-import enum
 import json
 import os
-from typing import Any
+from typing import Optional
 
-from cook_tpu.models.entities import (
-    Checkpoint,
-    ConstraintOperator,
-    Container,
-    DruMode,
-    Group,
-    GroupPlacementType,
-    HostPlacement,
-    Instance,
-    InstanceStatus,
-    Job,
-    JobConstraint,
-    JobState,
-    Pool,
-    Quota,
-    Resources,
-    Share,
-    StragglerHandling,
-)
+from cook_tpu.models import codec
 from cook_tpu.models.store import Event, JobStore
 
-
-def _encode(obj: Any) -> Any:
-    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        return {k: _encode(v)
-                for k, v in dataclasses.asdict(obj).items()}
-    if isinstance(obj, enum.Enum):
-        return obj.value
-    if isinstance(obj, (list, tuple)):
-        return [_encode(v) for v in obj]
-    if isinstance(obj, dict):
-        return {k: _encode(v) for k, v in obj.items()}
-    if isinstance(obj, float) and obj == float("inf"):
-        return "Infinity"
-    return obj
-
-
-def _dec_float(x):
-    return float("inf") if x == "Infinity" else x
-
-
-def _dec_resources(d: dict) -> Resources:
-    return Resources(
-        mem=_dec_float(d["mem"]), cpus=_dec_float(d["cpus"]),
-        gpus=_dec_float(d["gpus"]), disk=_dec_float(d.get("disk", 0.0)),
-        ports=int(d.get("ports", 0)),
-    )
-
-
-def _dec_job(d: dict) -> Job:
-    return Job(
-        uuid=d["uuid"],
-        user=d["user"],
-        command=d["command"],
-        name=d["name"],
-        priority=d["priority"],
-        max_retries=d["max_retries"],
-        max_runtime_ms=d["max_runtime_ms"],
-        expected_runtime_ms=d["expected_runtime_ms"],
-        resources=_dec_resources(d["resources"]),
-        pool=d["pool"],
-        state=JobState(d["state"]),
-        submit_time_ms=d["submit_time_ms"],
-        user_provided_env=tuple(map(tuple, d["user_provided_env"])),
-        labels=tuple(map(tuple, d["labels"])),
-        constraints=tuple(
-            JobConstraint(attribute=c["attribute"],
-                          operator=ConstraintOperator(c["operator"]),
-                          pattern=c["pattern"])
-            for c in d["constraints"]
-        ),
-        group_uuid=d["group_uuid"],
-        container=(Container(**{**d["container"],
-                                "volumes": tuple(d["container"]["volumes"]),
-                                "ports": tuple(d["container"]["ports"]),
-                                "env": tuple(map(tuple, d["container"]["env"]))})
-                   if d["container"] else None),
-        application=None,
-        checkpoint=(Checkpoint(
-            mode=d["checkpoint"]["mode"],
-            periodic_sec=d["checkpoint"]["periodic_sec"],
-            preserve_paths=tuple(d["checkpoint"]["preserve_paths"]),
-            location=d["checkpoint"]["location"],
-        ) if d["checkpoint"] else None),
-        disable_mea_culpa_retries=d["disable_mea_culpa_retries"],
-        instance_ids=tuple(d["instance_ids"]),
-        custom_executor=d["custom_executor"],
-        last_waiting_start_time_ms=d["last_waiting_start_time_ms"],
-        last_fenzo_placement_failure=d["last_fenzo_placement_failure"],
-    )
-
-
-def _dec_instance(d: dict) -> Instance:
-    d = dict(d)
-    d["status"] = InstanceStatus(d["status"])
-    return Instance(**d)
-
-
-def _dec_group(d: dict) -> Group:
-    return Group(
-        uuid=d["uuid"],
-        name=d["name"],
-        host_placement=HostPlacement(
-            type=GroupPlacementType(d["host_placement"]["type"]),
-            attribute=d["host_placement"]["attribute"],
-            minimum=d["host_placement"]["minimum"],
-        ),
-        straggler_handling=StragglerHandling(**d["straggler_handling"]),
-        job_uuids=tuple(d["job_uuids"]),
-    )
+_encode = codec.encode  # back-compat aliases
+_dec_resources = codec.dec_resources
+_dec_job = codec.dec_job
+_dec_instance = codec.dec_instance
+_dec_group = codec.dec_group
 
 
 def snapshot(store: JobStore, path: str) -> None:
     """Write full store state atomically."""
     with store._lock:
         state = {
-            "seq": store._events[-1].seq if store._events else 0,
-            "jobs": {k: _encode(v) for k, v in store.jobs.items()},
-            "instances": {k: _encode(v) for k, v in store.instances.items()},
-            "groups": {k: _encode(v) for k, v in store.groups.items()},
-            "pools": {k: _encode(v) for k, v in store.pools.items()},
+            "seq": store.last_seq(),
+            "jobs": {k: codec.encode(v) for k, v in store.jobs.items()},
+            "instances": {k: codec.encode(v)
+                          for k, v in store.instances.items()},
+            "groups": {k: codec.encode(v) for k, v in store.groups.items()},
+            "pools": {k: codec.encode(v) for k, v in store.pools.items()},
             "shares": [
-                _encode(v) for v in store.shares.values()
+                codec.encode(v) for v in store.shares.values()
             ],
             "quotas": [
-                _encode(v) for v in store.quotas.values()
+                codec.encode(v) for v in store.quotas.values()
             ],
             "dynamic_config": store.dynamic_config,
         }
@@ -163,33 +62,55 @@ def load_snapshot(path: str, *, clock=None) -> JobStore:
         state = json.load(f)
     store = JobStore(clock=clock)
     for k, v in state["pools"].items():
-        store.pools[k] = Pool(name=v["name"], purpose=v["purpose"],
-                              state=v["state"],
-                              dru_mode=DruMode(v["dru_mode"]))
+        store.pools[k] = codec.dec_pool(v)
     for k, v in state["jobs"].items():
-        job = _dec_job(v)
+        job = codec.dec_job(v)
         store.jobs[k] = job
         store.job_seq[k] = len(store.job_seq)  # snapshot preserves order
         store._index_job(job, None)
     for k, v in state["instances"].items():
-        store.instances[k] = _dec_instance(v)
+        store.instances[k] = codec.dec_instance(v)
     for k, v in state["groups"].items():
-        store.groups[k] = _dec_group(v)
+        store.groups[k] = codec.dec_group(v)
     for v in state["shares"]:
-        store.shares[(v["user"], v["pool"])] = Share(
-            user=v["user"], pool=v["pool"],
-            resources=_dec_resources(v["resources"]), reason=v["reason"])
+        share = codec.dec_share(v)
+        store.shares[(share.user, share.pool)] = share
     for v in state["quotas"]:
-        store.quotas[(v["user"], v["pool"])] = Quota(
-            user=v["user"], pool=v["pool"],
-            resources=_dec_resources(v["resources"]),
-            count=v["count"], reason=v["reason"])
+        quota = codec.dec_quota(v)
+        store.quotas[(quota.user, quota.pool)] = quota
     store.dynamic_config = state.get("dynamic_config", {})
-    # resume event sequence numbering after the snapshot point
-    import itertools
-
-    store._seq = itertools.count(state["seq"] + 1)
+    store.reset_seq(state["seq"])
     return store
+
+
+def _truncate_torn_tail(path: str) -> None:
+    """Drop any unparsable tail left by a crash mid-write.  Appending onto a
+    torn fragment would merge the next event into one corrupt line, silently
+    discarding it (and everything after) on the NEXT recovery — so the
+    fragment must go before a writer reopens the file."""
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        data = f.read()
+    end = len(data)
+    while end > 0:
+        if data[end - 1:end] != b"\n":
+            # partial tail with no line terminator: drop it
+            end = data.rfind(b"\n", 0, end) + 1  # no newline at all -> 0
+            continue
+        # prefix ends in a terminator; validate its final line
+        nl = data.rfind(b"\n", 0, end - 1)
+        line = data[nl + 1:end - 1].strip()
+        if line:
+            try:
+                json.loads(line)
+                break  # clean, parsable tail: keep through end
+            except json.JSONDecodeError:
+                pass
+        end = nl + 1  # drop the blank/corrupt line (each step shrinks end)
+    if end < len(data):
+        with open(path, "r+b") as f:
+            f.truncate(end)
 
 
 class JournalWriter:
@@ -202,6 +123,7 @@ class JournalWriter:
         import threading
 
         self._lock = threading.Lock()
+        _truncate_torn_tail(path)
         self._f = open(path, "a")
 
     def __call__(self, event: Event) -> None:
@@ -239,6 +161,99 @@ def read_journal(path: str) -> list[dict]:
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 events.append(json.loads(line))
+            except json.JSONDecodeError:
+                break  # torn tail write from a crash: the suffix is unusable
     return events
+
+
+def _upsert_job(store: JobStore, payload: dict) -> None:
+    job = codec.dec_job(payload)
+    old = store.jobs.get(job.uuid)
+    if old is not None and old.pool != job.pool:
+        store._pool_pending.get(old.pool, set()).discard(job.uuid)
+        store._pool_running.get(old.pool, set()).discard(job.uuid)
+    if old is None:
+        store.job_seq[job.uuid] = len(store.job_seq)
+    store.jobs[job.uuid] = job
+    store._index_job(job, old)
+
+
+def apply_journal(store: JobStore, events: list[dict],
+                  *, after_seq: int = 0) -> int:
+    """Replay journal entries onto a store.  Entries carry post-transaction
+    entity payloads, so replay is a pure upsert — no state-machine re-checks
+    and no watcher fan-out (this runs before watchers attach).  Returns the
+    number of entries applied."""
+    applied = 0
+    max_seq = store.last_seq()
+    for entry in events:
+        seq = entry.get("seq", 0)
+        if seq <= after_seq or seq <= max_seq:
+            continue
+        kind = entry.get("kind", "")
+        data = entry.get("data", {})
+        entities = entry.get("entities") or {}
+        if "job" in entities:
+            _upsert_job(store, entities["job"])
+        if "instance" in entities:
+            inst = codec.dec_instance(entities["instance"])
+            store.instances[inst.task_id] = inst
+        if "group" in entities:
+            group = codec.dec_group(entities["group"])
+            store.groups[group.uuid] = group
+        if "pool" in entities:
+            pool = codec.dec_pool(entities["pool"])
+            store.pools[pool.name] = pool
+        if "share" in entities:
+            share = codec.dec_share(entities["share"])
+            store.shares[(share.user, share.pool)] = share
+        if "quota" in entities:
+            quota = codec.dec_quota(entities["quota"])
+            store.quotas[(quota.user, quota.pool)] = quota
+        if kind == "share/retracted":
+            store.shares.pop((data["user"], data["pool"]), None)
+        elif kind == "quota/retracted":
+            store.quotas.pop((data["user"], data["pool"]), None)
+        elif kind == "config/updated":
+            store.dynamic_config.update(data.get("updates", {}))
+        max_seq = max(max_seq, seq)
+        applied += 1
+    store.reset_seq(max_seq)
+    return applied
+
+
+def recover(data_dir: str, *, clock=None,
+            snapshot_name: str = "snapshot.json",
+            journal_name: str = "journal.jsonl") -> Optional[JobStore]:
+    """Rebuild a store from the last snapshot plus the journal suffix after
+    it (the documented failover path).  Returns None when the data dir holds
+    neither a snapshot nor a journal (fresh start).
+
+    The rotated journal (`journal.jsonl.1`) is replayed too: rotation only
+    happens after a successful snapshot, so its entries are normally all
+    ≤ the snapshot seq and skip out — but if a crash lands between rotate
+    and the next snapshot write, the suffix is still there to replay.
+    """
+    snap_path = os.path.join(data_dir, snapshot_name)
+    journal_path = os.path.join(data_dir, journal_name)
+    store = None
+    snap_seq = 0
+    if os.path.exists(snap_path):
+        store = load_snapshot(snap_path, clock=clock)
+        snap_seq = store.last_seq()
+    replayed = 0
+    for path in (journal_path + ".1", journal_path):
+        entries = read_journal(path)
+        if not entries:
+            continue
+        if store is None:
+            store = JobStore(clock=clock)
+        replayed += apply_journal(store, entries, after_seq=snap_seq)
+    if store is not None:
+        store.recovered_stats = {"snapshot_seq": snap_seq,
+                                 "journal_replayed": replayed}
+    return store

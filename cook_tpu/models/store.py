@@ -35,14 +35,30 @@ from cook_tpu.models.reasons import Reason, get_reason
 
 @dataclass(frozen=True)
 class Event:
-    """One entry in the transaction log."""
+    """One entry in the transaction log.
+
+    `entities` holds references to the post-transaction entity objects the
+    event touched (all immutable — mutation always replaces), keyed by
+    entity kind ("job", "instance", "group", "pool", "share", "quota").
+    The journal serializes them so a snapshot + journal suffix replays to
+    the exact store state (persistence.apply_journal); keeping references
+    here instead of eagerly encoding keeps the hot path free of
+    serialization cost when no journal is attached.
+    """
 
     seq: int
     kind: str
     data: dict[str, Any]
+    entities: Optional[dict[str, Any]] = None
 
     def to_json(self) -> str:
-        return json.dumps({"seq": self.seq, "kind": self.kind, "data": self.data})
+        from cook_tpu.models import codec
+
+        d = {"seq": self.seq, "kind": self.kind, "data": self.data}
+        if self.entities:
+            d["entities"] = {k: codec.encode(v)
+                             for k, v in self.entities.items()}
+        return json.dumps(d)
 
 
 Watcher = Callable[[Event], None]
@@ -60,6 +76,8 @@ class JobStore:
     def __init__(self, *, mea_culpa_limit: int = 5, clock: Callable[[], int] = None):
         self._lock = threading.RLock()
         self._seq = itertools.count(1)
+        self._last_seq = 0
+        self.recovered_stats: dict[str, int] = {}
         self._events: list[Event] = []
         self._watchers: list[Watcher] = []
         self.mea_culpa_limit = mea_culpa_limit
@@ -94,9 +112,30 @@ class JobStore:
         with self._lock:
             return [e for e in self._events if e.seq > seq]
 
-    def _emit(self, kind: str, data: dict[str, Any]) -> Event:
-        event = Event(seq=next(self._seq), kind=kind, data=data)
+    def last_seq(self) -> int:
+        """Sequence number of the last committed event (survives recovery —
+        unlike `_events`, which only holds this process's events)."""
+        with self._lock:
+            return self._last_seq
+
+    def reset_seq(self, seq: int) -> None:
+        """Resume event numbering after `seq` (recovery from snapshot or
+        journal replay)."""
+        with self._lock:
+            self._seq = itertools.count(seq + 1)
+            self._last_seq = seq
+
+    # retained recent-event window for events_since debugging/polling; the
+    # durable record is the journal, so this may be bounded
+    EVENT_WINDOW = 10_000
+
+    def _emit(self, kind: str, data: dict[str, Any], **entities: Any) -> Event:
+        event = Event(seq=next(self._seq), kind=kind, data=data,
+                      entities=entities or None)
+        self._last_seq = event.seq
         self._events.append(event)
+        if len(self._events) > 2 * self.EVENT_WINDOW:
+            del self._events[:-self.EVENT_WINDOW]
         return event
 
     def _fan_out(self, events: list[Event]) -> None:
@@ -134,10 +173,10 @@ class JobStore:
             for job in jobs:
                 if job.uuid in self.jobs:
                     raise TransactionVetoed(f"job {job.uuid} already exists")
-            events = []
             for group in groups:
                 self.groups[group.uuid] = group
-                events.append(self._emit("group/created", {"uuid": group.uuid}))
+            created_jobs = []
+            touched_groups: dict[str, bool] = {}
             for job in jobs:
                 if job.submit_time_ms == 0:
                     job = job.with_(submit_time_ms=now)
@@ -150,10 +189,25 @@ class JobStore:
                     self.groups[job.group_uuid] = dataclasses.replace(
                         g, job_uuids=g.job_uuids + (job.uuid,)
                     )
+                    touched_groups[job.group_uuid] = True
+                created_jobs.append(job)
+            # events carry the final post-transaction payloads (membership
+            # updates included), so journal replay is a pure upsert
+            events = []
+            for group in groups:
+                touched_groups.pop(group.uuid, None)
+                events.append(self._emit("group/created",
+                                         {"uuid": group.uuid},
+                                         group=self.groups[group.uuid]))
+            for guuid in touched_groups:
+                events.append(self._emit("group/updated", {"uuid": guuid},
+                                         group=self.groups[guuid]))
+            for job in created_jobs:
                 events.append(
                     self._emit(
                         "job/created",
                         {"uuid": job.uuid, "user": job.user, "pool": job.pool},
+                        job=job,
                     )
                 )
             self._fan_out(events)
@@ -200,8 +254,10 @@ class JobStore:
                 self._emit(
                     "instance/created",
                     {"task_id": task_id, "job": job_uuid, "hostname": hostname},
+                    instance=inst,
                 ),
-                self._emit("job/state", {"uuid": job_uuid, "state": "running"}),
+                self._emit("job/state", {"uuid": job_uuid, "state": "running"},
+                           job=job),
             ]
             self._fan_out(events)
             return inst
@@ -245,6 +301,7 @@ class JobStore:
                         "status": new_status.value,
                         "reason": reason_code,
                     },
+                    instance=new_inst,
                 )
             ]
             if update.new_job_state != job.state:
@@ -255,6 +312,7 @@ class JobStore:
                     self._emit(
                         "job/state",
                         {"uuid": job.uuid, "state": update.new_job_state.value},
+                        job=job,
                     )
                 )
             self.jobs[job.uuid] = job
@@ -280,6 +338,7 @@ class JobStore:
                     self._emit(
                         "job/state",
                         {"uuid": uuid, "state": "completed", "killed": True},
+                        job=job,
                     )
                 )
                 killed.append(uuid)
@@ -291,8 +350,11 @@ class JobStore:
             inst = self.instances.get(task_id)
             if inst is None:
                 return False
-            self.instances[task_id] = inst.with_(cancelled=True)
-            self._fan_out([self._emit("instance/cancelled", {"task_id": task_id})])
+            new_inst = inst.with_(cancelled=True)
+            self.instances[task_id] = new_inst
+            self._fan_out([self._emit("instance/cancelled",
+                                      {"task_id": task_id},
+                                      instance=new_inst)])
             return True
 
     def retry_job(self, job_uuid: str, retries: int, *, increment: bool = False) -> Job:
@@ -319,6 +381,7 @@ class JobStore:
                     "job/retried",
                     {"uuid": job_uuid, "retries": retries,
                      "state": job.state.value},
+                    job=job,
                 )
             ]
             if new_state != old_state:
@@ -326,7 +389,8 @@ class JobStore:
                 # key off job/state events; a revived job must emit one
                 events.append(
                     self._emit("job/state",
-                               {"uuid": job_uuid, "state": new_state.value})
+                               {"uuid": job_uuid, "state": new_state.value},
+                               job=job)
                 )
             self._fan_out(events)
             return job
@@ -348,7 +412,8 @@ class JobStore:
             self._fan_out([
                 self._emit("job/pool-moved",
                            {"uuid": job_uuid, "from": old_pool,
-                            "to": new_pool})
+                            "to": new_pool},
+                           job=job)
             ])
             return True
 
@@ -363,9 +428,14 @@ class JobStore:
             # (reference: progress.clj progress-aggregator)
             if progress < inst.progress:
                 return False
-            self.instances[task_id] = inst.with_(
+            new_inst = inst.with_(
                 progress=progress, progress_message=message or inst.progress_message
             )
+            self.instances[task_id] = new_inst
+            self._fan_out([self._emit("instance/progress",
+                                      {"task_id": task_id,
+                                       "progress": progress},
+                                      instance=new_inst)])
             return True
 
     def set_instance_output(
@@ -384,21 +454,33 @@ class JobStore:
             if sandbox_directory is not None:
                 kw["sandbox_directory"] = sandbox_directory
             if kw:
-                self.instances[task_id] = inst.with_(**kw)
+                new_inst = inst.with_(**kw)
+                self.instances[task_id] = new_inst
+                self._fan_out([self._emit("instance/output",
+                                          {"task_id": task_id},
+                                          instance=new_inst)])
 
     # ------------------------------------------------------- share/quota/pool
 
     def set_pool(self, pool: Pool) -> None:
         with self._lock:
             self.pools[pool.name] = pool
+            self._fan_out([self._emit("pool/set", {"name": pool.name},
+                                      pool=pool)])
 
     def set_share(self, share: Share) -> None:
         with self._lock:
             self.shares[(share.user, share.pool)] = share
+            self._fan_out([self._emit("share/set",
+                                      {"user": share.user,
+                                       "pool": share.pool},
+                                      share=share)])
 
     def retract_share(self, user: str, pool: str) -> None:
         with self._lock:
             self.shares.pop((user, pool), None)
+            self._fan_out([self._emit("share/retracted",
+                                      {"user": user, "pool": pool})])
 
     def get_share(self, user: str, pool: str) -> Resources:
         """Share lookup with default-user fallback (share.clj:123).  A share
@@ -421,10 +503,24 @@ class JobStore:
     def set_quota(self, quota: Quota) -> None:
         with self._lock:
             self.quotas[(quota.user, quota.pool)] = quota
+            self._fan_out([self._emit("quota/set",
+                                      {"user": quota.user,
+                                       "pool": quota.pool},
+                                      quota=quota)])
 
     def retract_quota(self, user: str, pool: str) -> None:
         with self._lock:
             self.quotas.pop((user, pool), None)
+            self._fan_out([self._emit("quota/retracted",
+                                      {"user": user, "pool": pool})])
+
+    def update_dynamic_config(self, updates: dict[str, Any]) -> None:
+        """Runtime-mutable config writes (rebalancer params, incremental
+        configs) go through the event feed so they survive failover."""
+        with self._lock:
+            self.dynamic_config.update(updates)
+            self._fan_out([self._emit("config/updated",
+                                      {"updates": updates})])
 
     def get_quota(self, user: str, pool: str) -> Quota:
         with self._lock:

@@ -39,7 +39,7 @@ def select_from_values(values: Sequence[dict], entity_id: str) -> Optional[Any]:
 
 def write_incremental(store: JobStore, key: str,
                       values: Sequence[dict]) -> None:
-    store.dynamic_config[INCREMENTAL_PREFIX + key] = list(values)
+    store.update_dynamic_config({INCREMENTAL_PREFIX + key: list(values)})
 
 
 def read_incremental(store: JobStore, key: str) -> list[dict]:

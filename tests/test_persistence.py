@@ -2,6 +2,7 @@
 import json
 
 from cook_tpu.models.entities import (
+    Application,
     Checkpoint,
     InstanceStatus,
     JobState,
@@ -14,6 +15,7 @@ from cook_tpu.models.persistence import (
     attach_journal,
     load_snapshot,
     read_journal,
+    recover,
     snapshot,
 )
 from cook_tpu.models.store import JobStore
@@ -29,7 +31,10 @@ def populated_store(clock):
                           resources=Resources(mem=float("inf"), cpus=50),
                           count=10))
     j1 = make_job(user="alice", checkpoint=Checkpoint(mode="auto",
-                                                      location="us-east"))
+                                                      location="us-east"),
+                  application=Application(name="svc", version="1.2",
+                                          workload_class="batch",
+                                          workload_id="w-17"))
     j2 = make_job(user="bob", max_retries=3)
     j3 = make_job(user="bob")
     store.submit_jobs([j1, j2, j3])
@@ -62,6 +67,9 @@ def test_snapshot_roundtrip(tmp_path, clock):
     # the restored store keeps transacting where the old one left off
     restored.update_instance_state("t1", InstanceStatus.SUCCESS, 1000)
     assert restored.jobs[j1.uuid].state == JobState.COMPLETED
+    # application metadata survives the roundtrip (advisor finding r1)
+    assert restored.jobs[j1.uuid].application == store.jobs[j1.uuid].application
+    assert restored.jobs[j1.uuid].application.workload_id == "w-17"
 
 
 def test_journal_appends_events(tmp_path, clock):
@@ -116,3 +124,124 @@ def test_journal_rotation(tmp_path, clock):
     # snapshot + fresh journal reconstruct: snapshot has job1, journal job2
     restored = load_snapshot(str(tmp_path / "snap.json"), clock=clock)
     assert len(restored.jobs) == 1
+
+
+def _same_state(a: JobStore, b: JobStore) -> None:
+    assert b.jobs == a.jobs
+    assert b.instances == a.instances
+    assert b.groups == a.groups
+    assert b.pools == a.pools
+    assert b.shares == a.shares
+    assert b.quotas == a.quotas
+    assert b.dynamic_config == a.dynamic_config
+    for pool in a.pools:
+        assert ({j.uuid for j in b.pending_jobs(pool)}
+                == {j.uuid for j in a.pending_jobs(pool)})
+        assert ({j.uuid for j in b.running_jobs(pool)}
+                == {j.uuid for j in a.running_jobs(pool)})
+
+
+def test_recover_journal_only(tmp_path, clock):
+    """With no snapshot at all, the journal alone reconstructs the store —
+    events carry full post-transaction entity payloads."""
+    store = JobStore(clock=clock)
+    writer = attach_journal(store, str(tmp_path / "journal.jsonl"))
+    store.set_pool(Pool(name="default"))
+    store.set_share(Share(user="default", pool="default",
+                          resources=Resources(mem=500, cpus=4, gpus=0)))
+    store.set_quota(Quota(user="alice", pool="default",
+                          resources=Resources(mem=1e9, cpus=100), count=7))
+    j1 = make_job(user="alice",
+                  application=Application(name="a", version="2"))
+    j2 = make_job(user="bob")
+    store.submit_jobs([j1, j2])
+    store.create_instance(j1.uuid, "t1", hostname="h1")
+    store.update_instance_state("t1", InstanceStatus.RUNNING)
+    store.update_instance_progress("t1", 40, "halfway-ish")
+    store.set_instance_output("t1", exit_code=None, sandbox_directory="/sb")
+    store.update_dynamic_config({"rebalancer": {"max_preemption": 9}})
+    store.retract_quota("alice", "default")
+    writer.close()
+
+    restored = recover(str(tmp_path), clock=clock)
+    assert restored is not None
+    _same_state(store, restored)
+    assert restored.jobs[j1.uuid].application.name == "a"
+    assert restored.instances["t1"].progress == 40
+    assert restored.instances["t1"].sandbox_directory == "/sb"
+    assert ("alice", "default") not in restored.quotas
+    # sequence numbering resumes after the replayed suffix
+    assert restored.last_seq() == store.last_seq()
+    restored.kill_jobs([j2.uuid])
+    assert restored.last_seq() == store.last_seq() + 1
+
+
+def test_recover_snapshot_plus_journal_suffix(tmp_path, clock):
+    """The ADVICE-r1 scenario: writes acknowledged after the snapshot fired
+    must survive — snapshot + journal suffix = exact state."""
+    store = JobStore(clock=clock)
+    writer = attach_journal(store, str(tmp_path / "journal.jsonl"))
+    store.set_pool(Pool(name="default"))
+    j1 = make_job(user="alice")
+    store.submit_jobs([j1])
+    snapshot(store, str(tmp_path / "snapshot.json"))
+    writer.rotate()
+    # post-snapshot writes: only the journal has them
+    j2 = make_job(user="bob", group_uuid=None)
+    store.submit_jobs([j2])
+    store.create_instance(j1.uuid, "t1", hostname="h1")
+    store.update_instance_state("t1", InstanceStatus.RUNNING)
+    store.update_instance_state("t1", InstanceStatus.SUCCESS, 1000)
+    store.retry_job(j2.uuid, 5)
+    writer.close()
+
+    restored = recover(str(tmp_path), clock=clock)
+    _same_state(store, restored)
+    assert restored.jobs[j1.uuid].state == JobState.COMPLETED
+    assert restored.jobs[j2.uuid].max_retries == 5
+    assert restored.recovered_stats["journal_replayed"] > 0
+
+
+def test_recover_tolerates_torn_tail(tmp_path, clock):
+    store = JobStore(clock=clock)
+    writer = attach_journal(store, str(tmp_path / "journal.jsonl"))
+    store.set_pool(Pool(name="default"))
+    j1 = make_job()
+    store.submit_jobs([j1])
+    writer.close()
+    with open(tmp_path / "journal.jsonl", "a") as f:
+        f.write('{"seq": 99, "kind": "job/created", "da')  # crash mid-write
+    restored = recover(str(tmp_path), clock=clock)
+    assert j1.uuid in restored.jobs
+
+
+def test_recover_empty_dir_returns_none(tmp_path, clock):
+    assert recover(str(tmp_path), clock=clock) is None
+
+
+def test_torn_tail_repaired_before_reattach(tmp_path, clock):
+    """Crash mid-write, restart, new acknowledged write, crash again: the
+    second recovery must keep the new write.  (Without truncating the torn
+    fragment before reattaching, the new event merges into one corrupt
+    line and everything after the tear is silently dropped.)"""
+    jpath = str(tmp_path / "journal.jsonl")
+    store = JobStore(clock=clock)
+    writer = attach_journal(store, jpath)
+    store.set_pool(Pool(name="default"))
+    j1 = make_job()
+    store.submit_jobs([j1])
+    writer.close()
+    with open(jpath, "a") as f:
+        f.write('{"seq": 77, "kind": "job/created", "da')  # torn write
+
+    # run 2: recover, reattach, acknowledge another job, crash
+    store2 = recover(str(tmp_path), clock=clock)
+    writer2 = attach_journal(store2, jpath)
+    j2 = make_job(user="bob")
+    store2.submit_jobs([j2])
+    writer2.close()
+
+    # run 3: BOTH acknowledged jobs must be there
+    store3 = recover(str(tmp_path), clock=clock)
+    assert j1.uuid in store3.jobs
+    assert j2.uuid in store3.jobs
