@@ -294,4 +294,11 @@ def job_display(job: Job) -> dict[str, Any]:
         "labels": dict(job.labels),
         "env": dict(job.user_provided_env),
         "instances": list(job.instance_ids),
+        "application": (
+            {"name": job.application.name,
+             "version": job.application.version,
+             "workload-class": job.application.workload_class,
+             "workload-id": job.application.workload_id}
+            if job.application else None
+        ),
     }

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import base64
 import json
+import re
 import statistics
 from dataclasses import dataclass
 from typing import Optional
@@ -62,6 +63,14 @@ class ApiConfig:
     admins: tuple = ("admin",)
     version: str = "cook-tpu-0.1.0"
     submission_rate_per_minute: float = 0.0  # 0 = unlimited
+    # origins allowed to make credentialed cross-origin requests
+    # (reference: rest/cors.clj).  Entries are exact origins, or regexes
+    # when prefixed with "re:" ("re:https://.*\\.corp\\.example") — exact
+    # entries are never regex-interpreted, so an unescaped "." cannot let
+    # lookalike origins through.  Empty = CORS disabled; reflecting the
+    # request Origin with Allow-Credentials would let any website issue
+    # credentialed requests.
+    cors_origins: tuple = ()
 
 
 def _parse_user(request: web.Request) -> str:
@@ -199,12 +208,24 @@ class CookApi:
             return _err(400, str(e))
         except json.JSONDecodeError as e:
             return _err(400, f"malformed JSON body: {e}")
-        # permissive CORS for browser dashboards (reference: cors middleware)
+        # CORS for browser dashboards, allowlist-gated (rest/cors.clj)
         origin = request.headers.get("Origin")
-        if origin:
+        if origin and self._origin_allowed(origin):
             response.headers["Access-Control-Allow-Origin"] = origin
             response.headers["Access-Control-Allow-Credentials"] = "true"
         return response
+
+    def _origin_allowed(self, origin: str) -> bool:
+        for allowed in self.config.cors_origins:
+            if allowed.startswith("re:"):
+                try:
+                    if re.fullmatch(allowed[3:], origin):
+                        return True
+                except re.error:
+                    continue  # invalid pattern never matches (nor 500s)
+            elif origin == allowed:
+                return True
+        return False
 
     # ------------------------------------------------------------------ jobs
 
@@ -960,7 +981,7 @@ class CookApi:
         if request["user"] not in self.config.admins:
             return _err(403, "admin required")
         body = await request.json()
-        self.store.dynamic_config.update(body)
+        self.store.update_dynamic_config(body)
         return web.json_response(self.store.dynamic_config, status=201)
 
     async def post_shutdown_leader(self, request: web.Request) -> web.Response:

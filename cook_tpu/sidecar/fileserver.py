@@ -15,13 +15,18 @@ from aiohttp import web
 
 class FileServer:
     def __init__(self, workdir: str):
-        self.workdir = os.path.abspath(workdir)
+        self.workdir = os.path.realpath(workdir)
 
     def _resolve(self, path: str) -> Optional[str]:
-        """Resolve a requested path inside the sandbox; None if it escapes."""
+        """Resolve a requested path inside the sandbox; None if it escapes.
+
+        realpath (not abspath) on both ends: a task could otherwise plant a
+        symlink inside its sandbox pointing outside COOK_WORKDIR and read
+        arbitrary pod-readable files through it.
+        """
         if not path:
             return None
-        full = os.path.abspath(
+        full = os.path.realpath(
             path if os.path.isabs(path) else os.path.join(self.workdir, path)
         )
         if full != self.workdir and not full.startswith(self.workdir + os.sep):

@@ -54,6 +54,7 @@ class Settings:
     queue_limit_per_pool: int = 1_000_000
     queue_limit_per_user: int = 100_000
     submission_rate_per_minute: float = 0.0
+    cors_origins: tuple = ()  # exact strings or regexes; empty = no CORS
 
     def match_config_for_pool(self, pool_name: str) -> MatchConfig:
         for ps in self.pool_schedulers:
@@ -91,6 +92,8 @@ def read_config(path: Optional[str] = None,
             setattr(settings, key, data[key])
     if "admins" in data:
         settings.admins = tuple(data["admins"])
+    if "cors_origins" in data:
+        settings.cors_origins = tuple(data["cors_origins"])
     if "pools" in data:
         settings.pools = data["pools"]
     if "clusters" in data:

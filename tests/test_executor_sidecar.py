@@ -140,3 +140,24 @@ def test_fileserver_blocks_traversal(fileserver):
     assert r.status_code == 404
     r = requests.get(f"{url}/files/read", params={"path": "/etc/passwd"})
     assert r.status_code == 404
+
+
+def test_fileserver_blocks_symlink_escape(fileserver):
+    """A task-planted symlink pointing outside the sandbox must not be
+    readable through the file server (advisor finding r1: abspath-based
+    containment follows symlinks)."""
+    url, tmp_path = fileserver
+    import os
+
+    os.symlink("/etc/passwd", tmp_path / "sneaky")
+    os.symlink("/etc", tmp_path / "sneakydir")
+    for path in ("sneaky", "sneakydir/passwd"):
+        r = requests.get(f"{url}/files/read", params={"path": path})
+        assert r.status_code == 404, path
+        r = requests.get(f"{url}/files/download", params={"path": path})
+        assert r.status_code == 404, path
+    # a symlink that stays inside the sandbox still works
+    os.symlink(tmp_path / "stdout", tmp_path / "inlink")
+    r = requests.get(f"{url}/files/read",
+                     params={"path": "inlink", "offset": 0, "length": 5})
+    assert r.json()["data"] == "hello"

@@ -254,6 +254,13 @@ def test_container_application_checkpoint_parsing(server):
     assert job.container.image == "repo/img:v1"
     assert job.application.name == "spark"
     assert job.checkpoint.location == "us-east"
+    # application is exposed back through the query API (rest/api.clj
+    # fetch-job-map includes :application)
+    r = requests.get(f"{server.url}/jobs/{job.uuid}", headers=hdr())
+    assert r.json()["application"] == {
+        "name": "spark", "version": "3.0",
+        "workload-class": "", "workload-id": "",
+    }
 
 
 def test_cancel_instance_endpoint(server):
@@ -330,3 +337,41 @@ def test_instance_stats_by_reason(server):
     assert stats["by-reason"].get("container-limitation-memory", 0) >= 1
     assert stats["by-status"].get("failed", 0) >= 1
     assert "percentiles" in stats["run-time-ms"]
+
+
+def test_cors_allowlist(server):
+    """CORS headers only for allowlisted origins — reflecting any Origin
+    with Allow-Credentials lets arbitrary sites make credentialed
+    cross-origin requests (advisor finding r1)."""
+    evil = {"Origin": "https://evil.example", **hdr()}
+    r = requests.get(f"{server.url}/info", headers=evil)
+    assert "Access-Control-Allow-Origin" not in r.headers
+    assert "Access-Control-Allow-Credentials" not in r.headers
+
+    server.api.config.cors_origins = (
+        "https://dashboard.example", r"re:https://.*\.corp\.example",
+    )
+    try:
+        ok = {"Origin": "https://dashboard.example", **hdr()}
+        r = requests.get(f"{server.url}/info", headers=ok)
+        assert r.headers["Access-Control-Allow-Origin"] == \
+            "https://dashboard.example"
+        assert r.headers["Access-Control-Allow-Credentials"] == "true"
+        regex_ok = {"Origin": "https://cook.corp.example", **hdr()}
+        r = requests.get(f"{server.url}/info", headers=regex_ok)
+        assert r.headers["Access-Control-Allow-Origin"] == \
+            "https://cook.corp.example"
+        r = requests.get(f"{server.url}/info", headers=evil)
+        assert "Access-Control-Allow-Origin" not in r.headers
+        # exact entries are never regex-interpreted: "." must not act as
+        # a wildcard letting lookalike origins through
+        lookalike = {"Origin": "https://dashboardxexample", **hdr()}
+        r = requests.get(f"{server.url}/info", headers=lookalike)
+        assert "Access-Control-Allow-Origin" not in r.headers
+        # an invalid regex entry never matches and never 500s
+        server.api.config.cors_origins = ("re:(unclosed",)
+        r = requests.get(f"{server.url}/info", headers=evil)
+        assert r.status_code == 200
+        assert "Access-Control-Allow-Origin" not in r.headers
+    finally:
+        server.api.config.cors_origins = ()
