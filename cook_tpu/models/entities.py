@@ -129,7 +129,7 @@ class GroupPlacementType(enum.Enum):
 class HostPlacement:
     type: GroupPlacementType = GroupPlacementType.ALL
     attribute: str = ""
-    minimum: int = 0  # for BALANCED: max allowed skew
+    minimum: int = 0  # for BALANCED: min distinct attr values to spread over
 
 
 @dataclass(frozen=True)

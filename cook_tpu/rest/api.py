@@ -208,7 +208,11 @@ class CookApi:
             return _err(400, str(e))
         except json.JSONDecodeError as e:
             return _err(400, f"malformed JSON body: {e}")
-        # CORS for browser dashboards, allowlist-gated (rest/cors.clj)
+        # CORS for browser dashboards, allowlist-gated (rest/cors.clj).
+        # Vary: Origin on every response: the CORS headers differ per
+        # Origin, so shared caches must not serve one origin's copy (or a
+        # no-Origin copy with no CORS headers) to another.
+        response.headers.setdefault("Vary", "Origin")
         origin = request.headers.get("Origin")
         if origin and self._origin_allowed(origin):
             response.headers["Access-Control-Allow-Origin"] = origin
@@ -382,6 +386,7 @@ class CookApi:
                 host_placement=HostPlacement(
                     type=ptype,
                     attribute=hp.get("parameters", {}).get("attribute", ""),
+                    minimum=int(hp.get("parameters", {}).get("minimum", 0)),
                 ),
                 straggler_handling=StragglerHandling(
                     type=sh.get("type", "none"),

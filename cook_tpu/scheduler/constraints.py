@@ -28,6 +28,12 @@ from cook_tpu.models.entities import (
     Job,
 )
 
+# Balanced-host treats a host with the attribute absent as carrying a nil
+# VALUE that participates in the frequency map (the reference maps cohost
+# attr maps with `get`, so nils are counted — constraints.clj:600), not as
+# an infeasible host.
+MISSING_ATTR = "\x00missing"
+
 
 @dataclass
 class EncodedNodes:
@@ -80,6 +86,7 @@ def feasibility_mask(
     previous_hosts: Optional[dict[str, set[str]]] = None,
     group_used_hosts: Optional[dict[str, set[str]]] = None,
     group_attr_value: Optional[dict[str, tuple[str, str]]] = None,
+    group_balance_counts: Optional[dict[str, dict[str, int]]] = None,
     groups: Optional[dict[str, Group]] = None,
     tasks_on_host: Optional[dict[str, int]] = None,
     max_tasks_per_host: int = 0,
@@ -157,6 +164,36 @@ def feasibility_mask(
                         else:
                             want = nodes.attr_vocab[attr].get(value, -2)
                             mask[ji, :] &= codes == want
+                elif (ptype == GroupPlacementType.BALANCED
+                      and group_balance_counts):
+                    # the running-member part of balanced-host
+                    # (constraints.clj:600) is order-independent, so it is
+                    # enforced up front: attribute values already at the
+                    # max member count are closed to the group (otherwise
+                    # the kernel would keep picking the fittest closed host
+                    # and the post-pass would reject it every cycle)
+                    counts = group_balance_counts.get(job.group_uuid)
+                    if counts:
+                        attr = group.host_placement.attribute
+                        minimum = group.host_placement.minimum
+                        codes = nodes.attr_codes.get(attr)
+                        if codes is None:
+                            # attr absent from every offer: all hosts carry
+                            # the nil value (code -1), same as the post-pass
+                            codes = np.full(nodes.n, -1, dtype=np.int32)
+                        minim = (0 if minimum > len(counts)
+                                 else min(counts.values()))
+                        maxim = max(counts.values())
+                        if minim != maxim:
+                            for value, c in counts.items():
+                                if c < maxim:
+                                    continue
+                                if value == MISSING_ATTR:
+                                    mask[ji, :] &= codes != -1
+                                else:
+                                    code = nodes.attr_vocab[attr].get(
+                                        value, -2)
+                                    mask[ji, :] &= codes != code
     return mask
 
 
@@ -167,16 +204,25 @@ def validate_group_assignments(
     groups: dict[str, Group],
     group_used_hosts: dict[str, set[str]],
     group_attr_value: dict[str, tuple[str, str]],
+    group_balance_counts: Optional[dict[str, dict[str, int]]] = None,
 ) -> np.ndarray:
     """Post-kernel pass enforcing intra-cycle group semantics: walk matches
     in schedule order; a match that violates its group's unique-host /
     attribute-equals placement against *earlier* matches this cycle is
-    unassigned (set to -1).  Returns the corrected assignment."""
+    unassigned (set to -1).  Returns the corrected assignment.
+
+    `group_balance_counts` seeds the balanced-host skew counts with RUNNING
+    members — including those on hosts outside this cycle's offer set — so
+    the constraint matches the reference's all-running-members semantics
+    (constraints.clj:600), not just intra-cycle placements."""
     assignment = assignment.copy()
     used: dict[str, set[str]] = {g: set(h) for g, h in group_used_hosts.items()}
     pinned: dict[str, tuple[str, str]] = dict(group_attr_value)
-    # balanced: per-group count of members per attribute value
-    balance_counts: dict[str, dict[str, int]] = {}
+    # balanced: per-group count of members per attribute value, seeded with
+    # running members
+    balance_counts: dict[str, dict[str, int]] = {
+        g: dict(c) for g, c in (group_balance_counts or {}).items()
+    }
     for ji, job in enumerate(jobs):
         node_idx = int(assignment[ji])
         if node_idx < 0 or not job.group_uuid:
@@ -204,19 +250,23 @@ def validate_group_assignments(
             elif prev != (attr, value):
                 assignment[ji] = -1
         elif ptype == GroupPlacementType.BALANCED:
-            # spread across attribute values with bounded skew
-            # (balanced-host constraint, constraints.clj:600)
+            # balanced-host (constraints.clj:600): a member may land on an
+            # already-seen attribute value only if that value's member count
+            # is below the current max (or all seen values are level); until
+            # `minimum` distinct values are in play the floor is pinned to 0,
+            # which forces spreading onto unseen values.  Unseen values
+            # always pass.
             attr = group.host_placement.attribute
-            max_skew = max(group.host_placement.minimum, 1)
-            value = dict(nodes.offers[node_idx].attributes).get(attr)
-            if value is None:
-                assignment[ji] = -1
-                continue
+            minimum = group.host_placement.minimum
+            value = dict(nodes.offers[node_idx].attributes).get(
+                attr, MISSING_ATTR)
             counts = balance_counts.setdefault(job.group_uuid, {})
-            new_count = counts.get(value, 0) + 1
-            floor = min(counts.values()) if counts else 0
-            if new_count - floor > max_skew:
-                assignment[ji] = -1
-                continue
-            counts[value] = new_count
+            freq = counts.get(value)
+            if counts and freq is not None:
+                minim = 0 if minimum > len(counts) else min(counts.values())
+                maxim = max(counts.values())
+                if minim != maxim and freq >= maxim:
+                    assignment[ji] = -1
+                    continue
+            counts[value] = counts.get(value, 0) + 1
     return assignment

@@ -96,6 +96,15 @@ class Scheduler:
         # rebalancer host reservations: hostname -> reserving job uuid
         # (reserve-hosts!, rebalancer.clj:419)
         self.host_reservations: dict[str, str] = {}
+        # accumulating hostname -> attributes cache: fully-occupied hosts
+        # emit no offers, but their attrs are still needed to count running
+        # group members for balanced-host placement (constraints.clj:600).
+        # LRU-bounded: long-lived autoscaled clusters mint unique node
+        # names forever
+        from collections import OrderedDict
+
+        self.host_attr_cache: OrderedDict[str, dict] = OrderedDict()
+        self.host_attr_cache_max = 100_000
         self.metrics: dict[str, float] = {}
         store.add_watcher(self._on_event)
         for cluster in self.clusters:
@@ -212,6 +221,7 @@ class Scheduler:
             launch_filter=self._make_launch_filter(),
             record_placement_failure=self._record_placement_failure,
             host_reservations=self.host_reservations,
+            host_attrs=self.host_attr_cache,
         )
         # charge launches against the per-user rate limiter (spend-through)
         if self.launch_rate_limiter is not None:
@@ -272,6 +282,7 @@ class Scheduler:
             launch_filter=self._make_launch_filter(),
             record_placement_failure=self._record_placement_failure,
             host_reservations=self.host_reservations,
+            host_attrs=self.host_attr_cache,
             mesh=mesh,
         )
         for pool in pools:
@@ -301,6 +312,10 @@ class Scheduler:
                 )
                 host_info[offer.hostname] = (dict(offer.attributes),
                                              cluster.location)
+                self.host_attr_cache[offer.hostname] = dict(offer.attributes)
+                self.host_attr_cache.move_to_end(offer.hostname)
+        while len(self.host_attr_cache) > self.host_attr_cache_max:
+            self.host_attr_cache.popitem(last=False)
         self.last_unmatched_offers[pool.name] = spare
         self.last_host_info = getattr(self, "last_host_info", {})
         self.last_host_info[pool.name] = host_info

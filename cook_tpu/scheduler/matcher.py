@@ -24,11 +24,12 @@ import numpy as np
 import jax.numpy as jnp
 
 from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
-from cook_tpu.models.entities import Job, Pool
+from cook_tpu.models.entities import GroupPlacementType, Job, Pool
 from cook_tpu.models.store import JobStore, TransactionVetoed
 from cook_tpu.ops.common import bucket_size, pad_to
 from cook_tpu.ops.match import MatchProblem, chunked_match, greedy_match
 from cook_tpu.scheduler.constraints import (
+    MISSING_ATTR,
     EncodedNodes,
     encode_nodes,
     feasibility_mask,
@@ -120,10 +121,21 @@ def build_match_problem(
     )
 
 
-def gather_group_context(store: JobStore, jobs: Sequence[Job]):
-    """Hostnames/attr-values pinned by running group members."""
+def gather_group_context(
+    store: JobStore,
+    jobs: Sequence[Job],
+    host_attrs: Optional[dict[str, dict]] = None,
+):
+    """Hostnames/attr-values pinned by running group members.
+
+    `host_attrs` maps hostname -> attribute dict for every host the
+    scheduler has ever seen an offer from — running members may sit on
+    hosts absent from this cycle's offers (full hosts emit no offer), and
+    the reference's balanced-host constraint counts ALL running members
+    (constraints.clj:600), not just those on currently-offered hosts."""
     group_used_hosts: dict[str, set[str]] = {}
     group_attr_value: dict[str, tuple[str, str]] = {}
+    group_balance_counts: dict[str, dict[str, int]] = {}
     groups = {}
     for job in jobs:
         if not job.group_uuid or job.group_uuid in groups:
@@ -132,13 +144,37 @@ def gather_group_context(store: JobStore, jobs: Sequence[Job]):
         if group is None:
             continue
         groups[group.uuid] = group
+        ptype = group.host_placement.type
+        count_attr = (group.host_placement.attribute
+                      if host_attrs and ptype in (
+                          GroupPlacementType.BALANCED,
+                          GroupPlacementType.ATTRIBUTE_EQUALS)
+                      else None)
         hosts: set[str] = set()
+        # counts are per running TASK, not per distinct host — the
+        # reference takes frequencies over cohost attr maps, one per cotask
+        # (constraints.clj:600), and a balanced group may co-locate members
+        counts: dict[str, int] = {}
         for member_uuid in group.job_uuids:
             for inst in store.job_instances(member_uuid):
-                if not inst.status.terminal and inst.hostname:
-                    hosts.add(inst.hostname)
+                if inst.status.terminal or not inst.hostname:
+                    continue
+                hosts.add(inst.hostname)
+                if count_attr is not None:
+                    value = host_attrs.get(inst.hostname, {}).get(count_attr)
+                    if value is None and ptype == GroupPlacementType.BALANCED:
+                        value = MISSING_ATTR  # nil counts as a value
+                    if value is not None:
+                        counts[value] = counts.get(value, 0) + 1
         group_used_hosts[group.uuid] = hosts
-    return groups, group_used_hosts, group_attr_value
+        if counts:
+            if ptype == GroupPlacementType.BALANCED:
+                group_balance_counts[group.uuid] = counts
+            elif group.uuid not in group_attr_value:
+                # running members pin the attribute value for the group
+                group_attr_value[group.uuid] = (
+                    count_attr, max(counts, key=counts.get))
+    return groups, group_used_hosts, group_attr_value, group_balance_counts
 
 
 def previous_failed_hosts(store: JobStore, jobs: Sequence[Job]) -> dict[str, set[str]]:
@@ -167,6 +203,7 @@ class PreparedPool:
     groups: dict = field(default_factory=dict)
     group_used_hosts: dict = field(default_factory=dict)
     group_attr_value: dict = field(default_factory=dict)
+    group_balance_counts: dict = field(default_factory=dict)
     feasible: Optional[np.ndarray] = None
     problem: Optional[MatchProblem] = None
 
@@ -185,6 +222,7 @@ def prepare_pool_problem(
     *,
     launch_filter: Optional[Callable[[Job], bool]] = None,
     host_reservations: Optional[dict[str, str]] = None,
+    host_attrs: Optional[dict[str, dict]] = None,
 ) -> PreparedPool:
     """Gather offers + considerable jobs and encode the tensor problem."""
     prepared = PreparedPool(pool=pool, outcome=MatchOutcome())
@@ -206,14 +244,27 @@ def prepare_pool_problem(
 
     nodes = encode_nodes([o for _, o in prepared.cluster_offers])
     prepared.nodes = nodes
+    # every host in this cycle's offers contributes attrs, written back
+    # into the caller's accumulated cache HERE (pre-match) — a host whose
+    # first offer is fully consumed this cycle would otherwise never be
+    # cached and its running group members would count as attribute-less
+    if host_attrs is not None:
+        for o in nodes.offers:
+            host_attrs[o.hostname] = dict(o.attributes)
+        merged_attrs: dict = host_attrs
+    else:
+        merged_attrs = {o.hostname: dict(o.attributes) for o in nodes.offers}
     (prepared.groups, prepared.group_used_hosts,
-     prepared.group_attr_value) = gather_group_context(store, considerable)
+     prepared.group_attr_value,
+     prepared.group_balance_counts) = gather_group_context(
+        store, considerable, host_attrs=merged_attrs)
     feasible = feasibility_mask(
         considerable,
         nodes,
         previous_hosts=previous_failed_hosts(store, considerable),
         group_used_hosts=prepared.group_used_hosts,
         group_attr_value=prepared.group_attr_value,
+        group_balance_counts=prepared.group_balance_counts,
         groups=prepared.groups,
         offer_locations=[c.location for c, _ in prepared.cluster_offers],
     )
@@ -259,6 +310,7 @@ def finalize_pool_match(
     assignment = validate_group_assignments(
         considerable, assignment, nodes, prepared.groups,
         prepared.group_used_hosts, prepared.group_attr_value,
+        prepared.group_balance_counts,
     )
 
     # transact + launch (scheduler.clj:790-1048)
@@ -361,11 +413,13 @@ def match_pool(
     launch_filter: Optional[Callable[[Job], bool]] = None,
     record_placement_failure: Optional[Callable[[Job, str], None]] = None,
     host_reservations: Optional[dict[str, str]] = None,
+    host_attrs: Optional[dict[str, dict]] = None,
 ) -> MatchOutcome:
     """One pool's match cycle end to end (prepare -> solve -> finalize)."""
     prepared = prepare_pool_problem(
         store, pool, queue, clusters, config, state,
         launch_filter=launch_filter, host_reservations=host_reservations,
+        host_attrs=host_attrs,
     )
     assignment = np.empty(0, dtype=np.int32)
     if prepared.solvable:
@@ -397,6 +451,7 @@ def match_pools_batched(
     launch_filter: Optional[Callable[[Job], bool]] = None,
     record_placement_failure: Optional[Callable[[Job, str], None]] = None,
     host_reservations: Optional[dict[str, str]] = None,
+    host_attrs: Optional[dict[str, dict]] = None,
     mesh=None,
 ) -> dict[str, MatchOutcome]:
     """Solve EVERY pool's match problem in one batched device call.
@@ -417,7 +472,7 @@ def match_pools_batched(
         prepare_pool_problem(
             store, pool, queues[pool.name], clusters, config,
             states[pool.name], launch_filter=launch_filter,
-            host_reservations=host_reservations,
+            host_reservations=host_reservations, host_attrs=host_attrs,
         )
         for pool in pools
     ]

@@ -8,7 +8,8 @@ Serves the same three endpoints with path traversal protection.
 from __future__ import annotations
 
 import os
-from typing import Optional
+import stat as stat_mod
+from typing import BinaryIO, Optional
 
 from aiohttp import web
 
@@ -32,6 +33,42 @@ class FileServer:
         if full != self.workdir and not full.startswith(self.workdir + os.sep):
             return None
         return full
+
+    def _open_contained(self, path: str) -> Optional[BinaryIO]:
+        """Open a sandbox file with the containment verified on the OPENED
+        fd, not just the pre-open path: _resolve alone is check-then-use —
+        a task can swap a directory for an outside-pointing symlink between
+        the realpath check and the open.  After opening, the fd's real path
+        (via /proc/self/fd) tells us what was actually opened; if that
+        escaped the sandbox, the handle is discarded."""
+        full = self._resolve(path)
+        if full is None:
+            return None
+        try:
+            # O_NONBLOCK: opening a task-planted FIFO read-only must not
+            # block the event loop waiting for a writer (harmless for
+            # regular files).  O_NOFOLLOW: the realpath above already
+            # resolved symlinks, so a symlink at the final component now
+            # means a race — reject it.
+            fd = os.open(full, os.O_RDONLY | os.O_NONBLOCK
+                         | getattr(os, "O_NOFOLLOW", 0))
+        except OSError:
+            return None
+        f = os.fdopen(fd, "rb")
+        if not stat_mod.S_ISREG(os.fstat(fd).st_mode):
+            f.close()
+            return None
+        try:
+            actual = os.path.realpath(f"/proc/self/fd/{fd}")
+        except OSError:
+            # non-Linux fallback: re-resolve the path post-open (narrows
+            # but does not fully close the race window)
+            actual = os.path.realpath(full)
+        if (actual != self.workdir
+                and not actual.startswith(self.workdir + os.sep)):
+            f.close()
+            return None
+        return f
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -62,27 +99,58 @@ class FileServer:
     async def read(self, request: web.Request) -> web.Response:
         """Mesos-style paged read: ?path=&offset=&length=.
         offset=-1 returns just the file size (how `cs tail` seeks)."""
-        path = self._resolve(request.query.get("path", ""))
-        if path is None or not os.path.isfile(path):
+        f = self._open_contained(request.query.get("path", ""))
+        if f is None:
             return web.json_response({"error": "no such file"}, status=404)
-        size = os.path.getsize(path)
-        offset = int(request.query.get("offset", 0))
-        if offset == -1:
-            return web.json_response({"offset": size, "data": ""})
-        length = min(int(request.query.get("length", 64 * 1024)), 1024 * 1024)
-        with open(path, "rb") as f:
-            f.seek(offset)
-            data = f.read(length)
+        import asyncio
+
+        with f:
+            size = os.fstat(f.fileno()).st_size
+            offset = int(request.query.get("offset", 0))
+            if offset == -1:
+                return web.json_response({"offset": size, "data": ""})
+            if offset < 0:
+                return web.json_response({"error": "bad offset"}, status=400)
+            # clamp below as well: length=-1 would turn f.read into
+            # read-whole-file and OOM the sidecar on a large log
+            length = min(max(int(request.query.get("length", 64 * 1024)), 0),
+                         1024 * 1024)
+
+            def _read() -> bytes:
+                f.seek(offset)
+                return f.read(length)
+
+            data = await asyncio.get_event_loop().run_in_executor(None, _read)
         return web.json_response({
             "offset": offset,
             "data": data.decode(errors="replace"),
         })
 
-    async def download(self, request: web.Request) -> web.Response:
-        path = self._resolve(request.query.get("path", ""))
-        if path is None or not os.path.isfile(path):
+    async def download(self, request: web.Request) -> web.StreamResponse:
+        f = self._open_contained(request.query.get("path", ""))
+        if f is None:
             return web.json_response({"error": "no such file"}, status=404)
-        return web.FileResponse(path)
+        import asyncio
+        import re
+
+        loop = asyncio.get_event_loop()
+        with f:
+            # sanitized: filenames are task-controlled, and quotes/control
+            # chars would malform the header (or make aiohttp 500)
+            name = re.sub(r"[^\w.+-]", "_", os.path.basename(
+                request.query.get("path", "file"))) or "file"
+            response = web.StreamResponse(headers={
+                "Content-Type": "application/octet-stream",
+                "Content-Disposition": f'attachment; filename="{name}"',
+            })
+            await response.prepare(request)
+            while True:
+                chunk = await loop.run_in_executor(None, f.read, 256 * 1024)
+                if not chunk:
+                    break
+                await response.write(chunk)
+            await response.write_eof()
+        return response
 
 
 def main(argv=None) -> int:

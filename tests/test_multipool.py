@@ -147,6 +147,58 @@ def test_balanced_group_placement():
     assert racks and max(racks.values()) - min(racks.values()) <= 1
 
 
+def test_balanced_counts_running_members_on_absent_hosts():
+    """A RUNNING group member on a host that emits no offer this cycle
+    still seeds the balanced-host skew counts (constraints.clj:600 counts
+    all running members, not just intra-cycle placements)."""
+    from cook_tpu.models.entities import (
+        Group,
+        GroupPlacementType,
+        HostPlacement,
+    )
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    hosts = [
+        MockHost(node_id="gone1", hostname="gone1", mem=1000, cpus=4,
+                 attributes=(("rack", "r1"),)),
+        MockHost(node_id="a1", hostname="a1", mem=8000, cpus=32,
+                 attributes=(("rack", "r1"),)),
+        MockHost(node_id="b1", hostname="b1", mem=8000, cpus=32,
+                 attributes=(("rack", "r2"),)),
+    ]
+    cluster = MockCluster("m", hosts, clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    pool = store.pools["default"]
+    # one empty cycle caches gone1's attributes off its offer
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    assert "gone1" in scheduler.host_attr_cache
+
+    group = Group(
+        uuid="bal2",
+        host_placement=HostPlacement(type=GroupPlacementType.BALANCED,
+                                     attribute="rack", minimum=2),
+    )
+    j0 = make_job(group_uuid="bal2", mem=100, cpus=1)
+    store.submit_jobs([j0], [group])
+    store.create_instance(j0.uuid, "t-gone", hostname="gone1",
+                          node_id="gone1", compute_cluster="m")
+    # the host disappears: full/cordoned hosts emit no offers
+    del cluster.hosts["gone1"]
+
+    jobs = [make_job(group_uuid="bal2", mem=100, cpus=1) for _ in range(2)]
+    store.submit_jobs(jobs)
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    # with {r1: 1} seeded and minimum=2 distinct values unmet, r1 (a1) is
+    # closed to the group until r2 catches up — placements go to b1 only
+    assert outcome.matched
+    for _, offer in outcome.matched:
+        assert dict(offer.attributes)["rack"] == "r2"
+
+
 def test_simulator_multipool_batched():
     """Multi-pool trace through the simulator with the batched device call:
     every pool's jobs complete, decisions match the per-pool path."""
