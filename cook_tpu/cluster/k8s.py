@@ -36,6 +36,9 @@ class PodPhase(enum.Enum):
     RUNNING = "Running"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    # kubelet stopped reporting (node unreachable); the pod may still be
+    # running — treated as alive until reconciliation or a real phase
+    UNKNOWN = "Unknown"
 
 
 @dataclass
@@ -59,6 +62,12 @@ class KubePod:
     phase: PodPhase = PodPhase.PENDING
     synthetic: bool = False
     failure_reason: str = ""
+    # launch details a real apiserver client needs to build the pod
+    # manifest (launch-pod, api.clj:2152); FakeKubeApi ignores them
+    command: str = ""
+    image: str = ""
+    env: tuple = ()
+    pool: str = ""
 
 
 class KubeApi:
@@ -69,7 +78,14 @@ class KubeApi:
         raise NotImplementedError
 
     def list_pods(self) -> Sequence[KubePod]:
+        """Cook-managed pods (the controller's domain)."""
         raise NotImplementedError
+
+    def list_all_pods(self) -> Sequence[KubePod]:
+        """EVERY pod consuming node resources — daemonsets/system pods
+        included — for offer synthesis (get-consumption, api.clj:886).
+        The controller must NOT see these (it kills unknown pods)."""
+        return self.list_pods()
 
     def create_pod(self, pod: KubePod) -> None:
         raise NotImplementedError
@@ -195,8 +211,9 @@ class KubeCluster(ComputeCluster):
         """Synthesize offers: capacity minus consumption per schedulable
         node (generate-offers)."""
         consumption: dict[str, list[float]] = {}
-        for pod in self.api.list_pods():
-            if pod.phase in (PodPhase.PENDING, PodPhase.RUNNING):
+        for pod in self.api.list_all_pods():
+            if pod.phase in (PodPhase.PENDING, PodPhase.RUNNING,
+                             PodPhase.UNKNOWN):
                 c = consumption.setdefault(pod.node_name, [0.0, 0.0, 0.0])
                 c[0] += pod.mem
                 c[1] += pod.cpus
@@ -231,6 +248,10 @@ class KubeCluster(ComputeCluster):
                     mem=spec.mem,
                     cpus=spec.cpus,
                     gpus=spec.gpus,
+                    command=spec.command,
+                    image=spec.container_image,
+                    env=tuple(spec.env),
+                    pool=pool,
                 ))
             except Exception:
                 self._report(spec.task_id, InstanceStatus.FAILED,
@@ -264,7 +285,9 @@ class KubeCluster(ComputeCluster):
         phase = pod.phase if pod is not None else None
 
         if expected == ExpectedState.KILLED:
-            if pod is not None and phase in (PodPhase.PENDING, PodPhase.RUNNING):
+            if pod is not None and phase in (PodPhase.PENDING,
+                                             PodPhase.RUNNING,
+                                             PodPhase.UNKNOWN):
                 self.api.delete_pod(task_id)
             self._report(task_id, InstanceStatus.FAILED, "killed-by-user")
             with self._lock:
@@ -299,7 +322,8 @@ class KubeCluster(ComputeCluster):
         if expected == ExpectedState.MISSING and pod is not None \
                 and not pod.synthetic:
             # unknown pod owned by us: kill it (controller's orphan branch)
-            if phase in (PodPhase.PENDING, PodPhase.RUNNING):
+            if phase in (PodPhase.PENDING, PodPhase.RUNNING,
+                         PodPhase.UNKNOWN):
                 self.api.delete_pod(task_id)
 
     def scan_all(self) -> None:
@@ -359,7 +383,8 @@ class KubeCluster(ComputeCluster):
         return sum(
             1 for p in self.api.list_pods()
             if p.node_name == hostname
-            and p.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+            and p.phase in (PodPhase.PENDING, PodPhase.RUNNING,
+                            PodPhase.UNKNOWN)
             and not p.synthetic
         )
 
@@ -377,7 +402,8 @@ class KubeCluster(ComputeCluster):
         return {
             p.name: p for p in self.api.list_pods()
             if not p.synthetic and p.phase in (PodPhase.PENDING,
-                                               PodPhase.RUNNING)
+                                               PodPhase.RUNNING,
+                                               PodPhase.UNKNOWN)
         }
 
     def _report(self, task_id, status, reason) -> None:

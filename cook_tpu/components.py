@@ -77,6 +77,34 @@ def _k8s_factory(conf: dict, clock) -> ComputeCluster:
                        synthetic_pod_limits=conf.get("synthetic_pods", {}))
 
 
+@register_cluster_factory("k8s-http")
+def _k8s_http_factory(conf: dict, clock) -> ComputeCluster:
+    """A real apiserver-backed cluster (kubernetes/api.clj analog):
+
+        {"kind": "k8s-http", "name": "prod", "url": "https://apiserver",
+         "namespace": "cook", "token_file": "/var/run/.../token",
+         "ca_file": "...", "file_server_port": 8000}
+    """
+    from cook_tpu.cluster.k8s import KubeCluster
+    from cook_tpu.cluster.k8s_http import HttpKubeApi
+
+    api = HttpKubeApi(
+        conf["url"],
+        namespace=conf.get("namespace", "default"),
+        token_file=conf.get("token_file"),
+        ca_file=conf.get("ca_file"),
+        insecure_skip_verify=bool(conf.get("insecure_skip_verify", False)),
+        default_image=conf.get("default_image", "busybox:stable"),
+        file_server_port=int(conf.get("file_server_port", 0)),
+        file_server_image=conf.get("file_server_image", ""),
+        watch_timeout_s=float(conf.get("watch_timeout_s", 300.0)),
+    )
+    cluster = KubeCluster(conf["name"], api, clock,
+                          synthetic_pod_limits=conf.get("synthetic_pods", {}))
+    api.start()  # pod watch loop (initialize-pod-watch)
+    return cluster
+
+
 class TriggerLoop:
     """A periodic trigger thread (chime/trigger-chan analog).  Also
     manually fireable for tests/simulator."""

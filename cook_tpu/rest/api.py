@@ -202,22 +202,29 @@ class CookApi:
         request["user"] = user
         try:
             response = await handler(request)
-        except web.HTTPException:
+        except web.HTTPException as e:
+            # HTTPExceptions ARE responses: CORS applies to errors too, or
+            # browser JS can't read them and caches can cross-serve them
+            self._apply_cors(request, e)
             raise
         except TransactionVetoed as e:
-            return _err(400, str(e))
+            response = _err(400, str(e))
         except json.JSONDecodeError as e:
-            return _err(400, f"malformed JSON body: {e}")
-        # CORS for browser dashboards, allowlist-gated (rest/cors.clj).
-        # Vary: Origin on every response: the CORS headers differ per
-        # Origin, so shared caches must not serve one origin's copy (or a
-        # no-Origin copy with no CORS headers) to another.
+            response = _err(400, f"malformed JSON body: {e}")
+        self._apply_cors(request, response)
+        return response
+
+    def _apply_cors(self, request: web.Request, response) -> None:
+        """CORS for browser dashboards, allowlist-gated (rest/cors.clj).
+        Vary: Origin on EVERY response (success or error): the CORS
+        headers differ per Origin, so shared caches must not serve one
+        origin's copy (or a no-Origin copy with no CORS headers) to
+        another."""
         response.headers.setdefault("Vary", "Origin")
         origin = request.headers.get("Origin")
         if origin and self._origin_allowed(origin):
             response.headers["Access-Control-Allow-Origin"] = origin
             response.headers["Access-Control-Allow-Credentials"] = "true"
-        return response
 
     def _origin_allowed(self, origin: str) -> bool:
         for allowed in self.config.cors_origins:

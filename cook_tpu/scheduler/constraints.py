@@ -191,8 +191,8 @@ def feasibility_mask(
                                 if value == MISSING_ATTR:
                                     mask[ji, :] &= codes != -1
                                 else:
-                                    code = nodes.attr_vocab[attr].get(
-                                        value, -2)
+                                    code = nodes.attr_vocab.get(
+                                        attr, {}).get(value, -2)
                                     mask[ji, :] &= codes != code
     return mask
 

@@ -1,0 +1,189 @@
+"""In-process fake Kubernetes apiserver speaking real HTTP.
+
+Test double for `HttpKubeApi` (cook_tpu/cluster/k8s_http.py) with faithful
+watch semantics: LIST returns a resourceVersion; WATCH streams JSON-line
+events from an event buffer starting after the requested resourceVersion;
+`inject_gap()` compacts the buffer so resumed watches get 410 Gone and the
+client must re-list — the failure mode the reference recovers from in
+initialize-pod-watch (api.clj:449)."""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+
+class FakeApiServerState:
+    def __init__(self):
+        self.lock = threading.Condition()
+        self.rv = 100
+        self.nodes: dict[str, dict] = {}
+        self.pods: dict[str, dict] = {}
+        # (rv, type, manifest-snapshot); compacted by inject_gap()
+        self.events: list[tuple[int, str, dict]] = []
+        self.min_event_rv = 0
+        self.auth_headers: list[str] = []
+        self.watch_epoch = 0
+
+    # ------------------------------------------------------- mutations
+
+    def add_node(self, name: str, mem_mb: float, cpus: float,
+                 labels: dict | None = None) -> None:
+        with self.lock:
+            self.nodes[name] = {
+                "metadata": {"name": name, "labels": labels or {}},
+                "spec": {},
+                "status": {
+                    "allocatable": {"memory": f"{int(mem_mb)}Mi",
+                                    "cpu": str(cpus)},
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                },
+            }
+
+    def create_pod(self, manifest: dict) -> None:
+        with self.lock:
+            name = manifest["metadata"]["name"]
+            if name in self.pods:
+                raise KeyError(name)
+            manifest.setdefault("status", {})["phase"] = "Pending"
+            self.rv += 1
+            manifest["metadata"]["resourceVersion"] = str(self.rv)
+            self.pods[name] = manifest
+            self.events.append((self.rv, "ADDED", json.loads(json.dumps(manifest))))
+            self.lock.notify_all()
+
+    def delete_pod(self, name: str) -> bool:
+        with self.lock:
+            manifest = self.pods.pop(name, None)
+            if manifest is None:
+                return False
+            self.rv += 1
+            self.events.append((self.rv, "DELETED",
+                                json.loads(json.dumps(manifest))))
+            self.lock.notify_all()
+            return True
+
+    def set_phase(self, name: str, phase: str, *, reason: str = "") -> None:
+        with self.lock:
+            manifest = self.pods[name]
+            manifest["status"]["phase"] = phase
+            if reason:
+                manifest["status"]["reason"] = reason
+            self.rv += 1
+            manifest["metadata"]["resourceVersion"] = str(self.rv)
+            self.events.append((self.rv, "MODIFIED",
+                                json.loads(json.dumps(manifest))))
+            self.lock.notify_all()
+
+    def inject_gap(self) -> None:
+        """Compact the event history and sever live watches: resumed
+        watches with a pre-compaction resourceVersion now get 410."""
+        with self.lock:
+            self.events.clear()
+            self.min_event_rv = self.rv + 1
+            self.watch_epoch += 1
+            self.lock.notify_all()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: FakeApiServerState  # set by make_server
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _json(self, code: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        st = self.state
+        st.auth_headers.append(self.headers.get("Authorization", ""))
+        parts = urlsplit(self.path)
+        query = {k: v[0] for k, v in parse_qs(parts.query).items()}
+        if parts.path == "/api/v1/nodes":
+            with st.lock:
+                items = list(st.nodes.values())
+            return self._json(200, {"items": items})
+        if parts.path.endswith("/pods") and query.get("watch") != "1":
+            with st.lock:
+                items = json.loads(json.dumps(list(st.pods.values())))
+                rv = str(st.rv)
+            return self._json(200, {"items": items,
+                                    "metadata": {"resourceVersion": rv}})
+        if parts.path.endswith("/pods"):
+            return self._watch(query)
+        return self._json(404, {"message": "not found"})
+
+    def _watch(self, query: dict) -> None:
+        st = self.state
+        from_rv = int(query.get("resourceVersion") or 0)
+        timeout_s = float(query.get("timeoutSeconds", 30))
+        with st.lock:
+            if from_rv < st.min_event_rv - 1 and st.min_event_rv:
+                return self._json(410, {"kind": "Status", "code": 410,
+                                        "reason": "Expired"})
+            epoch = st.watch_epoch
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        import time
+
+        deadline = time.time() + timeout_s
+        sent_rv = from_rv
+        while True:
+            with st.lock:
+                if st.watch_epoch != epoch:
+                    return  # severed: client must reconnect (and may 410)
+                batch = [e for e in st.events if e[0] > sent_rv]
+                if not batch:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return
+                    st.lock.wait(timeout=min(remaining, 0.2))
+                    continue
+            for rv, etype, manifest in batch:
+                line = json.dumps({"type": etype, "object": manifest}) + "\n"
+                try:
+                    self.wfile.write(line.encode())
+                    self.wfile.flush()
+                except OSError:
+                    return
+                sent_rv = rv
+
+    def do_POST(self):
+        st = self.state
+        st.auth_headers.append(self.headers.get("Authorization", ""))
+        length = int(self.headers.get("Content-Length", 0))
+        manifest = json.loads(self.rfile.read(length))
+        try:
+            st.create_pod(manifest)
+        except KeyError:
+            return self._json(409, {"message": "AlreadyExists"})
+        return self._json(201, manifest)
+
+    def do_DELETE(self):
+        st = self.state
+        st.auth_headers.append(self.headers.get("Authorization", ""))
+        name = urlsplit(self.path).path.rsplit("/", 1)[-1]
+        if st.delete_pod(name):
+            return self._json(200, {"status": "Success"})
+        return self._json(404, {"message": "NotFound"})
+
+
+def make_server() -> tuple[ThreadingHTTPServer, FakeApiServerState, str]:
+    """Start a fake apiserver on an ephemeral port; returns (server,
+    state, base_url).  Caller must server.shutdown()."""
+    state = FakeApiServerState()
+    handler = type("BoundHandler", (_Handler,), {"state": state})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    host, port = server.server_address
+    return server, state, f"http://{host}:{port}"

@@ -1,0 +1,234 @@
+"""HttpKubeApi against a live (fake) HTTP apiserver: pod CRUD + manifest
+construction, watch event flow, re-list on 410 gap, token refresh —
+KubeCluster runs UNMODIFIED against the HTTP client (the round-1 gap:
+kubernetes/api.clj:449-905,2152 had no analog)."""
+import os
+import time
+
+import pytest
+
+from cook_tpu.cluster.base import TaskSpec
+from cook_tpu.cluster.k8s import KubeCluster, PodPhase
+from cook_tpu.cluster.k8s_http import (
+    COOK_MANAGED_LABEL,
+    HttpKubeApi,
+    parse_cpu,
+    parse_mem,
+)
+from tests.fake_apiserver import make_server
+
+
+def wait_for(predicate, timeout=5.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture()
+def apiserver():
+    server, state, url = make_server()
+    yield state, url
+    server.shutdown()
+
+
+@pytest.fixture()
+def api(apiserver):
+    state, url = apiserver
+    api = HttpKubeApi(url, namespace="default", watch_timeout_s=5.0,
+                      relist_backoff_s=0.05)
+    yield api
+    api.stop()
+
+
+def spec(task_id="t1", node="n1", mem=512.0, cpus=2.0):
+    return TaskSpec(task_id=task_id, job_uuid="j1", user="alice",
+                    command="echo hi", mem=mem, cpus=cpus, gpus=0.0,
+                    node_id=node, hostname=node,
+                    env=(("FOO", "bar"),), container_image="img:1")
+
+
+def test_quantity_parsing():
+    assert parse_mem("512Mi") == 512.0
+    assert parse_mem("2Gi") == 2048.0
+    # unsuffixed memory is BYTES (the apiserver's normalized form)
+    assert parse_mem("1073741824") == 1024.0
+    assert parse_mem("1G") == pytest.approx(1e9 / (1024 * 1024))
+    assert parse_mem("1Pi") == 1024.0**3
+    assert parse_cpu("500m") == 0.5
+    assert parse_cpu("4") == 4.0
+
+
+def test_list_nodes_and_manifest_roundtrip(apiserver, api):
+    state, _ = apiserver
+    state.add_node("n1", 8192, 16, labels={"cook.scheduler/pool": "default",
+                                           "rack": "r1"})
+    [node] = api.list_nodes()
+    assert node.name == "n1" and node.mem == 8192 and node.cpus == 16
+    assert node.schedulable and dict(node.labels)["rack"] == "r1"
+
+
+def test_launch_builds_full_pod_manifest(apiserver, api):
+    state, _ = apiserver
+    state.add_node("n1", 8192, 16)
+    clock = lambda: 0
+    cluster = KubeCluster("k", api, clock)
+    cluster.launch_tasks("default", [spec()])
+    manifest = state.pods["t1"]
+    assert manifest["spec"]["nodeName"] == "n1"
+    [main] = [c for c in manifest["spec"]["containers"]
+              if c["name"] == "cook-job"]
+    assert main["image"] == "img:1"
+    assert main["command"] == ["/bin/sh", "-c", "echo hi"]
+    assert {"name": "FOO", "value": "bar"} in main["env"]
+    assert main["resources"]["requests"]["memory"] == "512Mi"
+    assert main["resources"]["requests"]["cpu"] == "2.0"
+    assert manifest["metadata"]["labels"][COOK_MANAGED_LABEL] == "true"
+
+
+def test_watch_drives_controller_to_success(apiserver, api):
+    state, _ = apiserver
+    state.add_node("n1", 8192, 16)
+    clock = lambda: 0
+    cluster = KubeCluster("k", api, clock)
+    statuses = []
+    cluster.status_callback = lambda tid, st, reason: statuses.append(
+        (tid, st.value, reason))
+    api.start()
+    cluster.launch_tasks("default", [spec()])
+    wait_for(lambda: "t1" in state.pods, what="pod created")
+    state.set_phase("t1", "Running")
+    wait_for(lambda: ("t1", "running", None) in statuses,
+             what="running status")
+    state.set_phase("t1", "Succeeded")
+    wait_for(lambda: ("t1", "success", "normal-exit") in statuses,
+             what="success status")
+    # the controller garbage-collects the completed pod via the api
+    wait_for(lambda: "t1" not in state.pods, what="pod deleted")
+
+
+def test_watch_gap_recovers_via_relist(apiserver, api):
+    """Events missed during a watch gap are reconstructed from a fresh
+    LIST diff (the api.clj:449 re-list branch): a pod that completed
+    while the watch was down still reaches the controller."""
+    state, _ = apiserver
+    state.add_node("n1", 8192, 16)
+    clock = lambda: 0
+    cluster = KubeCluster("k", api, clock)
+    statuses = []
+    cluster.status_callback = lambda tid, st, reason: statuses.append(
+        (tid, st.value))
+    api.start()
+    cluster.launch_tasks("default", [spec()])
+    state.set_phase("t1", "Running")
+    wait_for(lambda: ("t1", "running") in statuses, what="running")
+    # compact history + sever the stream, then mutate during the outage
+    state.inject_gap()
+    state.set_phase("t1", "Succeeded")
+    wait_for(lambda: ("t1", "success") in statuses, timeout=10,
+             what="success via re-list after 410")
+
+
+def test_pod_deleted_externally_is_mea_culpa(apiserver, api):
+    state, _ = apiserver
+    state.add_node("n1", 8192, 16)
+    cluster = KubeCluster("k", api, lambda: 0)
+    statuses = []
+    cluster.status_callback = lambda tid, st, reason: statuses.append(
+        (tid, st.value, reason))
+    api.start()
+    cluster.launch_tasks("default", [spec()])
+    state.set_phase("t1", "Running")
+    wait_for(lambda: ("t1", "running", None) in statuses, what="running")
+    state.delete_pod("t1")  # node drained / manual kubectl delete
+    wait_for(
+        lambda: ("t1", "failed", "could-not-reconstruct-state") in statuses,
+        what="mea-culpa failure")
+
+
+def test_bearer_token_refresh(apiserver, tmp_path):
+    state, url = apiserver
+    token_file = tmp_path / "token"
+    token_file.write_text("token-one")
+    api = HttpKubeApi(url, token_file=str(token_file))
+    api.list_nodes()
+    assert state.auth_headers[-1] == "Bearer token-one"
+    token_file.write_text("token-two")
+    # force an mtime change even on coarse-grained filesystems
+    os.utime(token_file, (time.time() + 2, time.time() + 2))
+    api.list_nodes()
+    assert state.auth_headers[-1] == "Bearer token-two"
+
+
+def test_synthesized_offers_over_http(apiserver, api):
+    state, _ = apiserver
+    state.add_node("n1", 8192, 16)
+    cluster = KubeCluster("k", api, lambda: 0)
+    cluster.launch_tasks("default", [spec(mem=2048, cpus=4)])
+    [offer] = cluster.pending_offers("default")
+    assert offer.mem == 8192 - 2048
+    assert offer.cpus == 16 - 4
+
+
+def test_full_process_schedules_onto_http_apiserver(apiserver):
+    """The whole service (build_process with a `k8s-http` cluster) places a
+    submitted job as a pod on the fake apiserver and completes it from
+    watch events — no FakeKubeApi anywhere in the path."""
+    from cook_tpu.components import (
+        build_process,
+        shutdown,
+        start_leader_duties,
+    )
+    from cook_tpu.models.entities import JobState
+    from cook_tpu.utils.config import Settings
+
+    state, url = apiserver
+    state.add_node("n1", 8192, 16)
+    settings = Settings(
+        rank_interval_s=3600, match_interval_s=3600,
+        clusters=[{"kind": "k8s-http", "name": "kprod", "url": url,
+                   "watch_timeout_s": 5}],
+    )
+    process = build_process(settings, start_rest=False)
+    try:
+        start_leader_duties(process, block=False, on_loss=lambda: None)
+        from tests.conftest import make_job
+
+        job = make_job(mem=512, cpus=2)
+        process.store.submit_jobs([job])
+        loops = {l.name: l for l in process.loops}
+        loops["rank"].fire()
+        loops["match"].fire()
+        wait_for(lambda: len(state.pods) == 1, what="pod on apiserver")
+        [name] = state.pods
+        state.set_phase(name, "Running")
+        wait_for(lambda: process.store.jobs[job.uuid].state
+                 == JobState.RUNNING, what="job running")
+        state.set_phase(name, "Succeeded")
+        wait_for(lambda: process.store.jobs[job.uuid].state
+                 == JobState.COMPLETED, what="job completed")
+    finally:
+        shutdown(process)
+        for cluster in process.clusters:
+            cluster.api.stop()
+
+
+def test_relist_prunes_stale_local_view(apiserver, api):
+    """A pod deleted during the gap disappears from the client's view and
+    the controller observes the deletion."""
+    state, _ = apiserver
+    state.add_node("n1", 8192, 16)
+    events = []
+    api.set_pod_watch(lambda name, pod: events.append(
+        (name, None if pod is None else pod.phase)))
+    api.start()
+    state.create_pod(api.pod_manifest(
+        __import__("cook_tpu.cluster.k8s", fromlist=["KubePod"]).KubePod(
+            name="p1", node_name="n1", mem=100, cpus=1)))
+    wait_for(lambda: ("p1", PodPhase.PENDING) in events, what="added")
+    state.inject_gap()
+    state.delete_pod("p1")
+    wait_for(lambda: ("p1", None) in events, timeout=10,
+             what="deletion via re-list")

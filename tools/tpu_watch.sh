@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe the accelerator until it answers, then run the tuning sweep.
+# The tunnel wedges when a client dies mid-session and the chip grant is
+# held server-side; it recovers asynchronously.  Probe in a subprocess
+# (in-process jax.devices() hangs unkillably), stagger 7 min apart.
+cd "$(dirname "$0")/.."
+while true; do
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date +%H:%M:%S) device healthy — starting sweep"
+    timeout 5400 python tools/tpu_sweep.py --out tpu_sweep.jsonl --repeats 3
+    echo "$(date +%H:%M:%S) sweep done rc=$?"
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) device unreachable; retrying in 7 min"
+  sleep 420
+done
