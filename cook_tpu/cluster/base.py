@@ -31,6 +31,36 @@ class Offer:
     attributes: tuple = ()       # ((key, value), ...) host attributes
     total_mem: float = 0.0       # capacity, for binpacking fitness
     total_cpus: float = 0.0
+    # free port ranges ((begin, end), ...) inclusive — Mesos-style offers
+    # carry port resources (mesos_mock.clj:162 range arithmetic)
+    ports: tuple = ()
+
+    def port_count(self) -> int:
+        return sum(e - b + 1 for b, e in self.ports)
+
+
+def subtract_ports(ranges: tuple, taken) -> tuple:
+    """Free (begin, end) ranges minus taken ports — interval arithmetic,
+    O(ranges + taken log taken), never iterating individual ports
+    (the range subtraction of mesos_mock.clj:184)."""
+    if not taken:
+        return tuple(ranges)
+    import bisect
+
+    taken_sorted = sorted(set(taken))
+    out = []
+    for begin, end in ranges:
+        cur = begin
+        i = bisect.bisect_left(taken_sorted, begin)
+        while i < len(taken_sorted) and taken_sorted[i] <= end:
+            p = taken_sorted[i]
+            if p > cur:
+                out.append((cur, p - 1))
+            cur = p + 1
+            i += 1
+        if cur <= end:
+            out.append((cur, end))
+    return tuple(out)
 
     def attr_dict(self) -> dict:
         return dict(self.attributes)
@@ -53,6 +83,9 @@ class TaskSpec:
     env: tuple = ()
     container_image: str = ""
     expected_runtime_ms: int = 0
+    # concrete ports assigned from the offer's ranges (mesos/task.clj
+    # port assignment; surfaced to the task as PORT0..PORTn env vars)
+    ports: tuple = ()
 
 
 class ClusterState(enum.Enum):

@@ -27,7 +27,7 @@ import threading
 from dataclasses import dataclass, replace
 from typing import Callable, Optional, Sequence
 
-from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
+from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec, subtract_ports
 from cook_tpu.models.entities import InstanceStatus
 
 
@@ -68,6 +68,8 @@ class KubePod:
     image: str = ""
     env: tuple = ()
     pool: str = ""
+    # host ports assigned to this pod (surfaced as hostPort entries)
+    ports: tuple = ()
 
 
 class KubeApi:
@@ -188,9 +190,14 @@ class ExpectedState(enum.Enum):
 class KubeCluster(ComputeCluster):
     def __init__(self, name: str, api: KubeApi, clock: Callable[[], int],
                  *, synthetic_pod_limits: Optional[dict] = None,
-                 file_server_port: int = 8000):
+                 file_server_port: int = 8000,
+                 host_port_range: tuple = (31000, 32767)):
         super().__init__(name)
         self.file_server_port = file_server_port
+        # offerable hostPort range per node (K8s has no port offers; jobs
+        # requesting ports get hostPorts from this window, mirroring the
+        # NodePort service range)
+        self.host_port_range = host_port_range
         self.api = api
         self.clock = clock
         self.expected: dict[str, ExpectedState] = {}
@@ -211,6 +218,7 @@ class KubeCluster(ComputeCluster):
         """Synthesize offers: capacity minus consumption per schedulable
         node (generate-offers)."""
         consumption: dict[str, list[float]] = {}
+        ports_taken: dict[str, set] = {}
         for pod in self.api.list_all_pods():
             if pod.phase in (PodPhase.PENDING, PodPhase.RUNNING,
                              PodPhase.UNKNOWN):
@@ -218,6 +226,9 @@ class KubeCluster(ComputeCluster):
                 c[0] += pod.mem
                 c[1] += pod.cpus
                 c[2] += pod.gpus
+                if pod.ports:
+                    ports_taken.setdefault(pod.node_name,
+                                           set()).update(pod.ports)
         offers = []
         for node in self.api.list_nodes():
             if not node.schedulable or node.pool != pool:
@@ -232,6 +243,8 @@ class KubeCluster(ComputeCluster):
                 attributes=node.labels,
                 total_mem=node.mem,
                 total_cpus=node.cpus,
+                ports=subtract_ports((self.host_port_range,),
+                                     ports_taken.get(node.name, ())),
             ))
         return offers
 
@@ -252,6 +265,7 @@ class KubeCluster(ComputeCluster):
                     image=spec.container_image,
                     env=tuple(spec.env),
                     pool=pool,
+                    ports=tuple(spec.ports),
                 ))
             except Exception:
                 self._report(spec.task_id, InstanceStatus.FAILED,

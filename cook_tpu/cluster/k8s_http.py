@@ -156,6 +156,7 @@ class HttpKubeApi(KubeApi):
         self.file_server_image = file_server_image
         self._watch_cb: Optional[Callable[[str, Optional[KubePod]], None]] = None
         self._known: dict[str, KubePod] = {}  # watch-maintained local view
+        self._synced = threading.Event()  # set after the first LIST
         self._stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
         self._lock = threading.RLock()
@@ -213,12 +214,16 @@ class HttpKubeApi(KubeApi):
         status = manifest.get("status", {})
         labels = meta.get("labels", {}) or {}
         mem = cpus = gpus = 0.0
+        host_ports = []
         for container in spec.get("containers", []):
             requests = container.get("resources", {}).get("requests", {})
             mem += parse_mem(requests.get("memory", 0))
             cpus += parse_cpu(requests.get("cpu", 0))
             gpus += parse_cpu(requests.get("nvidia.com/gpu", 0)
                               or requests.get("google.com/tpu", 0))
+            for port in container.get("ports", []) or []:
+                if port.get("hostPort"):
+                    host_ports.append(int(port["hostPort"]))
         try:
             phase = PodPhase(status.get("phase", "Pending"))
         except ValueError:
@@ -249,6 +254,7 @@ class HttpKubeApi(KubeApi):
             synthetic=labels.get(COOK_SYNTHETIC_LABEL) == "true",
             failure_reason=reason,
             pool=labels.get(COOK_POOL_LABEL, ""),
+            ports=tuple(host_ports),
         )
 
     @staticmethod
@@ -290,6 +296,8 @@ class HttpKubeApi(KubeApi):
             "image": pod.image or self.default_image,
             "command": ["/bin/sh", "-c", pod.command] if pod.command else [],
             "env": [{"name": k, "value": str(v)} for k, v in pod.env],
+            **({"ports": [{"containerPort": p, "hostPort": p}
+                          for p in pod.ports]} if pod.ports else {}),
             "resources": {
                 "requests": {
                     "memory": format_mem(pod.mem),
@@ -352,6 +360,12 @@ class HttpKubeApi(KubeApi):
                 for item in body.get("items", [])]
 
     def list_pods(self) -> list[KubePod]:
+        # the watch maintains a coherent local view; re-LISTing on every
+        # caller (reconcile, scan, autoscale, offer cycles) would hammer
+        # the apiserver for data the stream already delivers
+        if self._watch_thread is not None and self._synced.is_set():
+            with self._lock:
+                return list(self._known.values())
         body, _ = self._list_pods_raw()
         return body
 
@@ -402,6 +416,7 @@ class HttpKubeApi(KubeApi):
 
     def stop(self) -> None:
         self._stop.set()
+        self._synced.clear()
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=5)
             self._watch_thread = None
@@ -424,6 +439,7 @@ class HttpKubeApi(KubeApi):
             changed = [p for p in pods
                        if self._known.get(p.name) != p]
             self._known = fresh
+        self._synced.set()
         for name in gone:
             self._emit(name, None)
         for pod in changed:

@@ -12,7 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
-from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
+from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec, subtract_ports
 from cook_tpu.models.entities import InstanceStatus
 
 
@@ -26,6 +26,9 @@ class MockHost:
     disk: float = 0.0
     attributes: tuple = ()
     pool: str = "default"
+    # offerable port ranges ((begin, end), ...) inclusive — Mesos-style
+    # port resources (mesos_mock.clj:162)
+    ports: tuple = ()
 
 
 @dataclass
@@ -73,6 +76,17 @@ class MockCluster(ComputeCluster):
                 disk += rt.spec.disk
         return mem, cpus, gpus, disk
 
+    def _free_port_ranges(self, host: MockHost) -> tuple:
+        """Host ranges minus ports held by running tasks (the range
+        subtraction of mesos_mock.clj:184)."""
+        if not host.ports:
+            return ()
+        taken = set()
+        for rt in self.running.values():
+            if rt.spec.node_id == host.node_id:
+                taken.update(rt.spec.ports)
+        return subtract_ports(host.ports, taken)
+
     def pending_offers(self, pool: str) -> list[Offer]:
         offers = []
         for h in self.hosts.values():
@@ -90,6 +104,7 @@ class MockCluster(ComputeCluster):
                     attributes=h.attributes,
                     total_mem=h.mem,
                     total_cpus=h.cpus,
+                    ports=self._free_port_ranges(h),
                 )
             )
         return offers

@@ -58,8 +58,10 @@ def _mock_factory(conf: dict, clock) -> ComputeCluster:
             mem=float(h["mem"]),
             cpus=float(h["cpus"]),
             gpus=float(h.get("gpus", 0.0)),
+            disk=float(h.get("disk", 0.0)),
             pool=h.get("pool", "default"),
             attributes=tuple(sorted(h.get("attributes", {}).items())),
+            ports=tuple((int(b), int(e)) for b, e in h.get("ports", [])),
         )
         for h in conf.get("hosts", [])
     ]
@@ -401,3 +403,9 @@ def shutdown(process: CookProcess) -> None:
         process.selector.stop()
     if process.server is not None:
         process.server.stop()
+    # backend clients may own watch threads (HttpKubeApi): stop them or
+    # they keep mutating the torn-down store after failover
+    for cluster in process.clusters:
+        api_stop = getattr(getattr(cluster, "api", None), "stop", None)
+        if callable(api_stop):
+            api_stop()

@@ -58,6 +58,7 @@ def dec_resources(d: dict) -> Resources:
         mem=dec_float(d["mem"]), cpus=dec_float(d["cpus"]),
         gpus=dec_float(d["gpus"]), disk=dec_float(d.get("disk", 0.0)),
         ports=int(d.get("ports", 0)),
+        disk_type=d.get("disk_type", ""),
     )
 
 

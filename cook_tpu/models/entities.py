@@ -51,6 +51,9 @@ class Resources:
     gpus: float = 0.0
     disk: float = 0.0
     ports: int = 0
+    # requested disk type ("" = any); a typed request only matches hosts
+    # advertising that type (disk-host-constraint, constraints.clj:164)
+    disk_type: str = ""
 
     def __add__(self, other: "Resources") -> "Resources":
         return Resources(
@@ -291,6 +294,8 @@ def job_display(job: Job) -> dict[str, Any]:
         "cpus": job.resources.cpus,
         "gpus": job.resources.gpus,
         "disk": job.resources.disk,
+        "disk_type": job.resources.disk_type,
+        "ports": job.resources.ports,
         "labels": dict(job.labels),
         "env": dict(job.user_provided_env),
         "instances": list(job.instance_ids),

@@ -270,7 +270,12 @@ class CookApi:
             if not result.accepted:
                 return _err(400, result.message or "rejected by plugin")
             spec = self.plugins.modify_submission(spec, user, pool)
-            job, err = self._parse_job(spec, user, pool, groups)
+            try:
+                job, err = self._parse_job(spec, user, pool, groups)
+            except (ValueError, TypeError) as e:
+                # non-numeric mem/cpus/disk/ports etc.: a client error,
+                # not a server fault
+                job, err = None, f"malformed job field: {e}"
             if err:
                 return _err(400, err)
             jobs.append(job)
@@ -305,6 +310,18 @@ class CookApi:
             return None, f"cpus {cpus} out of range (0, {self.config.max_job_cpus}]"
         if gpus < 0 or gpus > self.config.max_job_gpus:
             return None, f"gpus {gpus} out of range [0, {self.config.max_job_gpus}]"
+        # disk: a bare number, or {"request": MiB, "type": "pd-ssd"}
+        # (disk-host-constraint, constraints.clj:164)
+        disk_spec = spec.get("disk", 0.0)
+        if isinstance(disk_spec, dict):
+            disk = float(disk_spec.get("request", 0.0))
+            disk_type = str(disk_spec.get("type", ""))
+        else:
+            disk = float(disk_spec)
+            disk_type = ""
+        ports = int(spec.get("ports", 0))
+        if not 0 <= ports <= 1000:
+            return None, f"ports {ports} out of range [0, 1000]"
         max_retries = int(spec.get("max_retries", 1))
         if not 0 < max_retries <= self.config.max_retries_limit:
             return None, f"max_retries {max_retries} out of range"
@@ -363,7 +380,8 @@ class CookApi:
             max_runtime_ms=int(spec.get("max_runtime", 2**62)),
             expected_runtime_ms=int(spec.get("expected_runtime", 0)),
             resources=Resources(mem=mem, cpus=cpus, gpus=gpus,
-                                disk=float(spec.get("disk", 0.0))),
+                                disk=disk, disk_type=disk_type,
+                                ports=ports),
             pool=pool,
             user_provided_env=tuple(sorted(spec.get("env", {}).items())),
             labels=tuple(sorted(spec.get("labels", {}).items())),

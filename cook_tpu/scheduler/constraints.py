@@ -91,6 +91,8 @@ def feasibility_mask(
     tasks_on_host: Optional[dict[str, int]] = None,
     max_tasks_per_host: int = 0,
     offer_locations: Optional[Sequence[str]] = None,
+    job_est_end_ms: Optional[np.ndarray] = None,
+    host_lifetime_mins: float = 0.0,
 ) -> np.ndarray:
     """Build the [J, N] mask.
 
@@ -111,6 +113,37 @@ def feasibility_mask(
     # non-gpu jobs never on gpu nodes.
     job_gpu = np.array([job.resources.gpus > 0 for job in jobs], dtype=bool)
     mask &= job_gpu[:, None] == nodes.has_gpus[None, :]
+
+    # disk type (disk-host-constraint, constraints.clj:164): a typed disk
+    # request only matches hosts advertising that "disk-type" attribute
+    # (space binpacking is the kernel's 4th resource column)
+    job_disk_type = [job.resources.disk_type for job in jobs]
+    if any(job_disk_type):
+        host_disk_type = np.array(
+            [dict(o.attributes).get("disk-type", "") for o in nodes.offers])
+        for ji, want in enumerate(job_disk_type):
+            if want:
+                mask[ji, :] &= host_disk_type == want
+
+    # port count: a job requesting N ports only fits offers carrying >= N
+    # free ports (mesos/task.clj port resources); concrete assignment
+    # happens post-solve in the matcher
+    job_ports = np.array([job.resources.ports for job in jobs])
+    if job_ports.any():
+        avail_ports = np.array([o.port_count() for o in nodes.offers])
+        mask &= job_ports[:, None] <= avail_ports[None, :]
+
+    # estimated completion vs host lifetime (constraints.clj:385): skip
+    # hosts expected to die before the job's estimated end; hosts without
+    # a "host-start-time" attribute (epoch seconds) always pass
+    if job_est_end_ms is not None and host_lifetime_mins > 0:
+        start_s = np.array(
+            [float(dict(o.attributes).get("host-start-time", -1))
+             for o in nodes.offers])
+        death_ms = start_s * 1000.0 + host_lifetime_mins * 60_000.0
+        no_estimate = job_est_end_ms < 0
+        mask &= (no_estimate[:, None] | (start_s < 0)[None, :]
+                 | (job_est_end_ms[:, None] < death_ms[None, :]))
 
     # max tasks per host
     if max_tasks_per_host and tasks_on_host:

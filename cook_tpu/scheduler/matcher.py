@@ -48,6 +48,11 @@ class MatchConfig:
     chunk: int = 0           # 0 = exact sequential greedy kernel
     chunk_rounds: int = 4
     chunk_passes: int = 2    # candidate recomputes per chunk
+    # estimated-completion constraint (constraints.clj:385 +
+    # estimated-completion-config): 0 multiplier or lifetime = disabled
+    completion_multiplier: float = 0.0
+    host_lifetime_mins: float = 0.0
+    agent_start_grace_mins: float = 10.0
 
 
 @dataclass
@@ -177,6 +182,64 @@ def gather_group_context(
     return groups, group_used_hosts, group_attr_value, group_balance_counts
 
 
+def _agent_removed_codes() -> frozenset:
+    from cook_tpu.models.reasons import REASONS_BY_NAME
+
+    return frozenset(
+        REASONS_BY_NAME[name].code
+        for name in ("node-removed", "could-not-reconstruct-state")
+        if name in REASONS_BY_NAME
+    )
+
+
+AGENT_REMOVED_CODES = _agent_removed_codes()
+
+
+def estimated_end_times(store: JobStore, jobs: Sequence[Job],
+                        config: MatchConfig) -> Optional[np.ndarray]:
+    """Per-job estimated completion time in epoch ms, -1 = no estimate
+    (build-estimated-completion-constraint, constraints.clj:410-432):
+    max of scaled expected runtime and the runtimes of instances that
+    died with the host (agent-removed analogs), capped at
+    host-lifetime - grace so a full-lifetime job can still start on a
+    fresh host."""
+    if not (config.completion_multiplier > 0
+            and config.host_lifetime_mins > 0):
+        return None
+    now_ms = store.clock()
+    cap_ms = (config.host_lifetime_mins
+              - config.agent_start_grace_mins) * 60_000.0
+    out = np.full(len(jobs), -1.0)
+    for ji, job in enumerate(jobs):
+        expected = (job.expected_runtime_ms * config.completion_multiplier
+                    if job.expected_runtime_ms else 0.0)
+        for inst in store.job_instances(job.uuid):
+            if (inst.status.terminal
+                    and inst.reason_code in AGENT_REMOVED_CODES
+                    and inst.end_time_ms > inst.start_time_ms):
+                expected = max(expected,
+                               inst.end_time_ms - inst.start_time_ms)
+        if expected > 0:
+            out[ji] = now_ms + min(expected, cap_ms)
+    return out
+
+
+def assign_ports(offer, used: set, count: int) -> Optional[tuple]:
+    """Pick `count` concrete ports from the offer's free ranges, skipping
+    ports already taken this cycle (mesos/task.clj port assignment)."""
+    if count <= 0:
+        return ()
+    picked = []
+    for begin, end in offer.ports:
+        for port in range(begin, end + 1):
+            if port in used:
+                continue
+            picked.append(port)
+            if len(picked) == count:
+                return tuple(picked)
+    return None
+
+
 def previous_failed_hosts(store: JobStore, jobs: Sequence[Job]) -> dict[str, set[str]]:
     """novel-host constraint input: hosts each job already failed on."""
     out: dict[str, set[str]] = {}
@@ -267,6 +330,8 @@ def prepare_pool_problem(
         group_balance_counts=prepared.group_balance_counts,
         groups=prepared.groups,
         offer_locations=[c.location for c, _ in prepared.cluster_offers],
+        job_est_end_ms=estimated_end_times(store, considerable, config),
+        host_lifetime_mins=config.host_lifetime_mins,
     )
     if host_reservations:
         # rebalancer reservations (constraints.clj:242 + reserve-hosts!,
@@ -319,6 +384,9 @@ def finalize_pool_match(
     # per-cluster launch budgets this cycle (max-launchable +
     # filter-matches-for-ratelimit, scheduler.clj:887)
     cluster_budget: dict[str, int] = {}
+    # ports handed out this cycle, per node (the mask guaranteed counts
+    # against the offer; concrete picks must not collide intra-cycle)
+    ports_used: dict[int, set] = {}
     for ji, job in enumerate(considerable):
         node_idx = int(assignment[ji])
         if node_idx < 0:
@@ -327,6 +395,16 @@ def finalize_pool_match(
                 record_placement_failure(job, _failure_reason(job, nodes, feasible[ji]))
             continue
         cluster, offer = cluster_offers[node_idx]
+        task_ports = assign_ports(offer, ports_used.setdefault(node_idx, set()),
+                                  job.resources.ports)
+        if task_ports is None:
+            # earlier matches this cycle exhausted the offer's ports
+            outcome.unmatched.append(job)
+            if record_placement_failure is not None:
+                record_placement_failure(
+                    job, "insufficient free ports on the matched node")
+            continue
+        ports_used[node_idx].update(task_ports)
         budget = cluster_budget.get(cluster.name)
         if budget is None:
             budget = cluster.max_launchable()
@@ -357,9 +435,11 @@ def finalize_pool_match(
             node_id=offer.node_id,
             hostname=offer.hostname,
             disk=job.resources.disk,
-            env=job.user_provided_env,
+            env=job.user_provided_env + tuple(
+                (f"PORT{i}", str(p)) for i, p in enumerate(task_ports)),
             container_image=(job.container.image if job.container else ""),
             expected_runtime_ms=job.expected_runtime_ms,
+            ports=task_ports,
         )
         launches_per_cluster.setdefault(cluster.name, []).append(spec)
         cluster_by_name[cluster.name] = cluster

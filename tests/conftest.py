@@ -52,14 +52,14 @@ def store(clock):
 
 
 def make_job(user="alice", pool="default", mem=100.0, cpus=1.0, gpus=0.0,
-             priority=50, max_retries=1, **kw) -> Job:
+             priority=50, max_retries=1, resources=None, **kw) -> Job:
     return Job(
         uuid=new_uuid(),
         user=user,
         pool=pool,
         priority=priority,
         max_retries=max_retries,
-        resources=Resources(mem=mem, cpus=cpus, gpus=gpus),
+        resources=resources or Resources(mem=mem, cpus=cpus, gpus=gpus),
         command="true",
         **kw,
     )

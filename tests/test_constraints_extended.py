@@ -1,0 +1,185 @@
+"""Disk-type, estimated-completion, and port-resource constraints
+(reference: constraints.clj:164 disk, :385 estimated completion;
+mesos/task.clj + mesos_mock.clj:162 port resources)."""
+import numpy as np
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import JobState, Pool, Resources
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.scheduler.matcher import MatchConfig
+from tests.conftest import FakeClock, make_job
+
+
+def setup(hosts, match=None):
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster("m", hosts, clock=clock)
+    config = SchedulerConfig(match=match or MatchConfig())
+    return clock, store, cluster, Scheduler(store, [cluster], config)
+
+
+def cycle(scheduler, store):
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    return scheduler.match_cycle(pool)
+
+
+# ------------------------------------------------------------------ disk
+
+
+def test_typed_disk_request_only_matches_advertising_hosts():
+    clock, store, cluster, sched = setup([
+        MockHost(node_id="std", hostname="std", mem=8000, cpus=32,
+                 disk=10_000, attributes=(("disk-type", "standard"),)),
+        MockHost(node_id="ssd", hostname="ssd", mem=8000, cpus=32,
+                 disk=10_000, attributes=(("disk-type", "pd-ssd"),)),
+    ])
+    job = make_job(mem=100, cpus=1,
+                   resources=Resources(mem=100, cpus=1, disk=500,
+                                       disk_type="pd-ssd"))
+    store.submit_jobs([job])
+    outcome = cycle(sched, store)
+    [(j, offer)] = outcome.matched
+    assert offer.hostname == "ssd"
+
+
+def test_disk_space_binpacked_as_fourth_resource():
+    clock, store, cluster, sched = setup([
+        MockHost(node_id="small", hostname="small", mem=8000, cpus=32,
+                 disk=100),
+        MockHost(node_id="big", hostname="big", mem=8000, cpus=32,
+                 disk=5000),
+    ])
+    job = make_job(mem=100, cpus=1,
+                   resources=Resources(mem=100, cpus=1, disk=800))
+    store.submit_jobs([job])
+    outcome = cycle(sched, store)
+    [(j, offer)] = outcome.matched
+    assert offer.hostname == "big"
+
+
+def test_typed_disk_unsatisfiable_stays_pending():
+    clock, store, cluster, sched = setup([
+        MockHost(node_id="std", hostname="std", mem=8000, cpus=32,
+                 disk=10_000, attributes=(("disk-type", "standard"),)),
+    ])
+    job = make_job(mem=100, cpus=1,
+                   resources=Resources(mem=100, cpus=1, disk=500,
+                                       disk_type="pd-ssd"))
+    store.submit_jobs([job])
+    outcome = cycle(sched, store)
+    assert not outcome.matched and outcome.unmatched
+
+
+# ------------------------------------------- estimated completion
+
+
+def est_config():
+    return MatchConfig(completion_multiplier=1.5, host_lifetime_mins=60,
+                       agent_start_grace_mins=10)
+
+
+def test_estimated_completion_avoids_dying_hosts():
+    """A job expected to run 30 min (x1.5 = 45 min) must skip a host that
+    dies in 20 min but may take one that dies in 50."""
+    clock, store, cluster, sched = setup([
+        # started 40 min ago -> dies in 20 min
+        MockHost(node_id="old", hostname="old", mem=8000, cpus=32,
+                 attributes=(("host-start-time", str(10_000_000 - 40 * 60)),)),
+        # started 10 min ago -> dies in 50 min
+        MockHost(node_id="fresh", hostname="fresh", mem=8000, cpus=32,
+                 attributes=(("host-start-time", str(10_000_000 - 10 * 60)),)),
+    ], match=est_config())
+    clock.now_ms = 10_000_000_000  # epoch 1e7 s
+    job = make_job(mem=100, cpus=1, expected_runtime_ms=30 * 60_000)
+    store.submit_jobs([job])
+    outcome = cycle(sched, store)
+    [(j, offer)] = outcome.matched
+    assert offer.hostname == "fresh"
+
+
+def test_estimated_completion_ignores_hosts_without_start_time():
+    clock, store, cluster, sched = setup([
+        MockHost(node_id="h", hostname="h", mem=8000, cpus=32),
+    ], match=est_config())
+    job = make_job(mem=100, cpus=1, expected_runtime_ms=10**9)
+    store.submit_jobs([job])
+    assert cycle(sched, store).matched
+
+
+def test_estimated_completion_counts_agent_removed_runtimes():
+    """A job with no expected runtime whose previous instance died with
+    the host after 45 min inherits that runtime as its estimate."""
+    clock, store, cluster, sched = setup([
+        MockHost(node_id="old", hostname="old", mem=8000, cpus=32,
+                 attributes=(("host-start-time", str(10_000_000 - 40 * 60)),)),
+        MockHost(node_id="fresh", hostname="fresh", mem=8000, cpus=32,
+                 attributes=(("host-start-time", str(10_000_000 - 10 * 60)),)),
+    ], match=est_config())
+    from cook_tpu.models.entities import InstanceStatus
+
+    job = make_job(mem=100, cpus=1, max_retries=3)
+    store.submit_jobs([job])
+    clock.now_ms = 0
+    store.create_instance(job.uuid, "t-prev", hostname="gone",
+                          node_id="gone", compute_cluster="m")
+    clock.now_ms = 45 * 60_000
+    store.update_instance_state("t-prev", InstanceStatus.FAILED,
+                                "node-removed")
+    clock.now_ms = 10_000_000_000
+    outcome = cycle(sched, store)
+    [(j, offer)] = outcome.matched
+    assert offer.hostname == "fresh"
+
+
+# ------------------------------------------------------------------ ports
+
+
+def test_port_assignment_and_release():
+    clock, store, cluster, sched = setup([
+        MockHost(node_id="h", hostname="h", mem=8000, cpus=32,
+                 ports=((31000, 31002),)),
+    ])
+    jobs = [make_job(mem=100, cpus=1,
+                     resources=Resources(mem=100, cpus=1, ports=2),
+                     expected_runtime_ms=60_000)
+            for _ in range(2)]
+    store.submit_jobs(jobs)
+    outcome = cycle(sched, store)
+    # 3 free ports: the first 2-port job fits, the second must wait
+    assert len(outcome.matched) == 1
+    assert len(outcome.unmatched) == 1
+    [rt] = cluster.running.values()
+    assert len(rt.spec.ports) == 2
+    assert set(rt.spec.ports) <= {31000, 31001, 31002}
+    env = dict(rt.spec.env)
+    assert env["PORT0"] == str(rt.spec.ports[0])
+    assert env["PORT1"] == str(rt.spec.ports[1])
+    # offer shrank to the single leftover port
+    [offer] = cluster.pending_offers("default")
+    assert offer.port_count() == 1
+    # completion releases the ports; the waiting job then fits
+    clock.now_ms += 120_000
+    cluster.advance_to(clock.now_ms)
+    outcome2 = cycle(sched, store)
+    assert len(outcome2.matched) == 1
+
+
+def test_intra_cycle_port_collision_avoided():
+    """Two port jobs matched to the same node in ONE cycle get disjoint
+    ports (the mask admits both; the post-solve assigner must not
+    double-book)."""
+    clock, store, cluster, sched = setup([
+        MockHost(node_id="h", hostname="h", mem=8000, cpus=32,
+                 ports=((31000, 31003),)),
+    ])
+    jobs = [make_job(mem=100, cpus=1,
+                     resources=Resources(mem=100, cpus=1, ports=2))
+            for _ in range(2)]
+    store.submit_jobs(jobs)
+    outcome = cycle(sched, store)
+    assert len(outcome.matched) == 2
+    all_ports = [p for rt in cluster.running.values() for p in rt.spec.ports]
+    assert len(all_ports) == len(set(all_ports)) == 4

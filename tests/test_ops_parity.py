@@ -114,27 +114,62 @@ def test_greedy_match_exact_parity(seed):
                                rtol=1e-4, atol=1e-3)
 
 
-@pytest.mark.parametrize("seed", range(3))
-def test_chunked_match_near_parity(seed):
-    rng = np.random.default_rng(200 + seed)
-    demands, avail, totals, feasible = random_match_problem(rng, j=256, n=64)
-    j, n = feasible.shape
+def _assert_chunked_parity(demands, avail, totals, feasible, *,
+                           chunk=64, bar=0.99):
+    """Chunked vs exact greedy: no oversubscription, and >= `bar` of the
+    exact packing on jobs placed AND on each resource dimension (the
+    project target is >=0.99, BASELINE.json 'Fenzo packing efficiency')."""
+    j, n = demands.shape[0], avail.shape[0]
     problem = MatchProblem(
         demands=jnp.asarray(demands),
         job_valid=jnp.ones(j, dtype=bool),
         avail=jnp.asarray(avail),
         totals=jnp.asarray(totals),
         node_valid=jnp.ones(n, dtype=bool),
-        feasible=jnp.asarray(feasible),
+        feasible=jnp.asarray(feasible) if feasible is not None else None,
     )
     exact = greedy_match(problem)
-    fast = chunked_match(problem, chunk=64)
+    fast = chunked_match(problem, chunk=chunk)
     q_exact = ref.packing_quality(demands, np.asarray(exact.assignment))
     q_fast = ref.packing_quality(demands, np.asarray(fast.assignment))
-    # chunked must never oversubscribe
-    assert np.all(np.asarray(fast.new_avail) >= -1e-6)
-    # and must place ~the same amount of work (>= 95% on these configs)
-    assert q_fast["num_placed"] >= 0.95 * q_exact["num_placed"]
+    assert np.all(np.asarray(fast.new_avail) >= -1e-3)
+    assert q_fast["num_placed"] >= bar * q_exact["num_placed"]
+    assert q_fast["cpus_placed"] >= bar * q_exact["cpus_placed"]
+    assert q_fast["mem_placed"] >= bar * q_exact["mem_placed"]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_chunked_match_near_parity(seed):
+    rng = np.random.default_rng(200 + seed)
+    demands, avail, totals, feasible = random_match_problem(rng, j=256, n=64)
+    _assert_chunked_parity(demands, avail, totals, feasible)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_chunked_match_parity_skewed_demands(seed):
+    """Zipf-ish job sizes: a few huge jobs among many tiny ones stress the
+    candidate-truncation and prefix-accept paths."""
+    rng = np.random.default_rng(400 + seed)
+    j, n = 256, 64
+    base = rng.choice([16, 64, 256, 1024, 4096], j,
+                      p=[0.4, 0.3, 0.15, 0.1, 0.05]).astype(float)
+    demands = np.stack([base, np.maximum(base / 256, 0.25), np.zeros(j)],
+                       axis=-1)
+    totals = np.stack([np.full(n, 8192.0), np.full(n, 32.0)], axis=-1)
+    avail = np.concatenate([totals * rng.uniform(0.2, 1.0, (n, 1)),
+                            np.zeros((n, 1))], axis=-1)
+    _assert_chunked_parity(demands, avail, totals, None)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_chunked_match_parity_few_feasible_nodes(seed):
+    """Each job feasible on only ~3 nodes (tight constraints): contention
+    concentrates on few nodes and candidate lists carry mostly -BIG."""
+    rng = np.random.default_rng(500 + seed)
+    demands, avail, totals, _ = random_match_problem(rng, j=256, n=64)
+    feasible = rng.uniform(size=(256, 64)) < 0.05
+    feasible[np.arange(256), rng.integers(0, 64, 256)] = True
+    _assert_chunked_parity(demands, avail, totals, feasible)
 
 
 def test_match_respects_validity_masks():

@@ -8,8 +8,9 @@ while true; do
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date +%H:%M:%S) device healthy — starting sweep"
     timeout 5400 python tools/tpu_sweep.py --out tpu_sweep.jsonl --repeats 3
-    echo "$(date +%H:%M:%S) sweep done rc=$?"
-    exit 0
+    rc=$?
+    echo "$(date +%H:%M:%S) sweep done rc=$rc"
+    exit $rc
   fi
   echo "$(date +%H:%M:%S) device unreachable; retrying in 7 min"
   sleep 420
