@@ -169,6 +169,11 @@ class ComputeCluster(abc.ABC):
         self.location = location
         self.state = ClusterState.RUNNING
         self.kill_lock = KillLock()
+        # per-cluster launch token bucket (launch-rate-limiter,
+        # rate_limit.clj:44 + compute_cluster.clj); None = unlimited.
+        # The matcher caps each cycle's launches on this cluster at the
+        # bucket's balance and spends through it.
+        self.launch_rate_limiter = None
 
     # --- offers ---
     @abc.abstractmethod

@@ -43,8 +43,28 @@ CLUSTER_FACTORIES: dict[str, Callable[[dict, Callable[[], int]], ComputeCluster]
 
 
 def register_cluster_factory(kind: str):
+    """Factories also attach the per-cluster launch rate limiter
+    (launch-rate-limiter, rate_limit.clj:44) from the cluster config:
+    {"launch_rate_per_minute": N, "launch_burst": M} — applies to every
+    cluster kind, static or REST-created."""
+
     def deco(fn):
-        CLUSTER_FACTORIES[kind] = fn
+        def wrapped(conf: dict, clock) -> ComputeCluster:
+            cluster = fn(conf, clock)
+            rate = float(conf.get("launch_rate_per_minute", 0) or 0)
+            if rate > 0:
+                from cook_tpu.scheduler.ratelimit import (
+                    TokenBucketRateLimiter,
+                )
+
+                cluster.launch_rate_limiter = TokenBucketRateLimiter(
+                    tokens_replenished_per_minute=rate,
+                    bucket_size=float(conf.get("launch_burst", rate)),
+                    clock=clock,
+                )
+            return cluster
+
+        CLUSTER_FACTORIES[kind] = wrapped
         return fn
     return deco
 
@@ -210,11 +230,15 @@ def build_process(
         clusters,
         SchedulerConfig(match=settings.match, rebalancer=settings.rebalancer),
     )
+    from cook_tpu.rest.auth import authenticator_from_config
+
     api = CookApi(store, scheduler, ApiConfig(
         default_pool=settings.default_pool,
         admins=settings.admins,
         submission_rate_per_minute=settings.submission_rate_per_minute,
         cors_origins=settings.cors_origins,
+        authenticator=(authenticator_from_config(settings.auth)
+                       if settings.auth else None),
     ))
     api.queue_limits.limits.per_pool = settings.queue_limit_per_pool
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user

@@ -16,7 +16,6 @@ honored for configured admins (rest/impersonation.clj).
 """
 from __future__ import annotations
 
-import base64
 import json
 import re
 import statistics
@@ -71,17 +70,9 @@ class ApiConfig:
     # request Origin with Allow-Credentials would let any website issue
     # credentialed requests.
     cors_origins: tuple = ()
-
-
-def _parse_user(request: web.Request) -> str:
-    auth = request.headers.get("Authorization", "")
-    if auth.startswith("Basic "):
-        try:
-            decoded = base64.b64decode(auth[6:]).decode()
-            return decoded.split(":", 1)[0]
-        except Exception:
-            pass
-    return request.headers.get("X-Cook-Requesting-User", "anonymous")
+    # injectable request authenticator (rest/auth.py); None = the
+    # permissive dev stack (basic auth, then dev header, then anonymous)
+    authenticator: object = None
 
 
 class CookApi:
@@ -105,6 +96,12 @@ class CookApi:
             )
         else:
             self.submission_limiter = UnlimitedRateLimiter()
+        if self.config.authenticator is not None:
+            self.authenticator = self.config.authenticator
+        else:
+            from cook_tpu.rest.auth import dev_default_authenticator
+
+            self.authenticator = dev_default_authenticator()
         self.leader = True
         self.leader_url = ""  # set on standbys for leader proxying
 
@@ -193,7 +190,16 @@ class CookApi:
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
-        user = _parse_user(request)
+        # pluggable authenticator stack (components.clj:267-284: spnego /
+        # basic / dev one-user, with impersonation wrapping the winner)
+        authenticator = self.authenticator
+        user = authenticator.authenticate(request)
+        if user is None:
+            response = _err(401, "authentication required")
+            for key, value in authenticator.challenge().items():
+                response.headers[key] = value
+            self._apply_cors(request, response)
+            return response
         impersonate = request.headers.get("X-Cook-Impersonate")
         if impersonate:
             if user not in self.config.admins:
@@ -505,7 +511,13 @@ class CookApi:
         if self.scheduler is not None:
             cluster = self.scheduler.cluster_by_name(inst.compute_cluster)
             if cluster is not None:
-                url = cluster.retrieve_sandbox_url_path(inst.task_id)
+                # FileUrlGenerator seam (plugins/definitions.clj:56):
+                # deployments may front sandbox access with their own
+                # file service instead of the backend's sidecar URL
+                url = self.plugins.sandbox_url(
+                    inst,
+                    lambda: cluster.retrieve_sandbox_url_path(inst.task_id),
+                )
                 if url:
                     d["output_url"] = url
         return d
@@ -616,6 +628,8 @@ class CookApi:
         return web.json_response(_res_json(share))
 
     async def post_share(self, request: web.Request) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "only admins may modify shares")
         body = await request.json()
         user = body.get("user")
         pool = body.get("pool", self.config.default_pool)
@@ -635,6 +649,8 @@ class CookApi:
                                  status=201)
 
     async def delete_share(self, request: web.Request) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "only admins may modify shares")
         user = request.query.get("user")
         pool = request.query.get("pool", self.config.default_pool)
         self.store.retract_share(user, pool)
@@ -651,6 +667,8 @@ class CookApi:
         return web.json_response(d)
 
     async def post_quota(self, request: web.Request) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "only admins may modify quotas")
         body = await request.json()
         user = body.get("user")
         pool = body.get("pool", self.config.default_pool)
@@ -671,6 +689,8 @@ class CookApi:
         return web.json_response({"user": user, "pool": pool}, status=201)
 
     async def delete_quota(self, request: web.Request) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "only admins may modify quotas")
         user = request.query.get("user")
         pool = request.query.get("pool", self.config.default_pool)
         self.store.retract_quota(user, pool)

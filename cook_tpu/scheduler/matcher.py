@@ -16,6 +16,7 @@ with the Fenzo solve replaced by the `ops.match` kernels, plus:
 """
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
@@ -395,6 +396,18 @@ def finalize_pool_match(
                 record_placement_failure(job, _failure_reason(job, nodes, feasible[ji]))
             continue
         cluster, offer = cluster_offers[node_idx]
+        budget = cluster_budget.get(cluster.name)
+        if budget is None:
+            budget = cluster.max_launchable()
+            # per-cluster launch rate limiter (rate_limit.clj:44): this
+            # cycle may launch at most the bucket's current balance here
+            limiter = getattr(cluster, "launch_rate_limiter", None)
+            tokens_available = getattr(limiter, "tokens_available", None)
+            if tokens_available is not None:
+                tokens = tokens_available(cluster.name)
+                # inf = unenforced bucket / unlimited null object
+                if math.isfinite(tokens):
+                    budget = min(budget, int(tokens))
         task_ports = assign_ports(offer, ports_used.setdefault(node_idx, set()),
                                   job.resources.ports)
         if task_ports is None:
@@ -405,11 +418,11 @@ def finalize_pool_match(
                     job, "insufficient free ports on the matched node")
             continue
         ports_used[node_idx].update(task_ports)
-        budget = cluster_budget.get(cluster.name)
-        if budget is None:
-            budget = cluster.max_launchable()
         if budget <= 0:
             outcome.unmatched.append(job)  # over the cluster's launch cap
+            if record_placement_failure is not None:
+                record_placement_failure(
+                    job, "cluster launch rate/cap reached this cycle")
             continue
         cluster_budget[cluster.name] = budget - 1
         task_id = make_task_id(job)
@@ -448,6 +461,10 @@ def finalize_pool_match(
 
     for cname, specs in launches_per_cluster.items():
         cluster = cluster_by_name[cname]
+        limiter = getattr(cluster, "launch_rate_limiter", None)
+        if limiter is not None:
+            # spend-through: charge the work that is about to happen
+            limiter.spend(cname, float(len(specs)))
         # read side of the kill-lock: kills can't interleave mid-launch
         with cluster.kill_lock.read():
             cluster.launch_tasks(pool.name, specs)

@@ -62,6 +62,15 @@ class JobRouter(Protocol):
     def route_pool(self, job_spec: dict) -> str: ...
 
 
+@runtime_checkable
+class FileUrlGenerator(Protocol):
+    """Generates the sandbox-file URL surfaced to clients for one
+    instance (reference: FileUrlGenerator, plugins/definitions.clj:56 —
+    deployments front sandbox access with their own file service)."""
+
+    def file_url(self, instance) -> str: ...
+
+
 class AttributePoolSelector:
     """Default pool selection: an explicit `pool` field, else the default
     (reference plugins/pool.clj attribute-pool-selector)."""
@@ -91,6 +100,15 @@ class PluginRegistry:
     pool_selector: Any = field(default_factory=AttributePoolSelector)
     job_adjusters: list = field(default_factory=list)
     job_routers: list = field(default_factory=list)
+    # None = the backend's own sandbox URL (retrieve_sandbox_url_path)
+    file_url_generator: Any = None
+
+    def sandbox_url(self, instance, default_fn) -> str:
+        """Sandbox file URL for an instance: the FileUrlGenerator plugin
+        when configured, else the backend default."""
+        if self.file_url_generator is not None:
+            return self.file_url_generator.file_url(instance)
+        return default_fn()
 
     def validate_submission(self, job_spec: dict, user: str, pool: str
                             ) -> PluginResult:

@@ -61,6 +61,14 @@ class TokenBucketRateLimiter:
         with self._lock:
             self._refill(key).tokens -= amount
 
+    def tokens_available(self, key: Hashable) -> float:
+        """Current balance (refilled): lets a caller budget a batch of
+        work up front (the matcher's per-cluster launch cap)."""
+        if not self.enforce:
+            return float("inf")
+        with self._lock:
+            return self._refill(key).tokens
+
     def try_spend(self, key: Hashable, amount: float = 1.0) -> bool:
         """allowed? + spend! in one step (submission path)."""
         if not self.enforce:

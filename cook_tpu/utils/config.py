@@ -55,6 +55,9 @@ class Settings:
     queue_limit_per_user: int = 100_000
     submission_rate_per_minute: float = 0.0
     cors_origins: tuple = ()  # exact strings or regexes; empty = no CORS
+    # authenticator config ({"kind": "dev"|"basic"|"spnego"|"composite"});
+    # empty = the permissive dev stack (rest/auth.py)
+    auth: dict = field(default_factory=dict)
 
     def match_config_for_pool(self, pool_name: str) -> MatchConfig:
         for ps in self.pool_schedulers:
@@ -97,6 +100,8 @@ def read_config(path: Optional[str] = None,
         settings.admins = tuple(data["admins"])
     if "cors_origins" in data:
         settings.cors_origins = tuple(data["cors_origins"])
+    if "auth" in data:
+        settings.auth = dict(data["auth"])
     if "pools" in data:
         settings.pools = data["pools"]
     if "clusters" in data:

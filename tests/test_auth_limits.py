@@ -1,0 +1,238 @@
+"""Pluggable auth (SPNEGO seam), per-cluster launch rate limiter,
+FileUrlGenerator seam, and admin negative paths across every gated route
+(reference: rest/spnego.clj, rate_limit.clj:44, plugins/definitions.clj:56,
+rest/authorization.clj)."""
+import base64
+
+import pytest
+import requests
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.auth import (
+    BasicAuthenticator,
+    CompositeAuthenticator,
+    DevHeaderAuthenticator,
+    SpnegoAuthenticator,
+    authenticator_from_config,
+)
+from cook_tpu.scheduler.core import Scheduler
+from cook_tpu.scheduler.ratelimit import TokenBucketRateLimiter
+from tests.conftest import FakeClock, make_job
+
+
+# ------------------------------------------------------------ unit level
+
+
+def make_request(headers=None):
+    """A minimal request stand-in: authenticators only read .headers."""
+    class R:
+        pass
+
+    r = R()
+    r.headers = headers or {}
+    return r
+
+
+def test_basic_authenticator_verify_rejects_bad_password():
+    auth = BasicAuthenticator(verify=lambda u, p: p == "sekrit")
+    ok = base64.b64encode(b"alice:sekrit").decode()
+    bad = base64.b64encode(b"alice:nope").decode()
+    assert auth.authenticate(
+        make_request({"Authorization": f"Basic {ok}"})) == "alice"
+    assert auth.authenticate(
+        make_request({"Authorization": f"Basic {bad}"})) is None
+    assert auth.authenticate(make_request({})) is None
+    assert "WWW-Authenticate" in auth.challenge()
+
+
+def test_spnego_authenticator_flow():
+    def gss_accept(token: bytes):
+        return "alice/host@EXAMPLE.COM" if token == b"valid" else None
+
+    auth = SpnegoAuthenticator(gss_accept=gss_accept)
+    good = base64.b64encode(b"valid").decode()
+    bad = base64.b64encode(b"forged").decode()
+    # principal's primary component becomes the user
+    assert auth.authenticate(
+        make_request({"Authorization": f"Negotiate {good}"})) == "alice"
+    assert auth.authenticate(
+        make_request({"Authorization": f"Negotiate {bad}"})) is None
+    assert auth.authenticate(make_request({})) is None
+    assert auth.authenticate(
+        make_request({"Authorization": "Negotiate !!!notb64"})) is None
+    assert auth.challenge() == {"WWW-Authenticate": "Negotiate"}
+
+
+def test_spnego_closed_by_default():
+    """No GSS acceptor configured -> nobody authenticates (closed, not
+    open, when the KDC plumbing is missing)."""
+    auth = SpnegoAuthenticator()
+    token = base64.b64encode(b"anything").decode()
+    assert auth.authenticate(
+        make_request({"Authorization": f"Negotiate {token}"})) is None
+
+
+def test_composite_merges_challenges():
+    auth = CompositeAuthenticator([SpnegoAuthenticator(),
+                                   BasicAuthenticator()])
+    challenge = auth.challenge()
+    # later members override: basic wins the header slot, but both kinds
+    # were consulted for authentication
+    assert "WWW-Authenticate" in challenge
+    assert auth.authenticate(make_request({})) is None
+
+
+def test_authenticator_from_config():
+    assert isinstance(authenticator_from_config({"kind": "spnego"}),
+                      SpnegoAuthenticator)
+    assert isinstance(authenticator_from_config({"kind": "basic"}),
+                      BasicAuthenticator)
+    dev = authenticator_from_config({"kind": "dev"})
+    assert dev.authenticate(make_request({})) == "anonymous"
+    with pytest.raises(ValueError):
+        authenticator_from_config({"kind": "ldap"})
+
+
+# ----------------------------------------------------------- HTTP level
+
+
+@pytest.fixture()
+def store():
+    store = JobStore(clock=FakeClock())
+    store.set_pool(Pool(name="default"))
+    return store
+
+
+def serve(api: CookApi):
+    from cook_tpu.rest.server import ServerThread
+
+    return ServerThread(api).start()
+
+
+def test_spnego_http_401_challenge_and_success(store):
+    def gss_accept(token: bytes):
+        return "alice@EXAMPLE.COM" if token == b"tkt" else None
+
+    api = CookApi(store, config=ApiConfig(
+        authenticator=SpnegoAuthenticator(gss_accept=gss_accept)))
+    srv = serve(api)
+    try:
+        resp = requests.get(f"{srv.url}/pools")
+        assert resp.status_code == 401
+        assert resp.headers["WWW-Authenticate"] == "Negotiate"
+        # dev header is NOT honored under spnego-only auth
+        resp = requests.get(f"{srv.url}/pools",
+                            headers={"X-Cook-Requesting-User": "mallory"})
+        assert resp.status_code == 401
+        token = base64.b64encode(b"tkt").decode()
+        resp = requests.get(
+            f"{srv.url}/pools",
+            headers={"Authorization": f"Negotiate {token}"})
+        assert resp.status_code == 200
+    finally:
+        srv.stop()
+
+
+ADMIN_GATED = [
+    ("POST", "/compute-clusters", {"name": "x", "kind": "mock"}),
+    ("DELETE", "/compute-clusters/m", None),
+    ("POST", "/incremental-config", {"x": 1}),
+    ("POST", "/shutdown-leader", None),
+    ("POST", "/share", {"user": "bob", "share": {"mem": 1}}),
+    ("DELETE", "/share?user=bob", None),
+    ("POST", "/quota", {"user": "bob", "quota": {"mem": 1}}),
+    ("DELETE", "/quota?user=bob", None),
+]
+
+
+def test_admin_gated_routes(store):
+    """EVERY admin-gated route 403s for a non-admin and admits an admin
+    (the reference's is-authorized? checks, rest/authorization.clj)."""
+    api = CookApi(store)
+    srv = serve(api)
+    try:
+        for method, path, body in ADMIN_GATED:
+            resp = requests.request(
+                method, f"{srv.url}{path}", json=body,
+                headers={"X-Cook-Requesting-User": "mallory"})
+            assert resp.status_code == 403, f"{method} {path} as mallory"
+        for method, path, body in ADMIN_GATED:
+            resp = requests.request(
+                method, f"{srv.url}{path}", json=body,
+                headers={"X-Cook-Requesting-User": "admin"})
+            assert resp.status_code != 403, f"{method} {path} as admin"
+    finally:
+        srv.stop()
+
+
+def test_file_url_generator_seam(store):
+    """The FileUrlGenerator plugin overrides the backend's sandbox URL
+    in instance JSON (plugins/definitions.clj:56)."""
+    from cook_tpu.scheduler.plugins import PluginRegistry
+
+    clock = store.clock
+    cluster = MockCluster(
+        "m", [MockHost(node_id="h", hostname="h", mem=1000, cpus=4)],
+        clock=clock, sandbox_url_fn=lambda tid: f"http://backend/{tid}")
+    scheduler = Scheduler(store, [cluster])
+
+    class Generator:
+        def file_url(self, instance):
+            return f"https://files.corp/{instance.task_id}"
+
+    job = make_job()
+    store.submit_jobs([job])
+    store.create_instance(job.uuid, "t1", hostname="h", node_id="h",
+                          compute_cluster="m")
+    plugins = PluginRegistry(file_url_generator=Generator())
+    api = CookApi(store, scheduler, plugins=plugins)
+    d = api._instance_json(store.instances["t1"])
+    assert d["output_url"] == "https://files.corp/t1"
+    # without the plugin, the backend's own URL is served
+    d = CookApi(store, scheduler)._instance_json(store.instances["t1"])
+    assert d["output_url"] == "http://backend/t1"
+
+
+# ------------------------------------------- per-cluster launch limiter
+
+
+def test_per_cluster_launch_rate_limiter():
+    """A cluster whose launch bucket holds 2 tokens launches at most 2
+    tasks per refill window, regardless of matches (rate_limit.clj:44)."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m", [MockHost(node_id="h", hostname="h", mem=64000, cpus=64)],
+        clock=clock)
+    cluster.launch_rate_limiter = TokenBucketRateLimiter(
+        tokens_replenished_per_minute=2.0, bucket_size=2.0, clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    jobs = [make_job(mem=100, cpus=1) for _ in range(5)]
+    store.submit_jobs(jobs)
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 2
+    assert len(outcome.unmatched) == 3
+    # no refill yet: nothing launches
+    scheduler.rank_cycle(pool)
+    assert len(scheduler.match_cycle(pool).matched) == 0
+    # one minute replenishes two tokens
+    clock.advance(60_000)
+    scheduler.rank_cycle(pool)
+    assert len(scheduler.match_cycle(pool).matched) == 2
+
+
+def test_factory_attaches_launch_limiter():
+    from cook_tpu.components import CLUSTER_FACTORIES
+
+    clock = FakeClock()
+    cluster = CLUSTER_FACTORIES["mock"](
+        {"name": "m", "hosts": [{"node_id": "h", "mem": 100, "cpus": 1}],
+         "launch_rate_per_minute": 10, "launch_burst": 3}, clock)
+    assert cluster.launch_rate_limiter is not None
+    assert cluster.launch_rate_limiter.tokens_available("m") == 3.0
