@@ -195,11 +195,18 @@ class CookApi:
         authenticator = self.authenticator
         user = authenticator.authenticate(request)
         if user is None:
-            response = _err(401, "authentication required")
-            for key, value in authenticator.challenge().items():
-                response.headers[key] = value
-            self._apply_cors(request, response)
-            return response
+            if self._auth_exempt(request):
+                # machine endpoints that carry no user credentials: LB
+                # health probes and the executor's heartbeat/progress
+                # posts (the reference receives these over the backend
+                # channel, outside the authed REST stack)
+                user = "anonymous"
+            else:
+                response = _err(401, "authentication required")
+                for key, value in authenticator.challenge().items():
+                    response.headers[key] = value
+                self._apply_cors(request, response)
+                return response
         impersonate = request.headers.get("X-Cook-Impersonate")
         if impersonate:
             if user not in self.config.admins:
@@ -219,6 +226,18 @@ class CookApi:
             response = _err(400, f"malformed JSON body: {e}")
         self._apply_cors(request, response)
         return response
+
+    @staticmethod
+    def _auth_exempt(request: web.Request) -> bool:
+        path = request.path
+        if path == "/debug":
+            return True
+        if request.method == "GET" and path == "/metrics":
+            return True
+        if request.method == "POST" and (path.startswith("/heartbeat/")
+                                         or path.startswith("/progress/")):
+            return True
+        return False
 
     def _apply_cors(self, request: web.Request, response) -> None:
         """CORS for browser dashboards, allowlist-gated (rest/cors.clj).

@@ -53,7 +53,8 @@ class BasicAuthenticator:
         try:
             decoded = base64.b64decode(header[6:]).decode()
             user, _, password = decoded.partition(":")
-        except (binascii.Error, UnicodeDecodeError):
+        except (binascii.Error, UnicodeDecodeError, ValueError):
+            # b64decode raises plain ValueError on non-ASCII input
             return None
         if not user:
             return None
@@ -151,7 +152,16 @@ def authenticator_from_config(conf: dict):
     if kind == "dev":
         return dev_default_authenticator()
     if kind == "basic":
-        return BasicAuthenticator()
+        verify = None
+        if conf.get("verify"):
+            # dotted path to a callable(user, password) -> bool, same
+            # plugin mechanism as spnego's gss_accept
+            from cook_tpu.scheduler.plugins import load_plugin
+
+            verify = load_plugin(conf["verify"])
+            if not callable(verify):
+                verify = verify.verify
+        return BasicAuthenticator(verify=verify)
     if kind == "spnego":
         acceptor = None
         if conf.get("gss_accept"):

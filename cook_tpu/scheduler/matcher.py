@@ -408,6 +408,15 @@ def finalize_pool_match(
                 # inf = unenforced bucket / unlimited null object
                 if math.isfinite(tokens):
                     budget = min(budget, int(tokens))
+        if budget <= 0:
+            # over the cluster's launch cap: reject BEFORE assigning
+            # ports, or rate-capped jobs would consume phantom ports and
+            # later jobs would report the wrong failure reason
+            outcome.unmatched.append(job)
+            if record_placement_failure is not None:
+                record_placement_failure(
+                    job, "cluster launch rate/cap reached this cycle")
+            continue
         task_ports = assign_ports(offer, ports_used.setdefault(node_idx, set()),
                                   job.resources.ports)
         if task_ports is None:
@@ -418,12 +427,6 @@ def finalize_pool_match(
                     job, "insufficient free ports on the matched node")
             continue
         ports_used[node_idx].update(task_ports)
-        if budget <= 0:
-            outcome.unmatched.append(job)  # over the cluster's launch cap
-            if record_placement_failure is not None:
-                record_placement_failure(
-                    job, "cluster launch rate/cap reached this cycle")
-            continue
         cluster_budget[cluster.name] = budget - 1
         task_id = make_task_id(job)
         try:

@@ -1,0 +1,75 @@
+// Command-line front end for the C++ jobclient — the smoke-test binary
+// the integration tests drive against a live scheduler (the role of the
+// Java client's examples/tests).
+//
+//   cook_cli --url http://host:port --user alice submit "echo hi" [mem cpus]
+//   cook_cli --url ... wait <uuid> [timeout_ms]
+//   cook_cli --url ... show <uuid>
+//   cook_cli --url ... kill <uuid>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "cook_client.hpp"
+
+int main(int argc, char** argv) {
+  std::string url = "http://127.0.0.1:12321";
+  std::string user = "anonymous";
+  int i = 1;
+  while (i + 1 < argc && argv[i][0] == '-') {
+    if (!strcmp(argv[i], "--url")) url = argv[++i];
+    else if (!strcmp(argv[i], "--user")) user = argv[++i];
+    else break;
+    ++i;
+  }
+  if (i >= argc) {
+    fprintf(stderr, "usage: cook_cli [--url U] [--user u] "
+                    "submit|wait|show|kill ...\n");
+    return 2;
+  }
+  std::string cmd = argv[i++];
+  cook::JobClient client = cook::JobClient::Builder()
+                               .url(url)
+                               .user(user)
+                               .poll_interval_ms(200)
+                               .build();
+  try {
+    if (cmd == "submit") {
+      if (i >= argc) { fprintf(stderr, "submit needs a command\n"); return 2; }
+      cook::JobSpec spec;
+      spec.command = argv[i++];
+      if (i < argc) spec.mem = std::stod(argv[i++]);
+      if (i < argc) spec.cpus = std::stod(argv[i++]);
+      auto uuids = client.submit({spec});
+      printf("%s\n", uuids[0].c_str());
+    } else if (cmd == "wait") {
+      if (i >= argc) { fprintf(stderr, "wait needs a uuid\n"); return 2; }
+      std::string uuid = argv[i++];
+      int timeout_ms = i < argc ? std::stoi(argv[i++]) : 60000;
+      client.set_listener([](const cook::JobStatus& status) {
+        fprintf(stderr, "status: %s\n", status.status.c_str());
+      });
+      cook::JobStatus status = client.wait(uuid, timeout_ms);
+      printf("%s\n", status.status.c_str());
+      return status.completed() && status.succeeded() ? 0 : 1;
+    } else if (cmd == "show") {
+      cook::JobStatus status = client.query(argv[i]);
+      printf("%s %s\n", status.uuid.c_str(), status.status.c_str());
+      for (const auto& inst : status.instances) {
+        printf("  %s %s host=%s\n", inst.task_id.c_str(),
+               inst.status.c_str(), inst.hostname.c_str());
+      }
+    } else if (cmd == "kill") {
+      client.kill(argv[i]);
+      printf("killed\n");
+    } else {
+      fprintf(stderr, "unknown command %s\n", cmd.c_str());
+      return 2;
+    }
+  } catch (const cook::JobClientError& e) {
+    fprintf(stderr, "error (%d): %s\n", e.status, e.what());
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,313 @@
+// Implementation of the cook C++ jobclient (see cook_client.hpp).
+// Reference parity: jobclient/java/.../JobClient.java — submit/query/kill/
+// retry/listener-polling over the REST API (rest/api.clj routes).
+#include "cook_client.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <sstream>
+#include <thread>
+
+namespace cook {
+namespace {
+
+struct ParsedUrl {
+  std::string host;
+  int port = 80;
+  std::string path_prefix;
+};
+
+ParsedUrl parse_url(const std::string& url) {
+  ParsedUrl out;
+  std::string rest = url;
+  const std::string scheme = "http://";
+  if (rest.rfind(scheme, 0) == 0) rest = rest.substr(scheme.size());
+  auto slash = rest.find('/');
+  std::string hostport = rest.substr(0, slash);
+  if (slash != std::string::npos) {
+    out.path_prefix = rest.substr(slash);
+    while (!out.path_prefix.empty() && out.path_prefix.back() == '/') {
+      out.path_prefix.pop_back();
+    }
+  }
+  auto colon = hostport.rfind(':');
+  if (colon != std::string::npos) {
+    out.host = hostport.substr(0, colon);
+    out.port = std::stoi(hostport.substr(colon + 1));
+  } else {
+    out.host = hostport;
+  }
+  return out;
+}
+
+class Socket {
+ public:
+  Socket(const std::string& host, int port, int timeout_ms) {
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* result = nullptr;
+    if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &result) != 0) {
+      throw JobClientError(0, "cannot resolve " + host);
+    }
+    for (addrinfo* ai = result; ai; ai = ai->ai_next) {
+      fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd_ < 0) continue;
+      timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+      setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+      setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+      if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      close(fd_);
+      fd_ = -1;
+    }
+    freeaddrinfo(result);
+    if (fd_ < 0) {
+      throw JobClientError(0, "cannot connect to " + host + ":" +
+                                  std::to_string(port));
+    }
+  }
+  ~Socket() {
+    if (fd_ >= 0) close(fd_);
+  }
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
+
+  void send_all(const std::string& data) const {
+    size_t sent = 0;
+    while (sent < data.size()) {
+      ssize_t n = ::send(fd_, data.data() + sent, data.size() - sent, 0);
+      if (n <= 0) throw JobClientError(0, "send failed");
+      sent += static_cast<size_t>(n);
+    }
+  }
+
+  std::string recv_all() const {
+    std::string out;
+    char buf[16384];
+    while (true) {
+      ssize_t n = ::recv(fd_, buf, sizeof buf, 0);
+      if (n < 0) throw JobClientError(0, "recv failed/timed out");
+      if (n == 0) break;
+      out.append(buf, static_cast<size_t>(n));
+      // stop early once content-length is satisfied
+      auto header_end = out.find("\r\n\r\n");
+      if (header_end != std::string::npos) {
+        auto cl = out.find("Content-Length: ");
+        if (cl != std::string::npos && cl < header_end) {
+          size_t len = std::stoul(out.substr(cl + 16));
+          if (out.size() >= header_end + 4 + len) break;
+        }
+      }
+    }
+    return out;
+  }
+
+ private:
+  int fd_ = -1;
+};
+
+std::string base64(const std::string& input) {
+  static const char* alphabet =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  size_t i = 0;
+  while (i + 2 < input.size()) {
+    uint32_t n = (static_cast<uint8_t>(input[i]) << 16) |
+                 (static_cast<uint8_t>(input[i + 1]) << 8) |
+                 static_cast<uint8_t>(input[i + 2]);
+    out += alphabet[(n >> 18) & 63];
+    out += alphabet[(n >> 12) & 63];
+    out += alphabet[(n >> 6) & 63];
+    out += alphabet[n & 63];
+    i += 3;
+  }
+  if (i + 1 == input.size()) {
+    uint32_t n = static_cast<uint8_t>(input[i]) << 16;
+    out += alphabet[(n >> 18) & 63];
+    out += alphabet[(n >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == input.size()) {
+    uint32_t n = (static_cast<uint8_t>(input[i]) << 16) |
+                 (static_cast<uint8_t>(input[i + 1]) << 8);
+    out += alphabet[(n >> 18) & 63];
+    out += alphabet[(n >> 12) & 63];
+    out += alphabet[(n >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+}  // namespace
+
+JobClient JobClient::Builder::build() const { return JobClient(*this); }
+
+HttpResponse JobClient::request(const std::string& method,
+                                const std::string& path,
+                                const std::string& body) const {
+  ParsedUrl url = parse_url(cfg_.url_);
+  Socket sock(url.host, url.port, cfg_.timeout_ms_);
+  std::ostringstream req;
+  req << method << ' ' << url.path_prefix << path << " HTTP/1.1\r\n"
+      << "Host: " << url.host << "\r\n"
+      << "Connection: close\r\n"
+      << "Accept: application/json\r\n"
+      << "Authorization: Basic " << base64(cfg_.user_ + ":") << "\r\n"
+      << "X-Cook-Requesting-User: " << cfg_.user_ << "\r\n";
+  if (!cfg_.impersonate_.empty()) {
+    req << "X-Cook-Impersonate: " << cfg_.impersonate_ << "\r\n";
+  }
+  if (!body.empty()) {
+    req << "Content-Type: application/json\r\n"
+        << "Content-Length: " << body.size() << "\r\n";
+  }
+  req << "\r\n" << body;
+  sock.send_all(req.str());
+  std::string raw = sock.recv_all();
+
+  HttpResponse resp;
+  auto line_end = raw.find("\r\n");
+  if (line_end == std::string::npos || raw.size() < 12) {
+    throw JobClientError(0, "malformed HTTP response");
+  }
+  resp.status = std::stoi(raw.substr(9, 3));
+  auto header_end = raw.find("\r\n\r\n");
+  if (header_end != std::string::npos) {
+    resp.body = raw.substr(header_end + 4);
+  }
+  return resp;
+}
+
+std::vector<std::string> JobClient::submit(const std::vector<JobSpec>& jobs) {
+  json::Array arr;
+  for (const auto& job : jobs) {
+    json::Object spec;
+    if (!job.uuid.empty()) spec["uuid"] = job.uuid;
+    spec["name"] = job.name;
+    spec["command"] = job.command;
+    spec["mem"] = job.mem;
+    spec["cpus"] = job.cpus;
+    if (job.gpus > 0) spec["gpus"] = job.gpus;
+    if (job.disk > 0) spec["disk"] = job.disk;
+    if (job.ports > 0) spec["ports"] = job.ports;
+    spec["max_retries"] = job.max_retries;
+    spec["priority"] = job.priority;
+    if (!job.pool.empty()) spec["pool"] = job.pool;
+    if (!job.group_uuid.empty()) spec["group"] = job.group_uuid;
+    if (!job.env.empty()) {
+      json::Object env;
+      for (const auto& [key, value] : job.env) env[key] = value;
+      spec["env"] = std::move(env);
+    }
+    if (!job.labels.empty()) {
+      json::Object labels;
+      for (const auto& [key, value] : job.labels) labels[key] = value;
+      spec["labels"] = std::move(labels);
+    }
+    arr.push_back(json::Value(std::move(spec)));
+  }
+  json::Object body;
+  body["jobs"] = std::move(arr);
+  HttpResponse resp = request("POST", "/jobs", json::Value(body).dump());
+  if (resp.status != 201) {
+    throw JobClientError(resp.status, "submit failed: " + resp.body);
+  }
+  std::vector<std::string> uuids;
+  json::Value parsed = json::parse(resp.body);
+  for (const auto& v : parsed.get("jobs").as_array()) {
+    uuids.push_back(v.as_string());
+  }
+  return uuids;
+}
+
+JobStatus JobClient::parse_job(const json::Value& v) {
+  JobStatus status;
+  status.uuid = v.get_string("uuid");
+  status.status = v.get_string("status");
+  const json::Value& instances = v.get("instances");
+  if (instances.type() == json::Value::Type::Arr) {
+    for (const auto& item : instances.as_array()) {
+      if (item.type() != json::Value::Type::Obj) continue;  // bare ids
+      InstanceStatus inst;
+      inst.task_id = item.get_string("task_id");
+      inst.status = item.get_string("status");
+      inst.hostname = item.get_string("hostname");
+      inst.reason = item.get_string("reason_string");
+      const json::Value& exit_code = item.get("exit_code");
+      if (!exit_code.is_null()) {
+        inst.exit_code = static_cast<int>(exit_code.as_number());
+      }
+      status.instances.push_back(std::move(inst));
+    }
+  }
+  return status;
+}
+
+JobStatus JobClient::query(const std::string& uuid) {
+  HttpResponse resp = request("GET", "/jobs/" + uuid);
+  if (resp.status != 200) {
+    throw JobClientError(resp.status, "query failed: " + resp.body);
+  }
+  return parse_job(json::parse(resp.body));
+}
+
+std::vector<JobStatus> JobClient::query_all(
+    const std::vector<std::string>& uuids) {
+  // batched query like the Java client's QUERY_BATCH_SIZE fan-out
+  std::string path = "/jobs?";
+  for (size_t i = 0; i < uuids.size(); ++i) {
+    if (i) path += '&';
+    path += "job=" + uuids[i];
+  }
+  HttpResponse resp = request("GET", path);
+  if (resp.status != 200) {
+    throw JobClientError(resp.status, "query failed: " + resp.body);
+  }
+  std::vector<JobStatus> out;
+  json::Value parsed = json::parse(resp.body);
+  for (const auto& v : parsed.as_array()) {
+    out.push_back(parse_job(v));
+  }
+  return out;
+}
+
+void JobClient::kill(const std::string& uuid) {
+  HttpResponse resp = request("DELETE", "/jobs?job=" + uuid);
+  if (resp.status >= 300) {
+    throw JobClientError(resp.status, "kill failed: " + resp.body);
+  }
+}
+
+void JobClient::retry(const std::string& uuid, int retries) {
+  json::Object body;
+  body["job"] = uuid;
+  body["retries"] = retries;
+  HttpResponse resp = request("POST", "/retry", json::Value(body).dump());
+  if (resp.status >= 300) {
+    throw JobClientError(resp.status, "retry failed: " + resp.body);
+  }
+}
+
+JobStatus JobClient::wait(const std::string& uuid, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  std::string last;
+  JobStatus status;
+  while (true) {
+    status = query(uuid);
+    if (status.status != last) {
+      last = status.status;
+      if (listener_) listener_(status);
+    }
+    if (status.completed()) return status;
+    if (std::chrono::steady_clock::now() >= deadline) return status;
+    std::this_thread::sleep_for(std::chrono::milliseconds(cfg_.poll_ms_));
+  }
+}
+
+}  // namespace cook

@@ -1,0 +1,128 @@
+// cook C++ jobclient: the native-language analog of the reference's Java
+// JobClient (/root/reference/jobclient/java/.../JobClient.java) — builder
+// configuration, batch submission, query, kill, and a status-polling wait
+// loop that fires listener callbacks on every state change.
+//
+// Dependency-free: HTTP over POSIX sockets (the scheduler's REST surface
+// is plain HTTP behind trusted proxies, like the reference's), JSON via
+// the bundled mini parser (json.hpp).
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "json.hpp"
+
+namespace cook {
+
+struct JobSpec {
+  std::string uuid;        // empty = server-assigned
+  std::string name = "cookjob";
+  std::string command;
+  double mem = 128.0;      // MB
+  double cpus = 1.0;
+  double gpus = 0.0;
+  double disk = 0.0;
+  int ports = 0;
+  int max_retries = 1;
+  int priority = 50;
+  std::string pool;        // empty = server default
+  std::string group_uuid;
+  std::map<std::string, std::string> env;
+  std::map<std::string, std::string> labels;
+};
+
+struct InstanceStatus {
+  std::string task_id;
+  std::string status;      // unknown/running/success/failed
+  std::string hostname;
+  std::optional<int> exit_code;
+  std::string reason;
+};
+
+struct JobStatus {
+  std::string uuid;
+  std::string status;      // waiting/running/completed
+  std::vector<InstanceStatus> instances;
+
+  bool completed() const { return status == "completed"; }
+  bool succeeded() const {
+    for (const auto& inst : instances) {
+      if (inst.status == "success") return true;
+    }
+    return false;
+  }
+};
+
+struct HttpResponse {
+  int status = 0;
+  std::string body;
+};
+
+class JobClientError : public std::runtime_error {
+ public:
+  JobClientError(int status, const std::string& message)
+      : std::runtime_error(message), status(status) {}
+  int status;
+};
+
+class JobClient {
+ public:
+  // Builder mirrors the Java client's JobClient.Builder
+  class Builder {
+   public:
+    Builder& url(std::string u) { url_ = std::move(u); return *this; }
+    Builder& user(std::string u) { user_ = std::move(u); return *this; }
+    Builder& impersonate(std::string u) { impersonate_ = std::move(u);
+                                          return *this; }
+    Builder& poll_interval_ms(int ms) { poll_ms_ = ms; return *this; }
+    Builder& request_timeout_ms(int ms) { timeout_ms_ = ms; return *this; }
+    JobClient build() const;
+
+   private:
+    friend class JobClient;
+    std::string url_ = "http://127.0.0.1:12321";
+    std::string user_ = "anonymous";
+    std::string impersonate_;
+    int poll_ms_ = 1000;
+    int timeout_ms_ = 30000;
+  };
+
+  using Listener = std::function<void(const JobStatus&)>;
+
+  // Submit jobs (and optional group uuids referenced by them); returns
+  // the job uuids in submission order.
+  std::vector<std::string> submit(const std::vector<JobSpec>& jobs);
+
+  JobStatus query(const std::string& uuid);
+  std::vector<JobStatus> query_all(const std::vector<std::string>& uuids);
+
+  void kill(const std::string& uuid);
+  void retry(const std::string& uuid, int retries);
+
+  // Poll until the job completes or timeout_ms elapses; the listener (if
+  // set) fires on every observed status change, like the Java client's
+  // JobListener. Returns the final observed status.
+  JobStatus wait(const std::string& uuid, int timeout_ms = 600000);
+
+  void set_listener(Listener listener) { listener_ = std::move(listener); }
+
+  // exposed for testing
+  HttpResponse request(const std::string& method, const std::string& path,
+                       const std::string& body = "") const;
+
+ private:
+  friend class Builder;
+  explicit JobClient(Builder builder) : cfg_(std::move(builder)) {}
+
+  static JobStatus parse_job(const json::Value& v);
+
+  Builder cfg_;
+  Listener listener_;
+};
+
+}  // namespace cook

@@ -1,0 +1,287 @@
+// Minimal JSON value, parser, and writer for the cook C++ jobclient.
+//
+// The reference's Java client (jobclient/java/.../JobClient.java) leans on
+// org.json; this client is dependency-free, so the tiny subset of JSON the
+// cook REST API speaks (objects, arrays, strings, numbers, bools, null) is
+// implemented here directly.
+#pragma once
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace cook {
+namespace json {
+
+class Value;
+using Array = std::vector<Value>;
+using Object = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Number, String, Arr, Obj };
+
+  Value() : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(double d) : type_(Type::Number), num_(d) {}
+  Value(int i) : type_(Type::Number), num_(i) {}
+  Value(int64_t i) : type_(Type::Number), num_(static_cast<double>(i)) {}
+  Value(const char* s) : type_(Type::String), str_(s) {}
+  Value(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Value(Array a) : type_(Type::Arr), arr_(std::move(a)) {}
+  Value(Object o) : type_(Type::Obj), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool as_bool() const { return bool_; }
+  double as_number() const { return num_; }
+  const std::string& as_string() const { return str_; }
+  const Array& as_array() const { return arr_; }
+  const Object& as_object() const { return obj_; }
+  Array& as_array() { return arr_; }
+  Object& as_object() { return obj_; }
+
+  // lookup with default for optional fields
+  const Value& get(const std::string& key) const {
+    static const Value kNull;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? kNull : it->second;
+  }
+  std::string get_string(const std::string& key,
+                         const std::string& fallback = "") const {
+    const Value& v = get(key);
+    return v.type_ == Type::String ? v.str_ : fallback;
+  }
+  double get_number(const std::string& key, double fallback = 0) const {
+    const Value& v = get(key);
+    return v.type_ == Type::Number ? v.num_ : fallback;
+  }
+
+  std::string dump() const {
+    std::ostringstream out;
+    write(out);
+    return out.str();
+  }
+
+ private:
+  void write(std::ostringstream& out) const {
+    switch (type_) {
+      case Type::Null: out << "null"; break;
+      case Type::Bool: out << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 1e15) {
+          out << static_cast<int64_t>(num_);
+        } else {
+          out << num_;
+        }
+        break;
+      }
+      case Type::String: write_string(out, str_); break;
+      case Type::Arr: {
+        out << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) out << ',';
+          arr_[i].write(out);
+        }
+        out << ']';
+        break;
+      }
+      case Type::Obj: {
+        out << '{';
+        bool first = true;
+        for (const auto& [key, value] : obj_) {
+          if (!first) out << ',';
+          first = false;
+          write_string(out, key);
+          out << ':';
+          value.write(out);
+        }
+        out << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& out, const std::string& s) {
+    out << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': out << "\\\""; break;
+        case '\\': out << "\\\\"; break;
+        case '\n': out << "\\n"; break;
+        case '\r': out << "\\r"; break;
+        case '\t': out << "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof buf, "\\u%04x", c);
+            out << buf;
+          } else {
+            out << c;
+          }
+      }
+    }
+    out << '"';
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  Array arr_;
+  Object obj_;
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : text_(text) {}
+
+  Value parse() {
+    Value v = parse_value();
+    skip_ws();
+    if (pos_ != text_.size()) throw std::runtime_error("trailing JSON");
+    return v;
+  }
+
+ private:
+  void skip_ws() {
+    while (pos_ < text_.size() &&
+           std::isspace(static_cast<unsigned char>(text_[pos_]))) {
+      ++pos_;
+    }
+  }
+
+  char peek() {
+    skip_ws();
+    if (pos_ >= text_.size()) throw std::runtime_error("truncated JSON");
+    return text_[pos_];
+  }
+
+  void expect(char c) {
+    if (peek() != c) {
+      throw std::runtime_error(std::string("expected '") + c + "'");
+    }
+    ++pos_;
+  }
+
+  Value parse_value() {
+    switch (peek()) {
+      case '{': return parse_object();
+      case '[': return parse_array();
+      case '"': return Value(parse_string());
+      case 't': expect_word("true"); return Value(true);
+      case 'f': expect_word("false"); return Value(false);
+      case 'n': expect_word("null"); return Value();
+      default: return parse_number();
+    }
+  }
+
+  void expect_word(const char* word) {
+    skip_ws();
+    for (const char* p = word; *p; ++p) {
+      if (pos_ >= text_.size() || text_[pos_++] != *p) {
+        throw std::runtime_error("bad literal");
+      }
+    }
+  }
+
+  Value parse_object() {
+    expect('{');
+    Object obj;
+    if (peek() == '}') { ++pos_; return Value(std::move(obj)); }
+    while (true) {
+      std::string key = parse_string();
+      expect(':');
+      obj.emplace(std::move(key), parse_value());
+      char c = peek();
+      ++pos_;
+      if (c == '}') break;
+      if (c != ',') throw std::runtime_error("expected , or }");
+    }
+    return Value(std::move(obj));
+  }
+
+  Value parse_array() {
+    expect('[');
+    Array arr;
+    if (peek() == ']') { ++pos_; return Value(std::move(arr)); }
+    while (true) {
+      arr.push_back(parse_value());
+      char c = peek();
+      ++pos_;
+      if (c == ']') break;
+      if (c != ',') throw std::runtime_error("expected , or ]");
+    }
+    return Value(std::move(arr));
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (pos_ < text_.size()) {
+      char c = text_[pos_++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (pos_ >= text_.size()) break;
+        char esc = text_[pos_++];
+        switch (esc) {
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            if (pos_ + 4 > text_.size()) throw std::runtime_error("bad \\u");
+            unsigned code = std::stoul(text_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // BMP-only UTF-8 encoding (ample for cook payloads)
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: out += esc;
+        }
+      } else {
+        out += c;
+      }
+    }
+    throw std::runtime_error("unterminated string");
+  }
+
+  Value parse_number() {
+    skip_ws();
+    size_t start = pos_;
+    while (pos_ < text_.size() &&
+           (std::isdigit(static_cast<unsigned char>(text_[pos_])) ||
+            strchr("+-.eE", text_[pos_]))) {
+      ++pos_;
+    }
+    if (start == pos_) throw std::runtime_error("bad number");
+    return Value(std::stod(text_.substr(start, pos_ - start)));
+  }
+
+  const std::string& text_;
+  size_t pos_ = 0;
+};
+
+inline Value parse(const std::string& text) { return Parser(text).parse(); }
+
+}  // namespace json
+}  // namespace cook

@@ -136,6 +136,27 @@ def test_spnego_http_401_challenge_and_success(store):
         srv.stop()
 
 
+def test_machine_endpoints_exempt_under_strict_auth(store):
+    """LB health probes and executor heartbeat/progress posts carry no
+    user credentials; strict auth must not 401 them (the reference takes
+    these over the backend channel, outside the authed REST stack)."""
+    api = CookApi(store, config=ApiConfig(
+        authenticator=SpnegoAuthenticator()))  # closed: nobody auths
+    srv = serve(api)
+    try:
+        assert requests.get(f"{srv.url}/debug").status_code == 200
+        assert requests.get(f"{srv.url}/metrics").status_code == 200
+        r = requests.post(f"{srv.url}/heartbeat/nope")
+        assert r.status_code != 401
+        r = requests.post(f"{srv.url}/progress/nope",
+                          json={"progress_percent": 10, "sequence": 1})
+        assert r.status_code != 401
+        # everything else stays locked
+        assert requests.get(f"{srv.url}/pools").status_code == 401
+    finally:
+        srv.stop()
+
+
 ADMIN_GATED = [
     ("POST", "/compute-clusters", {"name": "x", "kind": "mock"}),
     ("DELETE", "/compute-clusters/m", None),
