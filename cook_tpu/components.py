@@ -239,6 +239,7 @@ def build_process(
         cors_origins=settings.cors_origins,
         authenticator=(authenticator_from_config(settings.auth)
                        if settings.auth else None),
+        executor_token=settings.executor_token,
     ))
     api.queue_limits.limits.per_pool = settings.queue_limit_per_pool
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user

@@ -161,6 +161,11 @@ class RestUpdateSink:
 
         self.base_url = base_url.rstrip("/")
         self.session = session or requests.Session()
+        # shared executor secret (COOK_EXECUTOR_TOKEN): lets the API keep
+        # heartbeat/progress spoof-proof under strict auth
+        token = os.environ.get("COOK_EXECUTOR_TOKEN", "")
+        if token:
+            self.session.headers["X-Cook-Executor-Token"] = token
 
     def __call__(self, update: TaskUpdate) -> None:
         if update.kind == "progress":
@@ -186,6 +191,9 @@ class HeartbeatSender:
         self.url = f"{base_url.rstrip('/')}/heartbeat/{task_id}"
         self.interval_s = interval_s
         self.session = session or requests.Session()
+        token = os.environ.get("COOK_EXECUTOR_TOKEN", "")
+        if token:
+            self.session.headers["X-Cook-Executor-Token"] = token
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

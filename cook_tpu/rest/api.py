@@ -73,6 +73,11 @@ class ApiConfig:
     # injectable request authenticator (rest/auth.py); None = the
     # permissive dev stack (basic auth, then dev header, then anonymous)
     authenticator: object = None
+    # shared secret executors present (X-Cook-Executor-Token) on their
+    # heartbeat/progress posts; when set, those endpoints are only
+    # auth-exempt for callers carrying it — without it any network peer
+    # could spoof liveness/progress under a strict authenticator
+    executor_token: str = ""
 
 
 class CookApi:
@@ -227,8 +232,7 @@ class CookApi:
         self._apply_cors(request, response)
         return response
 
-    @staticmethod
-    def _auth_exempt(request: web.Request) -> bool:
+    def _auth_exempt(self, request: web.Request) -> bool:
         path = request.path
         if path == "/debug":
             return True
@@ -236,7 +240,9 @@ class CookApi:
             return True
         if request.method == "POST" and (path.startswith("/heartbeat/")
                                          or path.startswith("/progress/")):
-            return True
+            token = self.config.executor_token
+            return (not token
+                    or request.headers.get("X-Cook-Executor-Token") == token)
         return False
 
     def _apply_cors(self, request: web.Request, response) -> None:

@@ -411,7 +411,11 @@ def finalize_pool_match(
         if budget <= 0:
             # over the cluster's launch cap: reject BEFORE assigning
             # ports, or rate-capped jobs would consume phantom ports and
-            # later jobs would report the wrong failure reason
+            # later jobs would report the wrong failure reason.  Cache the
+            # zero so later jobs skip the limiter lookup — and so a bucket
+            # refilling mid-cycle cannot admit lower-ranked jobs after
+            # higher-ranked ones were rejected
+            cluster_budget[cluster.name] = 0
             outcome.unmatched.append(job)
             if record_placement_failure is not None:
                 record_placement_failure(

@@ -117,6 +117,27 @@ def cmd_compare(args) -> int:
     return 1
 
 
+def cmd_loadgen(args) -> int:
+    import json as json_mod
+    import sys
+
+    from cook_tpu.sim.loadgen import LoadConfig, run_load
+
+    config = LoadConfig(
+        n_jobs=args.jobs, rate_per_minute=args.rate, n_users=args.users,
+        seed=args.seed, speedup=args.speedup, pool=args.pool,
+    )
+    report = run_load(args.url, config, wait_timeout_s=args.wait_timeout_s,
+                      log=lambda *a: print(*a, file=sys.stderr))
+    summary = report.summary()
+    print(json_mod.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json_mod.dump(summary, f)
+    return 0 if summary["failed"] == 0 and \
+        summary["completed"] == summary["submitted"] else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cook-tpu-sim")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -150,6 +171,22 @@ def main(argv=None) -> int:
     c.add_argument("trace1")
     c.add_argument("trace2")
     c.set_defaults(fn=cmd_compare)
+
+    lg = sub.add_parser(
+        "loadgen",
+        help="generate + replay a workload against a DEPLOYED service "
+             "over HTTP (the deploy-scale simulator, simulator/README.md)")
+    lg.add_argument("--url", required=True)
+    lg.add_argument("--jobs", type=int, default=200)
+    lg.add_argument("--rate", type=float, default=600.0,
+                    help="arrival rate, jobs/minute")
+    lg.add_argument("--users", type=int, default=8)
+    lg.add_argument("--seed", type=int, default=0)
+    lg.add_argument("--speedup", type=float, default=1.0)
+    lg.add_argument("--pool", default=None)
+    lg.add_argument("--wait-timeout-s", type=float, default=300.0)
+    lg.add_argument("--out", default="")
+    lg.set_defaults(fn=cmd_loadgen)
 
     args = p.parse_args(argv)
     return args.fn(args)

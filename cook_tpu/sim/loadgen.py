@@ -1,0 +1,151 @@
+"""Deploy-scale workload generator: drive a RUNNING service over HTTP.
+
+Reference: simulator/ (simulator/README.md) — distinct from the in-process
+trace simulator (sim/simulator.py), this tool generates a randomized
+multi-user workload and replays it against a fully deployed scheduler
+through the public REST API, measuring what a user of the deployment
+measures: submission latency, time-to-first-schedule, completion.
+
+    python -m cook_tpu.sim.cli loadgen --url http://host:port \
+        --jobs 500 --rate 600 --users 10 --seed 7 --out results.json
+
+The arrival process is Poisson at `--rate` jobs/minute (compressed by
+`--speedup`), job shapes are drawn from skewed size distributions, and
+every job carries a short mock runtime so a mock/k8s-backed deployment
+completes it quickly.
+"""
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from cook_tpu.client.jobclient import JobClient
+
+
+@dataclass
+class LoadConfig:
+    n_jobs: int = 200
+    rate_per_minute: float = 600.0
+    n_users: int = 8
+    seed: int = 0
+    speedup: float = 1.0            # >1 compresses inter-arrival gaps
+    pool: Optional[str] = None
+    runtime_ms_choices: tuple = (500, 1000, 2000)
+    mem_choices: tuple = (128, 256, 512, 1024, 4096)
+    cpus_choices: tuple = (0.5, 1, 2, 4)
+    batch_max: int = 20             # jobs per submit call (burst arrivals)
+
+
+@dataclass
+class LoadReport:
+    submitted: int = 0
+    completed: int = 0
+    failed: int = 0
+    wall_s: float = 0.0
+    submit_latency_ms: list = field(default_factory=list)
+    schedule_latency_ms: dict = field(default_factory=dict)  # uuid -> ms
+
+    def summary(self) -> dict:
+        lat = sorted(self.submit_latency_ms)
+        sched = sorted(self.schedule_latency_ms.values())
+
+        def pct(values, q):
+            if not values:
+                return None
+            return round(float(np.percentile(values, q)), 1)
+
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "wall_s": round(self.wall_s, 2),
+            "throughput_jobs_per_s": round(
+                self.completed / self.wall_s, 2) if self.wall_s else 0,
+            "submit_ms_p50": pct(lat, 50),
+            "submit_ms_p99": pct(lat, 99),
+            "schedule_ms_p50": pct(sched, 50),
+            "schedule_ms_p99": pct(sched, 99),
+        }
+
+
+def generate_workload(config: LoadConfig) -> list[tuple[float, dict]]:
+    """(arrival_offset_s, job_spec) pairs — Poisson arrivals, skewed
+    shapes, round-robin-ish user mix."""
+    rng = np.random.default_rng(config.seed)
+    gaps = rng.exponential(60.0 / config.rate_per_minute, config.n_jobs)
+    offsets = np.cumsum(gaps) / config.speedup
+    out = []
+    for i in range(config.n_jobs):
+        spec = {
+            "command": "true",
+            "name": f"loadgen-{i}",
+            "mem": float(rng.choice(config.mem_choices)),
+            "cpus": float(rng.choice(config.cpus_choices)),
+            "max_retries": 3,
+            "expected_runtime": int(rng.choice(config.runtime_ms_choices)),
+            "labels": {"loadgen-user": f"user{int(rng.integers(config.n_users))}"},
+            **({"pool": config.pool} if config.pool else {}),
+        }
+        out.append((float(offsets[i]), spec))
+    return out
+
+
+def run_load(url: str, config: LoadConfig, *,
+             wait_timeout_s: float = 120.0,
+             log=lambda *a: None) -> LoadReport:
+    """Replay the workload against a live deployment and wait for every
+    job to finish."""
+    workload = generate_workload(config)
+    clients = [JobClient(url, user=f"user{u}")
+               for u in range(config.n_users)]
+    report = LoadReport()
+    submitted: dict[str, float] = {}  # uuid -> submit wall time
+    start = time.time()
+
+    i = 0
+    while i < len(workload):
+        now = time.time() - start
+        due = []
+        while i < len(workload) and workload[i][0] <= now \
+                and len(due) < config.batch_max:
+            due.append(workload[i][1])
+            i += 1
+        if not due:
+            time.sleep(min(workload[i][0] - now, 0.05))
+            continue
+        client = clients[i % len(clients)]
+        t0 = time.time()
+        uuids = client.submit(due)
+        report.submit_latency_ms.append((time.time() - t0) * 1000)
+        for uuid in uuids:
+            submitted[uuid] = time.time()
+        report.submitted += len(uuids)
+        if report.submitted % 100 == 0:
+            log(f"submitted {report.submitted}/{config.n_jobs}")
+
+    # wait for completion, recording time-to-first-instance
+    deadline = time.time() + wait_timeout_s
+    pending = set(submitted)
+    poll_client = clients[0]
+    while pending and time.time() < deadline:
+        batch = list(pending)[:256]
+        for job in poll_client.query(batch):
+            uuid = job["uuid"]
+            if uuid not in report.schedule_latency_ms and job["instances"]:
+                report.schedule_latency_ms[uuid] = (
+                    (time.time() - submitted[uuid]) * 1000)
+            if job["status"] == "completed":
+                pending.discard(uuid)
+                if any(i.get("status") == "success"
+                       for i in job["instances"]):
+                    report.completed += 1
+                else:
+                    report.failed += 1
+        if pending:
+            time.sleep(0.2)
+    report.wall_s = time.time() - start
+    return report

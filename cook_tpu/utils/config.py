@@ -58,6 +58,9 @@ class Settings:
     # authenticator config ({"kind": "dev"|"basic"|"spnego"|"composite"});
     # empty = the permissive dev stack (rest/auth.py)
     auth: dict = field(default_factory=dict)
+    # shared secret for executor heartbeat/progress posts ("" = not
+    # enforced); executors read it from COOK_EXECUTOR_TOKEN
+    executor_token: str = ""
 
     def match_config_for_pool(self, pool_name: str) -> MatchConfig:
         for ps in self.pool_schedulers:
@@ -102,6 +105,8 @@ def read_config(path: Optional[str] = None,
         settings.cors_origins = tuple(data["cors_origins"])
     if "auth" in data:
         settings.auth = dict(data["auth"])
+    if "executor_token" in data:
+        settings.executor_token = str(data["executor_token"])
     if "pools" in data:
         settings.pools = data["pools"]
     if "clusters" in data:

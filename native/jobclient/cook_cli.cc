@@ -54,6 +54,7 @@ int main(int argc, char** argv) {
       printf("%s\n", status.status.c_str());
       return status.completed() && status.succeeded() ? 0 : 1;
     } else if (cmd == "show") {
+      if (i >= argc) { fprintf(stderr, "show needs a uuid\n"); return 2; }
       cook::JobStatus status = client.query(argv[i]);
       printf("%s %s\n", status.uuid.c_str(), status.status.c_str());
       for (const auto& inst : status.instances) {
@@ -61,6 +62,7 @@ int main(int argc, char** argv) {
                inst.status.c_str(), inst.hostname.c_str());
       }
     } else if (cmd == "kill") {
+      if (i >= argc) { fprintf(stderr, "kill needs a uuid\n"); return 2; }
       client.kill(argv[i]);
       printf("killed\n");
     } else {
@@ -69,6 +71,10 @@ int main(int argc, char** argv) {
     }
   } catch (const cook::JobClientError& e) {
     fprintf(stderr, "error (%d): %s\n", e.status, e.what());
+    return 1;
+  } catch (const std::exception& e) {
+    // e.g. the JSON parser on a non-JSON body from a proxy
+    fprintf(stderr, "error: %s\n", e.what());
     return 1;
   }
   return 0;

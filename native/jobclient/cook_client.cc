@@ -298,8 +298,19 @@ JobStatus JobClient::wait(const std::string& uuid, int timeout_ms) {
                   std::chrono::milliseconds(timeout_ms);
   std::string last;
   JobStatus status;
+  int consecutive_failures = 0;
   while (true) {
-    status = query(uuid);
+    try {
+      status = query(uuid);
+      consecutive_failures = 0;
+    } catch (const std::exception&) {
+      // transient blips (leader failover, dropped connection) must not
+      // abort a long wait — the Java client polls through them too
+      if (++consecutive_failures >= 5) throw;
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(cfg_.poll_ms_ * consecutive_failures));
+      continue;
+    }
     if (status.status != last) {
       last = status.status;
       if (listener_) listener_(status);

@@ -157,6 +157,28 @@ def test_machine_endpoints_exempt_under_strict_auth(store):
         srv.stop()
 
 
+def test_executor_token_gates_machine_posts(store):
+    """With executor_token configured, heartbeat/progress posts need the
+    shared secret — an unauthenticated peer can no longer spoof executor
+    liveness for someone else's task."""
+    api = CookApi(store, config=ApiConfig(
+        authenticator=SpnegoAuthenticator(), executor_token="s3cret"))
+    srv = serve(api)
+    try:
+        r = requests.post(f"{srv.url}/heartbeat/nope")
+        assert r.status_code == 401
+        r = requests.post(f"{srv.url}/heartbeat/nope",
+                          headers={"X-Cook-Executor-Token": "wrong"})
+        assert r.status_code == 401
+        r = requests.post(f"{srv.url}/heartbeat/nope",
+                          headers={"X-Cook-Executor-Token": "s3cret"})
+        assert r.status_code != 401
+        # health stays open regardless
+        assert requests.get(f"{srv.url}/debug").status_code == 200
+    finally:
+        srv.stop()
+
+
 ADMIN_GATED = [
     ("POST", "/compute-clusters", {"name": "x", "kind": "mock"}),
     ("DELETE", "/compute-clusters/m", None),

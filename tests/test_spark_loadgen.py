@@ -1,0 +1,105 @@
+"""Spark backend integration + deploy-scale load generator, both driven
+against a live service process (reference: spark/ patches, simulator/)."""
+import time
+
+import pytest
+
+from cook_tpu.client.jobclient import JobClient
+from cook_tpu.components import build_process, shutdown, start_leader_duties
+from cook_tpu.integrations.spark import (
+    SparkCookBackend,
+    SparkExecutorSpec,
+    parse_master_url,
+)
+from cook_tpu.rest.server import free_port
+from cook_tpu.sim.loadgen import LoadConfig, generate_workload, run_load
+from cook_tpu.utils.config import Settings
+
+
+@pytest.fixture(scope="module")
+def service():
+    settings = Settings(
+        port=free_port(),
+        rank_interval_s=0.2, match_interval_s=0.2,
+        clusters=[{"kind": "mock", "name": "m", "default_runtime_ms": 600,
+                   "hosts": [{"node_id": f"h{i}", "mem": 32000, "cpus": 32}
+                             for i in range(4)]}],
+    )
+    process = build_process(settings)
+    start_leader_duties(process, block=False, on_loss=lambda: None)
+    yield f"http://127.0.0.1:{settings.port}", process
+    shutdown(process)
+
+
+def test_parse_master_url():
+    master = parse_master_url("cook://alice@scheduler:12321")
+    assert master.user == "alice"
+    assert master.url == "http://scheduler:12321"
+    assert parse_master_url("cook://host:1").user == "spark"
+    with pytest.raises(ValueError):
+        parse_master_url("spark://host:1")
+    with pytest.raises(ValueError):
+        parse_master_url("cook://nohostport")
+
+
+def test_spark_backend_fleet_lifecycle(service):
+    url, process = service
+    host, port = url.rsplit("//", 1)[1].split(":")
+    backend = SparkCookBackend(
+        f"cook://spark-user@{host}:{port}",
+        driver_url="spark://CoarseGrainedScheduler@driver:7077",
+        spec=SparkExecutorSpec(executor_cores=2, executor_mem=1024,
+                               max_cores=8),
+    )
+    with backend:
+        # spark.cores.max=8 / executor.cores=2 -> 4 executors
+        assert len(backend.executors) == 4
+        client = JobClient(url, user="spark-user")
+        jobs = client.query(list(backend.executors.values()))
+        # every executor carries a distinct id + the driver url
+        ids = {j["env"]["SPARK_EXECUTOR_ID"] for j in jobs}
+        assert len(ids) == 4
+        assert all("--driver-url spark://CoarseGrainedScheduler@driver:7077"
+                   in j["command"] for j in jobs)
+        # executors run on the cluster
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(s == "running"
+                   for s in backend.executor_status().values()):
+                break
+            time.sleep(0.1)
+        assert set(backend.executor_status().values()) == {"running"}
+
+        # dynamic allocation: shrink kills the newest executors
+        backend.request_total_executors(2)
+        assert len(backend.executors) == 2
+        assert sorted(backend.executors, key=int) == ["0", "1"]
+        # grow again mints fresh ids (Spark never reuses executor ids)
+        backend.request_total_executors(3)
+        assert "4" in backend.executors
+    # context exit killed the fleet
+    assert backend.executors == {}
+    listed = JobClient(url, user="spark-user").list_jobs(
+        "spark-user", states=("running",))
+    assert not [j for j in listed if j["name"].startswith("spark-executor")]
+
+
+def test_workload_generation_deterministic():
+    a = generate_workload(LoadConfig(n_jobs=20, seed=5))
+    b = generate_workload(LoadConfig(n_jobs=20, seed=5))
+    assert [s for _, s in a] == [s for _, s in b]
+    offsets = [t for t, _ in a]
+    assert offsets == sorted(offsets)
+
+
+def test_loadgen_against_live_service(service):
+    url, process = service
+    config = LoadConfig(n_jobs=40, rate_per_minute=6000, n_users=4,
+                        seed=3, speedup=10.0)
+    report = run_load(url, config, wait_timeout_s=60)
+    summary = report.summary()
+    assert summary["submitted"] == 40
+    assert summary["completed"] == 40
+    assert summary["failed"] == 0
+    assert summary["submit_ms_p50"] is not None
+    assert summary["schedule_ms_p50"] is not None
