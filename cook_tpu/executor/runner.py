@@ -151,21 +151,27 @@ class TaskRunner:
             pass
 
 
+def _executor_session(session=None):
+    """requests.Session carrying the shared executor secret
+    (COOK_EXECUTOR_TOKEN) so heartbeat/progress posts stay spoof-proof
+    under strict auth."""
+    import requests
+
+    session = session or requests.Session()
+    token = os.environ.get("COOK_EXECUTOR_TOKEN", "")
+    if token:
+        session.headers["X-Cook-Executor-Token"] = token
+    return session
+
+
 class RestUpdateSink:
     """Publishes executor updates to the scheduler's REST API (the k8s-mode
     transport; the sidecar progress reporter does the same,
     sidecar/progress.py)."""
 
     def __init__(self, base_url: str, session=None):
-        import requests
-
         self.base_url = base_url.rstrip("/")
-        self.session = session or requests.Session()
-        # shared executor secret (COOK_EXECUTOR_TOKEN): lets the API keep
-        # heartbeat/progress spoof-proof under strict auth
-        token = os.environ.get("COOK_EXECUTOR_TOKEN", "")
-        if token:
-            self.session.headers["X-Cook-Executor-Token"] = token
+        self.session = _executor_session(session)
 
     def __call__(self, update: TaskUpdate) -> None:
         if update.kind == "progress":
@@ -186,14 +192,9 @@ class HeartbeatSender:
 
     def __init__(self, base_url: str, task_id: str, *,
                  interval_s: float = 30.0, session=None):
-        import requests
-
         self.url = f"{base_url.rstrip('/')}/heartbeat/{task_id}"
         self.interval_s = interval_s
-        self.session = session or requests.Session()
-        token = os.environ.get("COOK_EXECUTOR_TOKEN", "")
-        if token:
-            self.session.headers["X-Cook-Executor-Token"] = token
+        self.session = _executor_session(session)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

@@ -76,13 +76,60 @@ def _best_node_kernel(d_ref, avail_ref, totals_ref, valid_ref,
         )
 
 
+def _best_node_masked_kernel(d_ref, avail_ref, totals_ref, valid_ref,
+                             feas_ref, best_val_ref, best_idx_ref):
+    """`_best_node_kernel` with a per-(job, node) constraint mask block —
+    the encoded feasibility_mask tile rides along in VMEM."""
+    n_tile = pl.program_id(1)
+    bn = avail_ref.shape[0]
+
+    d = d_ref[:]
+    avail = avail_ref[:]
+    totals = totals_ref[:]
+    valid = valid_ref[:]
+    feas_mask = feas_ref[:] > 0       # [BK, BN]
+
+    fits = jnp.all(avail[None, :, :] >= d[:, None, :], axis=-1)
+    feasible = fits & (valid[None, :] > 0) & feas_mask
+    denom0 = jnp.maximum(totals[:, 0], 1e-30)
+    denom1 = jnp.maximum(totals[:, 1], 1e-30)
+    used0 = totals[:, 0] - avail[:, 0]
+    used1 = totals[:, 1] - avail[:, 1]
+    fit = ((used0[None, :] + d[:, 0:1]) / denom0[None, :]
+           + (used1[None, :] + d[:, 1:2]) / denom1[None, :]) * 0.5
+    score = jnp.where(feasible, fit, -BIG)
+
+    local_best = jnp.max(score, axis=1)
+    col = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1)
+    local_idx = jnp.max(
+        jnp.where(score == local_best[:, None], bn - col, 0), axis=1
+    )
+    local_idx = (bn - local_idx) + n_tile * bn
+
+    @pl.when(n_tile == 0)
+    def _init():
+        best_val_ref[:] = local_best
+        best_idx_ref[:] = local_idx.astype(jnp.int32)
+
+    @pl.when(n_tile > 0)
+    def _accum():
+        prev_val = best_val_ref[:]
+        prev_idx = best_idx_ref[:]
+        take_new = local_best > prev_val
+        best_val_ref[:] = jnp.where(take_new, local_best, prev_val)
+        best_idx_ref[:] = jnp.where(
+            take_new, local_idx.astype(jnp.int32), prev_idx
+        )
+
+
 @functools.partial(jax.jit, static_argnames=("block_jobs", "block_nodes",
                                              "interpret"))
 def best_node(
-    demands: jnp.ndarray,     # [K, 3]
-    avail: jnp.ndarray,       # [N, 3]
+    demands: jnp.ndarray,     # [K, R] (R >= 3; only first 3 scored)
+    avail: jnp.ndarray,       # [N, R]
     totals: jnp.ndarray,      # [N, 2]
     node_valid: jnp.ndarray,  # [N] (bool or int)
+    feasible=None,            # optional [K, N] constraint mask
     *,
     block_jobs: int = 256,
     block_nodes: int = 512,
@@ -91,28 +138,53 @@ def best_node(
     """Per-job best feasible node: returns (best_score [K], best_idx [K]);
     best_idx is -1 (and score -BIG) when no node is feasible."""
     k, n = demands.shape[0], avail.shape[0]
-    assert k % block_jobs == 0 and n % block_nodes == 0
+    # largest dividing block <= requested: any k/n works (chunk sizes are
+    # caller-chosen, not always powers of two)
+    block_jobs = min(block_jobs, k)
+    while k % block_jobs:
+        block_jobs -= 1
+    block_nodes = min(block_nodes, n)
+    while n % block_nodes:
+        block_nodes -= 1
     valid_i = node_valid.astype(jnp.int32)
+    r = demands.shape[-1]
 
-    best_val, best_idx = pl.pallas_call(
-        _best_node_kernel,
-        grid=(k // block_jobs, n // block_nodes),
-        in_specs=[
-            pl.BlockSpec((block_jobs, 3), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_nodes, 3), lambda i, j: (j, 0)),
-            pl.BlockSpec((block_nodes, 2), lambda i, j: (j, 0)),
-            pl.BlockSpec((block_nodes,), lambda i, j: (j,)),
-        ],
-        out_specs=[
-            pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
-            pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((k,), jnp.float32),
-            jax.ShapeDtypeStruct((k,), jnp.int32),
-        ],
-        interpret=interpret,
-    )(demands.astype(jnp.float32), avail.astype(jnp.float32),
-      totals.astype(jnp.float32), valid_i)
+    job_specs = [
+        pl.BlockSpec((block_jobs, r), lambda i, j: (i, 0)),
+        pl.BlockSpec((block_nodes, r), lambda i, j: (j, 0)),
+        pl.BlockSpec((block_nodes, 2), lambda i, j: (j, 0)),
+        pl.BlockSpec((block_nodes,), lambda i, j: (j,)),
+    ]
+    out_specs = [
+        pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
+        pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((k,), jnp.float32),
+        jax.ShapeDtypeStruct((k,), jnp.int32),
+    ]
+    args = (demands.astype(jnp.float32), avail.astype(jnp.float32),
+            totals.astype(jnp.float32), valid_i)
+    if feasible is None:
+        best_val, best_idx = pl.pallas_call(
+            _best_node_kernel,
+            grid=(k // block_jobs, n // block_nodes),
+            in_specs=job_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*args)
+    else:
+        best_val, best_idx = pl.pallas_call(
+            _best_node_masked_kernel,
+            grid=(k // block_jobs, n // block_nodes),
+            in_specs=job_specs + [
+                pl.BlockSpec((block_jobs, block_nodes),
+                             lambda i, j: (i, j)),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*args, feasible.astype(jnp.int32))
     found = best_val > -BIG
     return best_val, jnp.where(found, best_idx, -1)

@@ -241,8 +241,14 @@ class CookApi:
         if request.method == "POST" and (path.startswith("/heartbeat/")
                                          or path.startswith("/progress/")):
             token = self.config.executor_token
-            return (not token
-                    or request.headers.get("X-Cook-Executor-Token") == token)
+            if not token:
+                return True
+            # constant-time: this is the one credential that bypasses
+            # strict auth; == would leak a byte-at-a-time timing oracle
+            import hmac
+
+            presented = request.headers.get("X-Cook-Executor-Token", "")
+            return hmac.compare_digest(presented, token)
         return False
 
     def _apply_cors(self, request: web.Request, response) -> None:

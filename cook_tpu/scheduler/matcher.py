@@ -49,6 +49,10 @@ class MatchConfig:
     chunk: int = 0           # 0 = exact sequential greedy kernel
     chunk_rounds: int = 4
     chunk_passes: int = 2    # candidate recomputes per chunk
+    chunk_kc: int = 128      # candidate-list width per job
+    # "xla" (approx_max_k candidate lists) or "pallas" (fused
+    # feasibility+fitness+argmax kernel, ops/pallas_match.py)
+    backend: str = "xla"
     # estimated-completion constraint (constraints.clj:385 +
     # estimated-completion-config): 0 multiplier or lifetime = disabled
     completion_multiplier: float = 0.0
@@ -530,7 +534,9 @@ def match_pool(
         if config.chunk:
             result = chunked_match(prepared.problem, chunk=config.chunk,
                                    rounds=config.chunk_rounds,
-                                   passes=config.chunk_passes)
+                                   passes=config.chunk_passes,
+                                   kc=config.chunk_kc,
+                                   use_pallas=config.backend == "pallas")
         else:
             result = greedy_match(prepared.problem)
         assignment = np.asarray(
@@ -610,7 +616,10 @@ def match_pools_batched(
             result = jax.vmap(
                 lambda p: chunked_match(p, chunk=config.chunk,
                                         rounds=config.chunk_rounds,
-                                        passes=config.chunk_passes)
+                                        passes=config.chunk_passes,
+                                        kc=config.chunk_kc,
+                                        use_pallas=(config.backend
+                                                    == "pallas"))
             )(stacked)
         else:
             result = jax.vmap(greedy_match)(stacked)

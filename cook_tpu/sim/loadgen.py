@@ -127,24 +127,29 @@ def run_load(url: str, config: LoadConfig, *,
         if report.submitted % 100 == 0:
             log(f"submitted {report.submitted}/{config.n_jobs}")
 
-    # wait for completion, recording time-to-first-instance
+    # wait for completion, recording time-to-first-instance; every poll
+    # sweep covers the ENTIRE pending set (batched requests), or jobs
+    # beyond the first window would get inflated schedule latencies and
+    # a wedged prefix would starve the rest
     deadline = time.time() + wait_timeout_s
     pending = set(submitted)
     poll_client = clients[0]
     while pending and time.time() < deadline:
-        batch = list(pending)[:256]
-        for job in poll_client.query(batch):
-            uuid = job["uuid"]
-            if uuid not in report.schedule_latency_ms and job["instances"]:
-                report.schedule_latency_ms[uuid] = (
-                    (time.time() - submitted[uuid]) * 1000)
-            if job["status"] == "completed":
-                pending.discard(uuid)
-                if any(i.get("status") == "success"
-                       for i in job["instances"]):
-                    report.completed += 1
-                else:
-                    report.failed += 1
+        snapshot = list(pending)
+        for start in range(0, len(snapshot), 256):
+            for job in poll_client.query(snapshot[start:start + 256]):
+                uuid = job["uuid"]
+                if uuid not in report.schedule_latency_ms \
+                        and job["instances"]:
+                    report.schedule_latency_ms[uuid] = (
+                        (time.time() - submitted[uuid]) * 1000)
+                if job["status"] == "completed":
+                    pending.discard(uuid)
+                    if any(i.get("status") == "success"
+                           for i in job["instances"]):
+                        report.completed += 1
+                    else:
+                        report.failed += 1
         if pending:
             time.sleep(0.2)
     report.wall_s = time.time() - start

@@ -75,6 +75,9 @@ def _match_config(d: dict) -> MatchConfig:
         scaleback=float(d.get("scaleback", 0.95)),
         chunk=int(d.get("chunk", 0)),
         chunk_rounds=int(d.get("chunk_rounds", 6)),
+        chunk_passes=int(d.get("chunk_passes", 2)),
+        chunk_kc=int(d.get("chunk_kc", 128)),
+        backend=str(d.get("backend", "xla")),
         completion_multiplier=float(d.get("completion_multiplier", 0.0)),
         host_lifetime_mins=float(d.get("host_lifetime_mins", 0.0)),
         agent_start_grace_mins=float(d.get("agent_start_grace_mins", 10.0)),

@@ -303,10 +303,14 @@ JobStatus JobClient::wait(const std::string& uuid, int timeout_ms) {
     try {
       status = query(uuid);
       consecutive_failures = 0;
-    } catch (const std::exception&) {
-      // transient blips (leader failover, dropped connection) must not
-      // abort a long wait — the Java client polls through them too
+    } catch (const JobClientError& e) {
+      // definitive HTTP errors (404 unknown job, 401/403 auth) fail
+      // fast; only transport-level failures (status 0: dropped
+      // connection, leader failover) are polled through, like the Java
+      // client does — and never past the deadline
+      if (e.status != 0) throw;
       if (++consecutive_failures >= 5) throw;
+      if (std::chrono::steady_clock::now() >= deadline) return status;
       std::this_thread::sleep_for(
           std::chrono::milliseconds(cfg_.poll_ms_ * consecutive_failures));
       continue;
