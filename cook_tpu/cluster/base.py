@@ -86,6 +86,12 @@ class TaskSpec:
     # concrete ports assigned from the offer's ranges (mesos/task.clj
     # port assignment; surfaced to the task as PORT0..PORTn env vars)
     ports: tuple = ()
+    # job checkpointing (schema.clj:84 :job/checkpoint): backends wire
+    # mode/period into the task sandbox (k8s: tools volume + init
+    # container + env, api.clj:934,1173-1198)
+    checkpoint_mode: str = ""            # "" = checkpointing off
+    checkpoint_periodic_sec: int = 0
+    checkpoint_preserve_paths: tuple = ()
 
 
 class ClusterState(enum.Enum):

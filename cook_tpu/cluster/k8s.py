@@ -70,6 +70,9 @@ class KubePod:
     pool: str = ""
     # host ports assigned to this pod (surfaced as hostPort entries)
     ports: tuple = ()
+    # checkpointing (api.clj:934 init container + :1173 volume wiring)
+    checkpoint_mode: str = ""
+    checkpoint_periodic_sec: int = 0
 
 
 class KubeApi:
@@ -266,6 +269,8 @@ class KubeCluster(ComputeCluster):
                     env=tuple(spec.env),
                     pool=pool,
                     ports=tuple(spec.ports),
+                    checkpoint_mode=spec.checkpoint_mode,
+                    checkpoint_periodic_sec=spec.checkpoint_periodic_sec,
                 ))
             except Exception:
                 self._report(spec.task_id, InstanceStatus.FAILED,

@@ -139,6 +139,7 @@ class HttpKubeApi(KubeApi):
         default_image: str = "busybox:stable",
         file_server_port: int = 0,
         file_server_image: str = "",
+        checkpoint_tools_image: str = "",
     ):
         self.base_url = base_url.rstrip("/")
         # apiservers behind path-prefixed proxies (kubeconfig allows
@@ -154,6 +155,7 @@ class HttpKubeApi(KubeApi):
         self.default_image = default_image
         self.file_server_port = file_server_port
         self.file_server_image = file_server_image
+        self.checkpoint_tools_image = checkpoint_tools_image
         self._watch_cb: Optional[Callable[[str, Optional[KubePod]], None]] = None
         self._known: dict[str, KubePod] = {}  # watch-maintained local view
         self._synced = threading.Event()  # set after the first LIST
@@ -290,7 +292,18 @@ class HttpKubeApi(KubeApi):
     def pod_manifest(self, pod: KubePod) -> dict:
         """launch-pod parity (api.clj:2152): main container + optional
         sidecar file server, resource requests == limits, labels, node
-        binding, synthetic priority class."""
+        binding, synthetic priority class, checkpointing volume/init
+        container/memory overhead (api.clj:934,1152-1198)."""
+        # checkpoint env vars (mode/period/preserve-paths) arrive already
+        # folded into pod.env by the matcher, and the memory overhead is
+        # already in pod.mem — match-time padding keeps placement and the
+        # launched pod in agreement (a backend-only pad would direct-bind
+        # pods the kubelet rejects OutOfmemory on tight-fit nodes)
+        checkpointing = bool(pod.checkpoint_mode)
+        volume_mounts = []
+        if checkpointing:
+            volume_mounts = [{"name": "cook-checkpoint-tools",
+                              "mountPath": "/opt/cook-checkpoint"}]
         containers = [{
             "name": "cook-job",
             "image": pod.image or self.default_image,
@@ -298,6 +311,7 @@ class HttpKubeApi(KubeApi):
             "env": [{"name": k, "value": str(v)} for k, v in pod.env],
             **({"ports": [{"containerPort": p, "hostPort": p}
                           for p in pod.ports]} if pod.ports else {}),
+            **({"volumeMounts": volume_mounts} if volume_mounts else {}),
             "resources": {
                 "requests": {
                     "memory": format_mem(pod.mem),
@@ -321,6 +335,24 @@ class HttpKubeApi(KubeApi):
                 "ports": [{"containerPort": self.file_server_port}],
                 "resources": {"requests": {"memory": "64Mi", "cpu": "0.1"}},
             })
+        init_containers = []
+        volumes = []
+        if checkpointing:
+            # the tools volume is populated by an init container from the
+            # checkpoint image, so app images stay checkpoint-agnostic
+            # (aux-cook-init-container-for-checkpoint, api.clj:934)
+            volumes.append({"name": "cook-checkpoint-tools",
+                            "emptyDir": {}})
+            init_containers.append({
+                "name": "aux-cook-init-container-for-checkpoint",
+                "image": (self.checkpoint_tools_image
+                          or self.default_image),
+                "command": ["/bin/sh", "-c",
+                            "cp -r /opt/checkpoint-tools/. "
+                            "/opt/cook-checkpoint/ 2>/dev/null || true"],
+                "volumeMounts": [{"name": "cook-checkpoint-tools",
+                                  "mountPath": "/opt/cook-checkpoint"}],
+            })
         manifest = {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -337,6 +369,9 @@ class HttpKubeApi(KubeApi):
             "spec": {
                 "restartPolicy": "Never",
                 "containers": containers,
+                **({"initContainers": init_containers}
+                   if init_containers else {}),
+                **({"volumes": volumes} if volumes else {}),
                 # synthetic pods must be preemptible by real workloads
                 **({"priorityClassName": SYNTHETIC_PRIORITY_CLASS}
                    if pod.synthetic else {}),

@@ -120,6 +120,7 @@ def _k8s_http_factory(conf: dict, clock) -> ComputeCluster:
         file_server_port=int(conf.get("file_server_port", 0)),
         file_server_image=conf.get("file_server_image", ""),
         watch_timeout_s=float(conf.get("watch_timeout_s", 300.0)),
+        checkpoint_tools_image=conf.get("checkpoint_tools_image", ""),
     )
     cluster = KubeCluster(conf["name"], api, clock,
                           synthetic_pod_limits=conf.get("synthetic_pods", {}))

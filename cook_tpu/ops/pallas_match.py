@@ -28,21 +28,18 @@ from jax.experimental import pallas as pl
 from cook_tpu.ops.common import BIG
 
 
-def _best_node_kernel(d_ref, avail_ref, totals_ref, valid_ref,
-                      best_val_ref, best_idx_ref):
-    """Grid = (jobs/BK, nodes/BN); node axis is innermost (sequential), so
-    (best_val, best_idx) accumulate across node tiles."""
-    n_tile = pl.program_id(1)
-    bn = avail_ref.shape[0]
-
-    d = d_ref[:]                      # [BK, 3]
-    avail = avail_ref[:]              # [BN, 3]
-    totals = totals_ref[:]            # [BN, 2]
-    valid = valid_ref[:]              # [BN]
+def _score_and_accumulate(d, avail, totals, valid, feas_mask,
+                          n_tile, best_val_ref, best_idx_ref):
+    """Shared kernel body: feasibility + cpuMemBinPacker fitness + argmax
+    for one (job-block, node-tile) pair, accumulated across node tiles.
+    `feas_mask` is an optional [BK, BN] constraint-mask tile."""
+    bn = avail.shape[0]
 
     # feasibility: every resource fits  -> [BK, BN]
     fits = jnp.all(avail[None, :, :] >= d[:, None, :], axis=-1)
     feasible = fits & (valid[None, :] > 0)
+    if feas_mask is not None:
+        feasible = feasible & feas_mask
     # cpuMemBinPacker fitness
     denom0 = jnp.maximum(totals[:, 0], 1e-30)
     denom1 = jnp.maximum(totals[:, 1], 1e-30)
@@ -76,50 +73,22 @@ def _best_node_kernel(d_ref, avail_ref, totals_ref, valid_ref,
         )
 
 
+def _best_node_kernel(d_ref, avail_ref, totals_ref, valid_ref,
+                      best_val_ref, best_idx_ref):
+    """Grid = (jobs/BK, nodes/BN); node axis is innermost (sequential), so
+    (best_val, best_idx) accumulate across node tiles."""
+    _score_and_accumulate(d_ref[:], avail_ref[:], totals_ref[:],
+                          valid_ref[:], None, pl.program_id(1),
+                          best_val_ref, best_idx_ref)
+
+
 def _best_node_masked_kernel(d_ref, avail_ref, totals_ref, valid_ref,
                              feas_ref, best_val_ref, best_idx_ref):
     """`_best_node_kernel` with a per-(job, node) constraint mask block —
     the encoded feasibility_mask tile rides along in VMEM."""
-    n_tile = pl.program_id(1)
-    bn = avail_ref.shape[0]
-
-    d = d_ref[:]
-    avail = avail_ref[:]
-    totals = totals_ref[:]
-    valid = valid_ref[:]
-    feas_mask = feas_ref[:] > 0       # [BK, BN]
-
-    fits = jnp.all(avail[None, :, :] >= d[:, None, :], axis=-1)
-    feasible = fits & (valid[None, :] > 0) & feas_mask
-    denom0 = jnp.maximum(totals[:, 0], 1e-30)
-    denom1 = jnp.maximum(totals[:, 1], 1e-30)
-    used0 = totals[:, 0] - avail[:, 0]
-    used1 = totals[:, 1] - avail[:, 1]
-    fit = ((used0[None, :] + d[:, 0:1]) / denom0[None, :]
-           + (used1[None, :] + d[:, 1:2]) / denom1[None, :]) * 0.5
-    score = jnp.where(feasible, fit, -BIG)
-
-    local_best = jnp.max(score, axis=1)
-    col = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1)
-    local_idx = jnp.max(
-        jnp.where(score == local_best[:, None], bn - col, 0), axis=1
-    )
-    local_idx = (bn - local_idx) + n_tile * bn
-
-    @pl.when(n_tile == 0)
-    def _init():
-        best_val_ref[:] = local_best
-        best_idx_ref[:] = local_idx.astype(jnp.int32)
-
-    @pl.when(n_tile > 0)
-    def _accum():
-        prev_val = best_val_ref[:]
-        prev_idx = best_idx_ref[:]
-        take_new = local_best > prev_val
-        best_val_ref[:] = jnp.where(take_new, local_best, prev_val)
-        best_idx_ref[:] = jnp.where(
-            take_new, local_idx.astype(jnp.int32), prev_idx
-        )
+    _score_and_accumulate(d_ref[:], avail_ref[:], totals_ref[:],
+                          valid_ref[:], feas_ref[:] > 0, pl.program_id(1),
+                          best_val_ref, best_idx_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("block_jobs", "block_nodes",
@@ -138,15 +107,26 @@ def best_node(
     """Per-job best feasible node: returns (best_score [K], best_idx [K]);
     best_idx is -1 (and score -BIG) when no node is feasible."""
     k, n = demands.shape[0], avail.shape[0]
-    # largest dividing block <= requested: any k/n works (chunk sizes are
-    # caller-chosen, not always powers of two)
+    # pad up to block multiples rather than shrinking the block: a prime
+    # node count would otherwise degenerate to 1-wide tiles (a sequential
+    # grid, and a Mosaic lane-tiling violation on real TPUs).  Padded
+    # jobs are unsatisfiable, padded nodes invalid — neither can win.
     block_jobs = min(block_jobs, k)
-    while k % block_jobs:
-        block_jobs -= 1
     block_nodes = min(block_nodes, n)
-    while n % block_nodes:
-        block_nodes -= 1
+    pad_k = (-k) % block_jobs
+    pad_n = (-n) % block_nodes
     valid_i = node_valid.astype(jnp.int32)
+    if pad_k:
+        demands = jnp.pad(demands, ((0, pad_k), (0, 0)),
+                          constant_values=2 * BIG)
+    if pad_n:
+        avail = jnp.pad(avail, ((0, pad_n), (0, 0)))
+        totals = jnp.pad(totals, ((0, pad_n), (0, 0)))
+        valid_i = jnp.pad(valid_i, (0, pad_n))
+    if feasible is not None and (pad_k or pad_n):
+        feasible = jnp.pad(feasible, ((0, pad_k), (0, pad_n)))
+    padded_k = k + pad_k
+    padded_n = n + pad_n
     r = demands.shape[-1]
 
     job_specs = [
@@ -160,15 +140,15 @@ def best_node(
         pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((k,), jnp.float32),
-        jax.ShapeDtypeStruct((k,), jnp.int32),
+        jax.ShapeDtypeStruct((padded_k,), jnp.float32),
+        jax.ShapeDtypeStruct((padded_k,), jnp.int32),
     ]
     args = (demands.astype(jnp.float32), avail.astype(jnp.float32),
             totals.astype(jnp.float32), valid_i)
     if feasible is None:
         best_val, best_idx = pl.pallas_call(
             _best_node_kernel,
-            grid=(k // block_jobs, n // block_nodes),
+            grid=(padded_k // block_jobs, padded_n // block_nodes),
             in_specs=job_specs,
             out_specs=out_specs,
             out_shape=out_shape,
@@ -177,7 +157,7 @@ def best_node(
     else:
         best_val, best_idx = pl.pallas_call(
             _best_node_masked_kernel,
-            grid=(k // block_jobs, n // block_nodes),
+            grid=(padded_k // block_jobs, padded_n // block_nodes),
             in_specs=job_specs + [
                 pl.BlockSpec((block_jobs, block_nodes),
                              lambda i, j: (i, j)),
@@ -186,5 +166,7 @@ def best_node(
             out_shape=out_shape,
             interpret=interpret,
         )(*args, feasible.astype(jnp.int32))
+    best_val = best_val[:k]
+    best_idx = best_idx[:k]
     found = best_val > -BIG
     return best_val, jnp.where(found, best_idx, -1)

@@ -58,6 +58,11 @@ class MatchConfig:
     completion_multiplier: float = 0.0
     host_lifetime_mins: float = 0.0
     agent_start_grace_mins: float = 10.0
+    # extra memory a checkpointing job consumes for its tooling, applied
+    # at MATCH time (demands + TaskSpec) so placement and the launched
+    # pod agree — padding only in the backend would direct-bind pods the
+    # kubelet must reject (calculate-effective-resources, api.clj:1152)
+    checkpoint_memory_overhead_mb: float = 0.0
 
 
 @dataclass
@@ -97,12 +102,22 @@ def select_considerable(
     return out
 
 
+def job_mem_with_overhead(job: Job, config: "MatchConfig") -> float:
+    """Effective memory demand: checkpointing jobs carry the tooling
+    overhead from match time onward."""
+    mem = job.resources.mem
+    if job.checkpoint is not None and job.checkpoint.mode:
+        mem += config.checkpoint_memory_overhead_mb
+    return mem
+
+
 def build_match_problem(
     jobs: Sequence[Job],
     nodes: EncodedNodes,
     feasible: np.ndarray,
     *,
     chunk: int = 0,
+    config: Optional["MatchConfig"] = None,
 ) -> MatchProblem:
     j, n = len(jobs), nodes.n
     pad_j = bucket_size(max(j, 1))
@@ -113,7 +128,9 @@ def build_match_problem(
     demands = np.zeros((j, 4), dtype=np.float32)
     for i, job in enumerate(jobs):
         r = job.resources
-        demands[i] = (r.mem, r.cpus, r.gpus, r.disk)
+        mem = (job_mem_with_overhead(job, config)
+               if config is not None else r.mem)
+        demands[i] = (mem, r.cpus, r.gpus, r.disk)
     avail = np.zeros((n, 4), dtype=np.float32)
     totals = np.zeros((n, 2), dtype=np.float32)
     for i, o in enumerate(nodes.offers):
@@ -349,7 +366,8 @@ def prepare_pool_problem(
             feasible[ji] &= ~has_reservation | (reserved_for == job.uuid)
     prepared.feasible = feasible
     prepared.problem = build_match_problem(considerable, nodes, feasible,
-                                           chunk=config.chunk)
+                                           chunk=config.chunk,
+                                           config=config)
     return prepared
 
 
@@ -448,22 +466,42 @@ def finalize_pool_match(
         except TransactionVetoed:
             # job completed/launched concurrently; drop the match
             continue
+        # checkpoint context rides in the task env uniformly for every
+        # backend (mode/period for the tooling, preserve paths for the
+        # restore — checkpoint->volume-mounts, api.clj:1194)
+        checkpoint_env: tuple = ()
+        if job.checkpoint is not None and job.checkpoint.mode:
+            checkpoint_env = (
+                ("COOK_CHECKPOINT_MODE", job.checkpoint.mode),
+                ("COOK_CHECKPOINT_PERIOD_SEC",
+                 str(job.checkpoint.periodic_sec)),
+            )
+            if job.checkpoint.preserve_paths:
+                checkpoint_env += (
+                    ("COOK_CHECKPOINT_PRESERVE_PATHS",
+                     ":".join(job.checkpoint.preserve_paths)),
+                )
         spec = TaskSpec(
             task_id=task_id,
             job_uuid=job.uuid,
             user=job.user,
             command=job.command,
-            mem=job.resources.mem,
+            mem=job_mem_with_overhead(job, config),
             cpus=job.resources.cpus,
             gpus=job.resources.gpus,
             node_id=offer.node_id,
             hostname=offer.hostname,
             disk=job.resources.disk,
-            env=job.user_provided_env + tuple(
+            env=job.user_provided_env + checkpoint_env + tuple(
                 (f"PORT{i}", str(p)) for i, p in enumerate(task_ports)),
             container_image=(job.container.image if job.container else ""),
             expected_runtime_ms=job.expected_runtime_ms,
             ports=task_ports,
+            checkpoint_mode=(job.checkpoint.mode if job.checkpoint else ""),
+            checkpoint_periodic_sec=(job.checkpoint.periodic_sec
+                                     if job.checkpoint else 0),
+            checkpoint_preserve_paths=(tuple(job.checkpoint.preserve_paths)
+                                       if job.checkpoint else ()),
         )
         launches_per_cluster.setdefault(cluster.name, []).append(spec)
         cluster_by_name[cluster.name] = cluster

@@ -136,8 +136,9 @@ def run_load(url: str, config: LoadConfig, *,
     poll_client = clients[0]
     while pending and time.time() < deadline:
         snapshot = list(pending)
-        for start in range(0, len(snapshot), 256):
-            for job in poll_client.query(snapshot[start:start + 256]):
+        for batch_start in range(0, len(snapshot), 256):
+            for job in poll_client.query(
+                    snapshot[batch_start:batch_start + 256]):
                 uuid = job["uuid"]
                 if uuid not in report.schedule_latency_ms \
                         and job["instances"]:

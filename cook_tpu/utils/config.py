@@ -81,6 +81,8 @@ def _match_config(d: dict) -> MatchConfig:
         completion_multiplier=float(d.get("completion_multiplier", 0.0)),
         host_lifetime_mins=float(d.get("host_lifetime_mins", 0.0)),
         agent_start_grace_mins=float(d.get("agent_start_grace_mins", 10.0)),
+        checkpoint_memory_overhead_mb=float(
+            d.get("checkpoint_memory_overhead_mb", 0.0)),
     )
 
 

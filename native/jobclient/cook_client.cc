@@ -299,18 +299,35 @@ JobStatus JobClient::wait(const std::string& uuid, int timeout_ms) {
   std::string last;
   JobStatus status;
   int consecutive_failures = 0;
+  bool have_status = false;
   while (true) {
     try {
       status = query(uuid);
+      have_status = true;
       consecutive_failures = 0;
     } catch (const JobClientError& e) {
-      // definitive HTTP errors (404 unknown job, 401/403 auth) fail
-      // fast; only transport-level failures (status 0: dropped
-      // connection, leader failover) are polled through, like the Java
-      // client does — and never past the deadline
-      if (e.status != 0) throw;
+      // definitive client errors (404 unknown job, 401/403 auth) fail
+      // fast; transport failures (status 0) AND server-side 5xx (a
+      // proxy answering 502/503 during leader failover) are polled
+      // through like the Java client does — but never past the
+      // deadline, and never swallowed into a default-constructed
+      // status the caller can't distinguish from a real one
+      if (e.status >= 400 && e.status < 500) throw;
       if (++consecutive_failures >= 5) throw;
-      if (std::chrono::steady_clock::now() >= deadline) return status;
+      if (std::chrono::steady_clock::now() >= deadline) {
+        if (!have_status) throw;
+        return status;
+      }
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(cfg_.poll_ms_ * consecutive_failures));
+      continue;
+    } catch (const std::exception&) {
+      // malformed body from an intermediary: transient, same policy
+      if (++consecutive_failures >= 5) throw;
+      if (std::chrono::steady_clock::now() >= deadline) {
+        if (!have_status) throw;
+        return status;
+      }
       std::this_thread::sleep_for(
           std::chrono::milliseconds(cfg_.poll_ms_ * consecutive_failures));
       continue;

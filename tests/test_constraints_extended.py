@@ -134,6 +134,40 @@ def test_estimated_completion_counts_agent_removed_runtimes():
     assert offer.hostname == "fresh"
 
 
+# ----------------------------------------------------- checkpoint overhead
+
+
+def test_checkpoint_overhead_applied_at_match_time():
+    """A checkpointing job's memory demand carries the tooling overhead
+    from MATCH time onward (calculate-effective-resources,
+    api.clj:1152): placement, the TaskSpec, and the checkpoint env all
+    agree, so a backend can never direct-bind a pod the kubelet must
+    reject."""
+    from cook_tpu.models.entities import Checkpoint
+
+    clock, store, cluster, sched = setup(
+        [
+            # only big fits 400 + 200 overhead
+            MockHost(node_id="small", hostname="small", mem=500, cpus=32),
+            MockHost(node_id="big", hostname="big", mem=1000, cpus=32),
+        ],
+        match=MatchConfig(checkpoint_memory_overhead_mb=200),
+    )
+    job = make_job(mem=400, cpus=1,
+                   checkpoint=Checkpoint(mode="auto", periodic_sec=120,
+                                         preserve_paths=("/data", "/ckpt")))
+    store.submit_jobs([job])
+    outcome = cycle(sched, store)
+    [(j, offer)] = outcome.matched
+    assert offer.hostname == "big"
+    [rt] = cluster.running.values()
+    assert rt.spec.mem == 600  # 400 + 200, visible to the backend
+    env = dict(rt.spec.env)
+    assert env["COOK_CHECKPOINT_MODE"] == "auto"
+    assert env["COOK_CHECKPOINT_PERIOD_SEC"] == "120"
+    assert env["COOK_CHECKPOINT_PRESERVE_PATHS"] == "/data:/ckpt"
+
+
 # ------------------------------------------------------------------ ports
 
 

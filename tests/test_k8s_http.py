@@ -88,6 +88,32 @@ def test_launch_builds_full_pod_manifest(apiserver, api):
     assert manifest["metadata"]["labels"][COOK_MANAGED_LABEL] == "true"
 
 
+def test_checkpoint_pod_wiring(apiserver):
+    """A checkpointing job's pod gets the tools volume, init container,
+    and the mount (api.clj:934,1173-1198); checkpoint env and the memory
+    overhead arrive already folded into the TaskSpec by the matcher."""
+    state, url = apiserver
+    state.add_node("n1", 8192, 16)
+    api = HttpKubeApi(url, checkpoint_tools_image="ckpt-tools:1")
+    cluster = KubeCluster("k", api, lambda: 0)
+    import dataclasses
+
+    task = dataclasses.replace(spec(), checkpoint_mode="auto",
+                               checkpoint_periodic_sec=300)
+    cluster.launch_tasks("default", [task])
+    manifest = state.pods["t1"]
+    [init] = manifest["spec"]["initContainers"]
+    assert init["name"] == "aux-cook-init-container-for-checkpoint"
+    assert init["image"] == "ckpt-tools:1"
+    [volume] = manifest["spec"]["volumes"]
+    assert volume["name"] == "cook-checkpoint-tools"
+    [main] = [c for c in manifest["spec"]["containers"]
+              if c["name"] == "cook-job"]
+    assert main["volumeMounts"][0]["mountPath"] == "/opt/cook-checkpoint"
+    # the spec's mem is used verbatim (overhead was added at match time)
+    assert main["resources"]["requests"]["memory"] == "512Mi"
+
+
 def test_watch_drives_controller_to_success(apiserver, api):
     state, _ = apiserver
     state.add_node("n1", 8192, 16)

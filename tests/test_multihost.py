@@ -1,0 +1,25 @@
+"""The DCN scale-out path: two real processes, jax.distributed, one
+global mesh, the pool-sharded match solve spanning both (SURVEY §2.4
+comm-backend row; examples/multihost_dryrun.py is the recipe)."""
+import socket
+import subprocess
+import sys
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dcn_dryrun():
+    port = free_port()
+    out = subprocess.run(
+        [sys.executable, "examples/multihost_dryrun.py", "--workers", "2",
+         "--coordinator", f"127.0.0.1:{port}"],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "multihost dryrun OK" in out.stdout
+    # both processes saw the full 8-device mesh and placed their shards
+    assert out.stdout.count("mesh 8 devices across 2 processes") == 2
