@@ -17,6 +17,15 @@ import numpy as np
 BIG = 1e30
 
 
+def binpack_fitness(used0, used1, d0, d1, denom0, denom1):
+    """cpuMemBinPacker fitness (Fenzo's default, config.clj:108): mean
+    post-placement utilization across mem and cpus.  Plain arithmetic so the
+    ONE definition serves both the jnp kernels (ops/match.py) and the numpy
+    host-side top-up (scheduler/constraints.py) — callers broadcast shapes.
+    """
+    return ((used0 + d0) / denom0 + (used1 + d1) / denom1) * 0.5
+
+
 def bucket_size(n: int, minimum: int = 64) -> int:
     """Round n up to the next power-of-two bucket (>= minimum)."""
     if n <= minimum:

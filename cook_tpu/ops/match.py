@@ -49,7 +49,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from cook_tpu.ops.common import BIG
+from cook_tpu.ops.common import BIG, binpack_fitness
 
 
 class MatchProblem(NamedTuple):
@@ -74,8 +74,8 @@ def _job_step(avail, totals, node_valid, demand, job_ok, feas_row):
     feasible = fits & node_valid & feas_row & job_ok
     used = totals - avail[:, :2]
     denom = jnp.maximum(totals, 1e-30)
-    fit = ((used[:, 0] + demand[0]) / denom[:, 0]
-           + (used[:, 1] + demand[1]) / denom[:, 1]) * 0.5
+    fit = binpack_fitness(used[:, 0], used[:, 1], demand[0], demand[1],
+                          denom[:, 0], denom[:, 1])
     score = jnp.where(feasible, fit, -BIG)
     best = jnp.argmax(score)
     placed = score[best] > -BIG
@@ -199,8 +199,9 @@ def chunked_match(
                         & (ok & unplaced)[:, None])
             used0 = totals[:, 0] - avail[:, 0]
             used1 = totals[:, 1] - avail[:, 1]
-            fit = ((used0[None, :] + d[:, 0:1]) / denom[None, :, 0]
-                   + (used1[None, :] + d[:, 1:2]) / denom[None, :, 1]) * 0.5
+            fit = binpack_fitness(used0[None, :], used1[None, :],
+                                  d[:, 0:1], d[:, 1:2],
+                                  denom[None, :, 0], denom[None, :, 1])
             score = jnp.where(feasible, fit, -BIG)
             if use_approx:
                 return jax.lax.approx_max_k(score, kc, recall_target=0.95)

@@ -22,6 +22,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from cook_tpu.cluster.base import Offer
+from cook_tpu.ops.common import binpack_fitness
 from cook_tpu.models.entities import (
     Group,
     GroupPlacementType,
@@ -33,6 +34,34 @@ from cook_tpu.models.entities import (
 # attr maps with `get`, so nils are counted — constraints.clj:600), not as
 # an infeasible host.
 MISSING_ATTR = "\x00missing"
+
+
+def _closed_value_mask(
+    counts: dict[str, int],
+    minimum: int,
+    codes: np.ndarray,
+    vocab: dict[str, int],
+) -> np.ndarray:
+    """[N] bool: nodes whose attribute value is closed to a balanced group
+    under `counts` — value at the max member count while counts are skewed
+    (until `minimum` distinct values are in play the floor is pinned to 0,
+    forcing spread onto unseen values).  The single encoding of the rule
+    shared by the pre-mask closure and the post-solve top-up."""
+    closed = np.zeros(codes.shape[0], dtype=bool)
+    if not counts:
+        return closed
+    minim = 0 if minimum > len(counts) else min(counts.values())
+    maxim = max(counts.values())
+    if minim == maxim:
+        return closed
+    for value, c in counts.items():
+        if c < maxim:
+            continue
+        if value == MISSING_ATTR:
+            closed |= codes == -1
+        else:
+            closed |= codes == vocab.get(value, -2)
+    return closed
 
 
 @dataclass
@@ -93,6 +122,7 @@ def feasibility_mask(
     offer_locations: Optional[Sequence[str]] = None,
     job_est_end_ms: Optional[np.ndarray] = None,
     host_lifetime_mins: float = 0.0,
+    balanced_pre_rows: Optional[dict[int, np.ndarray]] = None,
 ) -> np.ndarray:
     """Build the [J, N] mask.
 
@@ -214,19 +244,17 @@ def feasibility_mask(
                             # attr absent from every offer: all hosts carry
                             # the nil value (code -1), same as the post-pass
                             codes = np.full(nodes.n, -1, dtype=np.int32)
-                        minim = (0 if minimum > len(counts)
-                                 else min(counts.values()))
-                        maxim = max(counts.values())
-                        if minim != maxim:
-                            for value, c in counts.items():
-                                if c < maxim:
-                                    continue
-                                if value == MISSING_ATTR:
-                                    mask[ji, :] &= codes != -1
-                                else:
-                                    code = nodes.attr_vocab.get(
-                                        attr, {}).get(value, -2)
-                                    mask[ji, :] &= codes != code
+                        closed = _closed_value_mask(
+                            counts, minimum, codes,
+                            nodes.attr_vocab.get(attr, {}))
+                        if closed.any():
+                            # intra-cycle leveling can re-open a closed
+                            # value; keep the pre-closure row so the
+                            # post-solve top-up (balanced_group_topup) can
+                            # retry against live counts
+                            if balanced_pre_rows is not None:
+                                balanced_pre_rows[ji] = mask[ji].copy()
+                            mask[ji, :] &= ~closed
     return mask
 
 
@@ -238,6 +266,7 @@ def validate_group_assignments(
     group_used_hosts: dict[str, set[str]],
     group_attr_value: dict[str, tuple[str, str]],
     group_balance_counts: Optional[dict[str, dict[str, int]]] = None,
+    out_balance_counts: Optional[dict[str, dict[str, int]]] = None,
 ) -> np.ndarray:
     """Post-kernel pass enforcing intra-cycle group semantics: walk matches
     in schedule order; a match that violates its group's unique-host /
@@ -302,4 +331,72 @@ def validate_group_assignments(
                     assignment[ji] = -1
                     continue
             counts[value] = counts.get(value, 0) + 1
+    if out_balance_counts is not None:
+        out_balance_counts.update(balance_counts)
+    return assignment
+
+
+def balanced_group_topup(
+    jobs: Sequence[Job],
+    assignment: np.ndarray,
+    nodes: EncodedNodes,
+    groups: dict[str, Group],
+    balance_counts: dict[str, dict[str, int]],
+    balanced_pre_rows: dict[int, np.ndarray],
+    remaining_avail: np.ndarray,
+    demands: np.ndarray,
+    totals: np.ndarray,
+) -> np.ndarray:
+    """Second chance for balanced-group jobs the pre-mask closed out.
+
+    The pre-mask closes attribute values already at the max member count
+    using counts seeded BEFORE the solve; placements made during the cycle
+    can level those counts and legitimately re-open a closed value — which
+    the kernel, solving against the stale mask, could never propose.  This
+    host-side pass walks still-unplaced jobs whose rows the closure
+    restricted (in schedule order), re-evaluating admissibility against the
+    LIVE post-cycle counts (the same rule as validate_group_assignments)
+    and placing on the best-fitting node with enough remaining resources.
+
+    `remaining_avail`/`demands` are [N, R]/[J, R] in the kernel's resource
+    layout; both are mutated-by-copy (the returned assignment reflects the
+    extra placements, `remaining_avail` is updated in place so callers see
+    consumed capacity).
+    """
+    for ji in sorted(balanced_pre_rows):
+        if assignment[ji] >= 0:
+            continue
+        job = jobs[ji]
+        group = groups.get(job.group_uuid) if job.group_uuid else None
+        if group is None or (group.host_placement.type
+                             != GroupPlacementType.BALANCED):
+            continue
+        attr = group.host_placement.attribute
+        minimum = group.host_placement.minimum
+        counts = balance_counts.setdefault(job.group_uuid, {})
+        codes = nodes.attr_codes.get(attr)
+        if codes is None:
+            codes = np.full(nodes.n, -1, dtype=np.int32)
+        vocab = nodes.attr_vocab.get(attr, {})
+        # admissible values under LIVE counts (same rule as the pre-mask
+        # closure and the post-pass, via the shared helper)
+        closed = _closed_value_mask(counts, minimum, codes, vocab)
+        ok = (balanced_pre_rows[ji]
+              & ~closed
+              & np.all(remaining_avail >= demands[ji][None, :], axis=-1))
+        if not ok.any():
+            continue
+        # best-fit: the kernel's own fitness (shared definition), so the
+        # top-up doesn't undo the solve's packing quality
+        denom = np.maximum(totals, 1e-30)
+        used = totals - remaining_avail[:, :2]
+        fit_val = binpack_fitness(used[:, 0], used[:, 1], demands[ji][0],
+                                  demands[ji][1], denom[:, 0], denom[:, 1])
+        fit = np.where(ok, fit_val, -np.inf)
+        node_idx = int(np.argmax(fit))
+        assignment[ji] = node_idx
+        remaining_avail[node_idx] -= demands[ji]
+        value = dict(nodes.offers[node_idx].attributes).get(
+            attr, MISSING_ATTR)
+        counts[value] = counts.get(value, 0) + 1
     return assignment

@@ -32,6 +32,7 @@ from cook_tpu.ops.match import MatchProblem, chunked_match, greedy_match
 from cook_tpu.scheduler.constraints import (
     MISSING_ATTR,
     EncodedNodes,
+    balanced_group_topup,
     encode_nodes,
     feasibility_mask,
     validate_group_assignments,
@@ -289,6 +290,7 @@ class PreparedPool:
     group_used_hosts: dict = field(default_factory=dict)
     group_attr_value: dict = field(default_factory=dict)
     group_balance_counts: dict = field(default_factory=dict)
+    balanced_pre_rows: dict = field(default_factory=dict)
     feasible: Optional[np.ndarray] = None
     problem: Optional[MatchProblem] = None
 
@@ -354,6 +356,7 @@ def prepare_pool_problem(
         offer_locations=[c.location for c, _ in prepared.cluster_offers],
         job_est_end_ms=estimated_end_times(store, considerable, config),
         host_lifetime_mins=config.host_lifetime_mins,
+        balanced_pre_rows=prepared.balanced_pre_rows,
     )
     if host_reservations:
         # rebalancer reservations (constraints.clj:242 + reserve-hosts!,
@@ -363,7 +366,12 @@ def prepare_pool_problem(
         )
         has_reservation = reserved_for != ""
         for ji, job in enumerate(considerable):
-            feasible[ji] &= ~has_reservation | (reserved_for == job.uuid)
+            allowed = ~has_reservation | (reserved_for == job.uuid)
+            feasible[ji] &= allowed
+            # the saved pre-closure rows must honor reservations too, or
+            # the balanced top-up could steal a reserved host
+            if ji in prepared.balanced_pre_rows:
+                prepared.balanced_pre_rows[ji] &= allowed
     prepared.feasible = feasible
     prepared.problem = build_match_problem(considerable, nodes, feasible,
                                            chunk=config.chunk,
@@ -395,11 +403,29 @@ def finalize_pool_match(
     nodes = prepared.nodes
     cluster_offers = prepared.cluster_offers
     feasible = prepared.feasible
+    live_balance_counts: dict = {}
     assignment = validate_group_assignments(
         considerable, assignment, nodes, prepared.groups,
         prepared.group_used_hosts, prepared.group_attr_value,
         prepared.group_balance_counts,
+        out_balance_counts=live_balance_counts,
     )
+    if any(assignment[ji] < 0 for ji in prepared.balanced_pre_rows):
+        # retry balanced-group jobs the stale pre-mask closed out, against
+        # post-cycle counts (intra-cycle leveling re-opens values); the
+        # demand/avail tensors were already built for the kernel — slice
+        # the unpadded rows back instead of rebuilding
+        demands = np.asarray(prepared.problem.demands)[:len(considerable)]
+        remaining = np.asarray(prepared.problem.avail)[:nodes.n].copy()
+        placed_mask = assignment >= 0
+        np.subtract.at(remaining, assignment[placed_mask],
+                       demands[placed_mask])
+        assignment = balanced_group_topup(
+            considerable, assignment, nodes, prepared.groups,
+            live_balance_counts, prepared.balanced_pre_rows,
+            remaining, demands,
+            totals=np.asarray(prepared.problem.totals)[:nodes.n],
+        )
 
     # transact + launch (scheduler.clj:790-1048)
     launches_per_cluster: dict[str, list[TaskSpec]] = {}

@@ -199,6 +199,64 @@ def test_balanced_counts_running_members_on_absent_hosts():
         assert dict(offer.attributes)["rack"] == "r2"
 
 
+def test_balanced_leveling_reopens_closed_value_same_cycle():
+    """Intra-cycle leveling re-opens a value the pre-mask closed: with
+    running counts {r1: 2, r2: 1} the mask closes r1, but once this
+    cycle's first placement levels r2 to 2, a second member may land on
+    r1 — the reference's sequential evaluation allows it
+    (constraints.clj:600), so the post-solve top-up must recover it."""
+    from cook_tpu.models.entities import (
+        Group,
+        GroupPlacementType,
+        HostPlacement,
+    )
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    hosts = [
+        MockHost(node_id="ra", hostname="ra", mem=1000, cpus=4,
+                 attributes=(("rack", "r1"),)),
+        MockHost(node_id="rb", hostname="rb", mem=1000, cpus=4,
+                 attributes=(("rack", "r2"),)),
+        MockHost(node_id="a1", hostname="a1", mem=8000, cpus=32,
+                 attributes=(("rack", "r1"),)),
+        # room for exactly one 500-mem member this cycle
+        MockHost(node_id="b1", hostname="b1", mem=600, cpus=32,
+                 attributes=(("rack", "r2"),)),
+    ]
+    cluster = MockCluster("m", hosts, clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    pool = store.pools["default"]
+    # one empty cycle caches ra/rb attributes off their offers
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+
+    group = Group(
+        uuid="lvl",
+        host_placement=HostPlacement(type=GroupPlacementType.BALANCED,
+                                     attribute="rack", minimum=1),
+    )
+    running = [make_job(group_uuid="lvl", mem=100, cpus=1)
+               for _ in range(3)]
+    store.submit_jobs(running, [group])
+    for job, host in zip(running, ("ra", "ra", "rb")):
+        store.create_instance(job.uuid, f"t-{job.uuid[:6]}", hostname=host,
+                              node_id=host, compute_cluster="m")
+    # the seeded hosts disappear (full hosts emit no offers)
+    del cluster.hosts["ra"]
+    del cluster.hosts["rb"]
+
+    jobs = [make_job(group_uuid="lvl", mem=500, cpus=1) for _ in range(2)]
+    store.submit_jobs(jobs)
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    # both place: one levels r2 via b1, the other takes the re-opened r1
+    placed = {dict(o.attributes)["rack"] for _, o in outcome.matched}
+    assert len(outcome.matched) == 2
+    assert placed == {"r1", "r2"}
+
+
 def test_simulator_multipool_batched():
     """Multi-pool trace through the simulator with the batched device call:
     every pool's jobs complete, decisions match the per-pool path."""
