@@ -18,6 +18,10 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default="tpu_sweep.jsonl")
     parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--pallas", action="store_true",
+                        help="sweep the fused-kernel backend (kc=1 "
+                             "candidates, prefix-accept; bigger chunks, "
+                             "more passes)")
     args = parser.parse_args()
 
     import jax
@@ -60,20 +64,44 @@ def main():
     print(f"cpu[{kind}] {cpu_ms:.0f} ms placed {q_cpu['num_placed']}",
           file=sys.stderr)
 
-    grid = list(itertools.product(
-        [1024, 2048, 4096, 8192],  # chunk
-        [1, 2, 3],                 # passes
-        [2, 3, 4],                 # rounds
-        [32, 64, 128],             # kc
-    ))
+    if args.pallas:
+        # kc is fixed at 1 by the backend; passes do the heavy lifting
+        grid = list(itertools.product(
+            [4096, 8192, 16384, 32768, 131072],  # chunk
+            [4, 8, 12, 16],                      # passes
+            [1, 2, 3],                           # rounds
+            [1],                                 # kc (unused)
+        ))
+    else:
+        grid = list(itertools.product(
+            [1024, 2048, 4096, 8192],  # chunk
+            [1, 2, 3],                 # passes
+            [2, 3, 4],                 # rounds
+            [32, 64, 128],             # kc
+        ))
+    # resume: skip configs already recorded (the tunnel can wedge mid-sweep;
+    # the watcher restarts us and we pick up where we left off)
+    done = set()
+    try:
+        with open(args.out) as f:
+            for line in f:
+                r = json.loads(line)
+                if "p50_ms" in r:
+                    done.add((r.get("backend", "xla"), r["chunk"],
+                              r["passes"], r["rounds"], r["kc"]))
+    except FileNotFoundError:
+        pass
+    backend = "pallas" if args.pallas else "xla"
     with open(args.out, "a") as out:
         for chunk, passes, rounds, kc in grid:
+            if (backend, chunk, passes, rounds, kc) in done:
+                continue
             try:
                 # time must include a D2H fetch: over the remote-device
                 # tunnel block_until_ready returns without waiting
                 solve = lambda: np.asarray(chunked_match(
                     problem, chunk=chunk, rounds=rounds, kc=kc,
-                    passes=passes).assignment)
+                    passes=passes, use_pallas=args.pallas).assignment)
                 t0 = time.perf_counter()
                 a = solve()
                 compile_ms = (time.perf_counter() - t0) * 1000
@@ -87,6 +115,7 @@ def main():
                        if q_cpu["cpus_placed"] else 1.0)
                 record = {
                     "platform": platform,
+                    "backend": backend,
                     "chunk": chunk, "passes": passes, "rounds": rounds,
                     "kc": kc,
                     "p50_ms": round(float(np.percentile(times, 50)), 1),
@@ -96,8 +125,9 @@ def main():
                     "cpu_ms": round(cpu_ms),
                 }
             except Exception as e:  # noqa: BLE001 — record and continue
-                record = {"chunk": chunk, "passes": passes,
-                          "rounds": rounds, "kc": kc, "error": str(e)[:200]}
+                record = {"backend": backend, "chunk": chunk,
+                          "passes": passes, "rounds": rounds, "kc": kc,
+                          "error": str(e)[:200]}
             print(json.dumps(record), flush=True)
             out.write(json.dumps(record) + "\n")
             out.flush()

@@ -1,16 +1,28 @@
 #!/bin/bash
-# Probe the accelerator until it answers, then run the tuning sweep.
-# The tunnel wedges when a client dies mid-session and the chip grant is
-# held server-side; it recovers asynchronously.  Probe in a subprocess
-# (in-process jax.devices() hangs unkillably), stagger 7 min apart.
+# Probe the accelerator until it answers, then run the tuning sweeps and a
+# fresh bench log.  The tunnel wedges when a client dies mid-session and
+# the chip grant is held server-side; it recovers asynchronously.  Probe in
+# a subprocess (in-process jax.devices() hangs unkillably), stagger 7 min
+# apart.  Sweeps resume: configs already in the out file are skipped, so a
+# mid-sweep wedge just sends us back to the probe loop to finish later.
 cd "$(dirname "$0")/.."
+OUT=${SWEEP_OUT:-tpu_sweep_r2.jsonl}
 while true; do
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "$(date +%H:%M:%S) device healthy — starting sweep"
-    timeout 5400 python tools/tpu_sweep.py --out tpu_sweep.jsonl --repeats 3
+    echo "$(date +%H:%M:%S) device healthy — xla sweep"
+    timeout 5400 python tools/tpu_sweep.py --out "$OUT" --repeats 3
     rc=$?
-    echo "$(date +%H:%M:%S) sweep done rc=$rc"
-    exit $rc
+    echo "$(date +%H:%M:%S) xla sweep rc=$rc"
+    if [ $rc -ne 0 ]; then sleep 420; continue; fi
+    timeout 5400 python tools/tpu_sweep.py --out "$OUT" --repeats 3 --pallas
+    rc=$?
+    echo "$(date +%H:%M:%S) pallas sweep rc=$rc"
+    if [ $rc -ne 0 ]; then sleep 420; continue; fi
+    timeout 1800 python bench.py > bench_tpu_latest.json 2> bench_tpu_latest.log
+    rc=$?
+    echo "$(date +%H:%M:%S) bench rc=$rc"
+    if [ $rc -ne 0 ]; then sleep 420; continue; fi
+    exit 0
   fi
   echo "$(date +%H:%M:%S) device unreachable; retrying in 7 min"
   sleep 420
