@@ -48,15 +48,18 @@ def lexsort_perm(*keys):
     """Permutation sorting rows ascending by keys, last key least significant
     (numpy.lexsort convention reversed: keys[0] is MOST significant here).
 
-    Implemented as repeated stable argsort from least- to most-significant
-    key, which XLA handles natively (jnp.argsort is stable).
+    ONE fused multi-key `lax.sort` (keys compared lexicographically, an
+    index payload carries the permutation out) instead of k sequential
+    stable argsorts + gathers — measurably cheaper on TPU where each sort
+    of a 131k vector is a multi-pass bitonic network.
     """
+    import jax
+
     n = keys[0].shape[0]
-    perm = jnp.arange(n)
-    for key in reversed(keys):
-        order = jnp.argsort(key[perm], stable=True)
-        perm = perm[order]
-    return perm
+    iota = jnp.arange(n, dtype=jnp.int32)
+    out = jax.lax.sort(tuple(keys) + (iota,), num_keys=len(keys),
+                       is_stable=True)
+    return out[-1]
 
 
 def segment_starts(sorted_ids):

@@ -49,7 +49,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from cook_tpu.ops.common import BIG, binpack_fitness
+from cook_tpu.ops.common import BIG, binpack_fitness, lexsort_perm
 
 
 class MatchProblem(NamedTuple):
@@ -116,7 +116,7 @@ def _segment_rank(keys, order):
     original index space."""
     k = keys.shape[0]
     idxs = jnp.arange(k)
-    perm = jnp.lexsort((order, keys))
+    perm = lexsort_perm(keys, order)
     sk = keys[perm]
     starts = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
     seg_first = jax.lax.cummax(jnp.where(starts, idxs, 0))
@@ -251,7 +251,7 @@ def chunked_match(
             pick_key = jnp.where(take, pick, n)
             # prefix-accept: per-node cumulative demand among this round's
             # picks must fit availability (segmented over sorted picks)
-            perm2 = jnp.lexsort((order, pick_key))
+            perm2 = lexsort_perm(pick_key, order)
             sp2 = pick_key[perm2]
             d2 = jnp.where((sp2 < n)[:, None], d[perm2], 0.0)
             cums = jnp.cumsum(d2, axis=0)

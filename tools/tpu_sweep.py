@@ -80,22 +80,34 @@ def main():
             [32, 64, 128],             # kc
         ))
     # resume: skip configs already recorded (the tunnel can wedge mid-sweep;
-    # the watcher restarts us and we pick up where we left off)
+    # the watcher restarts us and we pick up where we left off).  A config
+    # is also skipped once it has 2 "started" markers without a result —
+    # a config that deterministically hangs the process would otherwise
+    # livelock the watcher's restart loop forever.
     done = set()
+    started: dict = {}
     try:
         with open(args.out) as f:
             for line in f:
                 r = json.loads(line)
-                if "p50_ms" in r:
-                    done.add((r.get("backend", "xla"), r["chunk"],
-                              r["passes"], r["rounds"], r["kc"]))
+                key = (r.get("backend", "xla"), r["chunk"], r["passes"],
+                       r["rounds"], r["kc"])
+                if "p50_ms" in r or "error" in r:
+                    done.add(key)
+                elif r.get("started"):
+                    started[key] = started.get(key, 0) + 1
     except FileNotFoundError:
         pass
     backend = "pallas" if args.pallas else "xla"
     with open(args.out, "a") as out:
         for chunk, passes, rounds, kc in grid:
-            if (backend, chunk, passes, rounds, kc) in done:
+            key = (backend, chunk, passes, rounds, kc)
+            if key in done or started.get(key, 0) >= 2:
                 continue
+            out.write(json.dumps({
+                "backend": backend, "chunk": chunk, "passes": passes,
+                "rounds": rounds, "kc": kc, "started": True}) + "\n")
+            out.flush()
             try:
                 # time must include a D2H fetch: over the remote-device
                 # tunnel block_until_ready returns without waiting

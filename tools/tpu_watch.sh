@@ -18,10 +18,14 @@ while true; do
     rc=$?
     echo "$(date +%H:%M:%S) pallas sweep rc=$rc"
     if [ $rc -ne 0 ]; then sleep 420; continue; fi
-    timeout 1800 python bench.py > bench_tpu_latest.json 2> bench_tpu_latest.log
+    timeout 1800 python bench.py > bench_tpu_latest.json.tmp 2> bench_tpu_latest.log.tmp
     rc=$?
     echo "$(date +%H:%M:%S) bench rc=$rc"
     if [ $rc -ne 0 ]; then sleep 420; continue; fi
+    # only replace the last good results on success — a wedge mid-bench
+    # must not truncate them
+    mv bench_tpu_latest.json.tmp bench_tpu_latest.json
+    mv bench_tpu_latest.log.tmp bench_tpu_latest.log
     exit 0
   fi
   echo "$(date +%H:%M:%S) device unreachable; retrying in 7 min"
