@@ -132,3 +132,33 @@ def test_pallas_backend_chunked_match_parity(seed):
     qf = ref.packing_quality(demands, fast)
     assert qf["num_placed"] >= 0.99 * qe["num_placed"]
     assert qf["cpus_placed"] >= 0.99 * qe["cpus_placed"]
+
+
+def test_pallas_backend_through_scheduler_config():
+    """`MatchConfig(backend="pallas")` drives a real scheduler match cycle
+    end to end: every job lands, accounting matches the cluster state."""
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.entities import JobState, Pool
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from cook_tpu.scheduler.matcher import MatchConfig
+    from tests.conftest import FakeClock, make_job
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    hosts = [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=8)
+             for i in range(4)]
+    cluster = MockCluster("m", hosts, clock=clock)
+    scheduler = Scheduler(
+        store, [cluster],
+        SchedulerConfig(match=MatchConfig(
+            chunk=16, backend="pallas", chunk_rounds=2, chunk_passes=12)))
+    jobs = [make_job(user=f"u{i % 3}", mem=500, cpus=1) for i in range(12)]
+    store.submit_jobs(jobs)
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == len(jobs)
+    for job in jobs:
+        assert store.jobs[job.uuid].state == JobState.RUNNING

@@ -89,9 +89,12 @@ def main():
     try:
         with open(args.out) as f:
             for line in f:
-                r = json.loads(line)
-                key = (r.get("backend", "xla"), r["chunk"], r["passes"],
-                       r["rounds"], r["kc"])
+                try:
+                    r = json.loads(line)
+                    key = (r.get("backend", "xla"), r["chunk"], r["passes"],
+                           r["rounds"], r["kc"])
+                except (json.JSONDecodeError, KeyError, TypeError):
+                    continue  # truncated line from a killed writer
                 if "p50_ms" in r or "error" in r:
                     done.add(key)
                 elif r.get("started"):
@@ -102,7 +105,18 @@ def main():
     with open(args.out, "a") as out:
         for chunk, passes, rounds, kc in grid:
             key = (backend, chunk, passes, rounds, kc)
-            if key in done or started.get(key, 0) >= 2:
+            if key in done:
+                continue
+            if started.get(key, 0) >= 2:
+                # leave a terminal record so grid-completeness analysis can
+                # tell "gave up after hangs" from "never ran"
+                rec = {"backend": backend, "chunk": chunk, "passes": passes,
+                       "rounds": rounds, "kc": kc,
+                       "error": "abandoned after 2 hung attempts"}
+                print(json.dumps(rec), flush=True)
+                out.write(json.dumps(rec) + "\n")
+                out.flush()
+                done.add(key)
                 continue
             out.write(json.dumps({
                 "backend": backend, "chunk": chunk, "passes": passes,

@@ -7,18 +7,34 @@
 # mid-sweep wedge just sends us back to the probe loop to finish later.
 cd "$(dirname "$0")/.."
 OUT=${SWEEP_OUT:-tpu_sweep_r2.jsonl}
+# hard deadline (default 6h): the driver runs bench.py itself at round
+# end — a still-looping watcher would race it for the single chip grant,
+# which is exactly how the tunnel wedges.  Every step's timeout is capped
+# at the time remaining so nothing overruns the deadline.
+DEADLINE=$(( $(date +%s) + ${WATCH_MAX_S:-21600} ))
+left() { echo $(( DEADLINE - $(date +%s) )); }
 while true; do
+  if [ "$(left)" -le 0 ]; then
+    echo "$(date +%H:%M:%S) deadline reached — exiting"
+    exit 0
+  fi
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    [ "$(left)" -le 0 ] && continue
     echo "$(date +%H:%M:%S) device healthy — xla sweep"
-    timeout 5400 python tools/tpu_sweep.py --out "$OUT" --repeats 3
+    timeout $(( $(left) < 5400 ? $(left) : 5400 )) \
+      python tools/tpu_sweep.py --out "$OUT" --repeats 3
     rc=$?
     echo "$(date +%H:%M:%S) xla sweep rc=$rc"
     if [ $rc -ne 0 ]; then sleep 420; continue; fi
-    timeout 5400 python tools/tpu_sweep.py --out "$OUT" --repeats 3 --pallas
+    [ "$(left)" -le 0 ] && continue
+    timeout $(( $(left) < 5400 ? $(left) : 5400 )) \
+      python tools/tpu_sweep.py --out "$OUT" --repeats 3 --pallas
     rc=$?
     echo "$(date +%H:%M:%S) pallas sweep rc=$rc"
     if [ $rc -ne 0 ]; then sleep 420; continue; fi
-    timeout 1800 python bench.py > bench_tpu_latest.json.tmp 2> bench_tpu_latest.log.tmp
+    [ "$(left)" -le 0 ] && continue
+    timeout $(( $(left) < 1800 ? $(left) : 1800 )) \
+      python bench.py > bench_tpu_latest.json.tmp 2> bench_tpu_latest.log.tmp
     rc=$?
     echo "$(date +%H:%M:%S) bench rc=$rc"
     if [ $rc -ne 0 ]; then sleep 420; continue; fi
