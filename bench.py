@@ -13,6 +13,7 @@ Prints ONE JSON line:
   {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": speedup}
 """
 import json
+import os
 import sys
 import time
 
@@ -70,6 +71,25 @@ def cpu_greedy(demands, avail, totals):
     return ref.np_greedy_match(demands, avail, totals), "numpy"
 
 
+def load_tuned():
+    """Hardware-measured best config written by tools/pick_tuned.py from
+    the sweep results; falls back to the r2 sweep's efficient-frontier
+    config when absent."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tuned_match.json")
+    tuned = {"backend": "xla", "chunk": 1024, "rounds": 3, "passes": 2,
+             "kc": 128}
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+        if isinstance(loaded, dict):
+            tuned.update({k: loaded[k] for k in tuned if k in loaded})
+            log(f"using tuned config from tuned_match.json: {tuned}")
+    except (OSError, ValueError):
+        pass
+    return tuned
+
+
 def bench_match(jax, jnp, platform):
     from cook_tpu.ops import cpu_reference as ref
     from cook_tpu.ops.match import MatchProblem, chunked_match
@@ -95,11 +115,21 @@ def bench_match(jax, jnp, platform):
         feasible=None,
     )
 
+    tuned = load_tuned()
+    # chunk and J are both powers of two, so min() keeps j % chunk == 0
+    # on the reduced CPU-fallback sizing
+    chunk = min(tuned["chunk"], J)
+    if platform == "cpu" and tuned["backend"] == "pallas":
+        # the Pallas kernel only compiles on real TPUs; interpret mode at
+        # this problem size would run for hours
+        log("cpu fallback: overriding tuned backend pallas -> xla")
+        tuned = dict(tuned, backend="xla")
+
     def solve():
-        # r2 TPU sweep best config at packing eff >= 1.0 vs sequential
-        # greedy: 552 ms @ 100k x 10k (vs 900 ms for rounds=4/passes=3)
-        result = chunked_match(problem, chunk=1024, rounds=3, kc=128,
-                               passes=2)
+        result = chunked_match(problem, chunk=chunk,
+                               rounds=tuned["rounds"], kc=tuned["kc"],
+                               passes=tuned["passes"],
+                               use_pallas=tuned["backend"] == "pallas")
         return np.asarray(result.assignment)
 
     t0 = time.perf_counter()

@@ -1,0 +1,60 @@
+"""Pick the best measured match config from a sweep file.
+
+Reads tpu_sweep JSONL records, filters to packing efficiency >= the
+parity bar (0.99 vs the sequential-greedy baseline), and writes the
+lowest-p50 config to tuned_match.json at the repo root — which bench.py
+picks up, so the round-end bench automatically runs the best
+hardware-measured configuration:
+
+    python tools/pick_tuned.py [--sweep tpu_sweep_r2.jsonl] [--min-eff 0.99]
+"""
+import argparse
+import json
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sweep", default="tpu_sweep_r2.jsonl")
+    parser.add_argument("--out", default="tuned_match.json")
+    parser.add_argument("--min-eff", type=float, default=0.99)
+    args = parser.parse_args()
+
+    best = None
+    try:
+        with open(args.sweep) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if ("p50_ms" not in r or r.get("platform") == "cpu"
+                        or r.get("packing_eff", 0) < args.min_eff):
+                    continue
+                if best is None or r["p50_ms"] < best["p50_ms"]:
+                    best = r
+    except FileNotFoundError:
+        print(f"no sweep file {args.sweep}", file=sys.stderr)
+        return 1
+    if best is None:
+        print("no config met the efficiency bar; keeping defaults",
+              file=sys.stderr)
+        return 1
+    tuned = {
+        "backend": best.get("backend", "xla"),
+        "chunk": best["chunk"],
+        "rounds": best["rounds"],
+        "passes": best["passes"],
+        "kc": best["kc"],
+        "measured_p50_ms": best["p50_ms"],
+        "measured_packing_eff": best["packing_eff"],
+        "source": args.sweep,
+    }
+    with open(args.out, "w") as f:
+        json.dump(tuned, f, indent=1)
+    print(json.dumps(tuned))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,19 +21,22 @@ while true; do
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     [ "$(left)" -le 0 ] && continue
     echo "$(date +%H:%M:%S) device healthy — xla sweep"
-    timeout $(( $(left) < 5400 ? $(left) : 5400 )) \
+    timeout $(( $(left) > 5400 ? 5400 : ($(left) > 1 ? $(left) : 1) )) \
       python tools/tpu_sweep.py --out "$OUT" --repeats 3
     rc=$?
     echo "$(date +%H:%M:%S) xla sweep rc=$rc"
     if [ $rc -ne 0 ]; then sleep 420; continue; fi
     [ "$(left)" -le 0 ] && continue
-    timeout $(( $(left) < 5400 ? $(left) : 5400 )) \
+    timeout $(( $(left) > 5400 ? 5400 : ($(left) > 1 ? $(left) : 1) )) \
       python tools/tpu_sweep.py --out "$OUT" --repeats 3 --pallas
     rc=$?
     echo "$(date +%H:%M:%S) pallas sweep rc=$rc"
     if [ $rc -ne 0 ]; then sleep 420; continue; fi
+    # promote the best measured config so bench runs it (0.995 bar: keep
+    # a margin above the 0.99 parity target rather than sitting on it)
+    python tools/pick_tuned.py --sweep "$OUT" --min-eff 0.995 || true
     [ "$(left)" -le 0 ] && continue
-    timeout $(( $(left) < 1800 ? $(left) : 1800 )) \
+    timeout $(( $(left) > 1800 ? 1800 : ($(left) > 1 ? $(left) : 1) )) \
       python bench.py > bench_tpu_latest.json.tmp 2> bench_tpu_latest.log.tmp
     rc=$?
     echo "$(date +%H:%M:%S) bench rc=$rc"
