@@ -170,6 +170,14 @@ def authenticator_from_config(conf: dict):
             acceptor = load_plugin(conf["gss_accept"])
             if not callable(acceptor):
                 acceptor = acceptor.gss_accept
+        elif conf.get("gssapi"):
+            # real Kerberos via libgssapi_krb5 (KRB5_KTNAME supplies the
+            # keytab in deployment); None when the library is absent,
+            # which keeps the closed-by-default posture
+            from cook_tpu.rest.gssapi import make_gssapi_acceptor
+
+            acceptor = make_gssapi_acceptor(
+                libname=conf.get("gssapi_lib") or None)
         return SpnegoAuthenticator(gss_accept=acceptor)
     if kind == "composite":
         return CompositeAuthenticator(
