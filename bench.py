@@ -129,7 +129,8 @@ def bench_match(jax, jnp, platform):
         result = chunked_match(problem, chunk=chunk,
                                rounds=tuned["rounds"], kc=tuned["kc"],
                                passes=tuned["passes"],
-                               use_pallas=tuned["backend"] == "pallas")
+                               use_pallas=tuned["backend"] == "pallas",
+                               bucketed=tuned["backend"] == "bucketed")
         return np.asarray(result.assignment)
 
     t0 = time.perf_counter()

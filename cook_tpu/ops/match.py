@@ -126,7 +126,7 @@ def _segment_rank(keys, order):
 
 @functools.partial(
     jax.jit, static_argnames=("chunk", "rounds", "kc", "use_approx",
-                              "passes", "use_pallas")
+                              "passes", "use_pallas", "bucketed")
 )
 def chunked_match(
     problem: MatchProblem,
@@ -137,6 +137,7 @@ def chunked_match(
     use_approx: bool = True,
     passes: int = 2,
     use_pallas: bool = False,
+    bucketed: bool = False,
 ) -> MatchResult:
     """Fast chunked greedy matcher (see module docstring for the scheme).
 
@@ -150,9 +151,21 @@ def chunked_match(
     (ops/pallas_match.py): feasibility + fitness + argmax in ONE VMEM-
     resident sweep per job block, returning each job's single best node
     (kc is effectively 1, so give the pallas backend more `passes` —
-    every pass re-picks fresh best nodes against updated availability)."""
+    every pass re-picks fresh best nodes against updated availability).
+
+    `bucketed` quantizes the chunk's jobs into at most 128 demand classes
+    (log-spaced mem x cpu levels, gpu/disk presence bits) and computes ONE
+    candidate list per class over the class's segment-max demand — a
+    [B, N] candidate pass instead of [K, N], ~K/B x cheaper.  Real
+    workloads cluster on a few requested shapes, so classes are dense.
+    Class feasibility (segment-max demand) is conservative for the class's
+    smaller jobs; the conflict rounds re-check exact per-job demands (and
+    the constraint mask, which class-shared lists cannot pre-apply), so
+    acceptance stays exact — the cost is candidate recall, recovered by
+    `passes` like any other truncation."""
     j, n = problem.demands.shape[0], problem.avail.shape[0]
     assert j % chunk == 0, "pad jobs to a multiple of chunk"
+    assert not (use_pallas and bucketed), "pick one candidate backend"
     kc = min(kc, n)
     n_res = problem.demands.shape[-1]  # (mem, cpus, gpus[, disk...])
     demands_c = problem.demands.reshape(j // chunk, chunk, n_res)
@@ -176,12 +189,59 @@ def chunked_match(
         # runs in interpret mode (tests, CPU fallback)
         pallas_interpret = jax_mod.default_backend() != "tpu"
 
+    # demand classes: 8 log-mem levels x 4 log-cpu levels x gpu bit
+    # (x disk bit when the resource column exists)
+    n_buckets = 8 * 4 * 2 * (2 if n_res > 3 else 1)
+
+    def _bucket_ids(d, active):
+        def levels(x, n_levels):
+            lo = jnp.min(jnp.where(active, x, jnp.inf))
+            hi = jnp.max(jnp.where(active, x, -jnp.inf))
+            scale = jnp.maximum(hi - lo, 1e-6)
+            lv = jnp.floor((x - lo) / scale * n_levels)
+            return jnp.clip(lv, 0, n_levels - 1).astype(jnp.int32)
+
+        b = levels(jnp.log(jnp.maximum(d[:, 0], 1e-3)), 8) * 4
+        b = b + levels(jnp.log(jnp.maximum(d[:, 1], 1e-3)), 4)
+        b = b * 2 + (d[:, 2] > 0).astype(jnp.int32)
+        if n_res > 3:
+            b = b * 2 + (d[:, 3] > 0).astype(jnp.int32)
+        return b
+
     def chunk_step(avail, inputs):
         d, ok, fr = inputs  # [K,3], [K], [K,N]|[1,1]
 
-        def candidate_pass(avail, assignment):
+        def score_topk(avail, demand_matrix, gate):
+            """Shared candidate scoring: feasibility x fitness over the
+            rows of `demand_matrix` ([M, R], jobs or demand classes),
+            gated by `gate` ([M, N]-broadcastable), -> top-kc per row.
+            ONE pipeline so the bucketed passes and the exact cleanup
+            pass can never rank candidates by diverging rules."""
+            fits = jnp.all(avail[None, :, :] >= demand_matrix[:, None, :],
+                           axis=-1)
+            feasible = fits & gate
+            used0 = totals[:, 0] - avail[:, 0]
+            used1 = totals[:, 1] - avail[:, 1]
+            fit = binpack_fitness(used0[None, :], used1[None, :],
+                                  demand_matrix[:, 0:1],
+                                  demand_matrix[:, 1:2],
+                                  denom[None, :, 0], denom[None, :, 1])
+            score = jnp.where(feasible, fit, -BIG)
+            if use_approx:
+                return jax.lax.approx_max_k(score, kc, recall_target=0.95)
+            return jax.lax.top_k(score, kc)
+
+        def candidate_pass(avail, assignment, use_bucket=False):
             # full fitness pass for still-unplaced jobs vs current avail
             unplaced = assignment < 0
+            if use_bucket:
+                active = ok & unplaced
+                bid = _bucket_ids(d, active)
+                bdem = (jnp.zeros((n_buckets, n_res), d.dtype)
+                        .at[bid].max(jnp.where(active[:, None], d, 0.0)))
+                bval, bidx = score_topk(avail, bdem, node_valid[None, :])
+                return (jnp.where(active[:, None], bval[bid], -BIG),
+                        bidx[bid])
             if use_pallas:
                 # fused feasibility+fitness+argmax; placed/invalid jobs
                 # are excluded by an unsatisfiable demand
@@ -194,18 +254,9 @@ def chunked_match(
                                      valid_arg, feas_arg,
                                      interpret=pallas_interpret)
                 return val[:, None], jnp.maximum(idx, 0)[:, None]
-            fits = jnp.all(avail[None, :, :] >= d[:, None, :], axis=-1)
-            feasible = (fits & node_valid[None, :] & fr
-                        & (ok & unplaced)[:, None])
-            used0 = totals[:, 0] - avail[:, 0]
-            used1 = totals[:, 1] - avail[:, 1]
-            fit = binpack_fitness(used0[None, :], used1[None, :],
-                                  d[:, 0:1], d[:, 1:2],
-                                  denom[None, :, 0], denom[None, :, 1])
-            score = jnp.where(feasible, fit, -BIG)
-            if use_approx:
-                return jax.lax.approx_max_k(score, kc, recall_target=0.95)
-            return jax.lax.top_k(score, kc)
+            return score_topk(
+                avail, d,
+                node_valid[None, :] & fr & (ok & unplaced)[:, None])
 
         def round_step(carry, _):
             avail, assignment, cand_val, cand_idx = carry
@@ -218,6 +269,10 @@ def chunked_match(
                 & cand_ok
                 & unplaced[:, None]
             )
+            if bucketed and problem.feasible is not None:
+                # class-shared candidate lists cannot pre-apply the per-job
+                # constraint mask; re-check it on the [K,kc] gather
+                feas_cand &= jnp.take_along_axis(fr, cand_idx, axis=1)
             has = feas_cand.any(axis=1)
             f0 = jnp.argmax(feas_cand, axis=1)
             pick0 = jnp.where(
@@ -277,8 +332,15 @@ def chunked_match(
             return (avail - delta, assignment, cand_val, cand_idx), None
 
         assignment = jnp.full((chunk,), -1, jnp.int32)
-        for _ in range(passes):
-            cand_val, cand_idx = candidate_pass(avail, assignment)
+        for p in range(passes):
+            # bucketed mode: cheap class-shared candidates for the early
+            # passes, then ONE exact per-job pass so stragglers whose
+            # class ordering diverged from their own fitness still land
+            # (the early passes place the bulk, so most of the [K, N]
+            # saving is kept)
+            use_bucket = bucketed and (p < passes - 1 or passes == 1)
+            cand_val, cand_idx = candidate_pass(avail, assignment,
+                                                use_bucket=use_bucket)
             (avail, assignment, _, _), _ = jax.lax.scan(
                 round_step, (avail, assignment, cand_val, cand_idx),
                 None, length=rounds,

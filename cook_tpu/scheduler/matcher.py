@@ -54,8 +54,9 @@ class MatchConfig:
     chunk_rounds: int = 3
     chunk_passes: int = 2    # candidate recomputes per chunk
     chunk_kc: int = 128      # candidate-list width per job
-    # "xla" (approx_max_k candidate lists) or "pallas" (fused
-    # feasibility+fitness+argmax kernel, ops/pallas_match.py)
+    # "xla" (approx_max_k candidate lists), "pallas" (fused
+    # feasibility+fitness+argmax kernel, ops/pallas_match.py), or
+    # "bucketed" (class-shared candidate lists + exact cleanup pass)
     backend: str = "xla"
     # estimated-completion constraint (constraints.clj:385 +
     # estimated-completion-config): 0 multiplier or lifetime = disabled
@@ -67,6 +68,12 @@ class MatchConfig:
     # pod agree — padding only in the backend would direct-bind pods the
     # kubelet must reject (calculate-effective-resources, api.clj:1152)
     checkpoint_memory_overhead_mb: float = 0.0
+
+    def __post_init__(self):
+        if self.backend not in ("xla", "pallas", "bucketed"):
+            raise ValueError(
+                f"unknown match backend {self.backend!r} "
+                "(expected xla | pallas | bucketed)")
 
 
 @dataclass
@@ -603,7 +610,8 @@ def match_pool(
                                    rounds=config.chunk_rounds,
                                    passes=config.chunk_passes,
                                    kc=config.chunk_kc,
-                                   use_pallas=config.backend == "pallas")
+                                   use_pallas=config.backend == "pallas",
+                                   bucketed=config.backend == "bucketed")
         else:
             result = greedy_match(prepared.problem)
         assignment = np.asarray(
@@ -686,7 +694,9 @@ def match_pools_batched(
                                         passes=config.chunk_passes,
                                         kc=config.chunk_kc,
                                         use_pallas=(config.backend
-                                                    == "pallas"))
+                                                    == "pallas"),
+                                        bucketed=(config.backend
+                                                  == "bucketed"))
             )(stacked)
         else:
             result = jax.vmap(greedy_match)(stacked)

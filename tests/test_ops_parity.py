@@ -115,7 +115,7 @@ def test_greedy_match_exact_parity(seed):
 
 
 def _assert_chunked_parity(demands, avail, totals, feasible, *,
-                           chunk=64, bar=0.99):
+                           chunk=64, bar=0.99, **kwargs):
     """Chunked vs exact greedy: no oversubscription, and >= `bar` of the
     exact packing on jobs placed AND on each resource dimension (the
     project target is >=0.99, BASELINE.json 'Fenzo packing efficiency')."""
@@ -129,7 +129,7 @@ def _assert_chunked_parity(demands, avail, totals, feasible, *,
         feasible=jnp.asarray(feasible) if feasible is not None else None,
     )
     exact = greedy_match(problem)
-    fast = chunked_match(problem, chunk=chunk)
+    fast = chunked_match(problem, chunk=chunk, **kwargs)
     q_exact = ref.packing_quality(demands, np.asarray(exact.assignment))
     q_fast = ref.packing_quality(demands, np.asarray(fast.assignment))
     assert np.all(np.asarray(fast.new_avail) >= -1e-3)
@@ -170,6 +170,55 @@ def test_chunked_match_parity_few_feasible_nodes(seed):
     feasible = rng.uniform(size=(256, 64)) < 0.05
     feasible[np.arange(256), rng.integers(0, 64, 256)] = True
     _assert_chunked_parity(demands, avail, totals, feasible)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_bucketed_match_near_parity(seed):
+    """Bucketed candidate mode (one candidate list per demand class) must
+    hold the same >=0.99 packing bar — continuous-uniform demands are the
+    hard case (256 distinct demands into <=64 classes)."""
+    rng = np.random.default_rng(700 + seed)
+    demands, avail, totals, feasible = random_match_problem(rng, j=256, n=64)
+    _assert_chunked_parity(demands, avail, totals, feasible,
+                           bucketed=True, passes=3)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_bucketed_match_parity_skewed_demands(seed):
+    """Discrete skewed shapes (the realistic case: few requested sizes) —
+    classes are exact, so bucketed candidates lose nothing."""
+    rng = np.random.default_rng(800 + seed)
+    j, n = 256, 64
+    base = rng.choice([16, 64, 256, 1024, 4096], j,
+                      p=[0.4, 0.3, 0.15, 0.1, 0.05]).astype(float)
+    demands = np.stack([base, np.maximum(base / 256, 0.25), np.zeros(j)],
+                       axis=-1)
+    totals = np.stack([np.full(n, 8192.0), np.full(n, 32.0)], axis=-1)
+    avail = np.concatenate([totals * rng.uniform(0.2, 1.0, (n, 1)),
+                            np.zeros((n, 1))], axis=-1)
+    _assert_chunked_parity(demands, avail, totals, None,
+                           bucketed=True, passes=3)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_bucketed_match_parity_few_feasible_nodes(seed):
+    """Constraint masks can't be pre-applied to class-shared candidate
+    lists; the rounds' [K,kc] mask recheck must keep acceptance exact."""
+    rng = np.random.default_rng(900 + seed)
+    demands, avail, totals, _ = random_match_problem(rng, j=256, n=64)
+    feasible = rng.uniform(size=(256, 64)) < 0.05
+    feasible[np.arange(256), rng.integers(0, 64, 256)] = True
+    _assert_chunked_parity(demands, avail, totals, feasible,
+                           bucketed=True, passes=6)
+    # masked assignments must never violate the constraint mask
+    problem = MatchProblem(
+        demands=jnp.asarray(demands), job_valid=jnp.ones(256, bool),
+        avail=jnp.asarray(avail), totals=jnp.asarray(totals),
+        node_valid=jnp.ones(64, bool), feasible=jnp.asarray(feasible))
+    a = np.asarray(chunked_match(problem, chunk=64, bucketed=True,
+                                 passes=6).assignment)
+    placed = a >= 0
+    assert feasible[np.where(placed)[0], a[placed]].all()
 
 
 def test_match_respects_validity_masks():

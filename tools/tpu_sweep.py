@@ -19,10 +19,16 @@ def main():
     parser.add_argument("--out", default="tpu_sweep.jsonl")
     parser.add_argument("--repeats", type=int, default=3)
     parser.add_argument("--pallas", action="store_true",
-                        help="sweep the fused-kernel backend (kc=1 "
-                             "candidates, prefix-accept; bigger chunks, "
-                             "more passes)")
+                        help="alias for --backend pallas")
+    parser.add_argument("--backend", default="xla",
+                        choices=["xla", "pallas", "bucketed"],
+                        help="candidate-pass backend to sweep")
     args = parser.parse_args()
+    if args.pallas:
+        if args.backend not in ("xla", "pallas"):
+            parser.error("--pallas conflicts with --backend "
+                         f"{args.backend}; drop the legacy flag")
+        args.backend = "pallas"
 
     import jax
     import jax.numpy as jnp
@@ -64,13 +70,22 @@ def main():
     print(f"cpu[{kind}] {cpu_ms:.0f} ms placed {q_cpu['num_placed']}",
           file=sys.stderr)
 
-    if args.pallas:
+    if args.backend == "pallas":
         # kc is fixed at 1 by the backend; passes do the heavy lifting
         grid = list(itertools.product(
             [4096, 8192, 16384, 32768, 131072],  # chunk
             [4, 8, 12, 16],                      # passes
             [1, 2, 3],                           # rounds
             [1],                                 # kc (unused)
+        ))
+    elif args.backend == "bucketed":
+        # early passes are ~chunk/128 x cheaper, so larger chunks and one
+        # extra pass (the exact cleanup) are the interesting region
+        grid = list(itertools.product(
+            [1024, 2048, 4096, 8192],  # chunk
+            [2, 3, 4],                 # passes (last one is exact)
+            [2, 3],                    # rounds
+            [64, 128],                 # kc
         ))
     else:
         grid = list(itertools.product(
@@ -101,7 +116,7 @@ def main():
                     started[key] = started.get(key, 0) + 1
     except FileNotFoundError:
         pass
-    backend = "pallas" if args.pallas else "xla"
+    backend = args.backend
     with open(args.out, "a") as out:
         for chunk, passes, rounds, kc in grid:
             key = (backend, chunk, passes, rounds, kc)
@@ -127,7 +142,8 @@ def main():
                 # tunnel block_until_ready returns without waiting
                 solve = lambda: np.asarray(chunked_match(
                     problem, chunk=chunk, rounds=rounds, kc=kc,
-                    passes=passes, use_pallas=args.pallas).assignment)
+                    passes=passes, use_pallas=backend == "pallas",
+                    bucketed=backend == "bucketed").assignment)
                 t0 = time.perf_counter()
                 a = solve()
                 compile_ms = (time.perf_counter() - t0) * 1000

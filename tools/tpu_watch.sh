@@ -28,7 +28,13 @@ while true; do
     if [ $rc -ne 0 ]; then sleep 420; continue; fi
     [ "$(left)" -le 0 ] && continue
     timeout $(( $(left) > 5400 ? 5400 : ($(left) > 1 ? $(left) : 1) )) \
-      python tools/tpu_sweep.py --out "$OUT" --repeats 3 --pallas
+      python tools/tpu_sweep.py --out "$OUT" --repeats 3 --backend bucketed
+    rc=$?
+    echo "$(date +%H:%M:%S) bucketed sweep rc=$rc"
+    if [ $rc -ne 0 ]; then sleep 420; continue; fi
+    [ "$(left)" -le 0 ] && continue
+    timeout $(( $(left) > 5400 ? 5400 : ($(left) > 1 ? $(left) : 1) )) \
+      python tools/tpu_sweep.py --out "$OUT" --repeats 3 --backend pallas
     rc=$?
     echo "$(date +%H:%M:%S) pallas sweep rc=$rc"
     if [ $rc -ne 0 ]; then sleep 420; continue; fi
