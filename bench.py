@@ -92,7 +92,7 @@ def load_tuned():
 
 def bench_match(jax, jnp, platform):
     from cook_tpu.ops import cpu_reference as ref
-    from cook_tpu.ops.match import MatchProblem, chunked_match
+    from cook_tpu.ops.match import MatchProblem, backend_flags, chunked_match
 
     if platform == "cpu":
         # fallback sizing: keep the bench finishing in minutes on CPU XLA
@@ -129,8 +129,7 @@ def bench_match(jax, jnp, platform):
         result = chunked_match(problem, chunk=chunk,
                                rounds=tuned["rounds"], kc=tuned["kc"],
                                passes=tuned["passes"],
-                               use_pallas=tuned["backend"] == "pallas",
-                               bucketed=tuned["backend"] == "bucketed")
+                               **backend_flags(tuned["backend"]))
         return np.asarray(result.assignment)
 
     t0 = time.perf_counter()

@@ -68,6 +68,17 @@ class MatchResult(NamedTuple):
     new_avail: jnp.ndarray   # [N, R] availability after placements
 
 
+def backend_flags(backend: str) -> dict:
+    """Map a candidate-pass backend name to chunked_match flags; the ONE
+    place backend strings are interpreted (and rejected) for every caller
+    — scheduler config, mesh solve, sweep, bench."""
+    if backend not in ("xla", "pallas", "bucketed"):
+        raise ValueError(f"unknown match backend {backend!r} "
+                         "(expected xla | pallas | bucketed)")
+    return {"use_pallas": backend == "pallas",
+            "bucketed": backend == "bucketed"}
+
+
 def _job_step(avail, totals, node_valid, demand, job_ok, feas_row):
     """Place one job: feasibility mask + binpacking-fitness argmax."""
     fits = jnp.all(avail >= demand[None, :], axis=-1)
@@ -331,7 +342,10 @@ def chunked_match(
             )
             return (avail - delta, assignment, cand_val, cand_idx), None
 
-        assignment = jnp.full((chunk,), -1, jnp.int32)
+        # derive the init from chunk data rather than a constant: under
+        # shard_map a replicated (unvarying) carry init clashes with the
+        # varying carry the scan body produces (scan-vma typing)
+        assignment = (d[:, 0] * 0).astype(jnp.int32) - 1
         for p in range(passes):
             # bucketed mode: cheap class-shared candidates for the early
             # passes, then ONE exact per-job pass so stragglers whose

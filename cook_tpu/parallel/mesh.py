@@ -28,7 +28,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cook_tpu.ops.common import BIG
 from cook_tpu.ops.dru import DruTasks, dru_rank
-from cook_tpu.ops.match import MatchProblem, MatchResult, chunked_match, greedy_match
+from cook_tpu.ops.match import (
+    MatchProblem,
+    MatchResult,
+    backend_flags,
+    chunked_match,
+    greedy_match,
+)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "pool") -> Mesh:
@@ -47,12 +53,15 @@ def shard_pools(mesh: Mesh, tree, axis: str = "pool"):
 
 def pool_sharded_match(mesh: Mesh, problems: MatchProblem, *,
                        chunk: int = 0, rounds: int = 4,
-                       passes: int = 2) -> MatchResult:
+                       passes: int = 2, kc: int = 128,
+                       backend: str = "xla") -> MatchResult:
     """Solve P pools' match problems concurrently, one shard of pools per
     device.  `problems` leaves have leading axis P (divisible by mesh size).
-    chunk=0 selects the exact sequential-greedy kernel."""
+    chunk=0 selects the exact sequential-greedy kernel; `backend` selects
+    the candidate pass like MatchConfig.backend (xla/pallas/bucketed)."""
     fn = (functools.partial(chunked_match, chunk=chunk, rounds=rounds,
-                            passes=passes) if chunk
+                            passes=passes, kc=kc,
+                            **backend_flags(backend)) if chunk
           else greedy_match)
     mapped = jax.vmap(fn)
     spec = P("pool")

@@ -28,7 +28,12 @@ from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
 from cook_tpu.models.entities import GroupPlacementType, Job, Pool
 from cook_tpu.models.store import JobStore, TransactionVetoed
 from cook_tpu.ops.common import bucket_size, pad_to
-from cook_tpu.ops.match import MatchProblem, chunked_match, greedy_match
+from cook_tpu.ops.match import (
+    MatchProblem,
+    backend_flags,
+    chunked_match,
+    greedy_match,
+)
 from cook_tpu.scheduler.constraints import (
     MISSING_ATTR,
     EncodedNodes,
@@ -70,10 +75,7 @@ class MatchConfig:
     checkpoint_memory_overhead_mb: float = 0.0
 
     def __post_init__(self):
-        if self.backend not in ("xla", "pallas", "bucketed"):
-            raise ValueError(
-                f"unknown match backend {self.backend!r} "
-                "(expected xla | pallas | bucketed)")
+        backend_flags(self.backend)  # raises on unknown names
 
 
 @dataclass
@@ -610,8 +612,7 @@ def match_pool(
                                    rounds=config.chunk_rounds,
                                    passes=config.chunk_passes,
                                    kc=config.chunk_kc,
-                                   use_pallas=config.backend == "pallas",
-                                   bucketed=config.backend == "bucketed")
+                                   **backend_flags(config.backend))
         else:
             result = greedy_match(prepared.problem)
         assignment = np.asarray(
@@ -686,17 +687,18 @@ def match_pools_batched(
         if mesh is not None and len(solvable) % mesh.devices.size == 0:
             stacked = shard_pools(mesh, stacked)
             result = pool_sharded_match(mesh, stacked,
-                                        chunk=config.chunk or 0)
+                                        chunk=config.chunk or 0,
+                                        rounds=config.chunk_rounds,
+                                        passes=config.chunk_passes,
+                                        kc=config.chunk_kc,
+                                        backend=config.backend)
         elif config.chunk:
             result = jax.vmap(
                 lambda p: chunked_match(p, chunk=config.chunk,
                                         rounds=config.chunk_rounds,
                                         passes=config.chunk_passes,
                                         kc=config.chunk_kc,
-                                        use_pallas=(config.backend
-                                                    == "pallas"),
-                                        bucketed=(config.backend
-                                                  == "bucketed"))
+                                        **backend_flags(config.backend))
             )(stacked)
         else:
             result = jax.vmap(greedy_match)(stacked)

@@ -50,6 +50,21 @@ def test_pool_sharded_match_parity(mesh):
     )
 
 
+def test_pool_sharded_match_backend_knobs(mesh):
+    """The sharded solve honors the configured backend + chunk knobs
+    (bucketed here): all placements respect per-pool constraint masks."""
+    problems = make_pool_batch()
+    problems = shard_pools(mesh, problems)
+    got = pool_sharded_match(mesh, problems, chunk=64, rounds=3, passes=3,
+                             backend="bucketed")
+    a = np.asarray(got.assignment)
+    feas = np.asarray(problems.feasible)
+    for p in range(a.shape[0]):
+        placed = a[p] >= 0
+        assert placed.sum() > 0
+        assert feas[p][np.where(placed)[0], a[p][placed]].all()
+
+
 def test_pool_sharded_dru_runs(mesh):
     from cook_tpu.ops.common import BIG, pad_to
     from cook_tpu.ops.dru import DruTasks, dru_rank

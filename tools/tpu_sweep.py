@@ -36,7 +36,7 @@ def main():
     sys.path.insert(0, ".")
     from bench import make_problem
     from cook_tpu.ops import cpu_reference as ref
-    from cook_tpu.ops.match import MatchProblem, chunked_match
+    from cook_tpu.ops.match import MatchProblem, backend_flags, chunked_match
 
     platform = jax.devices()[0].platform
     print(f"device: {jax.devices()[0]}", file=sys.stderr)
@@ -142,8 +142,7 @@ def main():
                 # tunnel block_until_ready returns without waiting
                 solve = lambda: np.asarray(chunked_match(
                     problem, chunk=chunk, rounds=rounds, kc=kc,
-                    passes=passes, use_pallas=backend == "pallas",
-                    bucketed=backend == "bucketed").assignment)
+                    passes=passes, **backend_flags(backend)).assignment)
                 t0 = time.perf_counter()
                 a = solve()
                 compile_ms = (time.perf_counter() - t0) * 1000
