@@ -226,10 +226,14 @@ def build_process(
         if factory is None:
             raise ValueError(f"unknown cluster kind {conf.get('kind')}")
         clusters.append(factory(conf, clock))
+    from cook_tpu.scheduler.plugins import registry_from_config
+
+    plugins = registry_from_config(settings.plugins)
     scheduler = Scheduler(
         store,
         clusters,
         SchedulerConfig(match=settings.match, rebalancer=settings.rebalancer),
+        plugins=plugins,
     )
     from cook_tpu.rest.auth import authenticator_from_config
 
@@ -241,7 +245,7 @@ def build_process(
         authenticator=(authenticator_from_config(settings.auth)
                        if settings.auth else None),
         executor_token=settings.executor_token,
-    ))
+    ), plugins=plugins)
     api.queue_limits.limits.per_pool = settings.queue_limit_per_pool
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user
     process = CookProcess(settings=settings, store=store, clusters=clusters,

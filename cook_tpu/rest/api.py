@@ -315,8 +315,18 @@ class CookApi:
                 job, err = None, f"malformed job field: {e}"
             if err:
                 return _err(400, err)
+            # JobAdjusters (plugins/definitions.clj JobAdjuster, e.g. the
+            # pool mover) may rewrite the parsed job; an adjusted pool
+            # must still exist and accept work, else revert ONLY the pool
+            # (other adjusters' changes survive)
+            adjusted = self.plugins.adjust(job)
+            if adjusted.pool != job.pool:
+                dest = self.store.pools.get(adjusted.pool)
+                if dest is None or not dest.accepts_submissions:
+                    adjusted = adjusted.with_(pool=job.pool)
+            job = adjusted
             jobs.append(job)
-            pools_counted[pool] = pools_counted.get(pool, 0) + 1
+            pools_counted[job.pool] = pools_counted.get(job.pool, 0) + 1
         for pool, count in pools_counted.items():
             limit_err = self.queue_limits.check_submission(user, pool, count)
             if limit_err:

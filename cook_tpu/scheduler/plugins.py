@@ -9,10 +9,12 @@ for a TTL like the reference's caching wrappers.
 from __future__ import annotations
 
 import importlib
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Protocol, runtime_checkable
 
 from cook_tpu.models.entities import Job
+from cook_tpu.utils.incremental import entity_fraction
 
 
 @dataclass(frozen=True)
@@ -79,6 +81,32 @@ class AttributePoolSelector:
         return job_spec.get("pool") or default_pool
 
 
+class PoolMoverAdjuster:
+    """Percentage-rollout migration of a user's jobs between pools at
+    submission (reference plugins/pool_mover.clj): config maps a
+    submission pool to `{"destination_pool": ..., "users": {user:
+    {"portion": 0..1}}}`; a job moves when its uuid's stable hash bucket
+    (mod 100) falls under portion*100 — the same jobs move on every
+    resubmission, giving a deterministic gradual rollout."""
+
+    def __init__(self, config: dict):
+        self.config = dict(config or {})
+
+    def adjust_job(self, job: Job) -> Job:
+        rule = self.config.get(job.pool)
+        if not rule:
+            return job
+        portion = (rule.get("users", {}).get(job.user) or {}).get("portion")
+        destination = rule.get("destination_pool")
+        if not isinstance(portion, (int, float)) or not destination:
+            return job
+        # stable uuid-hash rollout (pool_mover.clj: (mod (hash uuid) 100)),
+        # via the same bucketing idiom as incremental config rollouts
+        if entity_fraction(job.uuid) < portion:
+            return job.with_(pool=destination)
+        return job
+
+
 def load_plugin(dotted_path: str) -> Any:
     """`lazy-load-var` analog: 'package.module:ClassName' or
     'package.module.factory_fn'."""
@@ -141,3 +169,45 @@ class PluginRegistry:
     def on_completion(self, job: Job, instance) -> None:
         for handler in self.completion_handlers:
             handler.on_instance_completion(job, instance)
+
+    def adjust(self, job: Job) -> Job:
+        """Run JobAdjusters over a parsed job at submission.  A failing
+        adjuster is skipped and the job passes through unchanged, like
+        the reference's catch-and-keep (pool_mover.clj error path)."""
+        for adjuster in self.job_adjusters:
+            try:
+                job = adjuster.adjust_job(job)
+            except Exception:  # noqa: BLE001 — plugin faults never block
+                logging.getLogger(__name__).exception(
+                    "job adjuster %r failed; keeping job unchanged",
+                    adjuster)
+        return job
+
+
+def registry_from_config(conf: dict) -> "PluginRegistry":
+    """Build the registry from the `plugins` config section: dotted paths
+    per seam (the reference's lazy-load-var wiring, components.clj) plus
+    the built-in pool-mover rule table.
+
+        {"submission_validators": ["pkg.mod:Cls", ...],
+         "submission_modifiers": [...], "launch_filters": [...],
+         "completion_handlers": [...], "job_adjusters": [...],
+         "job_routers": [...], "pool_selector": "pkg.mod:Cls",
+         "file_url_generator": "pkg.mod:Cls",
+         "pool_mover": {submission_pool: {"destination_pool": ...,
+                        "users": {user: {"portion": 0.25}}}}}
+    """
+    conf = conf or {}
+    registry = PluginRegistry()
+    for seam in ("submission_validators", "submission_modifiers",
+                 "launch_filters", "completion_handlers",
+                 "job_adjusters", "job_routers"):
+        for path in conf.get(seam, []):
+            getattr(registry, seam).append(load_plugin(path))
+    if conf.get("pool_selector"):
+        registry.pool_selector = load_plugin(conf["pool_selector"])
+    if conf.get("file_url_generator"):
+        registry.file_url_generator = load_plugin(conf["file_url_generator"])
+    if conf.get("pool_mover"):
+        registry.job_adjusters.append(PoolMoverAdjuster(conf["pool_mover"]))
+    return registry

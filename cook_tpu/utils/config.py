@@ -61,6 +61,9 @@ class Settings:
     # shared secret for executor heartbeat/progress posts ("" = not
     # enforced); executors read it from COOK_EXECUTOR_TOKEN
     executor_token: str = ""
+    # plugin seams: dotted paths per seam + pool-mover rules
+    # (scheduler/plugins.py registry_from_config)
+    plugins: dict = field(default_factory=dict)
 
     def match_config_for_pool(self, pool_name: str) -> MatchConfig:
         for ps in self.pool_schedulers:
@@ -110,6 +113,8 @@ def read_config(path: Optional[str] = None,
         settings.cors_origins = tuple(data["cors_origins"])
     if "auth" in data:
         settings.auth = dict(data["auth"])
+    if "plugins" in data:
+        settings.plugins = dict(data["plugins"])
     if "executor_token" in data:
         settings.executor_token = str(data["executor_token"])
     if "pools" in data:
