@@ -40,48 +40,25 @@ class PreemptionDecision(NamedTuple):
     freed: jnp.ndarray         # [R] resources freed on the chosen host (spare + preempted)
 
 
-@jax.jit
-def find_preemption_decision(
-    state: RebalanceState,
-    demand: jnp.ndarray,        # [R] pending job resources
-    pending_dru: jnp.ndarray,   # scalar
-    safe_dru_threshold: jnp.ndarray,
-    min_dru_diff: jnp.ndarray,
-) -> PreemptionDecision:
-    t = state.task_host.shape[0]
-    h = state.spare.shape[0]
-
-    mask = (
-        state.task_eligible
-        & (state.task_dru >= safe_dru_threshold)
-        & ((state.task_dru - pending_dru) > min_dru_diff)
-    )
-
-    # Sort tasks by (host asc, dru desc, index asc); masked-out tasks sink to
-    # a sentinel host so they never join a real segment.
-    host_key = jnp.where(mask, state.task_host, jnp.iinfo(jnp.int32).max)
-    idx = jnp.arange(t)
-    perm = lexsort_perm(host_key, -state.task_dru, idx)
-    s_host = host_key[perm]
-    s_dru = state.task_dru[perm]
-    s_res = jnp.where(mask[perm][:, None], state.task_res[perm], 0.0)
-    s_valid = mask[perm]
-
+def _decide_sorted_core(s_host, s_dru, s_res, s_valid, spare, host_ok,
+                        demand) -> PreemptionDecision:
+    """The decision tail shared by both kernels, over host-sorted arrays
+    (s_* sorted by (host asc, dru desc)); returns the preempt mask in
+    SORTED space.  `s_valid` is the per-decision validity (eligibility +
+    dru thresholds); invalid rows must already contribute zero `s_res`.
+    """
+    t = s_host.shape[0]
+    h = spare.shape[0]
     # Per-host prefix sums of freed resources, seeded with the host's spare.
     cum = segmented_cumsum(s_res, s_host)
+    in_range = (s_host >= 0) & (s_host < h)
     spare_of = jnp.where(
-        ((s_host >= 0) & (s_host < h))[:, None],
-        state.spare[jnp.clip(s_host, 0, h - 1)],
-        0.0,
-    )
+        in_range[:, None], spare[jnp.clip(s_host, 0, h - 1)], 0.0)
     freed = cum + spare_of
     prefix_feasible = jnp.all(freed >= demand[None, :], axis=-1) & s_valid
 
     host_allowed = jnp.where(
-        (s_host >= 0) & (s_host < h),
-        state.host_ok[jnp.clip(s_host, 0, h - 1)],
-        False,
-    )
+        in_range, host_ok[jnp.clip(s_host, 0, h - 1)], False)
     # Candidate score: dru of the last task in the prefix (== min in prefix,
     # since sorted desc).  Only the FIRST feasible prefix per host matters —
     # longer ones can only lower the min-dru — and within a host that is the
@@ -93,7 +70,7 @@ def find_preemption_decision(
 
     # Spare-only candidates: hosts whose spare covers demand preempt nothing
     # and score BIG (reference: Double/MAX_VALUE pseudo-task).
-    spare_fits = jnp.all(state.spare >= demand[None, :], axis=-1) & state.host_ok
+    spare_fits = jnp.all(spare >= demand[None, :], axis=-1) & host_ok
     spare_score = jnp.where(spare_fits, BIG, -BIG)
 
     best_task_pos = jnp.argmax(cand_score)
@@ -115,21 +92,129 @@ def find_preemption_decision(
     in_prefix = same_host & (jnp.arange(t) <= best_task_pos) & s_valid
     take_tasks = (~use_spare) & (~none_found)
     preempt_sorted = in_prefix & take_tasks
-    # scatter back to original task order
-    preempt = jnp.zeros(t, dtype=bool).at[perm].set(preempt_sorted)
 
     freed_amount = jnp.where(
         none_found,
         jnp.zeros_like(demand),
         jnp.where(
             use_spare,
-            state.spare[jnp.clip(best_spare_host, 0, h - 1)],
+            spare[jnp.clip(best_spare_host, 0, h - 1)],
             freed[best_task_pos],
         ),
     )
     return PreemptionDecision(
         host=chosen_host,
         score=jnp.where(none_found, -BIG, score),
-        preempt_mask=preempt,
+        preempt_mask=preempt_sorted,
         freed=freed_amount,
     )
+
+
+@jax.jit
+def find_preemption_decision(
+    state: RebalanceState,
+    demand: jnp.ndarray,        # [R] pending job resources
+    pending_dru: jnp.ndarray,   # scalar
+    safe_dru_threshold: jnp.ndarray,
+    min_dru_diff: jnp.ndarray,
+) -> PreemptionDecision:
+    t = state.task_host.shape[0]
+
+    mask = (
+        state.task_eligible
+        & (state.task_dru >= safe_dru_threshold)
+        & ((state.task_dru - pending_dru) > min_dru_diff)
+    )
+
+    # Sort tasks by (host asc, dru desc, index asc); masked-out tasks sink to
+    # a sentinel host so they never join a real segment.
+    host_key = jnp.where(mask, state.task_host, jnp.iinfo(jnp.int32).max)
+    idx = jnp.arange(t)
+    perm = lexsort_perm(host_key, -state.task_dru, idx)
+    s_host = host_key[perm]
+    s_dru = state.task_dru[perm]
+    s_res = jnp.where(mask[perm][:, None], state.task_res[perm], 0.0)
+    s_valid = mask[perm]
+
+    decision = _decide_sorted_core(s_host, s_dru, s_res, s_valid,
+                                   state.spare, state.host_ok, demand)
+    # scatter the sorted-space mask back to original task order
+    preempt = jnp.zeros(t, dtype=bool).at[perm].set(decision.preempt_mask)
+    return decision._replace(preempt_mask=preempt)
+
+
+class SortedRebalanceState(NamedTuple):
+    """Task tensors pre-sorted by (host asc, dru desc) ONCE per cycle.
+
+    The full find_preemption_decision re-sorts all T tasks every call; at
+    the reference's max-preemption=100 decisions per cycle that is 100
+    sorts of the same data.  DRU values and task rows are immutable
+    within a fast cycle (see decide_from_sorted for the divergences), so
+    the sort is amortized: each decision is a per-decision [T] validity
+    mask + segmented cumsums + argmax — no sort.
+    """
+
+    perm: jnp.ndarray    # [T] original row index per sorted position
+    s_host: jnp.ndarray  # [T] host key (sentinel INT32_MAX for ineligible)
+    s_dru: jnp.ndarray   # [T]
+    s_res: jnp.ndarray   # [T, R]
+
+
+@jax.jit
+def sort_rebalance_state(
+    task_host: jnp.ndarray,
+    task_dru: jnp.ndarray,
+    task_res: jnp.ndarray,
+    task_eligible: jnp.ndarray,
+) -> SortedRebalanceState:
+    """One fused multi-key sort of the cycle's tasks (see docstring)."""
+    t = task_host.shape[0]
+    host_key = jnp.where(task_eligible, task_host,
+                         jnp.iinfo(jnp.int32).max)
+    perm = lexsort_perm(host_key, -task_dru, jnp.arange(t))
+    return SortedRebalanceState(
+        perm=perm,
+        s_host=host_key[perm],
+        s_dru=task_dru[perm],
+        s_res=task_res[perm],
+    )
+
+
+@jax.jit
+def decide_from_sorted(
+    ss: SortedRebalanceState,
+    row_ok_sorted: jnp.ndarray,  # [T] per-decision validity, sorted space
+    dru_sorted: jnp.ndarray,     # [T] LIVE dru values, sorted space
+    spare: jnp.ndarray,          # [H, R]
+    host_ok: jnp.ndarray,        # [H] bool
+    demand: jnp.ndarray,         # [R]
+    pending_dru: jnp.ndarray,
+    safe_dru_threshold: jnp.ndarray,
+    min_dru_diff: jnp.ndarray,
+) -> PreemptionDecision:
+    """find_preemption_decision against a pre-sorted cycle state.
+
+    Masked rows (preempted earlier this cycle, quota-restricted, below
+    threshold for THIS pending job) stay in their host segment with zero
+    resource contribution, which yields the same prefix sums over the
+    remaining valid rows as a fresh sort would.  `dru_sorted` carries the
+    LIVE rescored values (cheap per-decision gather), so the safety
+    threshold, min-diff guard, and min-preempted-dru score are exact; the
+    residual divergences vs the exact kernel are (a) the within-host
+    ORDER is frozen at cycle start — a user whose dru changed mid-cycle
+    keeps the stale prefix order — and (b) simulated launches consume
+    host spare instead of joining the task rows (they cannot be
+    re-preempted within the cycle).
+
+    The returned preempt_mask is in SORTED space; map positions back with
+    `ss.perm`."""
+    h = spare.shape[0]
+    m = (
+        row_ok_sorted
+        & (dru_sorted >= safe_dru_threshold)
+        & ((dru_sorted - pending_dru) > min_dru_diff)
+        & (ss.s_host < h)
+    )
+    res_eff = jnp.where(m[:, None], ss.s_res, 0.0)
+    return _decide_sorted_core(ss.s_host, dru_sorted, res_eff, m,
+                               spare, host_ok, demand)

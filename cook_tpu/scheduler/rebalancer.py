@@ -29,7 +29,12 @@ import jax.numpy as jnp
 from cook_tpu.models.entities import DruMode, Instance, Job, Pool, Resources
 from cook_tpu.models.store import JobStore
 from cook_tpu.ops.common import BIG
-from cook_tpu.ops.rebalance import RebalanceState, find_preemption_decision
+from cook_tpu.ops.rebalance import (
+    RebalanceState,
+    decide_from_sorted,
+    find_preemption_decision,
+    sort_rebalance_state,
+)
 
 
 @dataclass
@@ -40,6 +45,13 @@ class RebalancerParams:
     safe_dru_threshold: float = 1.0
     min_dru_diff: float = 0.5
     max_preemption: int = 100
+    # fast_cycle sorts the task tensors ONCE per cycle and reuses the
+    # order for every decision (ops/rebalance.py decide_from_sorted):
+    # ~max_preemption x fewer device sorts per cycle.  DRU values stay
+    # LIVE (threshold/min-diff/score exact); the approximations are the
+    # frozen within-host prefix ORDER and launches consuming spare
+    # instead of joining the preemptable rows
+    fast_cycle: bool = False
 
 
 @dataclass
@@ -144,7 +156,16 @@ class RebalanceCycle:
         self._dev_elig = jnp.asarray(self._elig_np)
         self._dev_spare = jnp.asarray(spare)
         self._dev_host_ok = jnp.ones(len(spare), dtype=bool)
+        self._spare_np = spare.copy()
         self.preempted: set[str] = set()
+        self._sorted = None
+        self._perm_np = None
+        if params.fast_cycle:
+            # ONE sort for the whole cycle; decisions reuse the order
+            self._sorted = sort_rebalance_state(
+                self._dev_host, self._dev_dru, self._dev_res,
+                self._dev_elig)
+            self._perm_np = np.asarray(self._sorted.perm)
 
     # ------------------------------------------------------------ internals
 
@@ -256,6 +277,8 @@ class RebalanceCycle:
         return ok
 
     def compute_decision(self, job: Job) -> Optional[Decision]:
+        if self.params.fast_cycle:
+            return self._compute_decision_fast(job)
         state = self._device_state()
         host_ok = self._host_ok_for(job)
         if host_ok is not None:
@@ -286,6 +309,47 @@ class RebalanceCycle:
             return None
         mask = np.asarray(decision.preempt_mask)
         task_ids = [self.row_ids[i] for i in np.where(mask)[0]]
+        self._apply(job, host, task_ids, np.asarray(decision.freed))
+        return Decision(
+            job=job,
+            hostname=self.hostnames[host],
+            task_ids=task_ids,
+            min_preempted_dru=float(decision.score),
+        )
+
+    def _compute_decision_fast(self, job: Job) -> Optional[Decision]:
+        """Decision against the cycle-start sort (RebalancerParams
+        .fast_cycle): per-decision validity is a host-side [T] mask
+        gathered into sorted space — no device sort per decision."""
+        host_ok = self._host_ok_for(job)
+        host_ok_dev = (jnp.asarray(host_ok) if host_ok is not None
+                       else self._dev_host_ok)
+        pending_dru = self.pending_job_dru(job)
+        row_ok = self._elig_np
+        if not self.user_below_quota(job):
+            ut = self.users.get(job.user)
+            own = np.zeros(len(self._elig_np), dtype=bool)
+            if ut:
+                own[np.asarray(ut.rows, dtype=np.int64)] = True
+            row_ok = row_ok & own
+        r = job.resources
+        decision = decide_from_sorted(
+            self._sorted,
+            jnp.asarray(row_ok[self._perm_np]),
+            jnp.asarray(self._dru_np[self._perm_np]),
+            jnp.asarray(self._spare_np),
+            host_ok_dev,
+            jnp.asarray([r.mem, r.cpus, r.gpus, r.disk], dtype=jnp.float32),
+            jnp.float32(pending_dru),
+            jnp.float32(self.params.safe_dru_threshold),
+            jnp.float32(self.params.min_dru_diff),
+        )
+        host = int(decision.host)
+        if host < 0:
+            return None
+        mask_sorted = np.asarray(decision.preempt_mask)
+        rows = self._perm_np[np.where(mask_sorted)[0]]
+        task_ids = [self.row_ids[i] for i in rows]
         self._apply(job, host, task_ids, np.asarray(decision.freed))
         return Decision(
             job=job,
@@ -332,8 +396,19 @@ class RebalanceCycle:
             touched.extend(self._rescore(user))
         for row in dead_rows:
             self._elig_np[row] = False
-        self._elig_np[sim_row] = True
+        # in fast_cycle the sim row is outside the cycle-start sort (its
+        # sorted position sits in the sentinel segment, which the decide
+        # kernel excludes); host-side bookkeeping above still counts it
+        # for quota/pending-dru purposes
+        self._elig_np[sim_row] = not self.params.fast_cycle
 
+        r = job.resources
+        new_spare = np.maximum(
+            freed - np.array([r.mem, r.cpus, r.gpus, r.disk]), 0.0
+        ).astype(np.float32)
+        self._spare_np[host] = new_spare
+        if self.params.fast_cycle:
+            return
         # device scatters: O(changed rows)
         rows = np.asarray(sorted(set(touched + dead_rows + [sim_row])),
                           dtype=np.int32)
@@ -345,10 +420,6 @@ class RebalanceCycle:
         self._dev_host = self._dev_host.at[sim_row].set(host)
         self._dev_res = self._dev_res.at[sim_row].set(
             jnp.asarray(np.asarray(res, np.float32)))
-        r = job.resources
-        new_spare = np.maximum(
-            freed - np.array([r.mem, r.cpus, r.gpus, r.disk]), 0.0
-        ).astype(np.float32)
         self._dev_spare = self._dev_spare.at[host].set(jnp.asarray(new_spare))
 
 

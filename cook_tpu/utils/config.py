@@ -127,6 +127,7 @@ def read_config(path: Optional[str] = None,
             safe_dru_threshold=float(rb.get("safe_dru_threshold", 1.0)),
             min_dru_diff=float(rb.get("min_dru_diff", 0.5)),
             max_preemption=int(rb.get("max_preemption", 100)),
+            fast_cycle=bool(rb.get("fast_cycle", False)),
         )
     if "match" in data:
         settings.match = _match_config(data["match"])

@@ -1,0 +1,185 @@
+"""fast_cycle rebalancer: ONE device sort per cycle, per-decision masks
+in sorted space (ops/rebalance.py sort_rebalance_state +
+decide_from_sorted).  Decisions must match the exact per-decision-sort
+kernel whenever the intra-cycle approximations (frozen DRU, launches
+consume spare only) cannot bite."""
+import numpy as np
+
+from cook_tpu.models.entities import (
+    DEFAULT_USER,
+    Pool,
+    Resources,
+    Share,
+)
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.rebalancer import (
+    RebalancerParams,
+    rebalance_pool,
+)
+from tests.conftest import FakeClock, make_job
+
+
+def _build_store(n_hosts=4, tasks_per_host=2):
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=400, cpus=4, gpus=1)))
+    # two hogs holding every host; distinct per-host task sizes so the
+    # min-dru ordering is unambiguous
+    for h in range(n_hosts):
+        for k in range(tasks_per_host):
+            user = f"hog{k % 2}"
+            job = make_job(user=user, mem=300 + 10 * h, cpus=3)
+            store.submit_jobs([job])
+            store.create_instance(job.uuid, f"t-{h}-{k}",
+                                  hostname=f"h{h}", node_id=f"h{h}",
+                                  compute_cluster="m")
+    spare = {f"h{h}": Resources(mem=50.0, cpus=1.0) for h in range(n_hosts)}
+    return clock, store, spare
+
+
+def _decision_sig(decisions):
+    return [(d.job.uuid, d.hostname, sorted(d.task_ids))
+            for d in decisions]
+
+
+def test_fast_cycle_matches_exact_across_decisions():
+    """Pending jobs from users with no running tasks, each decision on a
+    different host: the fast path must reproduce the exact kernel's
+    decision sequence (host, victims, order)."""
+    params_exact = RebalancerParams(safe_dru_threshold=0.0,
+                                    min_dru_diff=0.01, max_preemption=10)
+    params_fast = RebalancerParams(safe_dru_threshold=0.0,
+                                   min_dru_diff=0.01, max_preemption=10,
+                                   fast_cycle=True)
+    # distinct users -> no frozen-DRU interaction between decisions
+    results = []
+    for params in (params_exact, params_fast):
+        clock, store, spare = _build_store()
+        pending = [make_job(user=f"starved{i}", mem=320, cpus=3)
+                   for i in range(3)]
+        # deterministic uuids so the runs are comparable
+        pending = [j.with_(uuid=f"pend-{i}")
+                   for i, j in enumerate(pending)]
+        store.submit_jobs(pending)
+        decisions = rebalance_pool(store, store.pools["default"], pending,
+                                   spare, params)
+        results.append(_decision_sig(decisions))
+    exact_sig, fast_sig = results
+    assert exact_sig, "scenario must produce preemptions"
+    assert fast_sig == exact_sig
+
+
+def test_fast_cycle_decisions_internally_consistent():
+    """Across many decisions, victims are distinct, above threshold, and
+    the freed resources cover each pending demand."""
+    params = RebalancerParams(safe_dru_threshold=0.0, min_dru_diff=0.01,
+                              max_preemption=20, fast_cycle=True)
+    clock, store, spare = _build_store(n_hosts=6, tasks_per_host=3)
+    pending = [make_job(user=f"s{i}", mem=300, cpus=3).with_(uuid=f"p{i}")
+               for i in range(6)]
+    store.submit_jobs(pending)
+    decisions = rebalance_pool(store, store.pools["default"], pending,
+                               spare, params)
+    assert decisions
+    seen = set()
+    for d in decisions:
+        for tid in d.task_ids:
+            assert tid not in seen, "victim preempted twice"
+            seen.add(tid)
+        assert d.min_preempted_dru >= 0.0
+
+
+def test_fast_cycle_spare_only_host_preempts_nothing():
+    """A host whose spare alone covers the demand wins with no victims,
+    identically in both modes."""
+    for fast in (False, True):
+        clock, store, spare = _build_store(n_hosts=2)
+        spare["h1"] = Resources(mem=1000.0, cpus=8.0)
+        pending = [make_job(user="s", mem=500, cpus=2).with_(uuid="p0")]
+        store.submit_jobs(pending)
+        params = RebalancerParams(safe_dru_threshold=0.0,
+                                  min_dru_diff=0.01, max_preemption=5,
+                                  fast_cycle=fast)
+        decisions = rebalance_pool(store, store.pools["default"], pending,
+                                   spare, params)
+        # spare-only decisions carry no task_ids and rebalance_pool drops
+        # them from the returned list; no preemption must have happened
+        assert all(not d.task_ids for d in decisions)
+
+
+def test_fast_cycle_threshold_uses_live_dru():
+    """A task whose TRUE dru falls below safe_dru_threshold after an
+    earlier same-cycle preemption of the same user must be protected in
+    fast mode too (live dru values; only the ORDER is frozen)."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=100, cpus=100, gpus=1)))
+    # hog's cumulative dru: t0 2.0 (200/100), t1 5.0 (+300), t2 6.0 (+100)
+    sizes = [200, 300, 100]
+    jobs = []
+    for i, mem in enumerate(sizes):
+        job = make_job(user="hog", mem=mem, cpus=0.1)
+        jobs.append(job)
+        store.submit_jobs([job])
+        store.create_instance(job.uuid, f"t{i}", hostname=f"h{i}",
+                              node_id=f"h{i}", compute_cluster="m")
+    spare = {f"h{i}": Resources(mem=10.0, cpus=1.0) for i in range(3)}
+    # threshold 3.5: initially t1 (5.0) and t2 (6.0) are preemptable;
+    # preempting t1 drops t2's true dru to 3.0 -> protected afterwards
+    results = {}
+    for fast in (False, True):
+        params = RebalancerParams(safe_dru_threshold=3.5,
+                                  min_dru_diff=0.01, max_preemption=5,
+                                  fast_cycle=fast)
+        clock2 = FakeClock()
+        store2 = JobStore(clock=clock2)
+        store2.set_pool(Pool(name="default"))
+        store2.set_share(Share(user=DEFAULT_USER, pool="default",
+                               resources=Resources(mem=100, cpus=100,
+                                                   gpus=1)))
+        for i, mem in enumerate(sizes):
+            job = make_job(user="hog", mem=mem, cpus=0.1).with_(
+                uuid=f"hog-{i}")
+            store2.submit_jobs([job])
+            store2.create_instance(job.uuid, f"t{i}", hostname=f"h{i}",
+                                   node_id=f"h{i}", compute_cluster="m")
+        pending = [
+            make_job(user="s1", mem=250, cpus=0.1).with_(uuid="p1"),
+            make_job(user="s2", mem=90, cpus=0.1).with_(uuid="p2"),
+        ]
+        store2.submit_jobs(pending)
+        decisions = rebalance_pool(store2, store2.pools["default"],
+                                   pending, dict(spare), params)
+        results[fast] = _decision_sig(decisions)
+    assert results[True] == results[False]
+    preempted = {tid for _, _, tids in results[True] for tid in tids}
+    assert "t2" not in preempted, "t2's live dru fell below the threshold"
+
+
+def test_fast_cycle_respects_quota_own_task_restriction():
+    """An over-quota user's pending job may only preempt that user's own
+    tasks (rebalancer.clj:339-346) — enforced through the sorted-space
+    validity mask too."""
+    from cook_tpu.models.entities import Quota
+
+    for fast in (False, True):
+        clock, store, spare = _build_store(n_hosts=2, tasks_per_host=2)
+        store.set_quota(Quota(user="hog0", pool="default",
+                              resources=Resources(mem=100, cpus=1),
+                              count=1))
+        pending = [make_job(user="hog0", mem=320, cpus=3).with_(uuid="p0")]
+        store.submit_jobs(pending)
+        params = RebalancerParams(safe_dru_threshold=0.0,
+                                  min_dru_diff=0.01, max_preemption=5,
+                                  fast_cycle=fast)
+        decisions = rebalance_pool(store, store.pools["default"], pending,
+                                   spare, params)
+        for d in decisions:
+            for tid in d.task_ids:
+                # victims must be hog0's own tasks
+                inst_host, inst_k = tid.split("-")[1:]
+                assert int(inst_k) % 2 == 0, (fast, tid)
