@@ -336,6 +336,8 @@ class Scheduler:
                 "min_dru_diff", base.min_dru_diff)),
             max_preemption=int(overrides.get(
                 "max_preemption", base.max_preemption)),
+            fast_cycle=bool(overrides.get(
+                "fast_cycle", base.fast_cycle)),
         )
 
     def rebalance_cycle(self, pool: Pool) -> list[Decision]:
