@@ -1,4 +1,5 @@
 """Incremental config rollout, rebalancer host reservations, pool moves."""
+import pytest
 import numpy as np
 
 from cook_tpu.models.entities import (
@@ -75,7 +76,8 @@ def test_reservation_steers_matcher():
     assert not scheduler.host_reservations
 
 
-def test_rebalancer_multi_task_decision_creates_reservation():
+@pytest.mark.parametrize("fast", [False, True])
+def test_rebalancer_multi_task_decision_creates_reservation(fast):
     from cook_tpu.cluster.mock import MockCluster, MockHost
     from cook_tpu.models.store import JobStore
     from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
@@ -97,7 +99,8 @@ def test_rebalancer_multi_task_decision_creates_reservation():
     scheduler = Scheduler(
         store, [cluster],
         SchedulerConfig(rebalancer=RebalancerParams(
-            safe_dru_threshold=0.0, min_dru_diff=0.01, max_preemption=5)),
+            safe_dru_threshold=0.0, min_dru_diff=0.01, max_preemption=5,
+            fast_cycle=fast)),
     )
     pool = store.pools["default"]
     # hog runs two tasks filling the host
