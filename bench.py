@@ -195,10 +195,10 @@ def bench_dru(jax, jnp):
     return p50
 
 
-def bench_multipool(jax, jnp):
+def bench_multipool(jax, jnp, tuned):
     """BASELINE config 3: multi-pool cpu+mem+gpu bin-packing, pools as the
     batch axis of one vmapped solve."""
-    from cook_tpu.ops.match import MatchProblem, chunked_match
+    from cook_tpu.ops.match import MatchProblem, backend_flags, chunked_match
 
     P, J, N = 8, 16384, 2048
     rng = np.random.default_rng(5)
@@ -224,8 +224,14 @@ def bench_multipool(jax, jnp):
         node_valid=jnp.ones((P, N), bool),
         feasible=None,
     )
+    # pallas_call batching under vmap is not guaranteed; the pool-batched
+    # solve keeps to the pure-XLA backends
+    backend = "xla" if tuned["backend"] == "pallas" else tuned["backend"]
     solve = jax.vmap(
-        lambda p: chunked_match(p, chunk=1024, rounds=3, kc=128, passes=2)
+        lambda p: chunked_match(p, chunk=min(tuned["chunk"], J),
+                                rounds=tuned["rounds"], kc=tuned["kc"],
+                                passes=tuned["passes"],
+                                **backend_flags(backend))
     )
 
     def run():
@@ -302,7 +308,7 @@ def main():
     if platform != "cpu":
         dru_p50 = bench_dru(jax, jnp)
         reb_p50 = bench_rebalance(jax, jnp)
-        bench_multipool(jax, jnp)
+        bench_multipool(jax, jnp, load_tuned())
         log(f"full-cycle estimate (rank+match+rebalance): "
             f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
         extra = f", dru_ms={dru_p50:.1f}, rebalance_ms={reb_p50:.1f}"
