@@ -29,6 +29,14 @@ int main(int argc, char** argv) {
     return 2;
   }
   std::string cmd = argv[i++];
+  if (cmd == "--help" || cmd == "-h" || cmd == "help") {
+    fprintf(stderr, "usage: cook_cli [--url U] [--user u] <command>\n"
+                    "  submit <cmd> [mem] [cpus]   print the job uuid\n"
+                    "  wait <uuid> [timeout_ms]    poll until terminal\n"
+                    "  show <uuid>                 job + instance status\n"
+                    "  kill <uuid>\n");
+    return 0;
+  }
   cook::JobClient client = cook::JobClient::Builder()
                                .url(url)
                                .user(user)
