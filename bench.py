@@ -125,15 +125,30 @@ def bench_match(jax, jnp, platform):
         log("cpu fallback: overriding tuned backend pallas -> xla")
         tuned = dict(tuned, backend="xla")
 
-    def solve():
-        result = chunked_match(problem, chunk=chunk,
-                               rounds=tuned["rounds"], kc=tuned["kc"],
-                               passes=tuned["passes"],
-                               **backend_flags(tuned["backend"]))
-        return np.asarray(result.assignment)
+    def make_solve(cfg, cfg_chunk):
+        def solve():
+            result = chunked_match(problem, chunk=cfg_chunk,
+                                   rounds=cfg["rounds"], kc=cfg["kc"],
+                                   passes=cfg["passes"],
+                                   **backend_flags(cfg["backend"]))
+            return np.asarray(result.assignment)
+        return solve
 
+    solve = make_solve(tuned, chunk)
     t0 = time.perf_counter()
-    assignment = solve()
+    try:
+        assignment = solve()
+    except Exception as e:  # noqa: BLE001 — a promoted tuned config (e.g.
+        # a Pallas/Mosaic compile on this exact chip generation) must
+        # never cost us the round's measurement; fall back to defaults
+        log(f"tuned config failed to run ({type(e).__name__}: "
+            f"{str(e)[:200]}); falling back to the default config")
+        tuned = {"backend": "xla", "chunk": 1024, "rounds": 3,
+                 "passes": 2, "kc": 128}
+        chunk = min(tuned["chunk"], J)
+        solve = make_solve(tuned, chunk)
+        t0 = time.perf_counter()
+        assignment = solve()
     log(f"match compile+first run: {(time.perf_counter()-t0)*1000:.0f} ms")
     p50, times = time_fn(solve)
     tpu_assign = assignment[:j_real]
