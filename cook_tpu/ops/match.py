@@ -135,6 +135,87 @@ def _segment_rank(keys, order):
     return jnp.zeros(k, jnp.int32).at[perm].set(rank_sorted.astype(jnp.int32))
 
 
+def conflict_round(avail, assignment, cand_val, cand_idx, d, n, *,
+                   recheck_mask=None):
+    """One conflict-resolution round over candidate lists — THE shared
+    acceptance step of every chunked matcher variant (single-device,
+    node-sharded, pallas, bucketed):
+
+      1. each unplaced job takes its first still-feasible candidate;
+      2. contenders for the same node spread onto their c-th feasible
+         alternates (skipped for single-candidate lists, where the
+         prefix-accept below admits as many contenders as fit — extra
+         rounds still progress: a contender whose pick stops fitting
+         drops out of the segment, unblocking jobs queued behind it);
+      3. a pick is accepted iff the node holds the cumulative demand of
+         earlier accepted picks (segmented prefix-sum over sorted picks);
+      4. accepted demand is scatter-subtracted from availability.
+
+    `recheck_mask` ([K, N] bool) re-applies a constraint mask on the
+    candidate gather — needed when candidate lists were built without it
+    (class-shared bucketed lists).  Returns (new_avail, assignment)."""
+    k = cand_idx.shape[0]
+    n_res = d.shape[-1]
+    order = jnp.arange(k)
+    idxs = jnp.arange(k)
+    cand_ok = cand_val > -BIG  # [K,kc]
+    unplaced = assignment < 0
+    # candidate feasibility vs CURRENT availability (tiny gather)
+    avail_cand = avail[cand_idx]  # [K,kc,R]
+    feas_cand = (
+        jnp.all(avail_cand >= d[:, None, :], axis=-1)
+        & cand_ok
+        & unplaced[:, None]
+    )
+    if recheck_mask is not None:
+        feas_cand &= jnp.take_along_axis(recheck_mask, cand_idx, axis=1)
+    has = feas_cand.any(axis=1)
+    f0 = jnp.argmax(feas_cand, axis=1)
+    pick0 = jnp.where(
+        has,
+        jnp.take_along_axis(cand_idx, f0[:, None], axis=1)[:, 0],
+        n,
+    )
+    if cand_idx.shape[1] == 1:
+        pick = pick0
+        take = has
+    else:
+        # contention spreading: c-th contender takes its c-th feasible
+        # candidate
+        c = _segment_rank(pick0, order)
+        cum = jnp.cumsum(feas_cand, axis=1)
+        sel = (cum == (c + 1)[:, None]) & feas_cand
+        has_c = sel.any(axis=1)
+        pos = jnp.argmax(sel, axis=1)
+        pick = jnp.take_along_axis(cand_idx, pos[:, None], axis=1)[:, 0]
+        take = has & has_c
+    pick_key = jnp.where(take, pick, n)
+    # prefix-accept: per-node cumulative demand among this round's picks
+    # must fit availability (segmented over sorted picks)
+    perm2 = lexsort_perm(pick_key, order)
+    sp2 = pick_key[perm2]
+    d2 = jnp.where((sp2 < n)[:, None], d[perm2], 0.0)
+    cums = jnp.cumsum(d2, axis=0)
+    starts2 = jnp.concatenate([jnp.ones(1, bool), sp2[1:] != sp2[:-1]])
+    seg_first2 = jax.lax.cummax(jnp.where(starts2, idxs, 0))
+    base = jnp.where(
+        (seg_first2 > 0)[:, None],
+        cums[jnp.maximum(seg_first2 - 1, 0)],
+        0.0,
+    )
+    segcum = cums - base
+    have2 = avail[jnp.clip(sp2, 0, n - 1)]
+    accept2 = (sp2 < n) & jnp.all(segcum <= have2 + 1e-9, axis=-1)
+    accept = jnp.zeros(k, bool).at[perm2].set(accept2)
+    assignment = jnp.where(accept, pick, assignment).astype(jnp.int32)
+    delta = (
+        jnp.zeros((n, n_res), d.dtype)
+        .at[jnp.where(accept, pick, n - 1)]
+        .add(jnp.where(accept[:, None], d, 0.0))
+    )
+    return avail - delta, assignment
+
+
 @functools.partial(
     jax.jit, static_argnames=("chunk", "rounds", "kc", "use_approx",
                               "passes", "use_pallas", "bucketed")
@@ -188,8 +269,6 @@ def chunked_match(
     denom = jnp.maximum(problem.totals, 1e-30)
     node_valid = problem.node_valid
     totals = problem.totals
-    order = jnp.arange(chunk)
-    idxs = jnp.arange(chunk)
 
     if use_pallas:
         import jax as jax_mod
@@ -271,76 +350,12 @@ def chunked_match(
 
         def round_step(carry, _):
             avail, assignment, cand_val, cand_idx = carry
-            cand_ok = cand_val > -BIG  # [K,kc]
-            unplaced = assignment < 0
-            # candidate feasibility vs CURRENT availability (tiny gather)
-            avail_cand = avail[cand_idx]  # [K,kc,3]
-            feas_cand = (
-                jnp.all(avail_cand >= d[:, None, :], axis=-1)
-                & cand_ok
-                & unplaced[:, None]
-            )
-            if bucketed and problem.feasible is not None:
-                # class-shared candidate lists cannot pre-apply the per-job
-                # constraint mask; re-check it on the [K,kc] gather
-                feas_cand &= jnp.take_along_axis(fr, cand_idx, axis=1)
-            has = feas_cand.any(axis=1)
-            f0 = jnp.argmax(feas_cand, axis=1)
-            pick0 = jnp.where(
-                has,
-                jnp.take_along_axis(cand_idx, f0[:, None], axis=1)[:, 0],
-                n,
-            )
-            if cand_idx.shape[1] == 1:
-                # single-candidate lists (pallas backend): contention
-                # spreading has no alternates to spread onto — let every
-                # contender pick the node and the prefix-accept below
-                # admit as many as fit.  Extra rounds are NOT no-ops even
-                # on identical candidates: a contender whose pick stops
-                # fitting the reduced availability drops out of the
-                # segment, unblocking jobs that sat behind its demand in
-                # the prefix sum (measured: rounds=2 places ~6% more than
-                # rounds=1 at passes=8 on the parity workloads)
-                pick = pick0
-                take = has
-            else:
-                # contention spreading: c-th contender takes its c-th
-                # feasible candidate
-                c = _segment_rank(pick0, order)
-                cum = jnp.cumsum(feas_cand, axis=1)
-                sel = (cum == (c + 1)[:, None]) & feas_cand
-                has_c = sel.any(axis=1)
-                pos = jnp.argmax(sel, axis=1)
-                pick = jnp.take_along_axis(cand_idx, pos[:, None],
-                                           axis=1)[:, 0]
-                take = has & has_c
-            pick_key = jnp.where(take, pick, n)
-            # prefix-accept: per-node cumulative demand among this round's
-            # picks must fit availability (segmented over sorted picks)
-            perm2 = lexsort_perm(pick_key, order)
-            sp2 = pick_key[perm2]
-            d2 = jnp.where((sp2 < n)[:, None], d[perm2], 0.0)
-            cums = jnp.cumsum(d2, axis=0)
-            starts2 = jnp.concatenate(
-                [jnp.ones(1, bool), sp2[1:] != sp2[:-1]]
-            )
-            seg_first2 = jax.lax.cummax(jnp.where(starts2, idxs, 0))
-            base = jnp.where(
-                (seg_first2 > 0)[:, None],
-                cums[jnp.maximum(seg_first2 - 1, 0)],
-                0.0,
-            )
-            segcum = cums - base
-            have2 = avail[jnp.clip(sp2, 0, n - 1)]
-            accept2 = (sp2 < n) & jnp.all(segcum <= have2 + 1e-9, axis=-1)
-            accept = jnp.zeros(chunk, bool).at[perm2].set(accept2)
-            assignment = jnp.where(accept, pick, assignment).astype(jnp.int32)
-            delta = (
-                jnp.zeros((n, n_res), d.dtype)
-                .at[jnp.where(accept, pick, n - 1)]
-                .add(jnp.where(accept[:, None], d, 0.0))
-            )
-            return (avail - delta, assignment, cand_val, cand_idx), None
+            recheck = (fr if bucketed and problem.feasible is not None
+                       else None)
+            avail, assignment = conflict_round(
+                avail, assignment, cand_val, cand_idx, d, n,
+                recheck_mask=recheck)
+            return (avail, assignment, cand_val, cand_idx), None
 
         # derive the init from chunk data rather than a constant: under
         # shard_map a replicated (unvarying) carry init clashes with the

@@ -1,6 +1,7 @@
 """Mesh/sharding layer: pool-axis and node-axis sharded scheduling solves."""
 from cook_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
+    node_sharded_chunked_match,
     node_sharded_greedy_match,
     pool_sharded_dru,
     pool_sharded_match,

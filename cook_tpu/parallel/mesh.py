@@ -26,13 +26,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from cook_tpu.ops.common import BIG
+from cook_tpu.ops.common import BIG, binpack_fitness
 from cook_tpu.ops.dru import DruTasks, dru_rank
 from cook_tpu.ops.match import (
     MatchProblem,
     MatchResult,
     backend_flags,
     chunked_match,
+    conflict_round,
     greedy_match,
 )
 
@@ -171,5 +172,126 @@ def node_sharded_greedy_match(mesh: Mesh, problem: MatchProblem) -> MatchResult:
     assignment, new_avail = shmapped(
         problem.demands, problem.job_valid, problem.avail, problem.totals,
         problem.node_valid, feas,
+    )
+    return MatchResult(assignment=assignment, new_avail=new_avail)
+
+
+def node_sharded_chunked_match(
+    mesh: Mesh,
+    problem: MatchProblem,
+    *,
+    chunk: int = 1024,
+    rounds: int = 3,
+    kc: int = 128,
+    passes: int = 2,
+) -> MatchResult:
+    """The chunked production matcher with its candidate pass sharded over
+    the NODE axis — the scalable single-huge-pool path.
+
+    The availability state ([N, R], ~256 KB at 16k nodes) is cheap enough
+    to keep REPLICATED; what scales with the problem is the [K, N]
+    fitness/feasibility sweep, so that is what shards: each device scores
+    only its N/D node columns (O(K*N/D) work), takes a local top-kc, and
+    one all-gather merges the D*kc candidates into a global top-kc list.
+    The conflict-resolution rounds then run identically (deterministic)
+    on every device against the replicated availability — per-pass ICI
+    traffic is O(D * K * kc), never O(N).
+
+    Same semantics as `chunked_match` up to candidate-selection detail
+    (local-then-merged top-k can order equal scores differently than one
+    global top-k); parity is bounded by the same >=0.99 packing bar.
+    """
+    axis = mesh.axis_names[0]
+    ndev = mesh.devices.size
+    j, n = problem.demands.shape[0], problem.avail.shape[0]
+    n_res = problem.demands.shape[-1]
+    assert j % chunk == 0, "pad jobs to a multiple of chunk"
+    assert n % ndev == 0, "pad nodes to a multiple of mesh size"
+    nloc = n // ndev
+    kc_local = min(kc, nloc)   # per-device top-k is bounded by its shard
+    kc = min(kc, n)            # the MERGED list keeps the requested width
+
+    demands_c = problem.demands.reshape(j // chunk, chunk, n_res)
+    ok_c = problem.job_valid.reshape(j // chunk, chunk)
+    if problem.feasible is not None:
+        feas_c = problem.feasible.reshape(j // chunk, chunk, n)
+    else:
+        feas_c = jnp.ones((j // chunk, 1, 1), dtype=bool)
+
+    def local_solve(demands_c, ok_c, feas_c, avail0, totals_l, nv_l):
+        # totals_l / nv_l / feas_c arrive SHARDED on the node axis (each
+        # device holds its nloc columns — the [J, N] constraint mask is
+        # the big input, ~1 GB at headline scale, and must not be
+        # replicated); avail stays replicated because the conflict rounds
+        # gather and update arbitrary global nodes (it is [N, R], tiny)
+        my = jax.lax.axis_index(axis)
+        col0 = my * nloc
+        denom_l = jnp.maximum(totals_l, 1e-30)
+
+        def chunk_step(avail, inputs):
+            d, ok, fr_l = inputs
+
+            def candidate_pass(avail, assignment):
+                unplaced = assignment < 0
+                # my node-column slice of the replicated availability
+                avail_l = jax.lax.dynamic_slice_in_dim(avail, col0, nloc)
+                fits = jnp.all(avail_l[None, :, :] >= d[:, None, :],
+                               axis=-1)
+                feasible = (fits & nv_l[None, :] & fr_l
+                            & (ok & unplaced)[:, None])
+                used0 = totals_l[:, 0] - avail_l[:, 0]
+                used1 = totals_l[:, 1] - avail_l[:, 1]
+                fit = binpack_fitness(used0[None, :], used1[None, :],
+                                      d[:, 0:1], d[:, 1:2],
+                                      denom_l[None, :, 0],
+                                      denom_l[None, :, 1])
+                score = jnp.where(feasible, fit, -BIG)
+                lval, lidx = jax.lax.top_k(score, kc_local)  # [K, kc_l]
+                gidx = lidx + col0
+                # merge: [D, K, kc_l] -> [K, D*kc_l] -> global top-kc
+                all_val = jax.lax.all_gather(lval, axis)
+                all_idx = jax.lax.all_gather(gidx, axis)
+                flat_val = jnp.moveaxis(all_val, 0, 1).reshape(chunk, -1)
+                flat_idx = jnp.moveaxis(all_idx, 0, 1).reshape(chunk, -1)
+                mval, mpos = jax.lax.top_k(flat_val,
+                                           min(kc, ndev * kc_local))
+                midx = jnp.take_along_axis(flat_idx, mpos, axis=1)
+                return mval, midx
+
+            def round_step(carry, _):
+                # the SHARED acceptance step (ops/match.py conflict_round)
+                # runs replicated and deterministic on every device
+                avail, assignment, cand_val, cand_idx = carry
+                avail, assignment = conflict_round(
+                    avail, assignment, cand_val, cand_idx, d, n)
+                return (avail, assignment, cand_val, cand_idx), None
+
+            assignment = (d[:, 0] * 0).astype(jnp.int32) - 1
+            for _ in range(passes):
+                cand_val, cand_idx = candidate_pass(avail, assignment)
+                (avail, assignment, _, _), _ = jax.lax.scan(
+                    round_step, (avail, assignment, cand_val, cand_idx),
+                    None, length=rounds,
+                )
+            return avail, assignment
+
+        new_avail, assignment = jax.lax.scan(
+            chunk_step, avail0, (demands_c, ok_c, feas_c))
+        return assignment.reshape(j), new_avail
+
+    # the unconstrained placeholder mask ([C,1,1]) cannot shard its size-1
+    # node axis; real masks shard so no device holds the full [J, N] bools
+    feas_spec = P() if problem.feasible is None else P(None, None, axis)
+    shmapped = jax.shard_map(
+        local_solve, mesh=mesh,
+        in_specs=(P(), P(), feas_spec, P(), P(axis), P(axis)),
+        out_specs=(P(), P()),
+        # outputs are identical on all devices by construction (the merge
+        # collectives + replicated rounds); vma inference can't see that
+        check_vma=False,
+    )
+    assignment, new_avail = shmapped(
+        demands_c, ok_c, feas_c, problem.avail, problem.totals,
+        problem.node_valid,
     )
     return MatchResult(assignment=assignment, new_avail=new_avail)
