@@ -1,0 +1,63 @@
+"""tools/pick_tuned.py: the sweep -> tuned_match.json promotion that the
+round-end bench consumes — selection, efficiency bar, resilience."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_pick(tmp_path, rows, min_eff=None):
+    sweep = tmp_path / "sweep.jsonl"
+    with open(sweep, "w") as f:
+        for row in rows:
+            f.write((row if isinstance(row, str) else json.dumps(row))
+                    + "\n")
+    out = tmp_path / "tuned.json"
+    cmd = [sys.executable, str(REPO / "tools" / "pick_tuned.py"),
+           "--sweep", str(sweep), "--out", str(out)]
+    if min_eff is not None:
+        cmd += ["--min-eff", str(min_eff)]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    return proc, (json.load(open(out)) if out.exists() else None)
+
+
+def record(backend="xla", chunk=1024, passes=2, rounds=3, kc=128,
+           p50=500.0, eff=1.0, platform="tpu"):
+    return {"platform": platform, "backend": backend, "chunk": chunk,
+            "passes": passes, "rounds": rounds, "kc": kc,
+            "p50_ms": p50, "packing_eff": eff}
+
+
+def test_picks_lowest_p50_above_bar(tmp_path):
+    proc, tuned = run_pick(tmp_path, [
+        record(p50=700, eff=1.004),
+        record(backend="bucketed", p50=250, eff=0.997),
+        record(backend="pallas", p50=150, eff=0.985),  # below the bar
+        record(p50=400, eff=0.991),                    # below 0.995 bar
+    ], min_eff=0.995)
+    assert proc.returncode == 0
+    assert tuned["backend"] == "bucketed"
+    assert tuned["measured_p50_ms"] == 250
+
+
+def test_ignores_cpu_started_and_error_records(tmp_path):
+    proc, tuned = run_pick(tmp_path, [
+        record(p50=100, eff=1.0, platform="cpu"),  # cpu fallback: excluded
+        {"backend": "xla", "chunk": 1024, "passes": 2, "rounds": 3,
+         "kc": 128, "started": True},
+        {"backend": "pallas", "chunk": 8192, "passes": 8, "rounds": 1,
+         "kc": 1, "error": "abandoned after 2 hung attempts"},
+        '{"truncated": ',  # killed writer mid-line
+        record(p50=600, eff=1.002),
+    ])
+    assert proc.returncode == 0
+    assert tuned["measured_p50_ms"] == 600
+
+
+def test_no_qualifying_config_keeps_defaults(tmp_path):
+    proc, tuned = run_pick(tmp_path, [record(p50=100, eff=0.9)])
+    assert proc.returncode == 1
+    assert tuned is None
+    # bench falls back to its built-in default when the file is absent
