@@ -159,8 +159,15 @@ class HttpKubeApi(KubeApi):
         self._watch_cb: Optional[Callable[[str, Optional[KubePod]], None]] = None
         self._known: dict[str, KubePod] = {}  # watch-maintained local view
         self._synced = threading.Event()  # set after the first LIST
+        # second, selector-free watch: the cluster-wide consumption view
+        # feeding list_all_pods (the reference computes consumption from
+        # watch state, api.clj:886 — re-LISTing every cluster pod per
+        # offer cycle is the apiserver-hammering alternative)
+        self._known_all: dict[str, KubePod] = {}
+        self._all_synced = threading.Event()
         self._stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
+        self._all_watch_thread: Optional[threading.Thread] = None
         self._lock = threading.RLock()
 
     # ----------------------------------------------------------- plumbing
@@ -401,13 +408,19 @@ class HttpKubeApi(KubeApi):
         if self._watch_thread is not None and self._synced.is_set():
             with self._lock:
                 return list(self._known.values())
-        body, _ = self._list_pods_raw()
-        return body
+        pods, _ = self._list_raw(
+            f"/api/v1/namespaces/{self.namespace}/pods",
+            f"{COOK_MANAGED_LABEL}=true")
+        return pods
 
     def list_all_pods(self) -> list[KubePod]:
         """Cluster-wide, label-unfiltered: offers must account for
         daemonset/system pods or a direct-bound pod gets rejected
-        OutOfcpu by the kubelet (get-consumption, api.clj:886)."""
+        OutOfcpu by the kubelet (get-consumption, api.clj:886).  Served
+        from the selector-free watch view once synced."""
+        if self._all_watch_thread is not None and self._all_synced.is_set():
+            with self._lock:
+                return list(self._known_all.values())
         body = self._request("GET", "/api/v1/pods")
         return [self._pod_from_manifest(item)
                 for item in body.get("items", [])]
@@ -440,21 +453,40 @@ class HttpKubeApi(KubeApi):
 
     # -------------------------------------------------------------- watch
 
-    def start(self) -> None:
-        """Start the pod watch loop thread (initialize-pod-watch)."""
+    def start(self, *, watch_all_pods: bool = True) -> None:
+        """Start the watch loop threads: the cook-managed pod watch
+        (initialize-pod-watch) and, by default, the selector-free
+        cluster-wide watch that feeds `list_all_pods` consumption."""
         if self._watch_thread is not None:
             return
         self._stop.clear()
         self._watch_thread = threading.Thread(
-            target=self._watch_loop, name="kube-pod-watch", daemon=True)
+            target=self._watch_loop,
+            kwargs=dict(path=f"/api/v1/namespaces/{self.namespace}/pods",
+                        selector=f"{COOK_MANAGED_LABEL}=true",
+                        store=self._known, synced=self._synced,
+                        emit=self._emit, what="pod"),
+            name="kube-pod-watch", daemon=True)
         self._watch_thread.start()
+        if watch_all_pods:
+            self._all_watch_thread = threading.Thread(
+                target=self._watch_loop,
+                kwargs=dict(path="/api/v1/pods", selector=None,
+                            store=self._known_all, synced=self._all_synced,
+                            emit=None, what="all-pods"),
+                name="kube-all-pod-watch", daemon=True)
+            self._all_watch_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._synced.clear()
+        self._all_synced.clear()
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=5)
             self._watch_thread = None
+        if self._all_watch_thread is not None:
+            self._all_watch_thread.join(timeout=5)
+            self._all_watch_thread = None
 
     def _emit(self, name: str, pod: Optional[KubePod]) -> None:
         if self._watch_cb is not None:
@@ -463,60 +495,72 @@ class HttpKubeApi(KubeApi):
             except Exception:
                 log.exception("pod watch callback failed for %s", name)
 
-    def _relist_and_diff(self) -> str:
+    def _list_raw(self, path: str, selector: Optional[str]
+                  ) -> tuple[list[KubePod], str]:
+        query = {"labelSelector": selector} if selector else None
+        body = self._request("GET", path, query=query)
+        pods = [self._pod_from_manifest(item)
+                for item in body.get("items", [])]
+        rv = body.get("metadata", {}).get("resourceVersion", "")
+        return pods, rv
+
+    def _relist_and_diff(self, path, selector, store, synced, emit) -> str:
         """Fresh LIST; replay the diff against the local view as events —
         this is what closes a watch gap (missed events are reconstructed
         as state deltas, api.clj:449 re-list branch)."""
-        pods, rv = self._list_pods_raw()
+        pods, rv = self._list_raw(path, selector)
         fresh = {p.name: p for p in pods}
         with self._lock:
-            gone = [name for name in self._known if name not in fresh]
-            changed = [p for p in pods
-                       if self._known.get(p.name) != p]
-            self._known = fresh
-        self._synced.set()
-        for name in gone:
-            self._emit(name, None)
-        for pod in changed:
-            self._emit(pod.name, pod)
+            gone = [name for name in store if name not in fresh]
+            changed = [p for p in pods if store.get(p.name) != p]
+            store.clear()
+            store.update(fresh)
+        synced.set()
+        if emit is not None:
+            for name in gone:
+                emit(name, None)
+            for pod in changed:
+                emit(pod.name, pod)
         return rv
 
-    def _watch_loop(self) -> None:
+    def _watch_loop(self, *, path, selector, store, synced, emit,
+                    what) -> None:
         while not self._stop.is_set():
             try:
-                rv = self._relist_and_diff()
+                rv = self._relist_and_diff(path, selector, store, synced,
+                                           emit)
                 # a clean watch timeout resumes from the last event's (or
                 # bookmark's) resourceVersion — only a gap or error pays
                 # for a full re-list
                 while not self._stop.is_set():
-                    rv = self._stream_watch(rv)
+                    rv = self._stream_watch(rv, path, selector, store, emit)
             except WatchGap:
-                log.info("pod watch gap (410): re-listing")
+                log.info("%s watch gap (410): re-listing", what)
                 continue
             except Exception as e:
                 if self._stop.is_set():
                     return
-                log.warning("pod watch error, re-listing: %s", e)
+                log.warning("%s watch error, re-listing: %s", what, e)
                 self._stop.wait(self.relist_backoff_s)
 
-    def _stream_watch(self, resource_version: str) -> str:
+    def _stream_watch(self, resource_version: str, path, selector, store,
+                      emit) -> str:
         """One streaming watch connection; returns the last seen
         resourceVersion on clean timeout, raises WatchGap on 410."""
-        query = urlencode({
+        params = {
             "watch": "1",
-            "labelSelector": f"{COOK_MANAGED_LABEL}=true",
             "resourceVersion": resource_version,
             "allowWatchBookmarks": "true",
             "timeoutSeconds": str(int(self.watch_timeout_s)),
-        })
+        }
+        if selector:
+            params["labelSelector"] = selector
+        query = urlencode(params)
         conn = self._connection(self.watch_timeout_s + 10)
         last_rv = resource_version
         try:
-            conn.request(
-                "GET",
-                f"{self._path_prefix}/api/v1/namespaces/{self.namespace}"
-                f"/pods?{query}",
-                headers=self._headers())
+            conn.request("GET", f"{self._path_prefix}{path}?{query}",
+                         headers=self._headers())
             resp = conn.getresponse()
             if resp.status == 410:
                 raise WatchGap(resource_version)
@@ -545,12 +589,14 @@ class HttpKubeApi(KubeApi):
                 pod = self._pod_from_manifest(obj)
                 if etype == "DELETED":
                     with self._lock:
-                        self._known.pop(pod.name, None)
-                    self._emit(pod.name, None)
+                        store.pop(pod.name, None)
+                    if emit is not None:
+                        emit(pod.name, None)
                 else:  # ADDED / MODIFIED
                     with self._lock:
-                        self._known[pod.name] = pod
-                    self._emit(pod.name, pod)
+                        store[pod.name] = pod
+                    if emit is not None:
+                        emit(pod.name, pod)
             return last_rv
         finally:
             conn.close()
