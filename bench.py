@@ -213,7 +213,8 @@ def bench_dru(jax, jnp):
 def bench_multipool(jax, jnp, tuned):
     """BASELINE config 3: multi-pool cpu+mem+gpu bin-packing, pools as the
     batch axis of one vmapped solve."""
-    from cook_tpu.ops.match import MatchProblem, backend_flags, chunked_match
+    from cook_tpu.ops.match import (MatchProblem, backend_flags,
+                                    chunked_match, vmap_safe_backend)
 
     P, J, N = 8, 16384, 2048
     rng = np.random.default_rng(5)
@@ -241,7 +242,7 @@ def bench_multipool(jax, jnp, tuned):
     )
     # pallas_call batching under vmap is not guaranteed; the pool-batched
     # solve keeps to the pure-XLA backends
-    backend = "xla" if tuned["backend"] == "pallas" else tuned["backend"]
+    backend = vmap_safe_backend(tuned["backend"])
     solve = jax.vmap(
         lambda p: chunked_match(p, chunk=min(tuned["chunk"], J),
                                 rounds=tuned["rounds"], kc=tuned["kc"],

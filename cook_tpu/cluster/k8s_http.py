@@ -457,18 +457,21 @@ class HttpKubeApi(KubeApi):
         """Start the watch loop threads: the cook-managed pod watch
         (initialize-pod-watch) and, by default, the selector-free
         cluster-wide watch that feeds `list_all_pods` consumption."""
-        if self._watch_thread is not None:
-            return
-        self._stop.clear()
-        self._watch_thread = threading.Thread(
-            target=self._watch_loop,
-            kwargs=dict(path=f"/api/v1/namespaces/{self.namespace}/pods",
-                        selector=f"{COOK_MANAGED_LABEL}=true",
-                        store=self._known, synced=self._synced,
-                        emit=self._emit, what="pod"),
-            name="kube-pod-watch", daemon=True)
-        self._watch_thread.start()
-        if watch_all_pods:
+        if self._watch_thread is None:
+            self._stop.clear()
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop,
+                kwargs=dict(path=f"/api/v1/namespaces/{self.namespace}/pods",
+                            selector=f"{COOK_MANAGED_LABEL}=true",
+                            store=self._known, synced=self._synced,
+                            emit=self._emit, what="pod"),
+                name="kube-pod-watch", daemon=True)
+            self._watch_thread.start()
+        # not folded into the branch above: a second start(watch_all_pods=
+        # True) after start(watch_all_pods=False) must still launch the
+        # cluster-wide watch, or list_all_pods silently degrades to a full
+        # cluster LIST per offer cycle
+        if watch_all_pods and self._all_watch_thread is None:
             self._all_watch_thread = threading.Thread(
                 target=self._watch_loop,
                 kwargs=dict(path="/api/v1/pods", selector=None,

@@ -79,6 +79,17 @@ def backend_flags(backend: str) -> dict:
             "bucketed": backend == "bucketed"}
 
 
+def vmap_safe_backend(backend: str) -> str:
+    """Backend to use on a pool-batched (vmapped / shard_map-of-vmap)
+    solve.  pallas_call batching under jax.vmap is not guaranteed, so the
+    batched paths coerce pallas -> xla; every vmapped caller (scheduler
+    batched match, pool-sharded mesh solve, bench multipool) must route
+    through this so a pool configured with backend='pallas' degrades
+    predictably instead of failing at trace time."""
+    backend_flags(backend)  # validate the name with the canonical error
+    return "xla" if backend == "pallas" else backend
+
+
 def _job_step(avail, totals, node_valid, demand, job_ok, feas_row):
     """Place one job: feasibility mask + binpacking-fitness argmax."""
     fits = jnp.all(avail >= demand[None, :], axis=-1)
@@ -258,6 +269,12 @@ def chunked_match(
     j, n = problem.demands.shape[0], problem.avail.shape[0]
     assert j % chunk == 0, "pad jobs to a multiple of chunk"
     assert not (use_pallas and bucketed), "pick one candidate backend"
+    if bucketed and passes < 2:
+        # the bucketed scheme's acceptance-exactness story depends on the
+        # final exact per-job pass; with passes=1 that pass would never
+        # run and candidate recall silently collapses
+        raise ValueError("bucketed candidate mode requires passes >= 2 "
+                         "(the final pass is the exact per-job cleanup)")
     kc = min(kc, n)
     n_res = problem.demands.shape[-1]  # (mem, cpus, gpus[, disk...])
     demands_c = problem.demands.reshape(j // chunk, chunk, n_res)
@@ -367,7 +384,7 @@ def chunked_match(
             # class ordering diverged from their own fitness still land
             # (the early passes place the bulk, so most of the [K, N]
             # saving is kept)
-            use_bucket = bucketed and (p < passes - 1 or passes == 1)
+            use_bucket = bucketed and p < passes - 1
             cand_val, cand_idx = candidate_pass(avail, assignment,
                                                 use_bucket=use_bucket)
             (avail, assignment, _, _), _ = jax.lax.scan(

@@ -35,6 +35,7 @@ from cook_tpu.ops.match import (
     chunked_match,
     conflict_round,
     greedy_match,
+    vmap_safe_backend,
 )
 
 
@@ -62,8 +63,8 @@ def pool_sharded_match(mesh: Mesh, problems: MatchProblem, *,
     the candidate pass like MatchConfig.backend (xla/pallas/bucketed)."""
     fn = (functools.partial(chunked_match, chunk=chunk, rounds=rounds,
                             passes=passes, kc=kc,
-                            **backend_flags(backend)) if chunk
-          else greedy_match)
+                            **backend_flags(vmap_safe_backend(backend)))
+          if chunk else greedy_match)
     mapped = jax.vmap(fn)
     spec = P("pool")
     shmapped = jax.shard_map(
@@ -134,8 +135,8 @@ def node_sharded_greedy_match(mesh: Mesh, problem: MatchProblem) -> MatchResult:
             feasible_l = fits & node_valid & feas_row & ok
             used = totals - avail[:, :2]
             denom = jnp.maximum(totals, 1e-30)
-            fit = ((used[:, 0] + demand[0]) / denom[:, 0]
-                   + (used[:, 1] + demand[1]) / denom[:, 1]) * 0.5
+            fit = binpack_fitness(used[:, 0], used[:, 1], demand[0],
+                                  demand[1], denom[:, 0], denom[:, 1])
             score = jnp.where(feasible_l, fit, -BIG)
             lbest = jnp.argmax(score)
             lscore = score[lbest]

@@ -33,6 +33,7 @@ from cook_tpu.ops.match import (
     backend_flags,
     chunked_match,
     greedy_match,
+    vmap_safe_backend,
 )
 from cook_tpu.scheduler.constraints import (
     MISSING_ATTR,
@@ -694,11 +695,12 @@ def match_pools_batched(
                                         backend=config.backend)
         elif config.chunk:
             result = jax.vmap(
-                lambda p: chunked_match(p, chunk=config.chunk,
-                                        rounds=config.chunk_rounds,
-                                        passes=config.chunk_passes,
-                                        kc=config.chunk_kc,
-                                        **backend_flags(config.backend))
+                lambda p: chunked_match(
+                    p, chunk=config.chunk,
+                    rounds=config.chunk_rounds,
+                    passes=config.chunk_passes,
+                    kc=config.chunk_kc,
+                    **backend_flags(vmap_safe_backend(config.backend)))
             )(stacked)
         else:
             result = jax.vmap(greedy_match)(stacked)
