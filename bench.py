@@ -335,9 +335,33 @@ def _device_init_hangs() -> bool:
         return True
 
 
+def _accelerator_unreachable() -> bool:
+    """Re-probe the accelerator over a retry window before giving up.
+
+    The tunnel wedges transiently (a dead client can hold the chip grant
+    server-side for minutes); one failed probe must not demote the
+    round's official artifact to a CPU-fallback number.  Window/interval
+    via BENCH_PROBE_WINDOW_S (default 1800 s) / BENCH_PROBE_INTERVAL_S
+    (default 240 s); set BENCH_PROBE_WINDOW_S=0 to probe exactly once.
+    """
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_PROBE_WINDOW_S", "1800"))
+    interval = float(os.environ.get("BENCH_PROBE_INTERVAL_S", "240"))
+    while True:
+        if not _device_init_hangs():
+            return False
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return True
+        log(f"accelerator probe failed; retrying for another "
+            f"{remaining:.0f} s")
+        time.sleep(min(interval, max(remaining, 1)))
+
+
 def main():
-    if _device_init_hangs():
-        log("accelerator init unresponsive; falling back to CPU")
+    if _accelerator_unreachable():
+        log("accelerator init unresponsive after the retry window; "
+            "falling back to CPU")
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -937,13 +937,30 @@ class CookApi:
         ])
 
     async def get_settings(self, request: web.Request) -> web.Response:
-        return web.json_response({
+        payload = {
             "default-pool": self.config.default_pool,
             "max-job-mem": self.config.max_job_mem,
             "max-job-cpus": self.config.max_job_cpus,
             "max-retries-limit": self.config.max_retries_limit,
             "version": self.config.version,
-        })
+        }
+        if self.scheduler is not None:
+            # the EFFECTIVE matcher config (after tuned_match.json merge)
+            # so operators can verify which kernel production runs
+            from cook_tpu.ops.match import vmap_safe_backend
+
+            m = self.scheduler.config.match
+            payload["matcher"] = {
+                "backend": m.backend, "chunk": m.chunk,
+                "rounds": m.chunk_rounds, "passes": m.chunk_passes,
+                "kc": m.chunk_kc,
+                # the pool-batched/pool-sharded paths coerce pallas->xla
+                # (pallas_call under vmap); report what actually runs
+                # there so a pallas rollout isn't misread as active
+                "backend_batched": vmap_safe_backend(m.backend),
+                "quality_audit_every": m.quality_audit_every,
+            }
+        return web.json_response(payload)
 
     async def get_info(self, request: web.Request) -> web.Response:
         return web.json_response({

@@ -16,7 +16,9 @@ with the Fenzo solve replaced by the `ops.match` kernels, plus:
 """
 from __future__ import annotations
 
+import logging
 import math
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
@@ -44,6 +46,9 @@ from cook_tpu.scheduler.constraints import (
     validate_group_assignments,
 )
 from cook_tpu.scheduler.ranking import RankedQueue
+from cook_tpu.utils.metrics import global_registry
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -64,6 +69,12 @@ class MatchConfig:
     # feasibility+fitness+argmax kernel, ops/pallas_match.py), or
     # "bucketed" (class-shared candidate lists + exact cleanup pass)
     backend: str = "xla"
+    # every Nth chunked solve is replayed through the exact sequential-
+    # greedy kernel and the packing ratio gauged (match.quality_audit) —
+    # the runtime guard that tuned approximate configs keep >= 0.99
+    # packing parity on the REAL workload, not just the sweep shape.
+    # 0 disables; irrelevant when chunk=0 (the exact kernel is in use).
+    quality_audit_every: int = 50
     # estimated-completion constraint (constraints.clj:385 +
     # estimated-completion-config): 0 multiplier or lifetime = disabled
     completion_multiplier: float = 0.0
@@ -85,6 +96,7 @@ class PoolMatchState:
 
     num_considerable: int
     iterations_at_floor: int = 0
+    chunked_solves: int = 0  # drives the periodic quality audit
 
 
 @dataclass
@@ -586,6 +598,75 @@ def finalize_pool_match(
     return outcome
 
 
+_audit_lock = threading.Lock()
+last_audit_thread: Optional[threading.Thread] = None  # tests join this
+
+
+def start_quality_audit(prepared: "PreparedPool", assignment: np.ndarray,
+                        pool_name: str) -> None:
+    """Kick off audit_match_quality on a daemon thread.
+
+    The exact solve (plus its first-use XLA compile) can take seconds at
+    large considerable counts, so it must not stall the match cycle's
+    launches.  Single-flight: while one audit runs, due samples are
+    skipped rather than queued — the guard needs a periodic signal, not
+    every sample."""
+    global last_audit_thread
+    if not _audit_lock.acquire(blocking=False):
+        return
+    def run():
+        try:
+            audit_match_quality(prepared, assignment, pool_name)
+        except Exception:  # noqa: BLE001 — an audit failure must never
+            # take down the scheduler; it is purely observability
+            log.exception("match quality audit failed (pool %s)", pool_name)
+        finally:
+            _audit_lock.release()
+    t = threading.Thread(target=run, name=f"match-audit-{pool_name}",
+                         daemon=True)
+    last_audit_thread = t
+    t.start()
+
+
+def audit_match_quality(prepared: "PreparedPool", assignment: np.ndarray,
+                        pool_name: str) -> float:
+    """Replay a chunked solve's problem through the exact sequential-
+    greedy kernel and gauge the packing-parity ratio (placed demand
+    weight, approximate / exact).
+
+    This is the runtime guard behind `MatchConfig.quality_audit_every`:
+    sweep-promoted configs are only certified at the sweep's shape, and
+    the sweep showed quality collapse at some (chunk, kc) corners — so
+    the deployed config is continuously re-checked on the live workload.
+    The cost is one exact solve of the (<= max_jobs_considered)-job
+    problem every N cycles, run via start_quality_audit on a background
+    thread (the cycle's assignment is already final; the audit only
+    reads it)."""
+    n_consider = len(prepared.considerable)
+    exact = np.asarray(
+        greedy_match(prepared.problem).assignment[:n_consider])
+    demands = np.asarray(prepared.problem.demands[:n_consider])
+    # weight = mem + cpus, each normalized by the problem's mean demand
+    # so neither resource dominates (same spirit as bench packing_eff)
+    scale = np.maximum(demands.mean(axis=0), 1e-9)
+    weights = (demands[:, :2] / scale[:2]).sum(axis=-1)
+    approx_w = float(weights[assignment >= 0].sum())
+    exact_w = float(weights[exact >= 0].sum())
+    ratio = approx_w / exact_w if exact_w > 0 else 1.0
+    global_registry.gauge(
+        "match.quality_audit",
+        "packing parity of the chunked solve vs the exact kernel",
+    ).set(ratio, labels={"pool": pool_name})
+    if ratio < 0.99:
+        log.warning(
+            "match quality audit: pool %s chunked solve placed %.4f of "
+            "the exact kernel's demand weight (< 0.99) — the tuned "
+            "matcher config is underperforming on this workload; "
+            "consider re-running tools/tpu_sweep.py or lowering chunk",
+            pool_name, ratio)
+    return ratio
+
+
 def match_pool(
     store: JobStore,
     pool: Pool,
@@ -619,6 +700,12 @@ def match_pool(
         assignment = np.asarray(
             result.assignment[: len(prepared.considerable)]
         )
+        if config.chunk:
+            state.chunked_solves += 1
+            if (config.quality_audit_every
+                    and state.chunked_solves
+                    % config.quality_audit_every == 0):
+                start_quality_audit(prepared, assignment, pool.name)
     return finalize_pool_match(
         store, prepared, assignment, config, state, clusters,
         make_task_id=make_task_id,
@@ -713,6 +800,14 @@ def match_pools_batched(
         if prepared.solvable:
             assignment = assignments[solve_idx][: len(prepared.considerable)]
             solve_idx += 1
+            if config.chunk:
+                st = states[prepared.pool.name]
+                st.chunked_solves += 1
+                if (config.quality_audit_every
+                        and st.chunked_solves
+                        % config.quality_audit_every == 0):
+                    start_quality_audit(prepared, assignment,
+                                        prepared.pool.name)
         outcomes[prepared.pool.name] = finalize_pool_match(
             store, prepared, assignment, config, states[prepared.pool.name],
             clusters,

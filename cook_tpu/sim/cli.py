@@ -15,8 +15,8 @@ import csv
 import json
 
 from cook_tpu.scheduler.core import SchedulerConfig
-from cook_tpu.scheduler.matcher import MatchConfig
 from cook_tpu.scheduler.rebalancer import RebalancerParams
+from cook_tpu.utils.config import default_match_config
 from cook_tpu.sim.simulator import (
     SimConfig,
     Simulator,
@@ -33,8 +33,13 @@ def cmd_run(args) -> int:
         max_cycles=args.max_cycles,
         batched_match=args.batched,
         scheduler=SchedulerConfig(
-            match=MatchConfig(chunk=args.chunk,
-                              max_jobs_considered=args.considerable),
+            # chunk/backend default to the hardware-tuned config
+            # (tuned_match.json) like the service; flags override
+            match=default_match_config(
+                max_jobs_considered=args.considerable,
+                **{k: v for k, v in
+                   (("chunk", args.chunk), ("backend", args.backend))
+                   if v is not None}),
             rebalancer=RebalancerParams(
                 safe_dru_threshold=args.safe_dru_threshold,
                 min_dru_diff=args.min_dru_diff,
@@ -148,7 +153,11 @@ def main(argv=None) -> int:
     r.add_argument("--cycle-ms", type=int, default=30_000)
     r.add_argument("--rebalance-every", type=int, default=0)
     r.add_argument("--max-cycles", type=int, default=10_000)
-    r.add_argument("--chunk", type=int, default=0)
+    r.add_argument("--chunk", type=int, default=None,
+                   help="matcher chunk; default = tuned_match.json / 0")
+    r.add_argument("--backend", default=None,
+                   choices=["xla", "pallas", "bucketed"],
+                   help="candidate-pass backend; default = tuned config")
     r.add_argument("--considerable", type=int, default=1000)
     r.add_argument("--batched", action="store_true",
                    help="one device call for all pools")

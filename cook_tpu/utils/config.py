@@ -8,12 +8,56 @@ and runtime-mutable sections.
 from __future__ import annotations
 
 import json
+import os
 import re
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from cook_tpu.scheduler.matcher import MatchConfig
 from cook_tpu.scheduler.rebalancer import RebalancerParams
+
+
+def tuned_match_defaults(path: Optional[str] = None) -> dict:
+    """Hardware-sweep-promoted matcher defaults.
+
+    `tools/pick_tuned.py` writes the best measured sweep config (packing
+    efficiency >= its --min-eff bar vs the sequential-greedy oracle) to
+    `tuned_match.json`; the service treats it as the DEFAULT matcher
+    config so production gets the tuned chunked kernel, not the exact
+    O(J)-scan fallback.  Explicit `match` config keys always win.
+    Exactly ONE source is consulted: the `path` arg when given;
+    otherwise $COOK_TUNED_MATCH when set (""/"none"/"off" disables tuned
+    defaults entirely); otherwise the repo-root tuned_match.json.
+    Returns {} (pure dataclass defaults) when the consulted source is
+    absent or unreadable.
+    """
+    env = os.environ.get("COOK_TUNED_MATCH")
+    if path:
+        candidates = [path]
+    elif env is not None:
+        candidates = [] if env.lower() in ("", "none", "off") else [env]
+    else:
+        candidates = [os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "tuned_match.json")]
+    for p in candidates:
+        try:
+            with open(p) as f:
+                loaded = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(loaded, dict):
+            continue
+        # pick_tuned writes sweep-style names (rounds/passes/kc);
+        # translate to the MatchConfig field names
+        out = {}
+        for src, dst in (("chunk", "chunk"), ("rounds", "chunk_rounds"),
+                         ("passes", "chunk_passes"), ("kc", "chunk_kc"),
+                         ("backend", "backend")):
+            if src in loaded:
+                out[dst] = loaded[src]
+        return out
+    return {}
 
 
 @dataclass
@@ -73,6 +117,8 @@ class Settings:
 
 
 def _match_config(d: dict) -> MatchConfig:
+    tuned = tuned_match_defaults()
+    d = {**tuned, **d}  # explicit config keys override tuned defaults
     return MatchConfig(
         max_jobs_considered=int(d.get("max_jobs_considered", 1000)),
         scaleback=float(d.get("scaleback", 0.95)),
@@ -81,12 +127,20 @@ def _match_config(d: dict) -> MatchConfig:
         chunk_passes=int(d.get("chunk_passes", 2)),
         chunk_kc=int(d.get("chunk_kc", 128)),
         backend=str(d.get("backend", "xla")),
+        quality_audit_every=int(d.get("quality_audit_every", 50)),
         completion_multiplier=float(d.get("completion_multiplier", 0.0)),
         host_lifetime_mins=float(d.get("host_lifetime_mins", 0.0)),
         agent_start_grace_mins=float(d.get("agent_start_grace_mins", 10.0)),
         checkpoint_memory_overhead_mb=float(
             d.get("checkpoint_memory_overhead_mb", 0.0)),
     )
+
+
+def default_match_config(**overrides) -> MatchConfig:
+    """The service/sim default matcher config: dataclass defaults merged
+    under the hardware-tuned `tuned_match.json` (when present) and any
+    explicit overrides (highest precedence)."""
+    return _match_config(overrides)
 
 
 def read_config(path: Optional[str] = None,
@@ -129,8 +183,10 @@ def read_config(path: Optional[str] = None,
             max_preemption=int(rb.get("max_preemption", 100)),
             fast_cycle=bool(rb.get("fast_cycle", False)),
         )
-    if "match" in data:
-        settings.match = _match_config(data["match"])
+    # always route through _match_config so the tuned hardware defaults
+    # apply even when the operator config has no `match` section — a bare
+    # config must not fall into the exact-kernel (chunk=0) perf trap
+    settings.match = _match_config(data.get("match", {}))
     for ps in data.get("pool_schedulers", []):
         settings.pool_schedulers.append(
             PoolSchedulerConfig(

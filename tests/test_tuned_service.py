@@ -1,0 +1,147 @@
+"""Tuned-config promotion into the SERVICE (not just the bench): tuned
+defaults merge/override/disable in read_config, the effective-config
+surface, and the runtime match-quality audit guard."""
+import json
+
+import pytest
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import JobState, Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler import matcher as matcher_mod
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.scheduler.matcher import MatchConfig
+from cook_tpu.utils.config import (
+    default_match_config,
+    read_config,
+    tuned_match_defaults,
+)
+from cook_tpu.utils.metrics import global_registry
+from tests.conftest import FakeClock, make_job
+
+
+@pytest.fixture
+def tuned_file(tmp_path, monkeypatch):
+    p = tmp_path / "tuned.json"
+    p.write_text(json.dumps({
+        "backend": "bucketed", "chunk": 2048, "rounds": 4, "passes": 3,
+        "kc": 64, "measured_p50_ms": 123.0, "measured_packing_eff": 1.0,
+    }))
+    monkeypatch.setenv("COOK_TUNED_MATCH", str(p))
+    return p
+
+
+class TestTunedDefaults:
+    def test_tuned_defaults_applied_without_match_section(self, tuned_file):
+        s = read_config(None)
+        assert s.match.chunk == 2048
+        assert s.match.backend == "bucketed"
+        assert s.match.chunk_rounds == 4
+        assert s.match.chunk_passes == 3
+        assert s.match.chunk_kc == 64
+
+    def test_explicit_match_keys_override_tuned(self, tuned_file, tmp_path):
+        cfg = tmp_path / "c.json"
+        cfg.write_text(json.dumps({"match": {"chunk": 0}}))
+        s = read_config(str(cfg))
+        # the operator pinned chunk; everything they did NOT set still
+        # comes from the tuned file
+        assert s.match.chunk == 0
+        assert s.match.backend == "bucketed"
+
+    def test_pool_schedulers_also_get_tuned_defaults(self, tuned_file,
+                                                     tmp_path):
+        cfg = tmp_path / "c.json"
+        cfg.write_text(json.dumps({
+            "pool_schedulers": [{"pool_regex": "gpu.*",
+                                 "match": {"max_jobs_considered": 7}}],
+        }))
+        s = read_config(str(cfg))
+        assert s.match_config_for_pool("gpu1").chunk == 2048
+        assert s.match_config_for_pool("gpu1").max_jobs_considered == 7
+
+    def test_env_none_disables(self, monkeypatch):
+        monkeypatch.setenv("COOK_TUNED_MATCH", "none")
+        assert tuned_match_defaults() == {}
+        s = read_config(None)
+        assert s.match.chunk == 0  # pure dataclass default
+
+    def test_repo_root_file_found_by_default(self, monkeypatch):
+        # the checked-in tuned_match.json (sweep-promoted) must reach the
+        # default service config — the VERDICT r2 "perf trap" regression
+        monkeypatch.delenv("COOK_TUNED_MATCH", raising=False)
+        tuned = tuned_match_defaults()
+        assert tuned.get("chunk", 0) > 0
+        assert default_match_config().chunk == tuned["chunk"]
+
+    def test_default_match_config_override_precedence(self, tuned_file):
+        m = default_match_config(chunk=512)
+        assert m.chunk == 512
+        assert m.backend == "bucketed"  # still from tuned
+
+
+def _chunked_scheduler(audit_every):
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    hosts = [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=8)
+             for i in range(4)]
+    cluster = MockCluster("mock", hosts, clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=64, quality_audit_every=audit_every)))
+    return clock, store, cluster, scheduler
+
+
+class TestQualityAudit:
+    def test_audit_gauges_parity_every_cycle(self):
+        clock, store, cluster, scheduler = _chunked_scheduler(audit_every=1)
+        gauge = global_registry.gauge("match.quality_audit")
+        gauge.set(-1.0, labels={"pool": "default"})
+        store.submit_jobs([make_job(user="u1", mem=500, cpus=1)
+                           for _ in range(8)])
+        pool = store.pools["default"]
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+        assert matcher_mod.last_audit_thread is not None
+        matcher_mod.last_audit_thread.join(timeout=30)
+        ratio = gauge.value(labels={"pool": "default"})
+        # tiny uncontended problem: the chunked kernel must match the
+        # exact kernel's packing exactly
+        assert ratio == pytest.approx(1.0)
+        for job in store.jobs.values():
+            assert job.state == JobState.RUNNING
+
+    def test_audit_disabled_at_zero(self):
+        clock, store, cluster, scheduler = _chunked_scheduler(audit_every=0)
+        gauge = global_registry.gauge("match.quality_audit")
+        gauge.set(-2.0, labels={"pool": "default"})
+        store.submit_jobs([make_job(user="u1", mem=500, cpus=1)])
+        pool = store.pools["default"]
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+        assert gauge.value(labels={"pool": "default"}) == -2.0
+
+    def test_audit_covers_batched_path(self):
+        clock = FakeClock()
+        store = JobStore(clock=clock)
+        for p in range(2):
+            store.set_pool(Pool(name=f"pool{p}"))
+        hosts = [MockHost(node_id=f"p{p}h{i}", hostname=f"p{p}h{i}",
+                          mem=4000, cpus=8, pool=f"pool{p}")
+                 for p in range(2) for i in range(2)]
+        cluster = MockCluster("mock", hosts, clock=clock)
+        scheduler = Scheduler(store, [cluster], SchedulerConfig(
+            match=MatchConfig(chunk=64, quality_audit_every=1)))
+        gauge = global_registry.gauge("match.quality_audit")
+        for p in range(2):
+            gauge.set(-3.0, labels={"pool": f"pool{p}"})
+        store.submit_jobs([make_job(user="u1", pool=f"pool{p}",
+                                    mem=500, cpus=1)
+                           for p in range(2) for _ in range(4)])
+        scheduler.match_cycle_all_pools()
+        # single-flight: at least one pool's audit ran this cycle
+        assert matcher_mod.last_audit_thread is not None
+        matcher_mod.last_audit_thread.join(timeout=30)
+        ratios = [gauge.value(labels={"pool": f"pool{p}"})
+                  for p in range(2)]
+        assert any(r == pytest.approx(1.0) for r in ratios)
