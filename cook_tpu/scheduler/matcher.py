@@ -27,7 +27,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
-from cook_tpu.models.entities import GroupPlacementType, Job, Pool
+from cook_tpu.models.entities import GroupPlacementType, Job, JobState, Pool
 from cook_tpu.models.store import JobStore, TransactionVetoed
 from cook_tpu.ops.common import bucket_size, pad_to
 from cook_tpu.ops.match import (
@@ -45,7 +45,7 @@ from cook_tpu.scheduler.constraints import (
     feasibility_mask,
     validate_group_assignments,
 )
-from cook_tpu.scheduler.ranking import RankedQueue
+from cook_tpu.scheduler.ranking import QuotaWalk, RankedQueue
 from cook_tpu.utils.metrics import global_registry
 
 log = logging.getLogger(__name__)
@@ -116,10 +116,28 @@ def select_considerable(
     *,
     launch_filter: Optional[Callable[[Job], bool]] = None,
 ) -> list[Job]:
-    """Head of the ranked queue, quota- and plugin-filtered
-    (scheduler.clj:729 `pending-jobs->considerable-jobs`)."""
+    """Head of the ranked queue, re-filtered against LIVE per-user quota
+    and usage, then plugin-filtered, capped at `limit` (scheduler.clj:729
+    `pending-jobs->considerable-jobs` + tools.clj:961
+    `filter-pending-jobs-for-quota`).
+
+    The rank cycle already quota-capped the queue, but that snapshot is
+    up to one rank interval old — launches, completions, and quota
+    changes since then must be honored here or a user can exceed quota by
+    a rank interval's worth of matches.  Filter order mirrors the
+    reference: quota admission consumes the user's budget even for jobs a
+    later filter rejects (the reference threads usage state through the
+    whole stream before its other filters)."""
+    walk = QuotaWalk(store, pool.name)
     out = []
     for job in queue.jobs:
+        # stale-queue liveness: a job killed/launched since the rank tick
+        # must neither be matched nor consume the user's quota budget
+        live = store.jobs.get(job.uuid)
+        if live is None or live.state is not JobState.WAITING:
+            continue
+        if not walk.admit(job):
+            continue
         if launch_filter is not None and not launch_filter(job):
             continue
         out.append(job)
@@ -641,15 +659,26 @@ def audit_match_quality(prepared: "PreparedPool", assignment: np.ndarray,
     The cost is one exact solve of the (<= max_jobs_considered)-job
     problem every N cycles, run via start_quality_audit on a background
     thread (the cycle's assignment is already final; the audit only
-    reads it)."""
+    reads it).  The exact solve runs on the host CPU backend: XLA
+    serializes execution per device, so running it on the accelerator
+    would queue the NEXT match cycle's solve behind a multi-second
+    audit — the stall the background thread exists to avoid."""
+    import jax
+
     n_consider = len(prepared.considerable)
-    exact = np.asarray(
-        greedy_match(prepared.problem).assignment[:n_consider])
+    problem = prepared.problem
+    try:
+        cpu = jax.devices("cpu")[0]
+        problem = jax.device_put(problem, cpu)
+    except RuntimeError:
+        pass  # no host platform registered; accept device contention
+    exact = np.asarray(greedy_match(problem).assignment[:n_consider])
     demands = np.asarray(prepared.problem.demands[:n_consider])
-    # weight = mem + cpus, each normalized by the problem's mean demand
-    # so neither resource dominates (same spirit as bench packing_eff)
+    # weight = mem + cpus + gpus, each normalized by the problem's mean
+    # demand so no resource dominates (same spirit as bench packing_eff);
+    # gpus included so a collapse confined to gpu jobs still registers
     scale = np.maximum(demands.mean(axis=0), 1e-9)
-    weights = (demands[:, :2] / scale[:2]).sum(axis=-1)
+    weights = (demands[:, :3] / scale[:3]).sum(axis=-1)
     approx_w = float(weights[assignment >= 0].sum())
     exact_w = float(weights[exact >= 0].sum())
     ratio = approx_w / exact_w if exact_w > 0 else 1.0

@@ -38,6 +38,65 @@ class RankedQueue:
             self.quarantined = []
 
 
+class QuotaWalk:
+    """Incremental per-user quota admission over a priority-ordered job
+    stream (reference `filter-based-on-user-quota` + `filter-sequential`,
+    tools.clj:903/:654).
+
+    Snapshot of running usage is taken at construction; each admit() call
+    accumulates the job's demand onto the user's cumulative usage and
+    answers whether the user stays within quota on every dimension.
+    Take-while semantics per user: since usage only grows along the walk,
+    the first over-quota job closes the user's queue (a later smaller job
+    must not jump it) — which is exactly the reference's state-threading
+    through rejected jobs, monotonicity collapsed into a closed set.
+
+    Used at RANK time to cap the queue and again at MATCH time with a
+    fresh snapshot (`pending-jobs->considerable-jobs`, scheduler.clj:729)
+    so launches or quota changes between rank ticks cannot push a user
+    over quota."""
+
+    def __init__(self, store: JobStore, pool: str):
+        self.store = store
+        self.pool = pool
+        self.usage = store.user_usage(pool)
+        self.running_counts: dict[str, int] = {}
+        for job in store.running_jobs(pool):
+            self.running_counts[job.user] = (
+                self.running_counts.get(job.user, 0) + 1)
+        # per-user cumulative (mem, cpus, gpus, count) tuples + a quota
+        # cache — admit() is called once per pending job per cycle
+        self.quotas: dict[str, tuple[float, float, float, int]] = {}
+        self.cum: dict[str, tuple[float, float, float, int]] = {}
+        self.closed: set[str] = set()
+
+    def admit(self, job: Job) -> bool:
+        user = job.user
+        if user in self.closed:
+            return False
+        q = self.quotas.get(user)
+        if q is None:
+            quota = self.store.get_quota(user, self.pool)
+            q = (quota.resources.mem, quota.resources.cpus,
+                 quota.resources.gpus, quota.count)
+            self.quotas[user] = q
+        state = self.cum.get(user)
+        if state is None:
+            u = self.usage.get(user)
+            state = ((u.mem, u.cpus, u.gpus) if u is not None
+                     else (0.0, 0.0, 0.0)) + (
+                self.running_counts.get(user, 0),)
+        r = job.resources
+        new_state = (state[0] + r.mem, state[1] + r.cpus,
+                     state[2] + r.gpus, state[3] + 1)
+        if (new_state[3] <= q[3] and new_state[0] <= q[0]
+                and new_state[1] <= q[1] and new_state[2] <= q[2]):
+            self.cum[user] = new_state
+            return True
+        self.closed.add(user)
+        return False
+
+
 def _quota_cap(
     store: JobStore,
     pool: str,
@@ -47,44 +106,13 @@ def _quota_cap(
     usage + earlier pending jobs (reference `limit-over-quota-jobs` +
     `filter-based-on-quota`, scheduler.clj:2057-2157).  `pending` must be in
     per-user priority order."""
-    usage = store.user_usage(pool)
-    running_counts: dict[str, int] = {}
-    for job in store.running_jobs(pool):
-        running_counts[job.user] = running_counts.get(job.user, 0) + 1
+    walk = QuotaWalk(store, pool)
     kept, capped = [], []
-    # per-user cumulative (mem, cpus, gpus, count) as plain tuples, and a
-    # per-user quota cache — this loop runs once per pending job.
-    # Semantics: take-while per user — the first over-quota job closes the
-    # user's queue for this cycle (a later smaller job must not jump it).
-    quotas: dict[str, tuple[float, float, float, int]] = {}
-    cum: dict[str, tuple[float, float, float, int]] = {}
-    closed: set[str] = set()
     for job in pending:
-        user = job.user
-        if user in closed:
-            capped.append(job.uuid)
-            continue
-        q = quotas.get(user)
-        if q is None:
-            quota = store.get_quota(user, pool)
-            q = (quota.resources.mem, quota.resources.cpus,
-                 quota.resources.gpus, quota.count)
-            quotas[user] = q
-        state = cum.get(user)
-        if state is None:
-            u = usage.get(user)
-            state = ((u.mem, u.cpus, u.gpus) if u is not None
-                     else (0.0, 0.0, 0.0)) + (running_counts.get(user, 0),)
-        r = job.resources
-        new_state = (state[0] + r.mem, state[1] + r.cpus,
-                     state[2] + r.gpus, state[3] + 1)
-        if (new_state[3] <= q[3] and new_state[0] <= q[0]
-                and new_state[1] <= q[1] and new_state[2] <= q[2]):
+        if walk.admit(job):
             kept.append(job)
-            cum[user] = new_state
         else:
             capped.append(job.uuid)
-            closed.add(user)
     return kept, capped
 
 

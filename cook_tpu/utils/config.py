@@ -8,6 +8,7 @@ and runtime-mutable sections.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 from dataclasses import dataclass, field
@@ -15,6 +16,8 @@ from typing import Any, Optional
 
 from cook_tpu.scheduler.matcher import MatchConfig
 from cook_tpu.scheduler.rebalancer import RebalancerParams
+
+log = logging.getLogger(__name__)
 
 
 def tuned_match_defaults(path: Optional[str] = None) -> dict:
@@ -44,9 +47,18 @@ def tuned_match_defaults(path: Optional[str] = None) -> dict:
         try:
             with open(p) as f:
                 loaded = json.load(f)
-        except (OSError, ValueError):
+        except FileNotFoundError:
+            continue
+        except (OSError, ValueError) as e:
+            # an EXISTING tuned file that cannot be read/parsed silently
+            # reverting production to the untuned exact kernel is the
+            # perf trap this mechanism exists to prevent — say so
+            log.warning("tuned match config %s exists but is unusable "
+                        "(%s); falling back to untuned defaults", p, e)
             continue
         if not isinstance(loaded, dict):
+            log.warning("tuned match config %s is not a JSON object; "
+                        "falling back to untuned defaults", p)
             continue
         # pick_tuned writes sweep-style names (rounds/passes/kc);
         # translate to the MatchConfig field names

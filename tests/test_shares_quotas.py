@@ -51,3 +51,95 @@ def test_usage_accounting(store, job_factory):
     assert "bob" not in usage
     assert store.pending_count("default") == 1
     assert store.pending_count("default", user="bob") == 1
+
+
+# ---------------------------------------------------------------- match-time
+
+def _quota_scheduler():
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.entities import Pool
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from tests.conftest import FakeClock
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    hosts = [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=8000, cpus=16)
+             for i in range(4)]
+    cluster = MockCluster("mock", hosts, clock=clock)
+    return store, cluster, Scheduler(store, [cluster], SchedulerConfig())
+
+
+def test_match_refilters_quota_lowered_mid_interval(job_factory):
+    """Reference pending-jobs->considerable-jobs (scheduler.clj:729):
+    quota is re-checked at MATCH time, so a quota change between rank
+    ticks takes effect on the very next match."""
+    from cook_tpu.models.entities import DEFAULT_USER, JobState, Quota, Resources
+
+    store, cluster, scheduler = _quota_scheduler()
+    store.set_quota(Quota(user="alice", pool="default",
+                          resources=Resources(mem=1e9, cpus=1e9, gpus=1e9), count=2))
+    jobs = [job_factory(user="alice") for _ in range(2)]
+    store.submit_jobs(jobs)
+    pool = store.pools["default"]
+    queue = scheduler.rank_cycle(pool)
+    assert len(queue.jobs) == 2  # both under quota at rank time
+    # admin lowers the quota between the rank tick and the match tick
+    store.set_quota(Quota(user="alice", pool="default",
+                          resources=Resources(mem=1e9, cpus=1e9, gpus=1e9), count=1))
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 1
+    running = [j for j in store.jobs.values()
+               if j.state is JobState.RUNNING]
+    assert len(running) == 1
+
+
+def test_match_refilters_usage_grown_mid_interval(job_factory):
+    """A launch that lands through another path (reconciliation, another
+    scheduler instance) after the rank tick consumes quota budget at
+    match time."""
+    from cook_tpu.models.entities import JobState, Quota, Resources
+
+    store, cluster, scheduler = _quota_scheduler()
+    store.set_quota(Quota(user="alice", pool="default",
+                          resources=Resources(mem=1e9, cpus=1e9, gpus=1e9), count=2))
+    jobs = [job_factory(user="alice") for _ in range(2)]
+    store.submit_jobs(jobs)
+    pool = store.pools["default"]
+    queue = scheduler.rank_cycle(pool)
+    assert len(queue.jobs) == 2
+    # out-of-band launch after the rank snapshot: a third job starts
+    # running, filling one quota slot
+    extra = job_factory(user="alice")
+    store.submit_jobs([extra])
+    store.create_instance(extra.uuid, "t-extra", hostname="h0")
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) == 1
+    # and nothing further matches while the quota stays full
+    outcome2 = scheduler.match_cycle(pool)
+    assert len(outcome2.matched) == 0
+
+
+def test_match_skips_jobs_killed_since_rank(job_factory):
+    """A job killed between rank and match must neither match nor consume
+    the user's quota budget in the match-time walk."""
+    from cook_tpu.models.entities import JobState, Quota, Resources
+
+    store, cluster, scheduler = _quota_scheduler()
+    store.set_quota(Quota(user="alice", pool="default",
+                          resources=Resources(mem=1e9, cpus=1e9, gpus=1e9), count=1))
+    j1 = job_factory(user="alice")
+    j2 = job_factory(user="alice")
+    store.submit_jobs([j1, j2])
+    pool = store.pools["default"]
+    queue = scheduler.rank_cycle(pool)
+    assert [j.uuid for j in queue.jobs] == [j1.uuid]  # j2 quota-capped
+    store.kill_jobs([j1.uuid])
+    outcome = scheduler.match_cycle(pool)
+    # j1 is dead; j2 is not in the (stale) queue, so nothing matches —
+    # but j1 must not have consumed the budget either way
+    assert len(outcome.matched) == 0
+    queue = scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    assert [j.uuid for j, _ in outcome.matched] == [j2.uuid]
