@@ -320,83 +320,154 @@ def bench_rebalance(jax, jnp):
     return p50
 
 
-def _device_init_hangs() -> bool:
+def _probe_device() -> str:
     """Probe accelerator init in a subprocess: a wedged device tunnel hangs
-    the client inside PJRT, which no in-process timeout can interrupt."""
+    the client inside PJRT, which no in-process timeout can interrupt.
+
+    Returns "ok" (device answered), "wedged" (probe timed out — the
+    transient tunnel failure mode, worth retrying), or "error" (the probe
+    failed FAST — a plugin import/init error, which retrying won't fix).
+    """
     import subprocess
 
+    t0 = time.monotonic()
     try:
         subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=120, check=True, capture_output=True,
+            timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120")),
+            check=True, capture_output=True,
         )
-        return False
+        return "ok"
+    except subprocess.TimeoutExpired:
+        return "wedged"
     except Exception:
-        return True
+        # a fast non-zero exit is a persistent init error, not a wedge;
+        # anything that took >30 s to die is treated as a wedge anyway
+        return "wedged" if time.monotonic() - t0 > 30 else "error"
 
 
-def _accelerator_unreachable() -> bool:
-    """Re-probe the accelerator over a retry window before giving up.
-
-    The tunnel wedges transiently (a dead client can hold the chip grant
-    server-side for minutes); one failed probe must not demote the
-    round's official artifact to a CPU-fallback number.  Window/interval
-    via BENCH_PROBE_WINDOW_S (default 1800 s) / BENCH_PROBE_INTERVAL_S
-    (default 240 s); set BENCH_PROBE_WINDOW_S=0 to probe exactly once.
-    """
-    deadline = time.monotonic() + float(
-        os.environ.get("BENCH_PROBE_WINDOW_S", "1800"))
-    interval = float(os.environ.get("BENCH_PROBE_INTERVAL_S", "240"))
-    while True:
-        if not _device_init_hangs():
-            return False
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            return True
-        log(f"accelerator probe failed; retrying for another "
-            f"{remaining:.0f} s")
-        time.sleep(min(interval, max(remaining, 1)))
-
-
-def main():
-    if _accelerator_unreachable():
-        log("accelerator init unresponsive after the retry window; "
-            "falling back to CPU")
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    import jax
-    import jax.numpy as jnp
-
-    platform = jax.devices()[0].platform
-    log(f"device: {jax.devices()[0]} ({platform})")
-
-    match_p50, cpu_ms, eff, (j_real, n_real) = bench_match(jax, jnp, platform)
-    if platform != "cpu":
-        dru_p50 = bench_dru(jax, jnp)
-        reb_p50 = bench_rebalance(jax, jnp)
-        bench_multipool(jax, jnp, load_tuned())
-        log(f"full-cycle estimate (rank+match+rebalance): "
-            f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
-        extra = f", dru_ms={dru_p50:.1f}, rebalance_ms={reb_p50:.1f}"
-    else:
-        extra = ""
-
-    note = ""
-    if platform == "cpu":
-        # the accelerator was unreachable; this measures CPU XLA vs the C++
-        # baseline at reduced size — see docs/status.md for the real-TPU
-        # numbers measured interactively (e.g. 0.97 s for 100k x 10k vs
-        # 5.3 s C++ with the pre-restructure kernel)
-        note = " [CPU FALLBACK — accelerator unreachable; see docs/status.md]"
-    print(json.dumps({
+def _result_line(match_p50, cpu_ms, eff, j_real, n_real, platform,
+                 extra="", note=""):
+    return {
         "metric": f"match-cycle p50 latency, {j_real} jobs x {n_real} nodes "
                   f"(packing_eff={eff:.4f}{extra}, platform={platform})"
                   + note,
         "value": round(match_p50, 2),
         "unit": "ms",
         "vs_baseline": round(cpu_ms / match_p50, 2),
-    }))
+    }
+
+
+def device_main():
+    """Full device bench; assumes the accelerator is reachable (probed by
+    the caller).  Prints the one JSON line on stdout."""
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    log(f"device: {jax.devices()[0]} ({platform})")
+    match_p50, cpu_ms, eff, (j_real, n_real) = bench_match(jax, jnp, platform)
+    dru_p50 = bench_dru(jax, jnp)
+    reb_p50 = bench_rebalance(jax, jnp)
+    bench_multipool(jax, jnp, load_tuned())
+    log(f"full-cycle estimate (rank+match+rebalance): "
+        f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
+    extra = f", dru_ms={dru_p50:.1f}, rebalance_ms={reb_p50:.1f}"
+    print(json.dumps(_result_line(match_p50, cpu_ms, eff, j_real, n_real,
+                                  platform, extra=extra)), flush=True)
+
+
+def cpu_main():
+    """CPU-XLA fallback bench at reduced size.  Prints the JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    log(f"device: {jax.devices()[0]} (cpu fallback)")
+    match_p50, cpu_ms, eff, (j_real, n_real) = bench_match(jax, jnp, "cpu")
+    # the accelerator was unreachable; this measures CPU XLA vs the C++
+    # baseline at reduced size — see docs/status.md for the real-TPU
+    # numbers measured interactively (552 ms for 100k x 10k vs 5.3-6.3 s
+    # C++, tpu_sweep_r2.jsonl)
+    note = " [CPU FALLBACK — accelerator unreachable; see docs/status.md]"
+    print(json.dumps(_result_line(match_p50, cpu_ms, eff, j_real, n_real,
+                                  "cpu", note=note)), flush=True)
+
+
+def _try_device_upgrade(budget_s: float) -> bool:
+    """Run the device bench in a subprocess (this process already
+    initialized jax on CPU) and relay its JSON line.  Returns success."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--device-only"],
+            timeout=budget_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        log("device bench subprocess timed out; keeping the CPU number")
+        return False
+    for ln in (proc.stderr or "").splitlines():
+        log(f"[device bench] {ln}")
+    lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
+    if proc.returncode == 0 and lines:
+        try:
+            parsed = json.loads(lines[-1])
+        except ValueError:
+            log("device bench printed unparseable output; keeping CPU line")
+            return False
+        # re-print: the driver takes the last JSON line, upgrading the
+        # artifact from the CPU fallback to the real device measurement
+        print(json.dumps(parsed), flush=True)
+        return True
+    log(f"device bench subprocess rc={proc.returncode}; keeping CPU number")
+    return False
+
+
+def main():
+    """CPU-first, device-upgrade bench driver.
+
+    The round artifact must NEVER be empty (round 3 lost its number to an
+    1800 s probe-retry window outliving the driver's timeout).  Order:
+      1. one fast probe; device up -> full device bench, done;
+      2. otherwise run the CPU fallback and PRINT its line immediately;
+      3. spend the remaining (bounded) window re-probing, and on recovery
+         run the device bench in a subprocess, re-printing on success —
+         the last JSON line on stdout wins.
+    """
+    if "--device-only" in sys.argv:
+        device_main()
+        return
+    if "--cpu-only" in sys.argv or os.environ.get("BENCH_FORCE_CPU"):
+        cpu_main()
+        return
+
+    probe = _probe_device()
+    if probe == "ok":
+        device_main()
+        return
+
+    log(f"accelerator probe: {probe}; printing CPU fallback first")
+    cpu_main()
+    if probe == "error":
+        log("probe failed fast (persistent init error, not a tunnel "
+            "wedge) — skipping the retry window")
+        return
+    window = float(os.environ.get("BENCH_PROBE_WINDOW_S", "600"))
+    interval = float(os.environ.get("BENCH_PROBE_INTERVAL_S", "120"))
+    deadline = time.monotonic() + window
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            log("upgrade window expired; the CPU fallback line stands")
+            return
+        log(f"re-probing for a device upgrade ({remaining:.0f} s left)")
+        if _probe_device() == "ok":
+            budget = max(deadline - time.monotonic(), 300.0)
+            if _try_device_upgrade(budget):
+                return
+        time.sleep(min(interval, max(deadline - time.monotonic(), 1)))
 
 
 if __name__ == "__main__":

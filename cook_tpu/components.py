@@ -20,6 +20,7 @@ from cook_tpu.cluster.base import ComputeCluster
 from cook_tpu.cluster.mock import MockCluster, MockHost
 from cook_tpu.control.leader import (
     FileLeaseElector,
+    HttpLeaseElector,
     InMemoryElector,
     LeaderSelector,
 )
@@ -176,6 +177,7 @@ class CookProcess:
     heartbeats: object = None
     sandbox_publisher: object = None
     journal: object = None
+    follower: object = None  # standby-side journal replication
 
     def is_leader(self) -> bool:
         return self.selector is not None and self.selector.is_leader
@@ -262,10 +264,19 @@ def start_leader_duties(process: CookProcess,
     """Acquire leadership, then start the scheduling loops
     (mesos.clj takeLeadership)."""
     settings = process.settings
-    if settings.leader_lease_path:
+    advertised = settings.advertised_url \
+        or f"http://127.0.0.1:{settings.port}"
+    if settings.leader_endpoint:
+        # networked election (the ZK-session analog): no shared
+        # filesystem between schedulers, only the lease service address
+        elector = HttpLeaseElector(
+            settings.leader_endpoint, settings.leader_group,
+            process.member_id, advertised_url=advertised,
+            ttl_s=settings.leader_ttl_s)
+    elif settings.leader_lease_path:
         elector = FileLeaseElector(
             settings.leader_lease_path, process.member_id,
-            advertised_url=f"http://127.0.0.1:{settings.port}")
+            advertised_url=advertised, ttl_s=settings.leader_ttl_s)
     else:
         elector = InMemoryElector("cook", process.member_id)
     process.selector = LeaderSelector(elector, on_loss=on_loss)
@@ -273,7 +284,29 @@ def start_leader_duties(process: CookProcess,
     process.api.leader = False
     if hasattr(elector, "current_leader_url"):
         process.api.leader_url = elector.current_leader_url()
+
+        # tail the leader's journal so promotion works from OUR copy of
+        # the state (the Datomic-replication role, control/replication.py)
+        from cook_tpu.control.replication import JournalFollower
+
+        def set_leader_url(url: str) -> None:
+            if not process.selector.is_leader:
+                process.api.leader_url = url if url != advertised else ""
+
+        process.follower = JournalFollower(
+            process.store,
+            leader_url_fn=elector.current_leader_url,
+            self_url=advertised,
+            data_dir=settings.data_dir,
+            journal=process.journal,
+            as_user=settings.replication_user,
+            on_leader_url=set_leader_url,
+        ).start()
     process.selector.wait_for_leadership()
+    if not process.selector.is_leader:
+        return  # stopped while standing by (shutdown during wait)
+    if process.follower is not None:
+        process.follower.stop()
     process.api.leader = True
     process.api.leader_url = ""
     log_info("leadership acquired", component="leader",
@@ -429,6 +462,8 @@ def start_leader_duties(process: CookProcess,
 def shutdown(process: CookProcess) -> None:
     for loop in process.loops:
         loop.stop()
+    if process.follower is not None:
+        process.follower.stop()
     if process.selector is not None:
         process.selector.stop()
     if process.server is not None:

@@ -11,8 +11,14 @@ Implementations:
   * InMemoryElector — single-process/tests.
   * FileLeaseElector — multi-process on one filesystem: an O_EXCL lease
     file with heartbeat timestamps; standbys take over when the lease
-    goes stale.  (The production analog would be an etcd/ZK lease; the
-    protocol boundary is what matters here.)
+    goes stale.
+  * HttpLeaseElector — the production path (the ZK-session analog):
+    leases held by an external lease service
+    (cook_tpu.control.lease_server) over plain HTTP, so two schedulers
+    on different machines with NO shared filesystem elect exactly one
+    leader.  Server-side TTLs + fencing epochs; network partitions from
+    the lease service dethrone the leader after one TTL (fail-fast,
+    mesos.clj:296-313).
 """
 from __future__ import annotations
 
@@ -20,6 +26,8 @@ import json
 import os
 import threading
 import time
+import urllib.error
+import urllib.request
 from abc import ABC, abstractmethod
 from typing import Callable, Optional
 
@@ -134,6 +142,90 @@ class FileLeaseElector(LeaderElector):
         if lease is None or self.clock() - lease["t"] > self.ttl_s:
             return None
         return lease["leader"]
+
+
+class HttpLeaseElector(LeaderElector):
+    """Lease-service-backed elector (cook_tpu.control.lease_server).
+
+    Loss semantics mirror a ZK session: a heartbeat the service answers
+    with ok=false (someone else holds the lease, or our fencing epoch is
+    stale) is a DEFINITIVE loss.  A heartbeat that cannot reach the
+    service at all is indeterminate — the lease may still be ours — so
+    leadership survives transient partitions up to one TTL past the last
+    confirmed renewal; beyond that the service may have re-granted the
+    lease, and we must fail fast rather than risk two leaders.
+    """
+
+    def __init__(self, endpoint: str, group: str, member_id: str,
+                 *, ttl_s: float = 10.0, advertised_url: str = "",
+                 timeout_s: float = 3.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.endpoint = endpoint.rstrip("/")
+        self.group = group
+        self.member_id = member_id
+        self.ttl_s = ttl_s
+        self.advertised_url = advertised_url
+        self.timeout_s = timeout_s
+        self.clock = clock
+        self._epoch = 0
+        self._last_renewal: Optional[float] = None
+
+    def _post(self, path: str, payload: dict) -> Optional[dict]:
+        req = urllib.request.Request(
+            self.endpoint + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def _get_leader(self) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(
+                    f"{self.endpoint}/leader?group={self.group}",
+                    timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def try_acquire(self) -> bool:
+        resp = self._post("/acquire", {
+            "group": self.group, "member": self.member_id,
+            "url": self.advertised_url, "ttl_s": self.ttl_s})
+        if resp is None or not resp.get("acquired"):
+            return False
+        self._epoch = int(resp.get("epoch", 0))
+        self._last_renewal = self.clock()
+        return True
+
+    def heartbeat(self) -> bool:
+        resp = self._post("/heartbeat", {
+            "group": self.group, "member": self.member_id,
+            "epoch": self._epoch, "ttl_s": self.ttl_s})
+        if resp is None:
+            # indeterminate: the service is unreachable, not lost — keep
+            # leading until the lease could actually have lapsed
+            last = self._last_renewal
+            return last is not None and self.clock() - last < self.ttl_s
+        if not resp.get("ok"):
+            return False
+        self._last_renewal = self.clock()
+        return True
+
+    def release(self) -> None:
+        self._post("/release", {"group": self.group,
+                                "member": self.member_id,
+                                "epoch": self._epoch})
+
+    def current_leader(self) -> Optional[str]:
+        resp = self._get_leader()
+        return resp.get("leader") if resp else None
+
+    def current_leader_url(self) -> str:
+        resp = self._get_leader()
+        return (resp.get("url") or "") if resp else ""
 
 
 class LeaderSelector:

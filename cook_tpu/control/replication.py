@@ -1,0 +1,161 @@
+"""Journal replication: standbys tail the leader's committed-event feed.
+
+Reference: Datomic is an external, REPLICATED source of truth — every
+scheduler node sees the same transaction log, and leader failover simply
+replays state from the DB (datomic.clj:45-127, the tx-report mult at
+:49; kubernetes/compute_cluster.clj:269).  This rebuild's store persists
+to the leader's local disk, so without replication a dead leader machine
+takes the cluster state with it.  `JournalFollower` closes that gap: a
+standby polls the leader's `/replication/journal` feed (rest/api.py),
+applies the events to its own in-memory store, and appends them to its
+OWN on-disk journal — so promotion works entirely from the standby's
+local copy, and the old leader's data directory can be lost outright.
+
+Bootstrap / gap handling: when the leader reports `snapshot_required`
+(the follower is behind the leader's retained event window — e.g. a
+fresh standby, or a leader that itself just recovered from disk), the
+follower fetches `/replication/snapshot`, rebuilds its store in place,
+rewrites its local snapshot file, and rotates its journal — then resumes
+tailing from the snapshot's sequence number.
+
+The follower also refreshes `api.leader_url` each poll so a standby's
+REST layer always proxies to the CURRENT leader (the reference's
+leader-proxying, rest/api.clj:2408).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Optional
+
+from cook_tpu.models import persistence
+from cook_tpu.models.store import JobStore
+
+log = logging.getLogger(__name__)
+
+
+class JournalFollower:
+    def __init__(
+        self,
+        store: JobStore,
+        *,
+        leader_url_fn: Callable[[], str],
+        self_url: str = "",
+        data_dir: str = "",
+        journal: Optional[persistence.JournalWriter] = None,
+        as_user: str = "admin",
+        poll_s: float = 1.0,
+        timeout_s: float = 10.0,
+        on_leader_url: Optional[Callable[[str], None]] = None,
+    ):
+        self.store = store
+        self.leader_url_fn = leader_url_fn
+        self.self_url = self_url.rstrip("/")
+        self.data_dir = data_dir
+        self.journal = journal
+        self.as_user = as_user
+        self.poll_s = poll_s
+        self.timeout_s = timeout_s
+        self.on_leader_url = on_leader_url
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # observability for tests/debug endpoints
+        self.synced_events = 0
+        self.full_resyncs = 0
+        self.last_error: str = ""
+
+    # ------------------------------------------------------------- transport
+
+    def _get(self, url: str) -> Optional[dict]:
+        req = urllib.request.Request(
+            url, headers={"X-Cook-Requesting-User": self.as_user})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            self.last_error = str(e)
+            return None
+
+    # ------------------------------------------------------------------ sync
+
+    def sync_once(self) -> int:
+        """One poll: fetch and apply everything the leader has past our
+        sequence number.  Returns the number of events applied."""
+        leader = (self.leader_url_fn() or "").rstrip("/")
+        if self.on_leader_url is not None:
+            self.on_leader_url(leader)
+        if not leader or leader == self.self_url:
+            return 0
+        applied = 0
+        while not self._stop.is_set():
+            after = self.store.last_seq()
+            resp = self._get(f"{leader}/replication/journal?"
+                             f"after_seq={after}")
+            if resp is None:
+                break
+            if resp.get("snapshot_required"):
+                if not self._full_resync(leader):
+                    break
+                continue
+            events = resp.get("events", [])
+            if events:
+                applied += self._apply(events)
+            if not resp.get("more"):
+                break
+        return applied
+
+    def _apply(self, events: list[dict]) -> int:
+        with self.store._lock:
+            applied = persistence.apply_journal(self.store, events)
+        # persist to OUR journal so promotion survives losing the leader's
+        # disk; lines are already in journal format
+        if self.journal is not None:
+            for entry in events:
+                self.journal.write_line(json.dumps(entry))
+        self.synced_events += applied
+        return applied
+
+    def _full_resync(self, leader: str) -> bool:
+        state = self._get(f"{leader}/replication/snapshot")
+        if state is None or "seq" not in state:
+            return False
+        persistence.restore_into(self.store, state)
+        if self.data_dir:
+            # the local snapshot now IS the bootstrap point; the journal
+            # restarts from here (the rotated segment only held pre-resync
+            # entries that the new snapshot supersedes)
+            persistence.snapshot(self.store,
+                                 os.path.join(self.data_dir,
+                                              "snapshot.json"))
+            if self.journal is not None:
+                self.journal.rotate()
+        self.full_resyncs += 1
+        log.info("replication: full resync from %s at seq %s", leader,
+                 state["seq"])
+        return True
+
+    # --------------------------------------------------------------- running
+
+    def start(self) -> "JournalFollower":
+        def loop():
+            while not self._stop.wait(self.poll_s):
+                try:
+                    self.sync_once()
+                except Exception:  # noqa: BLE001 — a standby's sync loop
+                    # must survive any leader hiccup
+                    log.exception("journal follower sync failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="journal-follower")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -31,10 +31,11 @@ _dec_instance = codec.dec_instance
 _dec_group = codec.dec_group
 
 
-def snapshot(store: JobStore, path: str) -> None:
-    """Write full store state atomically."""
+def snapshot_state(store: JobStore) -> dict:
+    """Serialize full store state to a JSON-ready dict (also served over
+    HTTP to replicating standbys, rest/api.py /replication/snapshot)."""
     with store._lock:
-        state = {
+        return {
             "seq": store.last_seq(),
             "jobs": {k: codec.encode(v) for k, v in store.jobs.items()},
             "instances": {k: codec.encode(v)
@@ -49,6 +50,11 @@ def snapshot(store: JobStore, path: str) -> None:
             ],
             "dynamic_config": store.dynamic_config,
         }
+
+
+def snapshot(store: JobStore, path: str) -> None:
+    """Write full store state atomically."""
+    state = snapshot_state(store)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(state, f)
@@ -61,6 +67,31 @@ def load_snapshot(path: str, *, clock=None) -> JobStore:
     with open(path) as f:
         state = json.load(f)
     store = JobStore(clock=clock)
+    _populate(store, state)
+    return store
+
+
+def restore_into(store: JobStore, state: dict) -> None:
+    """Replace a LIVE store's contents with a snapshot state dict (the
+    replicating standby's full-resync path — the store object is shared
+    with the REST layer, so it must be rebuilt in place, atomically under
+    the store lock)."""
+    with store._lock:
+        store.jobs.clear()
+        store.job_seq.clear()
+        store.instances.clear()
+        store.groups.clear()
+        store.pools.clear()
+        store.shares.clear()
+        store.quotas.clear()
+        store.dynamic_config = {}
+        store._user_jobs.clear()
+        store._pool_pending.clear()
+        store._pool_running.clear()
+        _populate(store, state)
+
+
+def _populate(store: JobStore, state: dict) -> None:
     for k, v in state["pools"].items():
         store.pools[k] = codec.dec_pool(v)
     for k, v in state["jobs"].items():
@@ -80,7 +111,6 @@ def load_snapshot(path: str, *, clock=None) -> JobStore:
         store.quotas[(quota.user, quota.pool)] = quota
     store.dynamic_config = state.get("dynamic_config", {})
     store.reset_seq(state["seq"])
-    return store
 
 
 def _truncate_torn_tail(path: str) -> None:
@@ -129,6 +159,18 @@ class JournalWriter:
     def __call__(self, event: Event) -> None:
         with self._lock:
             self._f.write(event.to_json() + "\n")
+            self._f.flush()
+            self._count += 1
+            if self.fsync_every and self._count % self.fsync_every == 0:
+                os.fsync(self._f.fileno())
+
+    def write_line(self, line: str) -> None:
+        """Append a pre-serialized journal line (the replication follower
+        persists events it fetched from the leader — they arrive already
+        encoded, and routing them through this writer keeps one lock and
+        one file handle on the journal)."""
+        with self._lock:
+            self._f.write(line.rstrip("\n") + "\n")
             self._f.flush()
             self._count += 1
             if self.fsync_every and self._count % self.fsync_every == 0:

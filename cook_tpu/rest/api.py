@@ -154,6 +154,8 @@ class CookApi:
         r.add_get("/incremental-config", self.get_incremental_config)
         r.add_post("/incremental-config", self.post_incremental_config)
         r.add_post("/shutdown-leader", self.post_shutdown_leader)
+        r.add_get("/replication/journal", self.get_replication_journal)
+        r.add_get("/replication/snapshot", self.get_replication_snapshot)
         r.add_get("/debug", self.get_debug)
         r.add_get("/swagger-docs", self.get_swagger_docs)
         r.add_get("/swagger-ui", self.get_swagger_ui)
@@ -1097,6 +1099,52 @@ class CookApi:
             return _err(403, "admin required")
         self.leader = False
         return web.json_response({"shutdown": "requested"}, status=202)
+
+    # ------------------------------------------------------- replication
+    # The Datomic tx-report role (datomic.clj:49): standbys tail the
+    # leader's committed-event feed so failover works from the STANDBY's
+    # own copy of the state — the leader's disk is not a single point of
+    # durability.  Consumed by control/replication.py JournalFollower.
+
+    REPLICATION_BATCH = 2000
+
+    async def get_replication_journal(self, request: web.Request
+                                      ) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "admin required")
+        try:
+            after_seq = int(request.query.get("after_seq", "0"))
+        except ValueError:
+            return _err(400, "after_seq must be an integer")
+        store = self.store
+        with store._lock:
+            last_seq = store.last_seq()
+            window = store._events
+            oldest = window[0].seq if window else None
+            # gap: events in (after_seq, oldest) have been trimmed from
+            # the window (or predate this process — e.g. a leader that
+            # itself recovered from disk); the follower must re-bootstrap
+            # from a full snapshot
+            if after_seq < last_seq and (oldest is None
+                                         or after_seq + 1 < oldest):
+                return web.json_response({
+                    "snapshot_required": True, "last_seq": last_seq})
+            events = [e for e in window if e.seq > after_seq]
+            batch = events[:self.REPLICATION_BATCH]
+            payload = {
+                "events": [json.loads(e.to_json()) for e in batch],
+                "last_seq": last_seq,
+                "more": len(events) > len(batch),
+            }
+        return web.json_response(payload)
+
+    async def get_replication_snapshot(self, request: web.Request
+                                       ) -> web.Response:
+        if request["user"] not in self.config.admins:
+            return _err(403, "admin required")
+        from cook_tpu.models import persistence
+
+        return web.json_response(persistence.snapshot_state(self.store))
 
 
 def _res_json(res: Resources) -> dict:

@@ -88,6 +88,13 @@ class MatchConfig:
 
     def __post_init__(self):
         backend_flags(self.backend)  # raises on unknown names
+        if self.backend == "bucketed" and 0 < self.chunk and \
+                self.chunk_passes < 2:
+            # the solve-time guard in ops/match.py would only fire on the
+            # first real match cycle; fail at config-parse time instead
+            raise ValueError(
+                "backend 'bucketed' requires chunk_passes >= 2 (the final "
+                "pass is the exact per-job cleanup)")
 
 
 @dataclass
@@ -640,10 +647,16 @@ def start_quality_audit(prepared: "PreparedPool", assignment: np.ndarray,
             log.exception("match quality audit failed (pool %s)", pool_name)
         finally:
             _audit_lock.release()
-    t = threading.Thread(target=run, name=f"match-audit-{pool_name}",
-                         daemon=True)
-    last_audit_thread = t
-    t.start()
+    try:
+        t = threading.Thread(target=run, name=f"match-audit-{pool_name}",
+                             daemon=True)
+        last_audit_thread = t
+        t.start()
+    except Exception:  # noqa: BLE001 — if the thread never starts, run()
+        # never runs, so ITS finally can't release the lock; releasing
+        # here keeps the audit alive for future cycles
+        _audit_lock.release()
+        raise
 
 
 def audit_match_quality(prepared: "PreparedPool", assignment: np.ndarray,
@@ -681,7 +694,14 @@ def audit_match_quality(prepared: "PreparedPool", assignment: np.ndarray,
     weights = (demands[:, :3] / scale[:3]).sum(axis=-1)
     approx_w = float(weights[assignment >= 0].sum())
     exact_w = float(weights[exact >= 0].sum())
-    ratio = approx_w / exact_w if exact_w > 0 else 1.0
+    if exact_w <= 0:
+        # the exact kernel placed nothing: a degenerate problem (no
+        # feasible pairs), not evidence of parity — setting the gauge to
+        # 1.0 would read "healthy" on a pathological cycle, so skip it
+        log.info("match quality audit: pool %s exact kernel placed zero "
+                 "weight; skipping gauge update", pool_name)
+        return 1.0
+    ratio = approx_w / exact_w
     global_registry.gauge(
         "match.quality_audit",
         "packing parity of the chunked solve vs the exact kernel",

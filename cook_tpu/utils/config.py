@@ -104,6 +104,17 @@ class Settings:
     # round-robin one-pool-per-tick (docs/tpu-design.md pool sharding)
     batched_match: bool = False
     leader_lease_path: str = ""
+    # networked election (control/lease_server.py — the ZK role): takes
+    # precedence over leader_lease_path when set
+    leader_endpoint: str = ""
+    leader_group: str = "cook"
+    leader_ttl_s: float = 10.0
+    # URL peers reach THIS node at (lease advertisement + standby
+    # replication); default http://127.0.0.1:{port}
+    advertised_url: str = ""
+    # identity standbys present to the leader's /replication endpoints
+    # (must be in the leader's admins)
+    replication_user: str = "admin"
     data_dir: str = ""                  # "" = in-memory only
     snapshot_interval_s: float = 300.0
     admins: tuple = ("admin",)
@@ -167,7 +178,9 @@ def read_config(path: Optional[str] = None,
     for key in ("port", "default_pool", "mea_culpa_failure_limit",
                 "rank_interval_s", "match_interval_s",
                 "rebalancer_interval_s", "optimizer_interval_s",
-                "leader_lease_path", "data_dir", "snapshot_interval_s",
+                "leader_lease_path", "leader_endpoint", "leader_group",
+                "leader_ttl_s", "advertised_url", "replication_user",
+                "data_dir", "snapshot_interval_s",
                 "batched_match",
                 "queue_limit_per_pool",
                 "queue_limit_per_user", "submission_rate_per_minute"):

@@ -1,0 +1,148 @@
+"""Whole-OS-process leader failover over the networked lease service.
+
+Three separate processes, nothing shared but TCP: a lease server
+(control/lease_server.py — the ZooKeeper role), and two scheduler
+processes (`python -m cook_tpu`) with SEPARATE data directories.  The
+leader is SIGKILLed (no graceful release) and its data dir deleted; the
+standby must take the lease after TTL expiry and serve the replicated
+state — the reference's ZK-election + Datomic-replay failover
+(mesos.clj:153-328, kubernetes/compute_cluster.clj:269) with no shared
+filesystem anywhere.
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from cook_tpu.rest.server import free_port
+
+H = {"X-Cook-Requesting-User": "u"}
+
+
+def _wait(predicate, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            if predicate():
+                return
+        except requests.RequestException:
+            pass
+        time.sleep(0.25)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _write_config(tmp_path, name, port, data_dir, lease_url):
+    config = {
+        "port": port,
+        "data_dir": data_dir,
+        "leader_endpoint": lease_url,
+        "leader_ttl_s": 2.0,
+        "rank_interval_s": 0.5,
+        "match_interval_s": 0.5,
+        "pools": [{"name": "default"}],
+        "clusters": [{
+            "kind": "mock", "name": "m1",
+            "hosts": [{"node_id": "h0", "mem": 4000, "cpus": 8}],
+        }],
+    }
+    path = tmp_path / f"{name}.json"
+    path.write_text(json.dumps(config))
+    return str(path)
+
+
+@pytest.mark.slow
+def test_sigkill_leader_promotes_standby_no_shared_fs(tmp_path):
+    lease_port = free_port()
+    env = dict(os.environ)
+    procs = []
+
+    def spawn(*argv):
+        p = subprocess.Popen([sys.executable, *argv], env=env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        procs.append(p)
+        return p
+
+    lease = spawn("-m", "cook_tpu.control.lease_server",
+                  "--host", "127.0.0.1", "--port", str(lease_port))
+    lease_url = f"http://127.0.0.1:{lease_port}"
+    try:
+        _wait(lambda: requests.get(f"{lease_url}/healthz",
+                                   timeout=1).ok, 15, "lease server up")
+
+        ports = [free_port(), free_port()]
+        dirs = [str(tmp_path / "node1"), str(tmp_path / "node2")]
+        nodes = []
+        for i in (0, 1):
+            cfg = _write_config(tmp_path, f"node{i}", ports[i], dirs[i],
+                                lease_url)
+            nodes.append(spawn("-m", "cook_tpu", "--config", cfg))
+            # stagger so node0 deterministically wins the first election
+            if i == 0:
+                _wait(lambda: requests.get(
+                    f"http://127.0.0.1:{ports[0]}/debug",
+                    timeout=1).json()["leader"], 90, "node0 leads")
+
+        leader_port, standby_port = ports
+        leader_proc, standby_proc = nodes
+        leader_dir = dirs[0]
+        _wait(lambda: requests.get(
+            f"http://127.0.0.1:{standby_port}/debug", timeout=1).ok,
+            90, "standby REST up")
+
+        uuid = "f0000000-0000-0000-0000-0000000000aa"
+        r = requests.post(f"http://127.0.0.1:{leader_port}/jobs", json={
+            "jobs": [{"command": "sleep 600", "mem": 100, "cpus": 1,
+                      "uuid": uuid}]}, headers=H, timeout=5)
+        assert r.status_code == 201, r.text
+
+        # standby replicates the job into its OWN store/disk
+        _wait(lambda: requests.get(
+            f"http://127.0.0.1:{standby_port}/jobs/{uuid}",
+            headers=H, timeout=2).status_code == 200,
+            30, "standby replicated the job")
+
+        # hard-kill the leader and burn its disk
+        leader_proc.send_signal(signal.SIGKILL)
+        leader_proc.wait(timeout=10)
+        shutil.rmtree(leader_dir)
+
+        _wait(lambda: requests.get(
+            f"http://127.0.0.1:{standby_port}/debug",
+            timeout=1).json()["leader"], 30, "standby promoted")
+        # lease service agrees on the new leader's advertised URL
+        current = requests.get(f"{lease_url}/leader?group=cook",
+                               timeout=2).json()
+        assert current["url"] == f"http://127.0.0.1:{standby_port}"
+
+        # state survived: the job is there, and the NEW leader schedules
+        # it to running on its mock cluster
+        r = requests.get(f"http://127.0.0.1:{standby_port}/jobs/{uuid}",
+                         headers=H, timeout=2)
+        assert r.status_code == 200
+        _wait(lambda: requests.get(
+            f"http://127.0.0.1:{standby_port}/jobs/{uuid}",
+            headers=H, timeout=2).json()["status"] == "running",
+            30, "new leader schedules the replicated job")
+
+        # and the new leader accepts writes directly
+        uuid2 = "f0000000-0000-0000-0000-0000000000ab"
+        r = requests.post(f"http://127.0.0.1:{standby_port}/jobs", json={
+            "jobs": [{"command": "true", "mem": 50, "cpus": 1,
+                      "uuid": uuid2}]}, headers=H, timeout=5)
+        assert r.status_code == 201, r.text
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
