@@ -27,6 +27,13 @@ def main(argv=None) -> int:
     if args.port:
         overrides["port"] = args.port
     settings = read_config(args.config, overrides)
+    if settings.platform:
+        # pin the jax platform BEFORE any backend init: a wedged
+        # accelerator (or a site hook that force-registers one) must not
+        # stall the scheduling loops of a node configured for cpu
+        import jax
+
+        jax.config.update("jax_platforms", settings.platform)
     process = build_process(settings)
     print(f"cook-tpu listening on :{settings.port} "
           f"(member {process.member_id})", file=sys.stderr)

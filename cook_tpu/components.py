@@ -247,6 +247,9 @@ def build_process(
         authenticator=(authenticator_from_config(settings.auth)
                        if settings.auth else None),
         executor_token=settings.executor_token,
+        replication_sync_ack=settings.replication_sync_ack,
+        replication_min_acks=settings.replication_min_acks,
+        replication_ack_timeout_s=settings.replication_ack_timeout_s,
     ), plugins=plugins)
     api.queue_limits.limits.per_pool = settings.queue_limit_per_pool
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user
@@ -280,8 +283,11 @@ def start_leader_duties(process: CookProcess,
     else:
         elector = InMemoryElector("cook", process.member_id)
     process.selector = LeaderSelector(elector, on_loss=on_loss)
-    # while standing by, surface the current leader for REST proxying
+    # while standing by, surface the current leader for REST proxying and
+    # keep the scheduler passive: replicated events maintain its indexes
+    # but must not re-execute the leader's side effects
     process.api.leader = False
+    process.scheduler.active = False
     if hasattr(elector, "current_leader_url"):
         process.api.leader_url = elector.current_leader_url()
 
@@ -300,13 +306,25 @@ def start_leader_duties(process: CookProcess,
             data_dir=settings.data_dir,
             journal=process.journal,
             as_user=settings.replication_user,
+            member_id=process.member_id,
             on_leader_url=set_leader_url,
         ).start()
     process.selector.wait_for_leadership()
     if not process.selector.is_leader:
         return  # stopped while standing by (shutdown during wait)
     if process.follower is not None:
+        # full join (stop waits out any in-flight fetch): a late response
+        # from a deposed leader must not clobber the state we now own
         process.follower.stop()
+    # promotion invariant: the columnar rank index tracked the leader via
+    # replicated-event fan-out; verify, and rebuild if anything drifted —
+    # a promoted standby must schedule from its replicated state
+    # immediately (no REST write in between).
+    columnar = getattr(process.scheduler, "columnar", None)
+    if columnar is not None and not columnar.consistent_with_store():
+        log.warning("columnar index inconsistent at promotion; rebuilding")
+        columnar.rebuild()
+    process.scheduler.active = True
     process.api.leader = True
     process.api.leader_url = ""
     log_info("leadership acquired", component="leader",

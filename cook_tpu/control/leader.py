@@ -191,16 +191,22 @@ class HttpLeaseElector(LeaderElector):
             return None
 
     def try_acquire(self) -> bool:
+        # measure the lease from BEFORE the request leaves: the server
+        # starts the TTL when it processes the request, so stamping the
+        # renewal at response time would extend our grace window up to one
+        # RTT past server-side expiry — a two-leader window
+        t0 = self.clock()
         resp = self._post("/acquire", {
             "group": self.group, "member": self.member_id,
             "url": self.advertised_url, "ttl_s": self.ttl_s})
         if resp is None or not resp.get("acquired"):
             return False
         self._epoch = int(resp.get("epoch", 0))
-        self._last_renewal = self.clock()
+        self._last_renewal = t0
         return True
 
     def heartbeat(self) -> bool:
+        t0 = self.clock()
         resp = self._post("/heartbeat", {
             "group": self.group, "member": self.member_id,
             "epoch": self._epoch, "ttl_s": self.ttl_s})
@@ -211,7 +217,7 @@ class HttpLeaseElector(LeaderElector):
             return last is not None and self.clock() - last < self.ttl_s
         if not resp.get("ok"):
             return False
-        self._last_renewal = self.clock()
+        self._last_renewal = t0
         return True
 
     def release(self) -> None:

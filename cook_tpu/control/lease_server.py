@@ -35,6 +35,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 
+# server-side TTL ceiling (seconds): leases must lapse fast enough for
+# failover to be useful no matter what a client asks for
+MAX_TTL_S = 60.0
+
+
 @dataclass
 class _Lease:
     leader: str
@@ -117,13 +122,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
-        if self.path.startswith("/leader"):
-            from urllib.parse import parse_qs, urlparse
+        from urllib.parse import parse_qs, urlparse
 
-            q = parse_qs(urlparse(self.path).query)
+        parsed = urlparse(self.path)
+        if parsed.path == "/leader":
+            q = parse_qs(parsed.query)
             group = (q.get("group") or ["cook"])[0]
             return self._json(200, self.table.current(group))
-        if self.path == "/healthz":
+        if parsed.path == "/healthz":
             return self._json(200, {"ok": True})
         return self._json(404, {"error": "unknown path"})
 
@@ -137,8 +143,15 @@ class _Handler(BaseHTTPRequestHandler):
         member = str(body.get("member", ""))
         if not member:
             return self._json(400, {"error": "member required"})
-        ttl = float(body.get("ttl_s", 10.0))
-        epoch = int(body.get("epoch", 0))
+        try:
+            ttl = float(body.get("ttl_s", 10.0))
+            epoch = int(body.get("epoch", 0))
+        except (TypeError, ValueError):
+            return self._json(400, {"error": "malformed ttl_s/epoch"})
+        # clamp: one buggy/malicious acquire with a huge TTL would lock
+        # the group to a dead member until the service restarts,
+        # defeating the fail-fast design
+        ttl = max(0.5, min(ttl, MAX_TTL_S))
         if self.path == "/acquire":
             return self._json(200, self.table.acquire(
                 group, member, str(body.get("url", "")), ttl))

@@ -51,6 +51,8 @@ class JournalFollower:
         as_user: str = "admin",
         poll_s: float = 1.0,
         timeout_s: float = 10.0,
+        long_poll_s: Optional[float] = None,
+        member_id: str = "",
         on_leader_url: Optional[Callable[[str], None]] = None,
     ):
         self.store = store
@@ -61,9 +63,23 @@ class JournalFollower:
         self.as_user = as_user
         self.poll_s = poll_s
         self.timeout_s = timeout_s
+        # long-poll window: the journal request parks on the leader until
+        # the next commit, so replication is push-like.  Must stay under
+        # timeout_s or an idle long-poll reads as a transport error.
+        self.long_poll_s = (max(0.0, timeout_s - 2.0)
+                            if long_poll_s is None else long_poll_s)
+        self.member_id = member_id or self.self_url or "standby"
+        self._last_acked = -1
         self.on_leader_url = on_leader_url
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # the leader incarnation the feed we're tailing belongs to: event
+        # sequence numbers are only comparable within one leader history,
+        # so a change (failover, or a leader restarted from its own disk)
+        # forces a snapshot bootstrap rather than risking silent
+        # divergence (a deposed leader may hold committed events the new
+        # leader never saw)
+        self._leader_incarnation: Optional[str] = None
         # observability for tests/debug endpoints
         self.synced_events = 0
         self.full_resyncs = 0
@@ -71,9 +87,23 @@ class JournalFollower:
 
     # ------------------------------------------------------------- transport
 
-    def _get(self, url: str) -> Optional[dict]:
+    def _get(self, url: str, *, timeout_s: Optional[float] = None
+             ) -> Optional[dict]:
         req = urllib.request.Request(
             url, headers={"X-Cook-Requesting-User": self.as_user})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout_s or self.timeout_s) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            self.last_error = str(e)
+            return None
+
+    def _post(self, url: str, payload: dict) -> Optional[dict]:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"X-Cook-Requesting-User": self.as_user,
+                     "Content-Type": "application/json"}, method="POST")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return json.loads(r.read())
@@ -92,12 +122,33 @@ class JournalFollower:
         if not leader or leader == self.self_url:
             return 0
         applied = 0
+        first_fetch = True
         while not self._stop.is_set():
             after = self.store.last_seq()
-            resp = self._get(f"{leader}/replication/journal?"
-                             f"after_seq={after}")
-            if resp is None:
+            # only the first fetch of a cycle long-polls: follow-up pages
+            # of a backlog should stream back-to-back
+            wait_s = self.long_poll_s if first_fetch else 0.0
+            first_fetch = False
+            resp = self._get(
+                f"{leader}/replication/journal?after_seq={after}"
+                f"&wait_s={wait_s}",
+                timeout_s=self.timeout_s + wait_s)
+            # a response landing after stop() is the promotion race: we
+            # may already be (about to be) the leader, and a reply from a
+            # still-alive deposed leader must not clobber our state
+            if resp is None or self._stop.is_set():
                 break
+            incarnation = resp.get("incarnation")
+            if incarnation and self._leader_incarnation not in (
+                    None, incarnation):
+                log.info("replication: leader incarnation changed %s -> %s;"
+                         " forcing snapshot bootstrap",
+                         self._leader_incarnation, incarnation)
+                if not self._full_resync(leader):
+                    break
+                continue
+            if incarnation:
+                self._leader_incarnation = incarnation
             if resp.get("snapshot_required"):
                 if not self._full_resync(leader):
                     break
@@ -107,23 +158,36 @@ class JournalFollower:
                 applied += self._apply(events)
             if not resp.get("more"):
                 break
+        # confirm what we hold: sync-ack submissions on the leader block
+        # until a standby's ack covers them (rest/api.py:_await_replication)
+        if not self._stop.is_set():
+            seq = self.store.last_seq()
+            if seq != self._last_acked and leader:
+                if self._post(f"{leader}/replication/ack",
+                              {"follower": self.member_id, "seq": seq}):
+                    self._last_acked = seq
         return applied
 
     def _apply(self, events: list[dict]) -> int:
+        # live mode: each entry becomes an ordinary committed event on our
+        # store — retained in the event window and fanned out to watchers
+        # (columnar index, attached journal writer, passport), so the
+        # standby's derived state tracks the leader continuously and
+        # promotion needs no rebuild.  Journal persistence rides the
+        # watcher fan-out (persistence.attach_journal), same as a local
+        # transaction.
         with self.store._lock:
-            applied = persistence.apply_journal(self.store, events)
-        # persist to OUR journal so promotion survives losing the leader's
-        # disk; lines are already in journal format
-        if self.journal is not None:
-            for entry in events:
-                self.journal.write_line(json.dumps(entry))
+            applied = persistence.apply_journal(self.store, events,
+                                                live=True)
         self.synced_events += applied
         return applied
 
     def _full_resync(self, leader: str) -> bool:
         state = self._get(f"{leader}/replication/snapshot")
-        if state is None or "seq" not in state:
+        if state is None or "seq" not in state or self._stop.is_set():
             return False
+        if state.get("incarnation"):
+            self._leader_incarnation = state["incarnation"]
         persistence.restore_into(self.store, state)
         if self.data_dir:
             # the local snapshot now IS the bootstrap point; the journal
@@ -156,6 +220,12 @@ class JournalFollower:
         return self
 
     def stop(self) -> None:
+        """Stop tailing and JOIN the sync thread fully.  The join timeout
+        must cover a whole in-flight fetch (timeout_s): promotion calls
+        this before taking writes, and a late response from a deposed
+        leader applying after promotion would clobber the new leader's
+        state (the sync loop also re-checks _stop after every fetch as a
+        second line of defense)."""
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=self.timeout_s + 5)

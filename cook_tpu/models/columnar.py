@@ -56,6 +56,9 @@ class ColumnarJobIndex:
         self.inst_start: np.ndarray = np.empty(0, np.int64)
         self.rebuild()
         store.add_watcher(self._on_event)
+        # snapshot bootstrap on a replicating standby replaces the whole
+        # store at once (persistence.restore_into) — rebuild from scratch
+        store.add_resync_listener(self.rebuild)
 
     # ------------------------------------------------------------ storage
 

@@ -33,23 +33,30 @@ _dec_group = codec.dec_group
 
 def snapshot_state(store: JobStore) -> dict:
     """Serialize full store state to a JSON-ready dict (also served over
-    HTTP to replicating standbys, rest/api.py /replication/snapshot)."""
+    HTTP to replicating standbys, rest/api.py /replication/snapshot).
+
+    Entities are immutable, so only the dict copies happen under the
+    store lock — the JSON encoding (the expensive part at 100k-job scale)
+    runs outside it and never stalls writers."""
     with store._lock:
-        return {
-            "seq": store.last_seq(),
-            "jobs": {k: codec.encode(v) for k, v in store.jobs.items()},
-            "instances": {k: codec.encode(v)
-                          for k, v in store.instances.items()},
-            "groups": {k: codec.encode(v) for k, v in store.groups.items()},
-            "pools": {k: codec.encode(v) for k, v in store.pools.items()},
-            "shares": [
-                codec.encode(v) for v in store.shares.values()
-            ],
-            "quotas": [
-                codec.encode(v) for v in store.quotas.values()
-            ],
-            "dynamic_config": store.dynamic_config,
-        }
+        seq = store.last_seq()
+        jobs = dict(store.jobs)
+        instances = dict(store.instances)
+        groups = dict(store.groups)
+        pools = dict(store.pools)
+        shares = list(store.shares.values())
+        quotas = list(store.quotas.values())
+        dynamic_config = dict(store.dynamic_config)
+    return {
+        "seq": seq,
+        "jobs": {k: codec.encode(v) for k, v in jobs.items()},
+        "instances": {k: codec.encode(v) for k, v in instances.items()},
+        "groups": {k: codec.encode(v) for k, v in groups.items()},
+        "pools": {k: codec.encode(v) for k, v in pools.items()},
+        "shares": [codec.encode(v) for v in shares],
+        "quotas": [codec.encode(v) for v in quotas],
+        "dynamic_config": dynamic_config,
+    }
 
 
 def snapshot(store: JobStore, path: str) -> None:
@@ -75,7 +82,11 @@ def restore_into(store: JobStore, state: dict) -> None:
     """Replace a LIVE store's contents with a snapshot state dict (the
     replicating standby's full-resync path — the store object is shared
     with the REST layer, so it must be rebuilt in place, atomically under
-    the store lock)."""
+    the store lock).  The retained event window is cleared too: its
+    entries predate the resync point, and a promoted standby serving
+    `/replication/journal` must never mix pre-resync events with
+    post-resync sequence numbering.  Watcher-derived state (columnar
+    index, scheduler caches) is rebuilt via the store's resync listeners."""
     with store._lock:
         store.jobs.clear()
         store.job_seq.clear()
@@ -88,7 +99,9 @@ def restore_into(store: JobStore, state: dict) -> None:
         store._user_jobs.clear()
         store._pool_pending.clear()
         store._pool_running.clear()
+        store._events.clear()
         _populate(store, state)
+        store._notify_resync()
 
 
 def _populate(store: JobStore, state: dict) -> None:
@@ -212,7 +225,7 @@ def read_journal(path: str) -> list[dict]:
     return events
 
 
-def _upsert_job(store: JobStore, payload: dict) -> None:
+def _upsert_job(store: JobStore, payload: dict):
     job = codec.dec_job(payload)
     old = store.jobs.get(job.uuid)
     if old is not None and old.pool != job.pool:
@@ -222,16 +235,35 @@ def _upsert_job(store: JobStore, payload: dict) -> None:
         store.job_seq[job.uuid] = len(store.job_seq)
     store.jobs[job.uuid] = job
     store._index_job(job, old)
+    return job
 
 
 def apply_journal(store: JobStore, events: list[dict],
-                  *, after_seq: int = 0) -> int:
+                  *, after_seq: int = 0, live: bool = False) -> int:
     """Replay journal entries onto a store.  Entries carry post-transaction
-    entity payloads, so replay is a pure upsert — no state-machine re-checks
-    and no watcher fan-out (this runs before watchers attach).  Returns the
-    number of entries applied."""
+    entity payloads, so replay is a pure upsert — no state-machine
+    re-checks.  Returns the number of entries applied.
+
+    Two modes:
+      * cold replay (default) — startup recovery, before watchers attach:
+        no event retention, no fan-out.
+      * ``live=True`` — a replicating standby applying the leader's feed
+        (control/replication.py): each applied entry becomes an ordinary
+        committed Event on THIS store — appended to the retained window
+        (so a promoted standby can serve `/replication/journal` itself)
+        and fanned out to watchers, exactly like a local transaction.
+        This is the Datomic-replication semantic: the tx-report mult
+        delivers to ALL listeners on every peer (reference
+        datomic.clj:49), so a standby's columnar rank index, journal
+        writer, and passport stream track the leader continuously and
+        promotion needs no rebuild.  Effect-executing consumers (the
+        scheduler's kill fan-out) gate on leadership instead — the
+        LEADER already performed those effects and their results arrive
+        as further replicated events.
+    """
     applied = 0
     max_seq = store.last_seq()
+    fan: list[Event] = []
     for entry in events:
         seq = entry.get("seq", 0)
         if seq <= after_seq or seq <= max_seq:
@@ -239,32 +271,47 @@ def apply_journal(store: JobStore, events: list[dict],
         kind = entry.get("kind", "")
         data = entry.get("data", {})
         entities = entry.get("entities") or {}
+        decoded: dict = {}
         if "job" in entities:
-            _upsert_job(store, entities["job"])
+            decoded["job"] = _upsert_job(store, entities["job"])
         if "instance" in entities:
             inst = codec.dec_instance(entities["instance"])
             store.instances[inst.task_id] = inst
+            decoded["instance"] = inst
         if "group" in entities:
             group = codec.dec_group(entities["group"])
             store.groups[group.uuid] = group
+            decoded["group"] = group
         if "pool" in entities:
             pool = codec.dec_pool(entities["pool"])
             store.pools[pool.name] = pool
+            decoded["pool"] = pool
         if "share" in entities:
             share = codec.dec_share(entities["share"])
             store.shares[(share.user, share.pool)] = share
+            decoded["share"] = share
         if "quota" in entities:
             quota = codec.dec_quota(entities["quota"])
             store.quotas[(quota.user, quota.pool)] = quota
+            decoded["quota"] = quota
         if kind == "share/retracted":
             store.shares.pop((data["user"], data["pool"]), None)
         elif kind == "quota/retracted":
             store.quotas.pop((data["user"], data["pool"]), None)
         elif kind == "config/updated":
             store.dynamic_config.update(data.get("updates", {}))
+        if live:
+            event = Event(seq=seq, kind=kind, data=data,
+                          entities=decoded or None)
+            store._events.append(event)
+            fan.append(event)
         max_seq = max(max_seq, seq)
         applied += 1
+    if live and len(store._events) > 2 * store.EVENT_WINDOW:
+        del store._events[:-store.EVENT_WINDOW]
     store.reset_seq(max_seq)
+    if fan:
+        store._fan_out(fan)
     return applied
 
 

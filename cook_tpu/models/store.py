@@ -80,6 +80,7 @@ class JobStore:
         self.recovered_stats: dict[str, int] = {}
         self._events: list[Event] = []
         self._watchers: list[Watcher] = []
+        self._resync_listeners: list[Callable[[], None]] = []
         self.mea_culpa_limit = mea_culpa_limit
         # clock returns milliseconds; injectable for the frozen-time simulator
         self.clock = clock or (lambda: 0)
@@ -107,6 +108,19 @@ class JobStore:
     def add_watcher(self, watcher: Watcher) -> None:
         with self._lock:
             self._watchers.append(watcher)
+
+    def add_resync_listener(self, listener: Callable[[], None]) -> None:
+        """Register a callback for wholesale state replacement
+        (persistence.restore_into — a standby's snapshot bootstrap).
+        Event watchers see each incremental commit; a resync invalidates
+        everything at once, so derived state (columnar index, caches)
+        rebuilds from the store instead."""
+        with self._lock:
+            self._resync_listeners.append(listener)
+
+    def _notify_resync(self) -> None:
+        for listener in list(self._resync_listeners):
+            listener()
 
     def events_since(self, seq: int) -> list[Event]:
         with self._lock:

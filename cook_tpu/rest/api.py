@@ -78,6 +78,17 @@ class ApiConfig:
     # auth-exempt for callers carrying it — without it any network peer
     # could spoof liveness/progress under a strict authenticator
     executor_token: str = ""
+    # sync-ack replication (the reference's durable-on-ack semantics,
+    # datomic.clj:79 transact-with-retries: a write survives leader death
+    # the moment the REST call returns).  When enabled, POST /jobs blocks
+    # until >= replication_min_acks standbys have ACKed a sequence number
+    # covering the submission, or the timeout lapses — a timeout still
+    # commits (the write is applied and journaled locally) but the
+    # response carries "replicated": false so callers know the durability
+    # bound was not met.
+    replication_sync_ack: bool = False
+    replication_min_acks: int = 1
+    replication_ack_timeout_s: float = 5.0
 
 
 class CookApi:
@@ -109,6 +120,21 @@ class CookApi:
             self.authenticator = dev_default_authenticator()
         self.leader = True
         self.leader_url = ""  # set on standbys for leader proxying
+        # replication feed identity: event sequence numbers are only
+        # comparable within one leader history, so every process stamps
+        # its feed with a fresh incarnation; followers force a snapshot
+        # bootstrap when it changes (a deposed leader may hold committed
+        # events the new leader never saw — silent divergence otherwise)
+        import uuid as _uuid
+
+        self.incarnation = _uuid.uuid4().hex[:12]
+        # follower -> highest event seq it has confirmed applied
+        # (POST /replication/ack); read by sync-ack submissions
+        self.replication_acks: dict[str, int] = {}
+        # long-poll/sync-ack wakeups: per-waiter events, set from the
+        # store's watcher thread via call_soon_threadsafe
+        self._repl_waiters: set = set()
+        self._repl_loop = None
 
     # ------------------------------------------------------------ app wiring
 
@@ -156,6 +182,7 @@ class CookApi:
         r.add_post("/shutdown-leader", self.post_shutdown_leader)
         r.add_get("/replication/journal", self.get_replication_journal)
         r.add_get("/replication/snapshot", self.get_replication_snapshot)
+        r.add_post("/replication/ack", self.post_replication_ack)
         r.add_get("/debug", self.get_debug)
         r.add_get("/swagger-docs", self.get_swagger_docs)
         r.add_get("/swagger-ui", self.get_swagger_ui)
@@ -338,6 +365,15 @@ class CookApi:
         except TransactionVetoed as e:
             return _err(400, str(e))
         global_registry.counter("jobs_submitted").inc(len(jobs))
+        if self.config.replication_sync_ack:
+            # durable-on-ack (datomic.clj:79): don't 201 until a standby
+            # holds the submission; a timeout still commits, but says so
+            replicated = await self._await_replication(self.store.last_seq())
+            if not replicated:
+                global_registry.counter("replication_ack_timeouts").inc()
+                return web.json_response(
+                    {"jobs": [j.uuid for j in jobs], "replicated": False},
+                    status=201)
         return web.json_response(
             {"jobs": [j.uuid for j in jobs]}, status=201
         )
@@ -1107,44 +1143,161 @@ class CookApi:
     # durability.  Consumed by control/replication.py JournalFollower.
 
     REPLICATION_BATCH = 2000
+    REPLICATION_MAX_WAIT_S = 25.0
 
-    async def get_replication_journal(self, request: web.Request
-                                      ) -> web.Response:
-        if request["user"] not in self.config.admins:
-            return _err(403, "admin required")
+    # --- wakeup plumbing: store commits happen on arbitrary writer
+    # threads; long-poll and sync-ack waiters live on the aiohttp loop.
+    # A store watcher marshals "something committed" onto the loop, which
+    # sets every parked waiter's event; waiters re-check their predicate.
+
+    def _ensure_repl_watcher(self) -> None:
+        import asyncio
+
+        if self._repl_loop is not None:
+            return
+        loop = asyncio.get_running_loop()
+        self._repl_loop = loop
+
+        def on_commit(_event) -> None:
+            try:
+                loop.call_soon_threadsafe(self._repl_wake_all)
+            except RuntimeError:
+                pass  # loop already closed (shutdown)
+
+        self.store.add_watcher(on_commit)
+
+    def _repl_wake_all(self) -> None:
+        for waiter in list(self._repl_waiters):
+            waiter.set()
+
+    async def _repl_wait(self, timeout_s: float) -> None:
+        """Park until the next commit/ack wakeup or timeout."""
+        import asyncio
+
+        waiter = asyncio.Event()
+        self._repl_waiters.add(waiter)
         try:
-            after_seq = int(request.query.get("after_seq", "0"))
-        except ValueError:
-            return _err(400, "after_seq must be an integer")
+            await asyncio.wait_for(waiter.wait(), timeout_s)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            self._repl_waiters.discard(waiter)
+
+    def _journal_slice(self, after_seq: int):
+        """Copy the event batch under the store lock; encode nothing
+        there (events are immutable — serialization happens outside so
+        standby polls never stall leader writes)."""
         store = self.store
         with store._lock:
             last_seq = store.last_seq()
             window = store._events
             oldest = window[0].seq if window else None
+            # follower ahead of us: it replicated from a leader history
+            # we never saw (e.g. we are a deposed leader's standby that
+            # outlived it) — only a snapshot bootstrap can converge it
+            if after_seq > last_seq:
+                return None, last_seq, False
             # gap: events in (after_seq, oldest) have been trimmed from
             # the window (or predate this process — e.g. a leader that
             # itself recovered from disk); the follower must re-bootstrap
             # from a full snapshot
             if after_seq < last_seq and (oldest is None
                                          or after_seq + 1 < oldest):
-                return web.json_response({
-                    "snapshot_required": True, "last_seq": last_seq})
+                return None, last_seq, False
             events = [e for e in window if e.seq > after_seq]
             batch = events[:self.REPLICATION_BATCH]
-            payload = {
-                "events": [json.loads(e.to_json()) for e in batch],
-                "last_seq": last_seq,
-                "more": len(events) > len(batch),
-            }
-        return web.json_response(payload)
+            return batch, last_seq, len(events) > len(batch)
+
+    async def get_replication_journal(self, request: web.Request
+                                      ) -> web.Response:
+        """Committed-event feed for standbys (the Datomic tx-report role,
+        datomic.clj:49).  `wait_s` long-polls: when the follower is caught
+        up, the request parks until the next commit instead of returning
+        empty — replication becomes push-like and write stalls don't scale
+        with standby count."""
+        import asyncio
+
+        if request["user"] not in self.config.admins:
+            return _err(403, "admin required")
+        try:
+            after_seq = int(request.query.get("after_seq", "0"))
+            wait_s = float(request.query.get("wait_s", "0"))
+        except ValueError:
+            return _err(400, "after_seq/wait_s must be numeric")
+        wait_s = min(wait_s, self.REPLICATION_MAX_WAIT_S)
+        self._ensure_repl_watcher()
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + wait_s
+        while True:
+            batch, last_seq, more = self._journal_slice(after_seq)
+            if batch is None:
+                return web.json_response({
+                    "snapshot_required": True, "last_seq": last_seq,
+                    "incarnation": self.incarnation})
+            if batch or loop.time() >= deadline:
+                break
+            await self._repl_wait(deadline - loop.time())
+        encoded = await loop.run_in_executor(
+            None, lambda: [json.loads(e.to_json()) for e in batch])
+        return web.json_response({
+            "events": encoded,
+            "last_seq": last_seq,
+            "more": more,
+            "incarnation": self.incarnation,
+        })
 
     async def get_replication_snapshot(self, request: web.Request
                                        ) -> web.Response:
         if request["user"] not in self.config.admins:
             return _err(403, "admin required")
+        import asyncio
+
         from cook_tpu.models import persistence
 
-        return web.json_response(persistence.snapshot_state(self.store))
+        # snapshot_state copies entity references under the store lock and
+        # encodes outside it; the executor keeps the encode off the loop
+        state = await asyncio.get_running_loop().run_in_executor(
+            None, persistence.snapshot_state, self.store)
+        state["incarnation"] = self.incarnation
+        return web.json_response(state)
+
+    async def post_replication_ack(self, request: web.Request
+                                   ) -> web.Response:
+        """Followers confirm the highest seq they have applied AND
+        journaled locally; sync-ack submissions block on these."""
+        if request["user"] not in self.config.admins:
+            return _err(403, "admin required")
+        body = await request.json()
+        follower = str(body.get("follower", ""))
+        try:
+            seq = int(body.get("seq"))
+        except (TypeError, ValueError):
+            return _err(400, "seq must be an integer")
+        if not follower:
+            return _err(400, "follower required")
+        prev = self.replication_acks.get(follower, 0)
+        self.replication_acks[follower] = max(prev, seq)
+        self._repl_wake_all()
+        return web.json_response({"ok": True})
+
+    async def _await_replication(self, seq: int) -> bool:
+        """Block until >= replication_min_acks followers confirm `seq`, or
+        the configured timeout lapses.  True = durability bound met."""
+        import asyncio
+
+        self._ensure_repl_watcher()
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.config.replication_ack_timeout_s
+        need = self.config.replication_min_acks
+        while True:
+            acked = sum(1 for s in self.replication_acks.values()
+                        if s >= seq)
+            if acked >= need:
+                return True
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return False
+            await self._repl_wait(remaining)
 
 
 def _res_json(res: Resources) -> dict:

@@ -84,6 +84,13 @@ class Scheduler:
                 clock=store.clock,
             )
         self._task_seq = itertools.count()
+        # effect gate: on a hot standby the store event feed carries
+        # REPLICATED transactions (control/replication.py) — the leader
+        # already executed their side effects (kill fan-out, completion
+        # plugins) and the results arrive as further replicated events, so
+        # a passive scheduler must only maintain indexes, never re-execute.
+        # components.start_leader_duties flips this at promotion.
+        self.active = True
         self.columnar = None
         if self.config.use_columnar_index:
             from cook_tpu.models.columnar import ColumnarJobIndex
@@ -144,6 +151,8 @@ class Scheduler:
         """Store event feed consumer: kill-on-complete fan-out
         (monitor-tx-report-queue, scheduler.clj:378) and instance-completion
         plugin dispatch (plugins/definitions.clj:44)."""
+        if not self.active:
+            return
         if event.kind == "instance/status" and event.data["status"] in (
             "success", "failed"
         ):

@@ -115,8 +115,20 @@ class Settings:
     # identity standbys present to the leader's /replication endpoints
     # (must be in the leader's admins)
     replication_user: str = "admin"
+    # durable-on-ack submissions (datomic.clj:79 semantics): POST /jobs
+    # blocks until >= replication_min_acks standbys confirmed the write,
+    # bounded by replication_ack_timeout_s (a timeout still commits but
+    # the response carries "replicated": false)
+    replication_sync_ack: bool = False
+    replication_min_acks: int = 1
+    replication_ack_timeout_s: float = 5.0
     data_dir: str = ""                  # "" = in-memory only
     snapshot_interval_s: float = 300.0
+    # pin jax to a platform at process start ("cpu", "tpu", ...); "" =
+    # environment default.  Scheduler nodes doing pure control-plane
+    # work (tests, standbys on cpu machines) set "cpu" so a wedged or
+    # slow accelerator can never stall the scheduling loops.
+    platform: str = ""
     admins: tuple = ("admin",)
     queue_limit_per_pool: int = 1_000_000
     queue_limit_per_user: int = 100_000
@@ -180,7 +192,9 @@ def read_config(path: Optional[str] = None,
                 "rebalancer_interval_s", "optimizer_interval_s",
                 "leader_lease_path", "leader_endpoint", "leader_group",
                 "leader_ttl_s", "advertised_url", "replication_user",
-                "data_dir", "snapshot_interval_s",
+                "replication_sync_ack", "replication_min_acks",
+                "replication_ack_timeout_s",
+                "data_dir", "snapshot_interval_s", "platform",
                 "batched_match",
                 "queue_limit_per_pool",
                 "queue_limit_per_user", "submission_rate_per_minute"):

@@ -107,6 +107,89 @@ def test_http_elector_partition_grace_then_fail_fast():
     assert not elector.heartbeat()  # past TTL: fail fast
 
 
+def test_lease_server_restart_fences_old_leader_within_one_ttl():
+    """The lease service is a single in-memory process (the deployment
+    doc is honest about this): a restart erases the lease, and a standby
+    can win the re-acquire race.  The bound under test: the OLD leader's
+    next heartbeat after the restart is a DEFINITIVE loss (the restarted
+    table holds no lease, or someone else's), so the dual-leader window
+    is at most one heartbeat interval — never silent, never unbounded."""
+    server = LeaseServer(port=0).start()
+    port = server.port
+    a = HttpLeaseElector(server.url, "cook", "A", ttl_s=5, timeout_s=1.0,
+                         advertised_url="http://a:1")
+    b = HttpLeaseElector(server.url, "cook", "B", ttl_s=5, timeout_s=1.0,
+                         advertised_url="http://b:2")
+    assert a.try_acquire()
+    server.stop()
+    # restart on the same address with an EMPTY table
+    server2 = LeaseServer(port=port).start()
+    try:
+        # case 1: the standby wins the re-acquire race
+        assert b.try_acquire()
+        # old leader's next heartbeat: lease is B's (and A's epoch is from
+        # the previous server incarnation) -> definitive loss, fail fast
+        assert not a.heartbeat()
+
+        # case 2: the sitting leader re-acquires first after a restart
+        server2.table._leases.clear()
+        assert a.try_acquire()
+        # B's heartbeat (it thinks it leads from case 1) is fenced too
+        assert not b.heartbeat()
+    finally:
+        server2.stop()
+
+
+def test_lease_server_clamps_ttl_and_exact_paths():
+    """A buggy/malicious acquire with a huge TTL must not lock the group
+    to a dead member; path matching is exact."""
+    import json as json_mod
+    import urllib.error
+    import urllib.request
+
+    from cook_tpu.control.lease_server import MAX_TTL_S
+
+    clock = FakeMonoClock()
+    server = LeaseServer(clock=clock).start()
+    try:
+        def post(path, payload):
+            req = urllib.request.Request(
+                server.url + path, data=json_mod.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=2) as r:
+                return json_mod.loads(r.read())
+
+        resp = post("/acquire", {"group": "g", "member": "A",
+                                 "ttl_s": 1e9})
+        assert resp["acquired"]
+        # server-side clamp: the lease lapses after MAX_TTL_S, not 1e9 s
+        clock.t += MAX_TTL_S + 1
+        resp = post("/acquire", {"group": "g", "member": "B", "ttl_s": 10})
+        assert resp["acquired"], "huge client TTL locked the group"
+
+        # exact path match: /leaderfoo is not /leader
+        req = urllib.request.Request(server.url + "/leaderfoo")
+        try:
+            urllib.request.urlopen(req, timeout=2)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        # malformed ttl is a 400, not a 500
+        req = urllib.request.Request(
+            server.url + "/acquire",
+            data=json_mod.dumps({"group": "g", "member": "C",
+                                 "ttl_s": "bogus"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=2)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.stop()
+
+
 # ----------------------------------------------- standby replication/failover
 
 
@@ -190,6 +273,63 @@ def test_standby_replicates_and_survives_leader_disk_loss(tmp_path):
         recovered = persistence.recover(dir2)
         assert recovered is not None
         assert all(u in recovered.jobs for u in uuids + [extra])
+    finally:
+        for p in (p1, p2):
+            if p is not None:
+                shutdown(p)
+        lease.stop()
+
+
+def test_promoted_standby_schedules_replicated_job_without_new_writes(
+        tmp_path):
+    """VERDICT-r4 regression: replicated events must reach the columnar
+    rank index.  A job that arrived on the standby ONLY via replication
+    must be schedulable by the very first rank+match cycles after
+    promotion — with no REST write in between to paper over a stale
+    index."""
+    from cook_tpu.models.entities import JobState
+
+    lease = LeaseServer().start()
+    p1 = p2 = None
+    h = {"X-Cook-Requesting-User": "u"}
+    uuid = "f0000000-0000-0000-0000-000000000031"
+    try:
+        s1 = _settings(free_port(), str(tmp_path / "n1"), lease.url)
+        p1 = build_process(s1)
+        start_leader_duties(p1, block=False, on_loss=lambda: None)
+        assert p1.is_leader()
+        # leader intentionally never runs a match (intervals are 3600s):
+        # the job must reach the standby WAITING
+        assert requests.post(f"http://127.0.0.1:{s1.port}/jobs", json={
+            "jobs": [{"command": "x", "mem": 100, "cpus": 1, "uuid": uuid}],
+        }, headers=h).status_code == 201
+
+        s2 = _settings(free_port(), str(tmp_path / "n2"), lease.url)
+        p2 = build_process(s2)
+        standby = threading.Thread(
+            target=start_leader_duties, args=(p2,),
+            kwargs={"block": False, "on_loss": lambda: None}, daemon=True)
+        standby.start()
+        deadline = time.time() + 15
+        while time.time() < deadline and uuid not in p2.store.jobs:
+            time.sleep(0.1)
+        assert uuid in p2.store.jobs, "standby never replicated"
+        # the replicated event fan-out kept the standby's columnar index
+        # current the whole time — not just rebuilt at promotion
+        assert p2.scheduler.columnar.consistent_with_store()
+
+        shutdown(p1)
+        p1 = None
+        standby.join(timeout=30)
+        assert p2.is_leader(), "standby never promoted"
+
+        # first cycles after promotion, no intervening writes
+        pool = p2.store.pools["default"]
+        p2.scheduler.rank_cycle(pool)
+        p2.scheduler.match_cycle(pool)
+        assert p2.store.jobs[uuid].state == JobState.RUNNING
+        insts = p2.store.job_instances(uuid)
+        assert insts and insts[0].hostname == "h0"
     finally:
         for p in (p1, p2):
             if p is not None:

@@ -45,6 +45,9 @@ def _write_config(tmp_path, name, port, data_dir, lease_url):
         "leader_ttl_s": 2.0,
         "rank_interval_s": 0.5,
         "match_interval_s": 0.5,
+        # control-plane-only nodes: a wedged accelerator (the site hook
+        # force-registers one) must not stall the first rank cycle
+        "platform": "cpu",
         "pools": [{"name": "default"}],
         "clusters": [{
             "kind": "mock", "name": "m1",

@@ -1,0 +1,301 @@
+"""Replication durability + divergence semantics.
+
+Reference bar: a write is durable the moment the REST call returns —
+Datomic is an external replicated store (datomic.clj:79
+transact-with-retries) and failover replays from it.  Here durability
+comes from standby replication, so these tests pin:
+
+  * sync-ack mode: POST /jobs blocks until a standby confirmed the
+    write — kill the leader right after the 201 and the job exists on
+    the standby (the acked-write loss window is CLOSED, not just small).
+  * ack-timeout honesty: with no standby alive, a sync-ack submission
+    still commits but says "replicated": false.
+  * follower-ahead divergence: a deposed leader rejoining as a standby
+    with a LONGER history than the new leader is told snapshot_required
+    and converges (never silently skips).
+  * incarnation fencing: a follower that switches to a different leader
+    process forces a snapshot bootstrap even when sequence numbers look
+    contiguous — seqs are only comparable within one leader history.
+  * restore_into clears the retained event window, so a promoted
+    standby never serves pre-resync events under post-resync numbering.
+  * long-poll: a parked journal request returns as soon as a write
+    commits (replication is push-like, not 1s-poll-bounded).
+"""
+import threading
+import time
+
+import requests
+
+from cook_tpu.components import build_process, shutdown, start_leader_duties
+from cook_tpu.control.lease_server import LeaseServer
+from cook_tpu.control.replication import JournalFollower
+from cook_tpu.models import persistence
+from cook_tpu.models.entities import JobState
+from cook_tpu.rest.server import free_port
+from cook_tpu.utils.config import Settings
+
+H = {"X-Cook-Requesting-User": "u"}
+ADMIN = {"X-Cook-Requesting-User": "admin"}
+
+
+def _settings(port, data_dir, lease_url, **kw):
+    return Settings(
+        port=port, data_dir=data_dir,
+        leader_endpoint=lease_url, leader_ttl_s=3.0,
+        clusters=[{
+            "kind": "mock", "name": "m1",
+            "hosts": [{"node_id": "h0", "mem": 4000, "cpus": 8}],
+        }],
+        pools=[{"name": "default"}],
+        rank_interval_s=3600, match_interval_s=3600,
+        **kw,
+    )
+
+
+def _wait(pred, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------------------------ sync-ack
+
+
+def test_sync_ack_submission_durable_on_standby_at_201(tmp_path):
+    """Kill the leader IMMEDIATELY after the 201: in sync-ack mode the
+    job must already be on the standby — no async poll window."""
+    lease = LeaseServer().start()
+    p1 = p2 = None
+    try:
+        s1 = _settings(free_port(), str(tmp_path / "n1"), lease.url,
+                       replication_sync_ack=True,
+                       replication_ack_timeout_s=10.0)
+        p1 = build_process(s1)
+        start_leader_duties(p1, block=False, on_loss=lambda: None)
+        assert p1.is_leader()
+
+        s2 = _settings(free_port(), str(tmp_path / "n2"), lease.url)
+        p2 = build_process(s2)
+        standby = threading.Thread(
+            target=start_leader_duties, args=(p2,),
+            kwargs={"block": False, "on_loss": lambda: None}, daemon=True)
+        standby.start()
+        # wait for the standby's follower to register with the leader
+        _wait(lambda: p1.api.replication_acks, 15, "standby ack presence")
+
+        uuid = "d0000000-0000-0000-0000-000000000001"
+        r = requests.post(f"http://127.0.0.1:{s1.port}/jobs", json={
+            "jobs": [{"command": "x", "mem": 100, "cpus": 1, "uuid": uuid}],
+        }, headers=H, timeout=15)
+        assert r.status_code == 201
+        assert "replicated" not in r.json(), "ack timeout despite standby"
+        # the durability claim: at this instant, with the leader frozen,
+        # the standby already holds the job in ITS store
+        assert uuid in p2.store.jobs
+        # and on its own disk (a cold recover of the standby's dir works)
+        shutdown(p1)
+        p1 = None
+        recovered = persistence.recover(s2.data_dir)
+        assert recovered is not None and uuid in recovered.jobs
+    finally:
+        for p in (p1, p2):
+            if p is not None:
+                shutdown(p)
+        lease.stop()
+
+
+def test_sync_ack_timeout_commits_but_reports(tmp_path):
+    """No standby at all: the write still commits locally, but the
+    response is honest about the durability bound."""
+    lease = LeaseServer().start()
+    s = _settings(free_port(), str(tmp_path / "n1"), lease.url,
+                  replication_sync_ack=True,
+                  replication_ack_timeout_s=0.3)
+    p = build_process(s)
+    try:
+        start_leader_duties(p, block=False, on_loss=lambda: None)
+        uuid = "d0000000-0000-0000-0000-000000000002"
+        r = requests.post(f"http://127.0.0.1:{s.port}/jobs", json={
+            "jobs": [{"command": "x", "mem": 100, "cpus": 1, "uuid": uuid}],
+        }, headers=H, timeout=10)
+        assert r.status_code == 201
+        assert r.json().get("replicated") is False
+        assert uuid in p.store.jobs  # committed regardless
+    finally:
+        shutdown(p)
+        lease.stop()
+
+
+# ------------------------------------------------------- divergence handling
+
+
+def test_follower_ahead_gets_snapshot_required(tmp_path):
+    """A standby that outlived a deposed leader can be AHEAD of the new
+    leader's history; the journal feed must answer snapshot_required, and
+    the follower must converge to the new leader's state."""
+    s1 = _settings(free_port(), str(tmp_path / "n1"), "")
+    s1.leader_endpoint = ""
+    p1 = build_process(s1)
+    try:
+        url = f"http://127.0.0.1:{s1.port}"
+        assert requests.post(f"{url}/jobs", json={"jobs": [
+            {"command": "x", "mem": 100, "cpus": 1,
+             "uuid": "d0000000-0000-0000-0000-000000000003"},
+        ]}, headers=H).status_code == 201
+        leader_seq = p1.store.last_seq()
+
+        # ask for events past a seq the leader never reached
+        r = requests.get(
+            f"{url}/replication/journal?after_seq={leader_seq + 50}",
+            headers=ADMIN)
+        assert r.status_code == 200
+        assert r.json().get("snapshot_required") is True
+
+        # a full follower with a diverged (longer) history converges
+        from cook_tpu.models.store import JobStore
+
+        diverged = JobStore()
+        diverged.reset_seq(leader_seq + 50)
+        follower = JournalFollower(diverged, leader_url_fn=lambda: url)
+        follower.sync_once()
+        assert follower.full_resyncs == 1
+        assert diverged.last_seq() == leader_seq
+        assert "d0000000-0000-0000-0000-000000000003" in diverged.jobs
+    finally:
+        shutdown(p1)
+
+
+def test_incarnation_change_forces_snapshot_bootstrap(tmp_path):
+    """Two leader processes with equal-length but different histories:
+    switching the follower between them must trigger a full resync (seq
+    numbers alone cannot detect the divergence)."""
+    pa = pb = None
+    try:
+        sa = _settings(free_port(), str(tmp_path / "na"), "")
+        sa.leader_endpoint = ""
+        pa = build_process(sa)
+        sb = _settings(free_port(), str(tmp_path / "nb"), "")
+        sb.leader_endpoint = ""
+        pb = build_process(sb)
+        url_a = f"http://127.0.0.1:{sa.port}"
+        url_b = f"http://127.0.0.1:{sb.port}"
+        for url, uuid in ((url_a, "d0000000-0000-0000-0000-00000000000a"),
+                          (url_b, "d0000000-0000-0000-0000-00000000000b")):
+            assert requests.post(f"{url}/jobs", json={"jobs": [
+                {"command": "x", "mem": 100, "cpus": 1, "uuid": uuid},
+            ]}, headers=H).status_code == 201
+        assert pa.store.last_seq() == pb.store.last_seq()
+
+        from cook_tpu.models.store import JobStore
+
+        store = JobStore()
+        current = {"url": url_a}
+        follower = JournalFollower(store, leader_url_fn=lambda: current["url"])
+        follower.sync_once()
+        assert "d0000000-0000-0000-0000-00000000000a" in store.jobs
+        # switch leaders: same seq, different incarnation + history
+        current["url"] = url_b
+        follower.sync_once()
+        assert follower.full_resyncs >= 1, \
+            "incarnation change did not force a snapshot bootstrap"
+        assert "d0000000-0000-0000-0000-00000000000b" in store.jobs
+        assert "d0000000-0000-0000-0000-00000000000a" not in store.jobs
+    finally:
+        for p in (pa, pb):
+            if p is not None:
+                shutdown(p)
+
+
+def test_restore_into_clears_event_window():
+    """After a snapshot bootstrap the pre-resync event window is gone: a
+    promoted standby must never serve old events under new numbering."""
+    from cook_tpu.models.store import JobStore
+    from tests.conftest import make_job
+
+    src = JobStore()
+    from cook_tpu.models.entities import Pool
+
+    src.set_pool(Pool(name="default"))
+    src.submit_jobs([make_job(user="u")])
+    state = persistence.snapshot_state(src)
+
+    dst = JobStore()
+    dst.set_pool(Pool(name="default"))
+    dst.submit_jobs([make_job(user="w")])  # pre-resync events
+    assert dst.events_since(0)
+    persistence.restore_into(dst, state)
+    assert dst.events_since(0) == []
+    assert dst.last_seq() == src.last_seq()
+
+
+def test_live_apply_events_enter_window_and_journal(tmp_path):
+    """Replicated events become ordinary committed events: retained in
+    the window (a promoted standby serves them) and journaled via the
+    watcher fan-out (exactly once)."""
+    from cook_tpu.models.entities import Pool
+    from cook_tpu.models.store import JobStore
+    from tests.conftest import make_job
+
+    leader = JobStore()
+    leader.set_pool(Pool(name="default"))
+    leader.submit_jobs([make_job(user="u"), make_job(user="v")])
+    entries = [__import__("json").loads(e.to_json())
+               for e in leader.events_since(0)]
+
+    standby = JobStore()
+    journal = persistence.attach_journal(
+        standby, str(tmp_path / "journal.jsonl"))
+    with standby._lock:
+        applied = persistence.apply_journal(standby, entries, live=True)
+    assert applied == len(entries)
+    # the window now serves the same events
+    assert [e.seq for e in standby.events_since(0)] == \
+        [e["seq"] for e in entries]
+    # journaled exactly once, replayable
+    journal.close()
+    replayed = persistence.read_journal(str(tmp_path / "journal.jsonl"))
+    assert [e["seq"] for e in replayed] == [e["seq"] for e in entries]
+    cold = JobStore()
+    persistence.apply_journal(cold, replayed)
+    assert set(cold.jobs) == set(leader.jobs)
+
+
+# ------------------------------------------------------------------ long-poll
+
+
+def test_journal_long_poll_returns_on_commit(tmp_path):
+    """A parked long-poll unblocks as soon as a write commits — the
+    push-like path sync-ack latency depends on."""
+    s = _settings(free_port(), str(tmp_path / "n1"), "")
+    s.leader_endpoint = ""
+    p = build_process(s)
+    try:
+        url = f"http://127.0.0.1:{s.port}"
+        seq0 = p.store.last_seq()
+        results = {}
+
+        def poll():
+            t0 = time.monotonic()
+            r = requests.get(
+                f"{url}/replication/journal?after_seq={seq0}&wait_s=10",
+                headers=ADMIN, timeout=15)
+            results["elapsed"] = time.monotonic() - t0
+            results["events"] = r.json().get("events", [])
+
+        t = threading.Thread(target=poll, daemon=True)
+        t.start()
+        time.sleep(0.5)  # let the poll park
+        assert requests.post(f"{url}/jobs", json={"jobs": [
+            {"command": "x", "mem": 100, "cpus": 1,
+             "uuid": "d0000000-0000-0000-0000-000000000004"},
+        ]}, headers=H).status_code == 201
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert results["events"], "long-poll returned no events"
+        # returned well before the 10s window: woke on the commit
+        assert results["elapsed"] < 5.0
+    finally:
+        shutdown(p)
