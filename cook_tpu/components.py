@@ -191,8 +191,14 @@ def build_process(
 ) -> CookProcess:
     store = None
     if settings.data_dir:
-        # failover recovery: load the last snapshot, then replay the journal
-        # suffix after it (every acknowledged write survives)
+        # failover recovery: load the last snapshot, then replay the
+        # journal suffix after it.  Durability bound: mutations committed
+        # through the transaction pipeline (cook_tpu.txn — every REST
+        # mutation) are group-fsynced before the call returns, so every
+        # acknowledged REST write survives; scheduler-internal events
+        # between txn commits ride the journal's batched fsync
+        # (JournalWriter.fsync_every) and a crash of the OS (not just the
+        # process) may lose up to that many of them.
         import os
 
         from cook_tpu.models import persistence
@@ -238,7 +244,9 @@ def build_process(
         plugins=plugins,
     )
     from cook_tpu.rest.auth import authenticator_from_config
+    from cook_tpu.txn import TransactionLog
 
+    txn = TransactionLog(store, journal=journal)
     api = CookApi(store, scheduler, ApiConfig(
         default_pool=settings.default_pool,
         admins=settings.admins,
@@ -250,7 +258,8 @@ def build_process(
         replication_sync_ack=settings.replication_sync_ack,
         replication_min_acks=settings.replication_min_acks,
         replication_ack_timeout_s=settings.replication_ack_timeout_s,
-    ), plugins=plugins)
+        replication_ack_liveness_s=settings.replication_ack_liveness_s,
+    ), plugins=plugins, txn=txn)
     api.queue_limits.limits.per_pool = settings.queue_limit_per_pool
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user
     process = CookProcess(settings=settings, store=store, clusters=clusters,

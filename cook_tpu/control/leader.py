@@ -154,6 +154,13 @@ class HttpLeaseElector(LeaderElector):
     leadership survives transient partitions up to one TTL past the last
     confirmed renewal; beyond that the service may have re-granted the
     lease, and we must fail fast rather than risk two leaders.
+
+    The TTL used for that grace window is the EFFECTIVE one the service
+    reports back in /acquire and /heartbeat responses (the server clamps
+    requested TTLs, lease_server.MAX_TTL_S): grace-checking against a
+    configured-but-clamped TTL would keep a partitioned leader seated
+    after the service already re-granted the lease — a two-leader
+    window.
     """
 
     def __init__(self, endpoint: str, group: str, member_id: str,
@@ -169,6 +176,9 @@ class HttpLeaseElector(LeaderElector):
         self.clock = clock
         self._epoch = 0
         self._last_renewal: Optional[float] = None
+        # effective TTL granted by the service (it may clamp ttl_s);
+        # adopted from every /acquire and /heartbeat response
+        self.effective_ttl_s = ttl_s
 
     def _post(self, path: str, payload: dict) -> Optional[dict]:
         req = urllib.request.Request(
@@ -202,8 +212,17 @@ class HttpLeaseElector(LeaderElector):
         if resp is None or not resp.get("acquired"):
             return False
         self._epoch = int(resp.get("epoch", 0))
+        self._adopt_ttl(resp)
         self._last_renewal = t0
         return True
+
+    def _adopt_ttl(self, resp: dict) -> None:
+        try:
+            granted = float(resp.get("ttl_s", self.ttl_s))
+        except (TypeError, ValueError):
+            return
+        if granted > 0:
+            self.effective_ttl_s = granted
 
     def heartbeat(self) -> bool:
         t0 = self.clock()
@@ -212,11 +231,14 @@ class HttpLeaseElector(LeaderElector):
             "epoch": self._epoch, "ttl_s": self.ttl_s})
         if resp is None:
             # indeterminate: the service is unreachable, not lost — keep
-            # leading until the lease could actually have lapsed
+            # leading until the lease could actually have lapsed, per the
+            # TTL the service actually granted (not the configured ask)
             last = self._last_renewal
-            return last is not None and self.clock() - last < self.ttl_s
+            return last is not None and \
+                self.clock() - last < self.effective_ttl_s
         if not resp.get("ok"):
             return False
+        self._adopt_ttl(resp)
         self._last_renewal = t0
         return True
 

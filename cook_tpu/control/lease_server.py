@@ -73,8 +73,13 @@ class LeaseTable:
             self._leases[group] = _Lease(leader=member, url=url,
                                          epoch=self._epoch,
                                          deadline=now + ttl_s)
+            # the EFFECTIVE (possibly clamped) TTL goes back to the
+            # client: the elector's unreachable-service grace window must
+            # match what the server actually granted, or a clamped lease
+            # leaves the old leader believing it holds a longer one — a
+            # two-leader window
             return {"acquired": True, "leader": member, "url": url,
-                    "epoch": self._epoch}
+                    "epoch": self._epoch, "ttl_s": ttl_s}
 
     def heartbeat(self, group: str, member: str, epoch: int,
                   ttl_s: float) -> dict:
@@ -87,7 +92,7 @@ class LeaseTable:
                     and lease.deadline > now else None
                 return {"ok": False, "leader": current}
             lease.deadline = now + ttl_s
-            return {"ok": True, "leader": member}
+            return {"ok": True, "leader": member, "ttl_s": ttl_s}
 
     def release(self, group: str, member: str, epoch: int) -> dict:
         with self._lock:

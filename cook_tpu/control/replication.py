@@ -158,15 +158,33 @@ class JournalFollower:
                 applied += self._apply(events)
             if not resp.get("more"):
                 break
-        # confirm what we hold: sync-ack submissions on the leader block
-        # until a standby's ack covers them (rest/api.py:_await_replication)
+        # confirm what we hold: sync-ack commits on the leader block
+        # until a standby's ack covers them (rest/api.py:_await_replication).
+        # Only a follower with a local journal/data_dir may claim the
+        # durable flag — "applied AND journaled locally" — a memory-only
+        # follower's ack must not satisfy the leader's durability bound
+        # (the leader skips non-durable acks when counting min_acks).
         if not self._stop.is_set():
             seq = self.store.last_seq()
             if seq != self._last_acked and leader:
+                durable = self.is_durable()
+                if durable and self.journal is not None:
+                    # the durable claim is "on OUR disk": group-fsync the
+                    # journal BEFORE the ack leaves, or an OS crash after
+                    # the ack could still lose the write the leader just
+                    # told its client was replicated
+                    self.journal.sync()
                 if self._post(f"{leader}/replication/ack",
-                              {"follower": self.member_id, "seq": seq}):
+                              {"follower": self.member_id, "seq": seq,
+                               "durable": durable}):
                     self._last_acked = seq
         return applied
+
+    def is_durable(self) -> bool:
+        """Whether acks may claim "journaled locally": this follower
+        persists what it applies (an attached journal writer, or a
+        data_dir it snapshots into)."""
+        return self.journal is not None or bool(self.data_dir)
 
     def _apply(self, events: list[dict]) -> int:
         # live mode: each entry becomes an ordinary committed event on our
@@ -221,11 +239,13 @@ class JournalFollower:
 
     def stop(self) -> None:
         """Stop tailing and JOIN the sync thread fully.  The join timeout
-        must cover a whole in-flight fetch (timeout_s): promotion calls
-        this before taking writes, and a late response from a deposed
-        leader applying after promotion would clobber the new leader's
-        state (the sync loop also re-checks _stop after every fetch as a
-        second line of defense)."""
+        must cover the longest possible in-flight fetch — a long-poll
+        parks on the leader for long_poll_s on top of the transport
+        timeout (sync_once passes timeout_s + wait_s to urlopen) — plus
+        slack: promotion calls this before taking writes, and a late
+        response from a deposed leader applying after promotion would
+        clobber the new leader's state (the sync loop also re-checks
+        _stop after every fetch as a second line of defense)."""
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=self.timeout_s + 5)
+            self._thread.join(timeout=self.timeout_s + self.long_poll_s + 5)

@@ -47,7 +47,9 @@ def snapshot_state(store: JobStore) -> dict:
         shares = list(store.shares.values())
         quotas = list(store.quotas.values())
         dynamic_config = dict(store.dynamic_config)
+        txns = dict(store.txn_results)
     return {
+        "txns": txns,
         "seq": seq,
         "jobs": {k: codec.encode(v) for k, v in jobs.items()},
         "instances": {k: codec.encode(v) for k, v in instances.items()},
@@ -96,6 +98,7 @@ def restore_into(store: JobStore, state: dict) -> None:
         store.shares.clear()
         store.quotas.clear()
         store.dynamic_config = {}
+        store.txn_results.clear()
         store._user_jobs.clear()
         store._pool_pending.clear()
         store._pool_running.clear()
@@ -123,6 +126,7 @@ def _populate(store: JobStore, state: dict) -> None:
         quota = codec.dec_quota(v)
         store.quotas[(quota.user, quota.pool)] = quota
     store.dynamic_config = state.get("dynamic_config", {})
+    store.txn_results.update(state.get("txns", {}))
     store.reset_seq(state["seq"])
 
 
@@ -157,25 +161,37 @@ def _truncate_torn_tail(path: str) -> None:
 
 
 class JournalWriter:
-    """Append-only event journal (one JSON line per committed event)."""
+    """Append-only event journal (one JSON line per committed event).
 
-    def __init__(self, path: str, *, fsync_every: int = 0):
+    Durability is batched by default: every write is flushed to the OS,
+    but fsync happens every `fsync_every` events OR whenever `sync()` is
+    called.  The transaction pipeline (cook_tpu.txn) calls `sync()` once
+    per commit before the write is acknowledged — group commit: one
+    fsync covers every event flushed so far, so concurrent commits share
+    the disk barrier.  fsync_every is the backstop bound for writes that
+    bypass the txn pipeline (scheduler-internal status updates): at most
+    that many non-txn events are exposed to an OS crash (process crashes
+    lose nothing — the data is in the page cache)."""
+
+    DEFAULT_FSYNC_EVERY = 64
+
+    def __init__(self, path: str, *, fsync_every: int = DEFAULT_FSYNC_EVERY):
         self.path = path
         self.fsync_every = fsync_every
         self._count = 0
+        self._dirty = False
         import threading
 
         self._lock = threading.Lock()
         _truncate_torn_tail(path)
         self._f = open(path, "a")
 
+    def _fsync_locked(self) -> None:
+        os.fsync(self._f.fileno())
+        self._dirty = False
+
     def __call__(self, event: Event) -> None:
-        with self._lock:
-            self._f.write(event.to_json() + "\n")
-            self._f.flush()
-            self._count += 1
-            if self.fsync_every and self._count % self.fsync_every == 0:
-                os.fsync(self._f.fileno())
+        self.write_line(event.to_json())
 
     def write_line(self, line: str) -> None:
         """Append a pre-serialized journal line (the replication follower
@@ -186,8 +202,17 @@ class JournalWriter:
             self._f.write(line.rstrip("\n") + "\n")
             self._f.flush()
             self._count += 1
+            self._dirty = True
             if self.fsync_every and self._count % self.fsync_every == 0:
-                os.fsync(self._f.fileno())
+                self._fsync_locked()
+
+    def sync(self) -> None:
+        """Group-commit barrier: fsync anything flushed since the last
+        sync.  A no-op when nothing is dirty — so of N concurrent
+        commits, whichever syncs first pays the fsync for all of them."""
+        with self._lock:
+            if self._dirty and not self._f.closed:
+                self._fsync_locked()
 
     def rotate(self) -> None:
         """After a snapshot, the journal prefix is redundant: move it aside
@@ -197,9 +222,12 @@ class JournalWriter:
             if os.path.exists(self.path):
                 os.replace(self.path, self.path + ".1")
             self._f = open(self.path, "a")
+            self._dirty = False
 
     def close(self) -> None:
         with self._lock:
+            if not self._f.closed and self._dirty:
+                self._fsync_locked()
             self._f.close()
 
 
@@ -300,6 +328,12 @@ def apply_journal(store: JobStore, events: list[dict],
             store.quotas.pop((data["user"], data["pool"]), None)
         elif kind == "config/updated":
             store.dynamic_config.update(data.get("updates", {}))
+        elif kind == "txn/committed":
+            # rebuild the idempotency table: a promoted standby (or a
+            # recovered leader) must answer retried commits of acked
+            # transactions without re-applying them (cook_tpu.txn)
+            store.record_txn(data.get("txn_id", ""), data.get("op", ""),
+                             seq, data.get("result"))
         if live:
             event = Event(seq=seq, kind=kind, data=data,
                           entities=decoded or None)

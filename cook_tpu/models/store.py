@@ -97,6 +97,13 @@ class JobStore:
         # runtime-mutable config (reference: Datomic-resident rebalancer params
         # + incremental configs)
         self.dynamic_config: dict[str, Any] = {}
+        # committed-transaction table: txn_id -> {op, seq, result}
+        # (cook_tpu.txn) — the idempotency record.  Replicated via
+        # txn/committed events and included in snapshots, so a promoted
+        # standby answers retried commits of acked transactions without
+        # re-applying them.  Insertion-ordered; bounded by
+        # TXN_RESULTS_WINDOW.
+        self.txn_results: dict[str, dict[str, Any]] = {}
 
         # secondary indexes
         self._user_jobs: dict[str, set[str]] = {}
@@ -156,6 +163,34 @@ class JobStore:
         for event in events:
             for watcher in list(self._watchers):
                 watcher(event)
+
+    # ----------------------------------------------------------- transactions
+
+    # committed-transaction records retained for idempotency answers; old
+    # enough duplicates (>10k commits ago) re-apply, which is safe for
+    # every registered op (all are state-idempotent upserts/kills)
+    TXN_RESULTS_WINDOW = 10_000
+
+    def record_txn(self, txn_id: str, op: str, seq: int, result: Any) -> None:
+        """Remember a committed transaction's outcome (also called from
+        journal/replication replay, persistence.apply_journal)."""
+        with self._lock:
+            self.txn_results[txn_id] = {"op": op, "seq": seq,
+                                        "result": result}
+            while len(self.txn_results) > self.TXN_RESULTS_WINDOW:
+                self.txn_results.pop(next(iter(self.txn_results)))
+
+    def note_txn(self, txn_id: str, op: str, result: Any) -> int:
+        """Seal a transaction: emit the txn/committed record event (it
+        replicates and journals like any entity event) and record the
+        outcome for idempotency.  Called by cook_tpu.txn with the store
+        lock held, right after the op handler applied."""
+        with self._lock:
+            event = self._emit("txn/committed",
+                               {"txn_id": txn_id, "op": op, "result": result})
+            self.record_txn(txn_id, op, event.seq, result)
+            self._fan_out([event])
+            return event.seq
 
     # ---------------------------------------------------------------- indexes
 

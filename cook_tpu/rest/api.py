@@ -49,6 +49,7 @@ from cook_tpu.scheduler.core import Scheduler
 from cook_tpu.scheduler.plugins import PluginRegistry
 from cook_tpu.scheduler.queue_limit import QueueLimitChecker
 from cook_tpu.scheduler.ratelimit import TokenBucketRateLimiter, UnlimitedRateLimiter
+from cook_tpu.txn import TransactionLog, TxnOutcome
 from cook_tpu.utils.metrics import global_registry
 
 
@@ -80,15 +81,22 @@ class ApiConfig:
     executor_token: str = ""
     # sync-ack replication (the reference's durable-on-ack semantics,
     # datomic.clj:79 transact-with-retries: a write survives leader death
-    # the moment the REST call returns).  When enabled, POST /jobs blocks
-    # until >= replication_min_acks standbys have ACKed a sequence number
-    # covering the submission, or the timeout lapses — a timeout still
+    # the moment the REST call returns).  When enabled, EVERY mutating
+    # endpoint (submit, kill, retry, share/quota, group ops, pool moves,
+    # config updates — all committed through cook_tpu.txn) blocks until
+    # >= replication_min_acks standbys have ACKed a sequence number
+    # covering the commit, or the timeout lapses — a timeout still
     # commits (the write is applied and journaled locally) but the
-    # response carries "replicated": false so callers know the durability
+    # response carries "replicated": false (JSON bodies) or
+    # X-Cook-Replicated: false (204s) so callers know the durability
     # bound was not met.
     replication_sync_ack: bool = False
     replication_min_acks: int = 1
     replication_ack_timeout_s: float = 5.0
+    # acks older than this stop counting toward min_acks (and are
+    # pruned): a decommissioned standby's last ack must not satisfy the
+    # durability bound forever.  <= 0 disables liveness qualification.
+    replication_ack_liveness_s: float = 30.0
 
 
 class CookApi:
@@ -98,11 +106,16 @@ class CookApi:
         scheduler: Optional[Scheduler] = None,
         config: Optional[ApiConfig] = None,
         plugins: Optional[PluginRegistry] = None,
+        txn: Optional[TransactionLog] = None,
     ):
         self.store = store
         self.scheduler = scheduler
         self.config = config or ApiConfig()
         self.plugins = plugins or PluginRegistry()
+        # the durable commit pipeline every mutating handler goes through
+        # (components.py wires the journal in; a bare CookApi commits
+        # in-memory only)
+        self.txn = txn or TransactionLog(store)
         self.queue_limits = QueueLimitChecker(store)
         if self.config.submission_rate_per_minute > 0:
             self.submission_limiter = TokenBucketRateLimiter(
@@ -128,9 +141,16 @@ class CookApi:
         import uuid as _uuid
 
         self.incarnation = _uuid.uuid4().hex[:12]
-        # follower -> highest event seq it has confirmed applied
-        # (POST /replication/ack); read by sync-ack submissions
+        # follower -> highest event seq it has confirmed applied AND
+        # journaled locally (POST /replication/ack with durable=true);
+        # read by sync-ack commits.  Acks from followers without local
+        # durability (no journal/data_dir) are tracked in
+        # replication_ack_meta only — they must not satisfy min_acks,
+        # or "replicated: true" would not mean what it says.
         self.replication_acks: dict[str, int] = {}
+        # follower -> {seq, durable, time(monotonic)} for every ack seen;
+        # liveness pruning keys off `time`
+        self.replication_ack_meta: dict[str, dict] = {}
         # long-poll/sync-ack wakeups: per-waiter events, set from the
         # store's watcher thread via call_soon_threadsafe
         self._repl_waiters: set = set()
@@ -158,6 +178,7 @@ class CookApi:
         r.add_post("/quota", self.post_quota)
         r.add_delete("/quota", self.delete_quota)
         r.add_get("/usage", self.get_usage)
+        r.add_post("/pool-move", self.post_pool_move)
         r.add_get("/retry", self.get_retry)
         r.add_post("/retry", self.post_retry)
         r.add_put("/retry", self.post_retry)
@@ -304,10 +325,62 @@ class CookApi:
                 return True
         return False
 
+    # ------------------------------------------------------- txn commit seam
+
+    async def _run_commit(self, op: str, payload: dict,
+                          txn_id: Optional[str]) -> TxnOutcome:
+        """Run the (synchronous) commit pipeline in the default executor:
+        it ends in an fsync (+ possible retry backoff sleeps), which must
+        not stall the event loop — and off-loop commits let the journal's
+        group-commit sync() actually merge concurrent commits into one
+        disk barrier."""
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.txn.commit(op, payload, txn_id=txn_id))
+
+    async def _commit(self, request: web.Request, op: str, payload: dict,
+                      *, txn_suffix: str = "") -> TxnOutcome:
+        """Commit one mutation through the transaction pipeline and, in
+        sync-ack mode, await the replication durability bound (the
+        datomic.clj:79 durable-on-ack semantics, now for EVERY mutation
+        type).  Clients may pass X-Cook-Txn-Id: a retried request with
+        the same id is answered from the transaction table, not
+        re-applied — on this leader or a promoted standby."""
+        txn_id = request.headers.get("X-Cook-Txn-Id") or None
+        if txn_id and txn_suffix:
+            txn_id = f"{txn_id}:{txn_suffix}"
+        outcome = await self._run_commit(op, payload, txn_id)
+        outcome.replicated = True
+        if self.config.replication_sync_ack and not outcome.duplicate:
+            outcome.replicated = await self._await_replication(outcome.seq)
+            if not outcome.replicated:
+                global_registry.counter("replication_ack_timeouts").inc()
+        return outcome
+
+    @staticmethod
+    def _no_content(outcome: TxnOutcome) -> web.Response:
+        """204 for a committed mutation; an unmet replication bound is
+        flagged in a header (a 204 carries no body to say it in)."""
+        response = web.Response(status=204)
+        if outcome.replicated is False:
+            response.headers["X-Cook-Replicated"] = "false"
+        return response
+
     # ------------------------------------------------------------------ jobs
 
     async def post_jobs(self, request: web.Request) -> web.Response:
         user = request["user"]
+        # a RETRIED submission (same X-Cook-Txn-Id) must be answered
+        # from the transaction table before parsing: the first commit's
+        # jobs exist now, so re-parsing would 400 "already exists" on
+        # exactly the requests idempotency is for
+        txn_id = request.headers.get("X-Cook-Txn-Id")
+        if txn_id:
+            cached = self.store.txn_results.get(txn_id)
+            if cached is not None and cached.get("op") == "jobs/submit":
+                return web.json_response(dict(cached.get("result") or {}),
+                                         status=201)
         body = await request.json()
         specs = body.get("jobs", [])
         group_specs = body.get("groups", [])
@@ -361,22 +434,19 @@ class CookApi:
             if limit_err:
                 return _err(400, limit_err)
         try:
-            self.store.submit_jobs(jobs, list(groups.values()))
+            outcome = await self._commit(
+                request, "jobs/submit",
+                {"jobs": jobs, "groups": list(groups.values())})
         except TransactionVetoed as e:
             return _err(400, str(e))
-        global_registry.counter("jobs_submitted").inc(len(jobs))
-        if self.config.replication_sync_ack:
-            # durable-on-ack (datomic.clj:79): don't 201 until a standby
-            # holds the submission; a timeout still commits, but says so
-            replicated = await self._await_replication(self.store.last_seq())
-            if not replicated:
-                global_registry.counter("replication_ack_timeouts").inc()
-                return web.json_response(
-                    {"jobs": [j.uuid for j in jobs], "replicated": False},
-                    status=201)
-        return web.json_response(
-            {"jobs": [j.uuid for j in jobs]}, status=201
-        )
+        if not outcome.duplicate:
+            global_registry.counter("jobs_submitted").inc(len(jobs))
+        body = dict(outcome.result or {"jobs": [j.uuid for j in jobs]})
+        if outcome.replicated is False:
+            # durable-on-ack (datomic.clj:79): the commit stands, but the
+            # standby durability bound was not met — say so
+            body["replicated"] = False
+        return web.json_response(body, status=201)
 
     def _parse_job(self, spec: dict, user: str, pool: str,
                    groups: dict[str, Group]) -> tuple[Optional[Job], Optional[str]]:
@@ -612,9 +682,10 @@ class CookApi:
                 return _err(404, f"unknown job {uuid}")
             if job.user != user and user not in self.config.admins:
                 return _err(403, f"not authorized to kill {uuid}")
-        self.store.kill_jobs(uuids)
-        global_registry.counter("jobs_killed").inc(len(uuids))
-        return web.Response(status=204)
+        outcome = await self._commit(request, "jobs/kill", {"uuids": uuids})
+        if not outcome.duplicate:
+            global_registry.counter("jobs_killed").inc(len(uuids))
+        return self._no_content(outcome)
 
     # ------------------------------------------------------------- instances
 
@@ -648,11 +719,11 @@ class CookApi:
             job = self.store.jobs[inst.job_uuid]
             if job.user != user and user not in self.config.admins:
                 return _err(403, f"not authorized to cancel {uuid}")
-        for uuid in uuids:
-            self.store.mark_instance_cancelled(uuid)
+        outcome = await self._commit(request, "instance/cancel",
+                                     {"task_ids": uuids})
         if self.scheduler is not None:
             self.scheduler.kill_cancelled_tasks()
-        return web.Response(status=204)
+        return self._no_content(outcome)
 
     # ---------------------------------------------------------------- groups
 
@@ -690,11 +761,10 @@ class CookApi:
     async def delete_groups(self, request: web.Request) -> web.Response:
         uuids = request.query.getall("uuid", [])
         for uuid in uuids:
-            group = self.store.groups.get(uuid)
-            if group is None:
+            if uuid not in self.store.groups:
                 return _err(404, f"unknown group {uuid}")
-            self.store.kill_jobs(group.job_uuids)
-        return web.Response(status=204)
+        outcome = await self._commit(request, "group/kill", {"groups": uuids})
+        return self._no_content(outcome)
 
     # ------------------------------------------------------------ share/quota
 
@@ -715,7 +785,7 @@ class CookApi:
         res = body.get("share", {})
         if not user:
             return _err(400, "user required")
-        self.store.set_share(Share(
+        outcome = await self._commit(request, "share/set", {"share": Share(
             user=user, pool=pool,
             resources=Resources(
                 mem=float(res.get("mem", 0)),
@@ -723,17 +793,20 @@ class CookApi:
                 gpus=float(res.get("gpus", 0)),
             ),
             reason=body.get("reason", ""),
-        ))
-        return web.json_response(_res_json(self.store.get_share(user, pool)),
-                                 status=201)
+        )})
+        body_out = _res_json(self.store.get_share(user, pool))
+        if outcome.replicated is False:
+            body_out["replicated"] = False
+        return web.json_response(body_out, status=201)
 
     async def delete_share(self, request: web.Request) -> web.Response:
         if request["user"] not in self.config.admins:
             return _err(403, "only admins may modify shares")
         user = request.query.get("user")
         pool = request.query.get("pool", self.config.default_pool)
-        self.store.retract_share(user, pool)
-        return web.Response(status=204)
+        outcome = await self._commit(request, "share/retract",
+                                     {"user": user, "pool": pool})
+        return self._no_content(outcome)
 
     async def get_quota(self, request: web.Request) -> web.Response:
         user = request.query.get("user")
@@ -755,7 +828,7 @@ class CookApi:
         if not user:
             return _err(400, "user required")
         inf = float("inf")
-        self.store.set_quota(Quota(
+        outcome = await self._commit(request, "quota/set", {"quota": Quota(
             user=user, pool=pool,
             resources=Resources(
                 mem=float(res.get("mem", inf)),
@@ -764,16 +837,20 @@ class CookApi:
             ),
             count=int(res.get("count", 2**31)),
             reason=body.get("reason", ""),
-        ))
-        return web.json_response({"user": user, "pool": pool}, status=201)
+        )})
+        body_out = {"user": user, "pool": pool}
+        if outcome.replicated is False:
+            body_out["replicated"] = False
+        return web.json_response(body_out, status=201)
 
     async def delete_quota(self, request: web.Request) -> web.Response:
         if request["user"] not in self.config.admins:
             return _err(403, "only admins may modify quotas")
         user = request.query.get("user")
         pool = request.query.get("pool", self.config.default_pool)
-        self.store.retract_quota(user, pool)
-        return web.Response(status=204)
+        outcome = await self._commit(request, "quota/retract",
+                                     {"user": user, "pool": pool})
+        return self._no_content(outcome)
 
     async def get_usage(self, request: web.Request) -> web.Response:
         user = request.query.get("user")
@@ -816,21 +893,78 @@ class CookApi:
             return _err(400, "no jobs specified")
         retries = body.get("retries")
         increment = body.get("increment")
+        if retries is None and increment is None:
+            return _err(400, "retries or increment required")
+        txn_id = request.headers.get("X-Cook-Txn-Id") or None
+        last_seq = 0
+        duplicates = 0
         for uuid in uuids:
             if uuid not in self.store.jobs:
                 return _err(404, f"unknown job {uuid}")
             try:
-                if retries is not None:
-                    self.store.retry_job(uuid, int(retries))
-                elif increment is not None:
-                    self.store.retry_job(uuid, int(increment), increment=True)
-                else:
-                    return _err(400, "retries or increment required")
+                # one transaction per job (each is one atomic retry
+                # commit); a client txn id fans out per-job so retried
+                # batches dedupe jobwise.  An absolute `retries` wins
+                # over `increment` when both are present (the original
+                # precedence).
+                outcome = await self._run_commit(
+                    "job/retry",
+                    {"uuid": uuid,
+                     "retries": int(retries if retries is not None
+                                    else increment),
+                     "increment": retries is None},
+                    txn_id=f"{txn_id}:{uuid}" if txn_id else None)
             except (TransactionVetoed, ValueError) as e:
                 return _err(400, str(e))
-        return web.json_response(
-            {"jobs": uuids}, status=201
-        )
+            last_seq = max(last_seq, outcome.seq)
+            duplicates += outcome.duplicate
+        body_out = {"jobs": uuids}
+        if self.config.replication_sync_ack and duplicates < len(uuids):
+            # one replication wait covers the whole batch (acks are
+            # cumulative sequence numbers)
+            if not await self._await_replication(last_seq):
+                global_registry.counter("replication_ack_timeouts").inc()
+                body_out["replicated"] = False
+        return web.json_response(body_out, status=201)
+
+    # ------------------------------------------------------------- pool move
+
+    async def post_pool_move(self, request: web.Request) -> web.Response:
+        """Move WAITING jobs to another pool (the reference's pool mover,
+        plugins/pool_mover.clj, as an admin mutation instead of a
+        submission-time adjuster)."""
+        if request["user"] not in self.config.admins:
+            return _err(403, "only admins may move jobs between pools")
+        body = await request.json()
+        uuids = body.get("jobs") or ([body["job"]] if "job" in body else [])
+        pool = body.get("pool")
+        if not uuids or not pool:
+            return _err(400, "jobs and pool required")
+        if pool not in self.store.pools:
+            return _err(400, f"unknown pool {pool}")
+        for uuid in uuids:
+            if uuid not in self.store.jobs:
+                return _err(404, f"unknown job {uuid}")
+        txn_id = request.headers.get("X-Cook-Txn-Id") or None
+        moved, skipped = [], []
+        last_seq = 0
+        duplicates = 0
+        for uuid in uuids:
+            outcome = await self._run_commit(
+                "job/pool-move", {"uuid": uuid, "pool": pool},
+                f"{txn_id}:{uuid}" if txn_id else None)
+            result = outcome.result or {}
+            (moved if result.get("moved") else skipped).append(uuid)
+            last_seq = max(last_seq, outcome.seq)
+            duplicates += outcome.duplicate
+        body_out = {"moved": moved, "skipped": skipped, "pool": pool}
+        # one replication wait covers the whole batch (acks are
+        # cumulative sequence numbers)
+        if self.config.replication_sync_ack and duplicates < len(uuids):
+            if not await self._await_replication(last_seq):
+                global_registry.counter("replication_ack_timeouts").inc()
+                body_out["replicated"] = False
+        return web.json_response(body_out, status=201)
 
     # ------------------------------------------------------------- queue etc
 
@@ -1127,7 +1261,7 @@ class CookApi:
         if request["user"] not in self.config.admins:
             return _err(403, "admin required")
         body = await request.json()
-        self.store.update_dynamic_config(body)
+        await self._commit(request, "config/update", {"updates": body})
         return web.json_response(self.store.dynamic_config, status=201)
 
     async def post_shutdown_leader(self, request: web.Request) -> web.Response:
@@ -1263,8 +1397,11 @@ class CookApi:
 
     async def post_replication_ack(self, request: web.Request
                                    ) -> web.Response:
-        """Followers confirm the highest seq they have applied AND
-        journaled locally; sync-ack submissions block on these."""
+        """Followers confirm the highest seq they have applied; only acks
+        flagged `durable` (applied AND journaled on the follower's own
+        disk) count toward the sync-ack bound — a memory-only follower
+        confirming a write does not make it survive two machine losses.
+        Absent flag defaults to durable for wire compatibility."""
         if request["user"] not in self.config.admins:
             return _err(403, "admin required")
         body = await request.json()
@@ -1275,14 +1412,37 @@ class CookApi:
             return _err(400, "seq must be an integer")
         if not follower:
             return _err(400, "follower required")
-        prev = self.replication_acks.get(follower, 0)
-        self.replication_acks[follower] = max(prev, seq)
+        durable = bool(body.get("durable", True))
+        import time as _time
+
+        self.replication_ack_meta[follower] = {
+            "seq": seq, "durable": durable, "time": _time.monotonic()}
+        if durable:
+            prev = self.replication_acks.get(follower, 0)
+            self.replication_acks[follower] = max(prev, seq)
         self._repl_wake_all()
-        return web.json_response({"ok": True})
+        return web.json_response({"ok": True, "counted": durable})
+
+    def _prune_stale_acks(self) -> None:
+        """Drop ack entries whose follower has gone quiet for longer than
+        the liveness window: a decommissioned standby's last ack (possibly
+        a high seq from a diverged history) must not satisfy the
+        durability bound forever."""
+        ttl = self.config.replication_ack_liveness_s
+        if ttl <= 0:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        for follower, meta in list(self.replication_ack_meta.items()):
+            if now - meta["time"] > ttl:
+                del self.replication_ack_meta[follower]
+                self.replication_acks.pop(follower, None)
 
     async def _await_replication(self, seq: int) -> bool:
-        """Block until >= replication_min_acks followers confirm `seq`, or
-        the configured timeout lapses.  True = durability bound met."""
+        """Block until >= replication_min_acks LIVE, durable followers
+        confirm `seq`, or the configured timeout lapses.  True =
+        durability bound met."""
         import asyncio
 
         self._ensure_repl_watcher()
@@ -1290,6 +1450,7 @@ class CookApi:
         deadline = loop.time() + self.config.replication_ack_timeout_s
         need = self.config.replication_min_acks
         while True:
+            self._prune_stale_acks()
             acked = sum(1 for s in self.replication_acks.values()
                         if s >= seq)
             if acked >= need:

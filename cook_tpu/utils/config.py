@@ -122,6 +122,9 @@ class Settings:
     replication_sync_ack: bool = False
     replication_min_acks: int = 1
     replication_ack_timeout_s: float = 5.0
+    # acks older than this stop counting toward min_acks (decommissioned
+    # standbys are pruned); <= 0 disables liveness qualification
+    replication_ack_liveness_s: float = 30.0
     data_dir: str = ""                  # "" = in-memory only
     snapshot_interval_s: float = 300.0
     # pin jax to a platform at process start ("cpu", "tpu", ...); "" =
@@ -193,7 +196,7 @@ def read_config(path: Optional[str] = None,
                 "leader_lease_path", "leader_endpoint", "leader_group",
                 "leader_ttl_s", "advertised_url", "replication_user",
                 "replication_sync_ack", "replication_min_acks",
-                "replication_ack_timeout_s",
+                "replication_ack_timeout_s", "replication_ack_liveness_s",
                 "data_dir", "snapshot_interval_s", "platform",
                 "batched_match",
                 "queue_limit_per_pool",

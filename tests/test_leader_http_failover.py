@@ -59,6 +59,37 @@ def _write_config(tmp_path, name, port, data_dir, lease_url):
     return str(path)
 
 
+def test_clamped_ttl_adopted_for_partition_grace():
+    """The lease service clamps requested TTLs (MAX_TTL_S); the elector
+    must grace-check partitions against the EFFECTIVE TTL the service
+    reports back, not its configured ask — or a clamped lease leaves the
+    old leader seated long after the service re-granted it (a two-leader
+    window)."""
+    from cook_tpu.control.leader import HttpLeaseElector
+    from cook_tpu.control.lease_server import MAX_TTL_S, LeaseServer
+
+    lease = LeaseServer().start()
+    clock = {"t": 0.0}
+    try:
+        elector = HttpLeaseElector(
+            lease.url, "g", "m1", ttl_s=300.0, timeout_s=1.0,
+            clock=lambda: clock["t"])
+        assert elector.try_acquire()
+        assert elector.effective_ttl_s == MAX_TTL_S  # 60, not 300
+
+        # partition the elector from the lease service
+        elector.endpoint = "http://127.0.0.1:1"
+        clock["t"] = MAX_TTL_S / 2
+        assert elector.heartbeat(), \
+            "partition within the granted TTL must not dethrone"
+        clock["t"] = MAX_TTL_S + 40.0  # beyond granted 60, within asked 300
+        assert not elector.heartbeat(), (
+            "elector kept leading past the clamped TTL: the service may "
+            "already have re-granted the lease")
+    finally:
+        lease.stop()
+
+
 @pytest.mark.slow
 def test_sigkill_leader_promotes_standby_no_shared_fs(tmp_path):
     lease_port = free_port()

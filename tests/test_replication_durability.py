@@ -263,6 +263,106 @@ def test_live_apply_events_enter_window_and_journal(tmp_path):
     assert set(cold.jobs) == set(leader.jobs)
 
 
+# ---------------------------------------------------- ack honesty/liveness
+
+
+def test_memory_only_follower_ack_does_not_satisfy_sync_ack(tmp_path):
+    """A follower with no journal/data_dir applies events but cannot
+    claim "journaled locally": its acks arrive flagged durable=false and
+    must not satisfy the sync-ack bound — replicated:true has to mean a
+    second DISK holds the write."""
+    s = _settings(free_port(), str(tmp_path / "n1"), "",
+                  replication_sync_ack=True,
+                  replication_ack_timeout_s=0.5)
+    s.leader_endpoint = ""
+    p = build_process(s)
+    follower = None
+    try:
+        url = f"http://127.0.0.1:{s.port}"
+        from cook_tpu.models.store import JobStore
+
+        follower = JournalFollower(JobStore(), leader_url_fn=lambda: url,
+                                   poll_s=0.05, timeout_s=5.0,
+                                   member_id="mem-only")
+        assert not follower.is_durable()
+        follower.start()
+        _wait(lambda: "mem-only" in p.api.replication_ack_meta, 10,
+              "non-durable ack arrival")
+
+        uuid = "d0000000-0000-0000-0000-000000000010"
+        r = requests.post(f"{url}/jobs", json={
+            "jobs": [{"command": "x", "mem": 100, "cpus": 1, "uuid": uuid}],
+        }, headers=H, timeout=10)
+        assert r.status_code == 201
+        assert r.json().get("replicated") is False, \
+            "a memory-only follower's ack satisfied the durability bound"
+        meta = p.api.replication_ack_meta["mem-only"]
+        assert meta["durable"] is False
+        assert "mem-only" not in p.api.replication_acks
+    finally:
+        if follower is not None:
+            follower.stop()
+        shutdown(p)
+
+
+def test_decommissioned_standby_ack_pruned_from_min_acks(tmp_path):
+    """replication_min_acks=2 with one live standby and one
+    decommissioned one: while the dead standby's last ack is fresh it
+    still counts, but past the liveness window it is pruned and the
+    bound is honestly reported unmet."""
+    lease = LeaseServer().start()
+    p1 = p2 = None
+    try:
+        s1 = _settings(free_port(), str(tmp_path / "n1"), lease.url,
+                       replication_sync_ack=True,
+                       replication_min_acks=2,
+                       replication_ack_timeout_s=3.0,
+                       replication_ack_liveness_s=2.5)
+        p1 = build_process(s1)
+        start_leader_duties(p1, block=False, on_loss=lambda: None)
+        s2 = _settings(free_port(), str(tmp_path / "n2"), lease.url)
+        p2 = build_process(s2)
+        standby = threading.Thread(
+            target=start_leader_duties, args=(p2,),
+            kwargs={"block": False, "on_loss": lambda: None}, daemon=True)
+        standby.start()
+        _wait(lambda: p1.api.replication_acks, 15, "live standby acks")
+
+        url = f"http://127.0.0.1:{s1.port}"
+        # the "decommissioned" standby: one durable ack claiming a huge
+        # seq (e.g. from a diverged pre-failover history), then silence
+        r = requests.post(f"{url}/replication/ack", json={
+            "follower": "ghost", "seq": 10**9, "durable": True,
+        }, headers=ADMIN, timeout=5)
+        assert r.status_code == 200 and r.json()["counted"] is True
+
+        # fresh ghost ack + live standby = bound met (2 acks)
+        uuid1 = "d0000000-0000-0000-0000-000000000011"
+        r = requests.post(f"{url}/jobs", json={
+            "jobs": [{"command": "x", "mem": 100, "cpus": 1,
+                      "uuid": uuid1}]}, headers=H, timeout=10)
+        assert r.status_code == 201
+        assert "replicated" not in r.json(), r.json()
+
+        # past the liveness window the ghost is pruned: only the live
+        # standby acks, min_acks=2 is unmet, and the response says so
+        time.sleep(3.5)
+        uuid2 = "d0000000-0000-0000-0000-000000000012"
+        r = requests.post(f"{url}/jobs", json={
+            "jobs": [{"command": "x", "mem": 100, "cpus": 1,
+                      "uuid": uuid2}]}, headers=H, timeout=10)
+        assert r.status_code == 201
+        assert r.json().get("replicated") is False, \
+            "a decommissioned standby's stale ack satisfied min_acks"
+        assert "ghost" not in p1.api.replication_acks
+        assert "ghost" not in p1.api.replication_ack_meta
+    finally:
+        for p in (p1, p2):
+            if p is not None:
+                shutdown(p)
+        lease.stop()
+
+
 # ------------------------------------------------------------------ long-poll
 
 

@@ -1,0 +1,327 @@
+"""Durable-on-ack for EVERY mutation type, through the txn pipeline.
+
+Reference bar: every Cook mutation — submit, kill, retry, share/quota,
+group ops, pool moves — goes through Datomic's transact-with-retries
+(datomic.clj:79) and is durable the moment the REST call returns.  Here
+that property comes from `cook_tpu.txn`: one commit pipeline (apply →
+journal group-fsync → sync-ack replication) with idempotency keys.
+These tests pin:
+
+  * failover durability: each mutation type, acked by the leader in
+    sync-ack mode, is present on the standby at ack time and survives
+    leader death + standby promotion;
+  * idempotent re-apply: a retried commit (same X-Cook-Txn-Id /
+    txn_id) on the NEW leader is answered from the replicated
+    transaction table, not re-applied;
+  * the parked-fetch promotion race: JournalFollower.stop() outlives
+    the longest possible in-flight long-poll fetch, so a late response
+    from a deposed leader can never clobber a promoted standby;
+  * TransactionLog unit semantics: duplicate detection, journal replay
+    rebuilding the idempotency table, bounded transient retries.
+"""
+import threading
+import time
+
+import requests
+
+from cook_tpu.components import build_process, shutdown, start_leader_duties
+from cook_tpu.control.lease_server import LeaseServer
+from cook_tpu.control.replication import JournalFollower
+from cook_tpu.models import persistence
+from cook_tpu.models.entities import JobState, Pool, Resources, Share
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.server import free_port
+from cook_tpu.txn import (
+    DurabilityPolicy,
+    OPS,
+    TransactionLog,
+    TransientTxnError,
+    txn_op,
+)
+from cook_tpu.utils.config import Settings
+
+H = {"X-Cook-Requesting-User": "u"}
+ADMIN = {"X-Cook-Requesting-User": "admin"}
+
+
+def _settings(port, data_dir, lease_url, **kw):
+    return Settings(
+        port=port, data_dir=data_dir,
+        leader_endpoint=lease_url, leader_ttl_s=3.0,
+        clusters=[{
+            "kind": "mock", "name": "m1",
+            "hosts": [{"node_id": "h0", "mem": 4000, "cpus": 8}],
+        }],
+        pools=[{"name": "default"}, {"name": "other"}],
+        rank_interval_s=3600, match_interval_s=3600,
+        **kw,
+    )
+
+
+def _wait(pred, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------- failover, every mutation
+
+
+def test_every_mutation_type_survives_promotion_and_dedupes(tmp_path):
+    """kill / retry / share / quota / group kill / pool move / config
+    update, each acked under sync-ack replication, then the leader dies:
+    all of them are present on the promoted standby, and re-committing
+    any of them with the same txn id is answered as a duplicate."""
+    lease = LeaseServer().start()
+    p1 = p2 = None
+    try:
+        s1 = _settings(free_port(), str(tmp_path / "n1"), lease.url,
+                       replication_sync_ack=True,
+                       replication_ack_timeout_s=10.0)
+        p1 = build_process(s1)
+        start_leader_duties(p1, block=False, on_loss=lambda: None)
+        assert p1.is_leader()
+
+        s2 = _settings(free_port(), str(tmp_path / "n2"), lease.url)
+        p2 = build_process(s2)
+        standby = threading.Thread(
+            target=start_leader_duties, args=(p2,),
+            kwargs={"block": False, "on_loss": lambda: None}, daemon=True)
+        standby.start()
+        _wait(lambda: p1.api.replication_acks, 15, "standby ack presence")
+
+        base = f"http://127.0.0.1:{s1.port}"
+        ja = "e0000000-0000-0000-0000-00000000000a"
+        jb = "e0000000-0000-0000-0000-00000000000b"
+        jc = "e0000000-0000-0000-0000-00000000000c"
+        jd = "e0000000-0000-0000-0000-00000000000d"
+        grp = "e0000000-0000-0000-0000-0000000000f0"
+
+        def ok(r, *codes):
+            assert r.status_code in codes, (r.status_code, r.text)
+            # the durability bound must have been met for every ack
+            assert r.headers.get("X-Cook-Replicated") != "false", r.headers
+            if r.headers.get("Content-Type", "").startswith(
+                    "application/json"):
+                assert r.json() is None or not isinstance(r.json(), dict) \
+                    or r.json().get("replicated") is not False, r.text
+            return r
+
+        # submit A, B, D plus C in group grp
+        ok(requests.post(f"{base}/jobs", json={"jobs": [
+            {"command": "x", "mem": 100, "cpus": 1, "uuid": u}
+            for u in (ja, jb, jd)]},
+            headers={**H, "X-Cook-Txn-Id": "t-submit"}, timeout=15), 201)
+        ok(requests.post(f"{base}/jobs", json={
+            "groups": [{"uuid": grp, "name": "g"}],
+            "jobs": [{"command": "x", "mem": 100, "cpus": 1, "uuid": jc,
+                      "group": grp}]}, headers=H, timeout=15), 201)
+        # kill A
+        ok(requests.delete(f"{base}/jobs", params={"job": ja},
+                           headers={**H, "X-Cook-Txn-Id": "t-kill"},
+                           timeout=15), 204)
+        # retry B to 7
+        ok(requests.post(f"{base}/retry", json={"job": jb, "retries": 7},
+                         headers={**H, "X-Cook-Txn-Id": "t-retry"},
+                         timeout=15), 201)
+        # share + quota for user u
+        ok(requests.post(f"{base}/share", json={
+            "user": "u", "share": {"mem": 123.0, "cpus": 4.0}},
+            headers={**ADMIN, "X-Cook-Txn-Id": "t-share"}, timeout=15), 201)
+        ok(requests.post(f"{base}/quota", json={
+            "user": "u", "quota": {"count": 5, "cpus": 9.0}},
+            headers={**ADMIN, "X-Cook-Txn-Id": "t-quota"}, timeout=15), 201)
+        # group kill (kills C)
+        ok(requests.delete(f"{base}/group", params={"uuid": grp},
+                           headers={**H, "X-Cook-Txn-Id": "t-group"},
+                           timeout=15), 204)
+        # pool move D -> other
+        r = ok(requests.post(f"{base}/pool-move", json={
+            "job": jd, "pool": "other"},
+            headers={**ADMIN, "X-Cook-Txn-Id": "t-move"}, timeout=15), 201)
+        assert r.json()["moved"] == [jd]
+        # dynamic config
+        ok(requests.post(f"{base}/incremental-config", json={"flag": "on"},
+                         headers={**ADMIN, "X-Cook-Txn-Id": "t-config"},
+                         timeout=15), 201)
+
+        # sync-ack means: at ack time the standby already holds ALL of it
+        sb = p2.store
+        assert sb.jobs[ja].state == JobState.COMPLETED
+        assert sb.jobs[jb].max_retries == 7
+        assert sb.jobs[jc].state == JobState.COMPLETED
+        assert sb.jobs[jd].pool == "other"
+        assert sb.shares[("u", "default")].resources.mem == 123.0
+        assert sb.quotas[("u", "default")].count == 5
+        assert sb.dynamic_config.get("flag") == "on"
+        for tid in ("t-submit", "t-kill", "t-retry:" + jb, "t-share",
+                    "t-quota", "t-group", "t-move:" + jd, "t-config"):
+            assert tid in sb.txn_results, f"txn record {tid} not replicated"
+
+        # leader dies; standby promotes
+        shutdown(p1)
+        p1 = None
+        _wait(lambda: p2.is_leader(), 30, "standby promotion")
+
+        # acked mutations present after failover (and on the standby's
+        # own disk: a cold recover of its data dir agrees)
+        recovered = persistence.recover(s2.data_dir)
+        assert recovered is not None
+        assert recovered.jobs[ja].state == JobState.COMPLETED
+        assert recovered.jobs[jd].pool == "other"
+        assert "t-kill" in recovered.txn_results
+
+        # idempotent re-apply on the NEW leader: same txn ids are
+        # answered from the replicated transaction table, not re-applied
+        seq_before = p2.store.last_seq()
+        dup = p2.api.txn.commit("jobs/kill", {"uuids": [ja]},
+                                txn_id="t-kill")
+        assert dup.duplicate is True
+        dup = p2.api.txn.commit(
+            "job/retry", {"uuid": jb, "retries": 7, "increment": False},
+            txn_id="t-retry:" + jb)
+        assert dup.duplicate is True
+        dup = p2.api.txn.commit("job/pool-move",
+                                {"uuid": jd, "pool": "other"},
+                                txn_id="t-move:" + jd)
+        assert dup.duplicate is True
+        assert p2.store.last_seq() == seq_before, \
+            "duplicate commits must not write new events"
+
+        # and over REST: retried kill with the same X-Cook-Txn-Id on the
+        # new leader is a no-op 204
+        base2 = f"http://127.0.0.1:{s2.port}"
+        r = requests.delete(f"{base2}/jobs", params={"job": ja},
+                            headers={**H, "X-Cook-Txn-Id": "t-kill"},
+                            timeout=15)
+        assert r.status_code == 204
+        assert p2.store.last_seq() == seq_before
+
+        # a retried SUBMISSION (same txn id, same explicit uuids) is
+        # answered from the transaction table — not "job already exists"
+        r = requests.post(f"{base2}/jobs", json={"jobs": [
+            {"command": "x", "mem": 100, "cpus": 1, "uuid": u}
+            for u in (ja, jb, jd)]},
+            headers={**H, "X-Cook-Txn-Id": "t-submit"}, timeout=15)
+        assert r.status_code == 201, r.text
+        assert sorted(r.json()["jobs"]) == sorted([ja, jb, jd])
+        assert p2.store.last_seq() == seq_before
+    finally:
+        for p in (p1, p2):
+            if p is not None:
+                shutdown(p)
+        lease.stop()
+
+
+# ------------------------------------------- parked-fetch promotion race
+
+
+def test_follower_stop_outlives_parked_long_poll(tmp_path):
+    """stop() must join the sync thread even when a long-poll fetch is
+    parked on the leader: the fetch can be in flight for up to
+    timeout_s + long_poll_s, longer than the old timeout_s + 5 join
+    bound, and an unjoined thread applying a late response after
+    promotion would clobber the new leader's state."""
+    s = Settings(
+        port=free_port(), data_dir=str(tmp_path / "n1"),
+        clusters=[], pools=[{"name": "default"}],
+        rank_interval_s=3600, match_interval_s=3600)
+    p = build_process(s)
+    follower = None
+    try:
+        url = f"http://127.0.0.1:{s.port}"
+        follower = JournalFollower(
+            JobStore(), leader_url_fn=lambda: url,
+            poll_s=0.05, timeout_s=1.0, long_poll_s=7.0)
+        follower.start()
+        # catch up, then park the next long-poll (no writes are coming)
+        _wait(lambda: follower.synced_events > 0, 10, "follower catch-up")
+        time.sleep(0.5)
+        t0 = time.monotonic()
+        follower.stop()
+        elapsed = time.monotonic() - t0
+        assert not follower._thread.is_alive(), (
+            "stop() returned with the sync thread still running — the "
+            "join window does not cover a parked long-poll fetch")
+        assert elapsed <= follower.timeout_s + follower.long_poll_s + 5
+    finally:
+        if follower is not None:
+            follower.stop()
+        shutdown(p)
+
+
+# --------------------------------------------------- TransactionLog units
+
+
+def test_txn_log_duplicate_answered_from_table(tmp_path):
+    store = JobStore()
+    store.set_pool(Pool(name="default"))
+    journal = persistence.attach_journal(store,
+                                         str(tmp_path / "journal.jsonl"))
+    txn = TransactionLog(store, journal=journal)
+    share = Share(user="u", pool="default",
+                  resources=Resources(mem=5.0, cpus=1.0))
+    out = txn.commit("share/set", {"share": share}, txn_id="t1")
+    assert not out.duplicate and out.seq == store.last_seq()
+    seq = store.last_seq()
+    dup = txn.commit("share/set", {"share": share}, txn_id="t1")
+    assert dup.duplicate is True
+    assert dup.seq == out.seq and dup.result == out.result
+    assert store.last_seq() == seq, "duplicate re-applied"
+
+    # journal replay rebuilds the idempotency table: a recovered store
+    # still answers the duplicate without re-applying
+    journal.close()
+    entries = persistence.read_journal(str(tmp_path / "journal.jsonl"))
+    cold = JobStore()
+    persistence.apply_journal(cold, entries)
+    assert "t1" in cold.txn_results
+    dup2 = TransactionLog(cold).commit("share/set", {"share": share},
+                                       txn_id="t1")
+    assert dup2.duplicate is True
+    assert cold.shares[("u", "default")].resources.mem == 5.0
+
+
+def test_txn_log_snapshot_carries_table():
+    src = JobStore()
+    src.set_pool(Pool(name="default"))
+    TransactionLog(src).commit("config/update", {"updates": {"a": 1}},
+                               txn_id="t-cfg")
+    state = persistence.snapshot_state(src)
+    dst = JobStore()
+    persistence.restore_into(dst, state)
+    assert "t-cfg" in dst.txn_results
+    assert TransactionLog(dst).commit("config/update",
+                                      {"updates": {"a": 1}},
+                                      txn_id="t-cfg").duplicate is True
+
+
+def test_txn_log_bounded_transient_retries():
+    calls = {"n": 0}
+
+    @txn_op("test/flaky")
+    def _flaky(store, payload):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientTxnError("not yet")
+        return {"ok": True}
+
+    try:
+        store = JobStore()
+        txn = TransactionLog(store, policy=DurabilityPolicy(
+            max_attempts=3, retry_backoff_s=0.0))
+        out = txn.commit("test/flaky", {})
+        assert out.attempts == 3 and out.result == {"ok": True}
+
+        calls["n"] = -100  # always transient within the budget
+        try:
+            txn.commit("test/flaky", {})
+        except TransientTxnError:
+            pass
+        else:
+            raise AssertionError("retry budget not bounded")
+    finally:
+        del OPS["test/flaky"]
