@@ -84,6 +84,10 @@ class JournalFollower:
         self.synced_events = 0
         self.full_resyncs = 0
         self.last_error: str = ""
+        # correlation: txn_id of the newest txn/committed event applied —
+        # rides in every ack so the leader can tie a replication ack back
+        # to the mutation it makes durable (docs/observability.md)
+        self.last_txn_id: str = ""
 
     # ------------------------------------------------------------- transport
 
@@ -176,8 +180,13 @@ class JournalFollower:
                     self.journal.sync()
                 if self._post(f"{leader}/replication/ack",
                               {"follower": self.member_id, "seq": seq,
-                               "durable": durable}):
+                               "durable": durable,
+                               "last_txn_id": self.last_txn_id}):
                     self._last_acked = seq
+                    # one correlation event per txn: later acks driven by
+                    # non-txn events (status updates) must not keep
+                    # re-attributing themselves to this transaction
+                    self.last_txn_id = ""
         return applied
 
     def is_durable(self) -> bool:
@@ -197,6 +206,12 @@ class JournalFollower:
         with self.store._lock:
             applied = persistence.apply_journal(self.store, events,
                                                 live=True)
+        for e in reversed(events):
+            if e.get("kind") == "txn/committed":
+                txn_id = (e.get("data") or {}).get("txn_id")
+                if txn_id:
+                    self.last_txn_id = txn_id
+                break
         self.synced_events += applied
         return applied
 
@@ -206,6 +221,10 @@ class JournalFollower:
             return False
         if state.get("incarnation"):
             self._leader_incarnation = state["incarnation"]
+        # the pre-resync correlation id belongs to a history this snapshot
+        # supersedes; carrying it into the next ack would misattribute
+        # which txn the ack makes durable
+        self.last_txn_id = ""
         persistence.restore_into(self.store, state)
         if self.data_dir:
             # the local snapshot now IS the bootstrap point; the journal

@@ -205,6 +205,9 @@ class CookApi:
         r.add_get("/replication/snapshot", self.get_replication_snapshot)
         r.add_post("/replication/ack", self.post_replication_ack)
         r.add_get("/debug", self.get_debug)
+        r.add_get("/debug/cycles", self.get_debug_cycles)
+        r.add_get("/debug/cycles/{cycle_id}", self.get_debug_cycle)
+        r.add_get("/debug/spans", self.get_debug_spans)
         r.add_get("/swagger-docs", self.get_swagger_docs)
         r.add_get("/swagger-ui", self.get_swagger_ui)
         self._openapi = _build_openapi(app)
@@ -242,6 +245,59 @@ class CookApi:
             "healthy": True,
             "leader": bool(self.scheduler) and self.leader,
         })
+
+    def _recorder(self):
+        return getattr(self.scheduler, "recorder", None) \
+            if self.scheduler is not None else None
+
+    async def get_debug_cycles(self, request: web.Request) -> web.Response:
+        """Flight-recorder ring: per-cycle structured decision records
+        (per-phase durations, per-job reason codes, preemption victims).
+        `?limit=` bounds the reply, `?pool=` filters."""
+        recorder = self._recorder()
+        if recorder is None:
+            return _err(503, "no scheduler/flight recorder attached")
+        try:
+            limit = int(request.query.get("limit", "50"))
+        except ValueError:
+            return _err(400, "limit must be an integer")
+        pool = request.query.get("pool")
+        return web.json_response({
+            "cycles": recorder.records_json(limit=max(1, limit), pool=pool),
+            "capacity": recorder.capacity,
+        })
+
+    async def get_debug_cycle(self, request: web.Request) -> web.Response:
+        """One full cycle record by id."""
+        recorder = self._recorder()
+        if recorder is None:
+            return _err(503, "no scheduler/flight recorder attached")
+        try:
+            cycle_id = int(request.match_info["cycle_id"])
+        except ValueError:
+            return _err(400, "cycle id must be an integer")
+        record = recorder.get_json(cycle_id)
+        if record is None:
+            return _err(404, f"cycle {cycle_id} not in the recorder ring")
+        return web.json_response(record)
+
+    async def get_debug_spans(self, request: web.Request) -> web.Response:
+        """Recent span-ring entries; `?txn_id=` filters to one correlation
+        id (the client's X-Cook-Txn-Id) so a mutation's spans — REST
+        commit, txn apply, store ops — read as one linked trace."""
+        from cook_tpu.utils import tracing
+
+        try:
+            limit = max(1, int(request.query.get("limit", "100")))
+        except ValueError:
+            return _err(400, "limit must be an integer")
+        txn_id = request.query.get("txn_id")
+        spans = tracing.recent_spans(
+            limit=tracing.ring_capacity() if txn_id else limit)
+        if txn_id:
+            spans = [s for s in spans
+                     if s.get("tags", {}).get("txn_id") == txn_id][-limit:]
+        return web.json_response({"spans": spans})
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
@@ -433,6 +489,9 @@ class CookApi:
             limit_err = self.queue_limits.check_submission(user, pool, count)
             if limit_err:
                 return _err(400, limit_err)
+        import time as _time
+
+        t_commit = _time.perf_counter()
         try:
             outcome = await self._commit(
                 request, "jobs/submit",
@@ -440,6 +499,13 @@ class CookApi:
         except TransactionVetoed as e:
             return _err(400, str(e))
         if not outcome.duplicate:
+            # submit -> commit-ack SLO: apply + journal fsync + (sync-ack
+            # mode) replication wait, as the submitting client experiences
+            # it.  Idempotent replays answer from the txn table in ~0s and
+            # would flood the histogram with samples no durable commit saw.
+            from cook_tpu.scheduler.monitor import observe_commit_ack
+
+            observe_commit_ack(_time.perf_counter() - t_commit)
             global_registry.counter("jobs_submitted").inc(len(jobs))
         body = dict(outcome.result or {"jobs": [j.uuid for j in jobs]})
         if outcome.replicated is False:
@@ -1050,12 +1116,36 @@ class CookApi:
                 "reason": "The job would cause you to exceed resource quotas.",
             })
         if self.scheduler is not None:
-            failure = self.scheduler.placement_failures.get(job.uuid)
-            if failure:
+            # the flight recorder's last-cycle decision beats the static
+            # placement-failure text: it carries the machine-readable
+            # reason code and the cycle id that produced it
+            from cook_tpu.scheduler import flight_recorder as fr
+
+            recorder = self._recorder()
+            cycle_reason = (recorder.job_reason(job.uuid)
+                            if recorder is not None else None)
+            if cycle_reason is not None and cycle_reason[1] != fr.MATCHED:
+                # a "matched" entry for a job that is WAITING again means
+                # the instance failed since — stale, fall through to the
+                # placement-failure text instead of claiming a match
+                cycle_id, code, detail = cycle_reason
                 reasons.append({
-                    "reason": "The job couldn't be placed on any available hosts.",
-                    "data": {"reasons": [{"reason": failure}]},
+                    "reason": "The job couldn't be placed on any available "
+                              "hosts." if code != fr.NOT_CONSIDERED else
+                              "The job was not considered in the last "
+                              "match cycle.",
+                    "data": {"reason_code": code, "cycle": cycle_id,
+                             "reasons": ([{"reason": detail}]
+                                         if detail else [])},
                 })
+            else:
+                failure = self.scheduler.placement_failures.get(job.uuid)
+                if failure:
+                    reasons.append({
+                        "reason": "The job couldn't be placed on any "
+                                  "available hosts.",
+                        "data": {"reasons": [{"reason": failure}]},
+                    })
             queue = self.scheduler.pool_queues.get(job.pool)
             if queue is not None:
                 for pos, qjob in enumerate(queue.jobs):
@@ -1413,13 +1503,23 @@ class CookApi:
         if not follower:
             return _err(400, "follower required")
         durable = bool(body.get("durable", True))
+        # correlation: the follower reports the txn id of the newest
+        # txn/committed event its ack covers, so the ack is attributable
+        # to the mutation it makes durable (and the span ring links it)
+        last_txn_id = str(body.get("last_txn_id", "") or "")
         import time as _time
 
         self.replication_ack_meta[follower] = {
-            "seq": seq, "durable": durable, "time": _time.monotonic()}
+            "seq": seq, "durable": durable, "time": _time.monotonic(),
+            "last_txn_id": last_txn_id}
         if durable:
             prev = self.replication_acks.get(follower, 0)
             self.replication_acks[follower] = max(prev, seq)
+        if last_txn_id:
+            from cook_tpu.utils import tracing
+
+            tracing.record_event("replication.ack", txn_id=last_txn_id,
+                                 follower=follower, durable=durable)
         self._repl_wake_all()
         return web.json_response({"ok": True, "counted": durable})
 

@@ -24,6 +24,7 @@ from cook_tpu.models.entities import (
     Resources,
 )
 from cook_tpu.models.store import Event, JobStore
+from cook_tpu.scheduler.flight_recorder import FlightRecorder, PreemptionRecord
 from cook_tpu.scheduler.matcher import (
     MatchConfig,
     MatchOutcome,
@@ -52,6 +53,9 @@ class SchedulerConfig:
     user_launch_burst: float = 0.0
     # columnar host-side state: O(delta) rank-cycle encoding
     use_columnar_index: bool = True
+    # flight recorder: bounded ring of per-cycle decision records served
+    # at GET /debug/cycles (flight_recorder.py); 0 disables
+    flight_recorder_capacity: int = 512
 
 
 class Scheduler:
@@ -113,6 +117,20 @@ class Scheduler:
         self.host_attr_cache: OrderedDict[str, dict] = OrderedDict()
         self.host_attr_cache_max = 100_000
         self.metrics: dict[str, float] = {}
+        # per-cycle flight recorder (GET /debug/cycles) + job-lifecycle
+        # latency histograms (submit->matched, matched->running,
+        # end-to-end), both the measurement substrate of docs/observability.md
+        self.recorder = (
+            FlightRecorder(capacity=self.config.flight_recorder_capacity)
+            if self.config.flight_recorder_capacity > 0 else None)
+        self._last_rank_s: dict[str, float] = {}
+        from cook_tpu.scheduler.monitor import JobLifecycleTracker
+
+        # effect-gated like _on_event: a standby applying replicated
+        # events must not observe apply-time latencies into the SLO
+        # histograms (a replayed backlog would inflate them by the outage)
+        self.lifecycle = JobLifecycleTracker(store,
+                                             enabled=lambda: self.active)
         store.add_watcher(self._on_event)
         for cluster in self.clusters:
             if hasattr(cluster, "status_callback"):
@@ -176,7 +194,11 @@ class Scheduler:
     def rank_cycle(self, pool: Pool) -> RankedQueue:
         # offensive-job filter: quarantine jobs no host in the pool could
         # ever hold (scheduler.clj:2198-2257)
+        import time as _time
+
         from cook_tpu.scheduler.ranking import offensive_job_filter
+
+        t_rank = _time.perf_counter()
 
         max_mem = max_cpus = max_gpus = 0.0
         autoscales = False
@@ -211,10 +233,43 @@ class Scheduler:
         self.metrics[f"rank.{pool.name}.queue_len"] = len(queue.jobs)
         global_registry.gauge("rank.queue_len").set(
             len(queue.jobs), {"pool": pool.name})
+        # stash the duration so the NEXT match cycle's flight record can
+        # claim its rank phase even when ranking is driven separately
+        # (components.py rank trigger, the simulator's explicit rank step)
+        self._last_rank_s[pool.name] = _time.perf_counter() - t_rank
         return queue
 
+    def _begin_cycle(self, pool_name: str):
+        from cook_tpu.scheduler.flight_recorder import NULL_CYCLE
+
+        if self.recorder is None:
+            return NULL_CYCLE
+        return self.recorder.begin(pool_name, self.store.clock())
+
+    def _commit_cycle(self, flight) -> None:
+        if self.recorder is not None and flight.record is not None:
+            self.recorder.commit(flight)
+
+    def _credit_rank_and_quarantine(self, flight, pool_name: str,
+                                    queue) -> None:
+        """Shared cycle-record prologue for both match paths: claim the
+        most recent rank cycle's duration, and record the jobs the rank
+        cycle's offensive-job filter quarantined (the matcher never sees
+        them)."""
+        from cook_tpu.scheduler.flight_recorder import EXCEEDS_POOL_CAPACITY
+
+        rank_s = self._last_rank_s.pop(pool_name, None)
+        if rank_s is not None:
+            flight.add_phase("rank", rank_s)
+        for uuid in getattr(queue, "quarantined", ()):
+            flight.note_skip(uuid, EXCEEDS_POOL_CAPACITY)
+
     def match_cycle(self, pool: Pool) -> MatchOutcome:
-        queue = self.pool_queues.get(pool.name) or self.rank_cycle(pool)
+        flight = self._begin_cycle(pool.name)
+        queue = self.pool_queues.get(pool.name)
+        if queue is None:
+            queue = self.rank_cycle(pool)
+        self._credit_rank_and_quarantine(flight, pool.name, queue)
         state = self.pool_match_state.setdefault(
             pool.name,
             PoolMatchState(num_considerable=self.config.match.max_jobs_considered),
@@ -231,6 +286,7 @@ class Scheduler:
             record_placement_failure=self._record_placement_failure,
             host_reservations=self.host_reservations,
             host_attrs=self.host_attr_cache,
+            flight=flight,
         )
         # charge launches against the per-user rate limiter (spend-through)
         if self.launch_rate_limiter is not None:
@@ -267,6 +323,9 @@ class Scheduler:
             head_matched=outcome.head_matched,
             considerable_window=state.num_considerable,
         )
+        if flight.record is not None:
+            flight.record.head_matched = outcome.head_matched
+        self._commit_cycle(flight)
         return outcome
 
     def match_cycle_all_pools(self, mesh=None) -> dict[str, MatchOutcome]:
@@ -276,9 +335,12 @@ class Scheduler:
         from cook_tpu.scheduler.matcher import match_pools_batched
 
         pools = [p for p in self.store.pools.values() if p.schedules_jobs]
+        flights = {pool.name: self._begin_cycle(pool.name) for pool in pools}
         for pool in pools:
             if pool.name not in self.pool_queues:
                 self.rank_cycle(pool)
+            self._credit_rank_and_quarantine(
+                flights[pool.name], pool.name, self.pool_queues[pool.name])
             self.pool_match_state.setdefault(
                 pool.name,
                 PoolMatchState(
@@ -293,6 +355,7 @@ class Scheduler:
             host_reservations=self.host_reservations,
             host_attrs=self.host_attr_cache,
             mesh=mesh,
+            flights=flights,
         )
         for pool in pools:
             outcome = outcomes[pool.name]
@@ -306,6 +369,10 @@ class Scheduler:
                     if uuid not in matched_uuids
                 }
             self._cache_spare(pool)
+            flight = flights[pool.name]
+            if flight.record is not None:
+                flight.record.head_matched = outcome.head_matched
+            self._commit_cycle(flight)
         return outcomes
 
     def _cache_spare(self, pool: Pool) -> None:
@@ -350,12 +417,28 @@ class Scheduler:
         )
 
     def rebalance_cycle(self, pool: Pool) -> list[Decision]:
+        import time as _time
+
         queue = self.pool_queues.get(pool.name) or self.rank_cycle(pool)
+        # timer starts AFTER the queue lookup: a rank triggered here is
+        # stashed in _last_rank_s and credited to the next match cycle's
+        # rank phase — counting it here too would double-book the wall
+        t0 = _time.perf_counter()
         spare = self.last_unmatched_offers.get(pool.name, {})
         decisions = rebalance_pool(
             self.store, pool, queue.jobs, spare, self._rebalancer_params(),
             host_info=getattr(self, "last_host_info", {}).get(pool.name),
         )
+        if self.recorder is not None:
+            self.recorder.annotate_preemptions(
+                pool.name,
+                [PreemptionRecord(
+                    job_uuid=d.job.uuid, hostname=d.hostname,
+                    task_ids=list(d.task_ids),
+                    min_preempted_dru=d.min_preempted_dru)
+                 for d in decisions if d.task_ids],
+                _time.perf_counter() - t0,
+            )
         for decision in decisions:
             self._transact_preemption(decision)
             if len(decision.task_ids) > 1:

@@ -1,0 +1,361 @@
+"""Scheduler flight recorder: per-cycle structured decision records.
+
+The reference scheduler is operable because every match cycle leaves a
+trail — ~200 named metrics, `with-duration` around every hot section,
+and per-job "why is this unscheduled" attribution (unscheduled.clj).
+This module is the rebuild's equivalent of that trail condensed into one
+artifact: every match cycle emits a `CycleRecord` holding
+
+  * per-phase wall durations (rank, tensor_build, solve, launch,
+    preemption_search), split into device vs host time — the solve runs
+    on the accelerator, everything else is host matchmaking;
+  * the jobs considered, matched (with host + task id), and skipped,
+    each skip carrying a machine-readable reason code;
+  * preemption victims with the DRU score that sentenced them;
+  * offer/node/queue counts.
+
+Records sit in a bounded ring served at `GET /debug/cycles` (rest/api.py)
+and are dumped by the simulator for offline analysis.  The recorder also
+keeps a bounded per-job index of the LAST cycle decision so
+`/unscheduled_jobs` can answer with the real reason code instead of a
+static guess.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Optional
+
+from cook_tpu.utils.metrics import global_registry
+
+# ---------------------------------------------------------------- reason codes
+# Machine-readable per-job outcomes of one match cycle.  These are the
+# matcher's decisions, distinct from instance failure reasons
+# (models/reasons.py) which describe how a RUNNING attempt died.
+
+MATCHED = "matched"
+NO_OFFERS = "no-offers"
+CONSTRAINTS_FILTERED = "all-nodes-filtered-by-constraints"
+INSUFFICIENT_RESOURCES = "insufficient-resources"
+LAUNCH_CAP = "cluster-launch-cap"
+PORTS_EXHAUSTED = "ports-exhausted"
+LAUNCH_VETOED = "launch-vetoed"
+NOT_CONSIDERED = "not-considered"
+EXCEEDS_POOL_CAPACITY = "exceeds-pool-capacity"
+
+REASON_TEXT = {
+    NO_OFFERS: "no offers",
+    CONSTRAINTS_FILTERED: "all nodes filtered by constraints",
+    INSUFFICIENT_RESOURCES: "insufficient resources on feasible nodes",
+    LAUNCH_CAP: "cluster launch rate/cap reached this cycle",
+    PORTS_EXHAUSTED: "insufficient free ports on the matched node",
+    LAUNCH_VETOED: "launch transaction vetoed (job changed state mid-cycle)",
+    NOT_CONSIDERED: "not in this cycle's considerable window",
+    EXCEEDS_POOL_CAPACITY:
+        "the job's resource demands exceed every host in the pool",
+}
+
+
+@dataclass
+class PreemptionRecord:
+    """One rebalancer decision: who was killed, for whom, and why."""
+
+    job_uuid: str                 # the beneficiary the room was made for
+    hostname: str
+    task_ids: list[str]           # victims
+    min_preempted_dru: float      # the DRU score that justified the kill
+
+    def to_json(self) -> dict:
+        return {
+            "job": self.job_uuid,
+            "hostname": self.hostname,
+            "task_ids": list(self.task_ids),
+            "dru": self.min_preempted_dru,
+        }
+
+
+@dataclass
+class CycleRecord:
+    """One match cycle's full decision record."""
+
+    cycle_id: int
+    pool: str
+    t_ms: int                     # store clock at cycle start (virtual ms)
+    wall_time: float              # epoch seconds at cycle start
+    batched: bool = False         # solved via the pool-batched device call
+    phases: dict[str, float] = field(default_factory=dict)   # name -> seconds
+    device_s: float = 0.0
+    host_s: float = 0.0
+    total_s: float = 0.0
+    offers: int = 0
+    queue_len: int = 0
+    considered: int = 0
+    # queued jobs outside this cycle's considerable window (count only —
+    # their uuids go to the per-job reason index, not the record, which
+    # would otherwise bloat by O(queue) every cycle)
+    not_considered: int = 0
+    head_matched: bool = True
+    # [{job, host, task_id}] / [{job, code, detail}]
+    matched: list[dict] = field(default_factory=list)
+    skipped: list[dict] = field(default_factory=list)
+    preemptions: list[PreemptionRecord] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "cycle": self.cycle_id,
+            "pool": self.pool,
+            "t_ms": self.t_ms,
+            "wall_time": self.wall_time,
+            "batched": self.batched,
+            "phases": dict(self.phases),
+            "device_s": self.device_s,
+            "host_s": self.host_s,
+            "total_s": self.total_s,
+            "offers": self.offers,
+            "queue_len": self.queue_len,
+            "considered": self.considered,
+            "not_considered": self.not_considered,
+            "matched_count": len(self.matched),
+            "skipped_count": len(self.skipped),
+            "head_matched": self.head_matched,
+            "matched": list(self.matched),
+            "skipped": list(self.skipped),
+            "preemptions": [p.to_json() for p in self.preemptions],
+        }
+
+
+class CycleBuilder:
+    """Mutable collector one match cycle writes into.
+
+    Single-threaded by construction: one builder per (pool, cycle), used
+    only on the cycle's driving thread.  `FlightRecorder.commit` freezes
+    it into a CycleRecord."""
+
+    def __init__(self, cycle_id: int, pool: str, t_ms: int):
+        self.record = CycleRecord(cycle_id=cycle_id, pool=pool, t_ms=t_ms,
+                                  wall_time=time.time())
+        # uuids queued but outside the considerable window; indexed at
+        # commit, never stored on the record (O(queue) per cycle)
+        self.not_considered: list[str] = []
+        self._t0 = time.perf_counter()
+
+    @contextmanager
+    def phase(self, name: str, device: bool = False):
+        """Time one phase; device=True attributes it to accelerator time."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_phase(name, time.perf_counter() - t0, device=device)
+
+    def add_phase(self, name: str, seconds: float,
+                  device: bool = False) -> None:
+        """Credit an externally-timed duration to a phase (the batched
+        multi-pool solve is one shared device call; its wall time is
+        attributed to every participating pool's record)."""
+        rec = self.record
+        rec.phases[name] = rec.phases.get(name, 0.0) + seconds
+        if device:
+            rec.device_s += seconds
+        else:
+            rec.host_s += seconds
+
+    def set_counts(self, *, offers: Optional[int] = None,
+                   queue_len: Optional[int] = None,
+                   considered: Optional[int] = None) -> None:
+        if offers is not None:
+            self.record.offers = offers
+        if queue_len is not None:
+            self.record.queue_len = queue_len
+        if considered is not None:
+            self.record.considered = considered
+
+    def note_match(self, job_uuid: str, hostname: str, task_id: str) -> None:
+        self.record.matched.append(
+            {"job": job_uuid, "host": hostname, "task_id": task_id})
+
+    def note_skip(self, job_uuid: str, code: str, detail: str = "") -> None:
+        self.record.skipped.append(
+            {"job": job_uuid, "code": code,
+             "detail": detail or REASON_TEXT.get(code, "")})
+
+    def note_not_considered(self, job_uuid: str) -> None:
+        self.not_considered.append(job_uuid)
+
+    def note_preemption(self, preemption: PreemptionRecord) -> None:
+        self.record.preemptions.append(preemption)
+
+    def finish(self) -> CycleRecord:
+        if self.record.batched:
+            # the pool-batched path starts every pool's builder before any
+            # pool's work begins, so builder-lifetime elapsed would report
+            # the whole BATCH's wall time for each pool; the sum of this
+            # pool's attributed phases (shared solve included) is the
+            # honest per-pool figure
+            self.record.total_s = self.record.device_s + self.record.host_s
+            return self.record
+        # rank may have been credited via add_phase from BEFORE the
+        # builder existed (a separately-triggered rank cycle): total must
+        # still cover every attributed phase
+        elapsed = time.perf_counter() - self._t0
+        self.record.total_s = max(elapsed,
+                                  self.record.device_s + self.record.host_s)
+        return self.record
+
+
+class NullCycle:
+    """No-op builder so instrumented code never branches on None.
+    `record` is None so call sites can uniformly test `flight.record is
+    not None` instead of hasattr."""
+
+    record = None
+
+    @contextmanager
+    def phase(self, name: str, device: bool = False):
+        yield
+
+    def add_phase(self, name: str, seconds: float, device: bool = False) -> None:
+        pass
+
+    def set_counts(self, **kw) -> None:
+        pass
+
+    def note_match(self, *a) -> None:
+        pass
+
+    def note_skip(self, *a, **kw) -> None:
+        pass
+
+    def note_not_considered(self, *a) -> None:
+        pass
+
+    def note_preemption(self, *a) -> None:
+        pass
+
+
+NULL_CYCLE = NullCycle()
+
+
+class FlightRecorder:
+    """Bounded ring of CycleRecords + per-job last-decision index."""
+
+    def __init__(self, capacity: int = 512, job_reason_capacity: int = 100_000):
+        self._ring: collections.deque[CycleRecord] = collections.deque(
+            maxlen=capacity)
+        self._by_id: collections.OrderedDict[int, CycleRecord] = \
+            collections.OrderedDict()
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        # job uuid -> (cycle_id, code, detail); LRU-bounded (job uuids are
+        # minted forever on a long-lived leader)
+        self._job_reasons: collections.OrderedDict[str, tuple[int, str, str]] \
+            = collections.OrderedDict()
+        self._job_reason_capacity = job_reason_capacity
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def begin(self, pool: str, t_ms: int) -> CycleBuilder:
+        with self._lock:
+            cycle_id = next(self._ids)
+        return CycleBuilder(cycle_id, pool, t_ms)
+
+    def commit(self, builder: CycleBuilder) -> CycleRecord:
+        record = builder.finish()
+        record.not_considered = len(builder.not_considered)
+        with self._lock:
+            self._ring.append(record)
+            self._by_id[record.cycle_id] = record
+            while len(self._by_id) > self._ring.maxlen:
+                self._by_id.popitem(last=False)
+            for m in record.matched:
+                self._note_reason(m["job"], record.cycle_id, MATCHED,
+                                  f"matched to {m['host']}")
+            for s in record.skipped:
+                self._note_reason(s["job"], record.cycle_id, s["code"],
+                                  s.get("detail", ""))
+            for uuid in builder.not_considered:
+                self._note_reason(uuid, record.cycle_id, NOT_CONSIDERED, "")
+        global_registry.histogram(
+            "cycle.duration", "total wall seconds per match cycle").observe(
+            record.total_s, {"pool": record.pool})
+        global_registry.gauge(
+            "cycle.device_seconds",
+            "accelerator time of the last match cycle").set(
+            record.device_s, {"pool": record.pool})
+        global_registry.gauge(
+            "cycle.host_seconds",
+            "host matchmaking time of the last match cycle").set(
+            record.host_s, {"pool": record.pool})
+        return record
+
+    def _note_reason(self, job_uuid: str, cycle_id: int, code: str,
+                     detail: str) -> None:
+        self._job_reasons[job_uuid] = (cycle_id, code, detail)
+        self._job_reasons.move_to_end(job_uuid)
+        while len(self._job_reasons) > self._job_reason_capacity:
+            self._job_reasons.popitem(last=False)
+
+    def annotate_preemptions(self, pool: str,
+                             preemptions: list[PreemptionRecord],
+                             duration_s: float) -> None:
+        """Attach a rebalance pass to the pool's most recent cycle record
+        (the preemption search runs as a phase of the same scheduling
+        cycle); falls back to a standalone record when no match cycle has
+        run yet for the pool."""
+        with self._lock:
+            target = None
+            for record in reversed(self._ring):
+                if record.pool == pool:
+                    target = record
+                    break
+            if target is None:
+                builder = CycleBuilder(next(self._ids), pool, 0)
+                target = builder.record
+                self._ring.append(target)
+                self._by_id[target.cycle_id] = target
+            target.phases["preemption_search"] = (
+                target.phases.get("preemption_search", 0.0) + duration_s)
+            target.host_s += duration_s
+            target.total_s += duration_s
+            target.preemptions.extend(preemptions)
+
+    # ------------------------------------------------------------------ reads
+
+    def records(self, limit: int = 50,
+                pool: Optional[str] = None) -> list[CycleRecord]:
+        """Live record references — same-thread (scheduler) use only;
+        concurrent readers must use records_json/get_json, which
+        serialize under the lock (annotate_preemptions mutates records
+        in place)."""
+        with self._lock:
+            out = [r for r in self._ring if pool is None or r.pool == pool]
+        return out[-limit:]
+
+    def get(self, cycle_id: int) -> Optional[CycleRecord]:
+        with self._lock:
+            return self._by_id.get(cycle_id)
+
+    def records_json(self, limit: int = 50,
+                     pool: Optional[str] = None) -> list[dict]:
+        """Snapshot for cross-thread consumers (REST, simulator dump):
+        serialized under the lock so a concurrent rebalance annotation
+        can't tear a record mid-render."""
+        with self._lock:
+            out = [r for r in self._ring if pool is None or r.pool == pool]
+            return [r.to_json() for r in out[-limit:]]
+
+    def get_json(self, cycle_id: int) -> Optional[dict]:
+        with self._lock:
+            record = self._by_id.get(cycle_id)
+            return None if record is None else record.to_json()
+
+    def job_reason(self, job_uuid: str) -> Optional[tuple[int, str, str]]:
+        """(cycle_id, code, detail) of the job's last cycle decision."""
+        with self._lock:
+            return self._job_reasons.get(job_uuid)

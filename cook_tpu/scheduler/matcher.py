@@ -45,6 +45,8 @@ from cook_tpu.scheduler.constraints import (
     feasibility_mask,
     validate_group_assignments,
 )
+from cook_tpu.scheduler import flight_recorder as flight_codes
+from cook_tpu.scheduler.flight_recorder import NULL_CYCLE
 from cook_tpu.scheduler.ranking import QuotaWalk, RankedQueue
 from cook_tpu.utils.metrics import global_registry
 
@@ -360,6 +362,7 @@ def prepare_pool_problem(
     launch_filter: Optional[Callable[[Job], bool]] = None,
     host_reservations: Optional[dict[str, str]] = None,
     host_attrs: Optional[dict[str, dict]] = None,
+    flight=NULL_CYCLE,
 ) -> PreparedPool:
     """Gather offers + considerable jobs and encode the tensor problem."""
     prepared = PreparedPool(pool=pool, outcome=MatchOutcome())
@@ -376,6 +379,20 @@ def prepare_pool_problem(
         store, pool, queue, state.num_considerable, launch_filter=launch_filter
     )
     considerable = prepared.considerable
+    flight.set_counts(offers=len(prepared.cluster_offers),
+                      queue_len=len(queue.jobs),
+                      considered=len(considerable))
+    if flight is not NULL_CYCLE and len(considerable) < len(queue.jobs):
+        # jobs in the ranked queue but outside this cycle's considerable
+        # window (cap, quota, launch filter, dead-in-queue): indexed so
+        # /unscheduled_jobs answers with the CURRENT reason, not a stale
+        # decision from the last cycle that did consider them.  Skipped
+        # entirely when no recorder is attached — this is O(queue) work
+        # on the latency-critical match path.
+        selected = {j.uuid for j in considerable}
+        for job in queue.jobs:
+            if job.uuid not in selected:
+                flight.note_not_considered(job.uuid)
     if not considerable or not prepared.cluster_offers:
         return prepared
 
@@ -439,6 +456,7 @@ def finalize_pool_match(
     *,
     make_task_id: Callable[[Job], str],
     record_placement_failure: Optional[Callable[[Job, str], None]] = None,
+    flight=NULL_CYCLE,
 ) -> MatchOutcome:
     """Apply a solved assignment: group validation, launch transactions,
     backend launches, autoscaling, head-of-queue backoff."""
@@ -448,6 +466,10 @@ def finalize_pool_match(
     if not prepared.solvable:
         outcome.unmatched = considerable
         outcome.head_matched = not considerable
+        code = (flight_codes.NO_OFFERS if not prepared.cluster_offers
+                else flight_codes.CONSTRAINTS_FILTERED)
+        for job in considerable:
+            flight.note_skip(job.uuid, code)
         _apply_backoff(config, state, outcome.head_matched)
         return outcome
     nodes = prepared.nodes
@@ -490,8 +512,10 @@ def finalize_pool_match(
         node_idx = int(assignment[ji])
         if node_idx < 0:
             outcome.unmatched.append(job)
+            code = _failure_reason(job, nodes, feasible[ji])
+            flight.note_skip(job.uuid, code)
             if record_placement_failure is not None:
-                record_placement_failure(job, _failure_reason(job, nodes, feasible[ji]))
+                record_placement_failure(job, flight_codes.REASON_TEXT[code])
             continue
         cluster, offer = cluster_offers[node_idx]
         budget = cluster_budget.get(cluster.name)
@@ -515,18 +539,21 @@ def finalize_pool_match(
             # higher-ranked ones were rejected
             cluster_budget[cluster.name] = 0
             outcome.unmatched.append(job)
+            flight.note_skip(job.uuid, flight_codes.LAUNCH_CAP)
             if record_placement_failure is not None:
                 record_placement_failure(
-                    job, "cluster launch rate/cap reached this cycle")
+                    job, flight_codes.REASON_TEXT[flight_codes.LAUNCH_CAP])
             continue
         task_ports = assign_ports(offer, ports_used.setdefault(node_idx, set()),
                                   job.resources.ports)
         if task_ports is None:
             # earlier matches this cycle exhausted the offer's ports
             outcome.unmatched.append(job)
+            flight.note_skip(job.uuid, flight_codes.PORTS_EXHAUSTED)
             if record_placement_failure is not None:
                 record_placement_failure(
-                    job, "insufficient free ports on the matched node")
+                    job,
+                    flight_codes.REASON_TEXT[flight_codes.PORTS_EXHAUSTED])
             continue
         ports_used[node_idx].update(task_ports)
         cluster_budget[cluster.name] = budget - 1
@@ -541,6 +568,7 @@ def finalize_pool_match(
             )
         except TransactionVetoed:
             # job completed/launched concurrently; drop the match
+            flight.note_skip(job.uuid, flight_codes.LAUNCH_VETOED)
             continue
         # checkpoint context rides in the task env uniformly for every
         # backend (mode/period for the tooling, preserve paths for the
@@ -583,6 +611,7 @@ def finalize_pool_match(
         cluster_by_name[cluster.name] = cluster
         outcome.matched.append((job, offer))
         outcome.launched_task_ids.append(task_id)
+        flight.note_match(job.uuid, offer.hostname, task_id)
 
     for cname, specs in launches_per_cluster.items():
         cluster = cluster_by_name[cname]
@@ -729,37 +758,45 @@ def match_pool(
     record_placement_failure: Optional[Callable[[Job, str], None]] = None,
     host_reservations: Optional[dict[str, str]] = None,
     host_attrs: Optional[dict[str, dict]] = None,
+    flight=NULL_CYCLE,
 ) -> MatchOutcome:
     """One pool's match cycle end to end (prepare -> solve -> finalize)."""
-    prepared = prepare_pool_problem(
-        store, pool, queue, clusters, config, state,
-        launch_filter=launch_filter, host_reservations=host_reservations,
-        host_attrs=host_attrs,
-    )
+    with flight.phase("tensor_build"):
+        prepared = prepare_pool_problem(
+            store, pool, queue, clusters, config, state,
+            launch_filter=launch_filter, host_reservations=host_reservations,
+            host_attrs=host_attrs, flight=flight,
+        )
     assignment = np.empty(0, dtype=np.int32)
     if prepared.solvable:
-        if config.chunk:
-            result = chunked_match(prepared.problem, chunk=config.chunk,
-                                   rounds=config.chunk_rounds,
-                                   passes=config.chunk_passes,
-                                   kc=config.chunk_kc,
-                                   **backend_flags(config.backend))
-        else:
-            result = greedy_match(prepared.problem)
-        assignment = np.asarray(
-            result.assignment[: len(prepared.considerable)]
-        )
+        # the solve is the cycle's device section: np.asarray blocks until
+        # the kernel's result is materialized, so this phase's wall time
+        # covers dispatch + device execution + transfer
+        with flight.phase("solve", device=True):
+            if config.chunk:
+                result = chunked_match(prepared.problem, chunk=config.chunk,
+                                       rounds=config.chunk_rounds,
+                                       passes=config.chunk_passes,
+                                       kc=config.chunk_kc,
+                                       **backend_flags(config.backend))
+            else:
+                result = greedy_match(prepared.problem)
+            assignment = np.asarray(
+                result.assignment[: len(prepared.considerable)]
+            )
         if config.chunk:
             state.chunked_solves += 1
             if (config.quality_audit_every
                     and state.chunked_solves
                     % config.quality_audit_every == 0):
                 start_quality_audit(prepared, assignment, pool.name)
-    return finalize_pool_match(
-        store, prepared, assignment, config, state, clusters,
-        make_task_id=make_task_id,
-        record_placement_failure=record_placement_failure,
-    )
+    with flight.phase("launch"):
+        return finalize_pool_match(
+            store, prepared, assignment, config, state, clusters,
+            make_task_id=make_task_id,
+            record_placement_failure=record_placement_failure,
+            flight=flight,
+        )
 
 
 def match_pools_batched(
@@ -776,6 +813,7 @@ def match_pools_batched(
     host_reservations: Optional[dict[str, str]] = None,
     host_attrs: Optional[dict[str, dict]] = None,
     mesh=None,
+    flights: Optional[dict] = None,
 ) -> dict[str, MatchOutcome]:
     """Solve EVERY pool's match problem in one batched device call.
 
@@ -791,16 +829,29 @@ def match_pools_batched(
 
     from cook_tpu.parallel.mesh import pool_sharded_match, shard_pools
 
-    prepared_list = [
-        prepare_pool_problem(
-            store, pool, queues[pool.name], clusters, config,
-            states[pool.name], launch_filter=launch_filter,
-            host_reservations=host_reservations, host_attrs=host_attrs,
-        )
-        for pool in pools
-    ]
+    flights = flights or {}
+    for f in flights.values():
+        if f.record is not None:
+            f.record.batched = True
+
+    def pool_flight(pool_name: str):
+        return flights.get(pool_name, NULL_CYCLE)
+
+    prepared_list = []
+    for pool in pools:
+        flight = pool_flight(pool.name)
+        with flight.phase("tensor_build"):
+            prepared_list.append(prepare_pool_problem(
+                store, pool, queues[pool.name], clusters, config,
+                states[pool.name], launch_filter=launch_filter,
+                host_reservations=host_reservations, host_attrs=host_attrs,
+                flight=flight,
+            ))
     solvable = [p for p in prepared_list if p.solvable]
     if solvable:
+        import time as _time
+
+        t_stack = _time.perf_counter()
         # pad every pool's problem to shared buckets and stack
         max_j = max(p.problem.demands.shape[0] for p in solvable)
         max_n = max(p.problem.avail.shape[0] for p in solvable)
@@ -821,6 +872,12 @@ def match_pools_batched(
             lambda *leaves: jnp.stack(leaves),
             *[pad_problem(p.problem) for p in solvable],
         )
+        # the shared pad/stack is host work, not solve time — credit it
+        # as tensor_build so device_s stays an honest accelerator figure
+        stack_s = _time.perf_counter() - t_stack
+        for p in solvable:
+            pool_flight(p.pool.name).add_phase("tensor_build", stack_s)
+        t_solve = _time.perf_counter()
         if mesh is not None and len(solvable) % mesh.devices.size == 0:
             stacked = shard_pools(mesh, stacked)
             result = pool_sharded_match(mesh, stacked,
@@ -841,6 +898,13 @@ def match_pools_batched(
         else:
             result = jax.vmap(greedy_match)(stacked)
         assignments = np.asarray(result.assignment)
+        # one shared device call solved every pool: each participating
+        # pool's record carries the full solve wall time (no pool's cycle
+        # can finish sooner than the batch)
+        solve_s = _time.perf_counter() - t_solve
+        for p in solvable:
+            pool_flight(p.pool.name).add_phase("solve", solve_s,
+                                               device=True)
 
     outcomes: dict[str, MatchOutcome] = {}
     solve_idx = 0
@@ -857,12 +921,15 @@ def match_pools_batched(
                         % config.quality_audit_every == 0):
                     start_quality_audit(prepared, assignment,
                                         prepared.pool.name)
-        outcomes[prepared.pool.name] = finalize_pool_match(
-            store, prepared, assignment, config, states[prepared.pool.name],
-            clusters,
-            make_task_id=make_task_id,
-            record_placement_failure=record_placement_failure,
-        )
+        flight = pool_flight(prepared.pool.name)
+        with flight.phase("launch"):
+            outcomes[prepared.pool.name] = finalize_pool_match(
+                store, prepared, assignment, config,
+                states[prepared.pool.name], clusters,
+                make_task_id=make_task_id,
+                record_placement_failure=record_placement_failure,
+                flight=flight,
+            )
     return outcomes
 
 
@@ -882,9 +949,13 @@ def _apply_backoff(config: MatchConfig, state: PoolMatchState,
         state.num_considerable = shrunk
 
 
-def _failure_reason(job: Job, nodes: EncodedNodes, feas_row: np.ndarray) -> str:
+def _failure_reason(job: Job, nodes: EncodedNodes,
+                    feas_row: np.ndarray) -> str:
+    """Machine-readable reason code for an unmatched job; the operator-
+    facing text is flight_recorder.REASON_TEXT[code] (one source, so
+    /unscheduled_jobs and the cycle record can never diverge)."""
     if nodes.n == 0:
-        return "no offers"
+        return flight_codes.NO_OFFERS
     if not feas_row.any():
-        return "all nodes filtered by constraints"
-    return "insufficient resources on feasible nodes"
+        return flight_codes.CONSTRAINTS_FILTERED
+    return flight_codes.INSUFFICIENT_RESOURCES

@@ -10,8 +10,91 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from cook_tpu.models.entities import Resources
-from cook_tpu.models.store import JobStore
+from cook_tpu.models.store import Event, JobStore
 from cook_tpu.utils.metrics import global_registry
+
+# job-lifecycle latencies span milliseconds (a hot match) to days (a
+# starved batch queue) — the default request-scale buckets top out at 60s
+LIFECYCLE_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0,
+                     600.0, 1800.0, 3600.0, 7200.0, 21600.0, 86400.0,
+                     float("inf"))
+
+
+def observe_commit_ack(seconds: float) -> None:
+    """submit -> commit-ack: wall time the REST layer spent committing a
+    submission (apply + journal fsync + replication wait).  Wide buckets:
+    a commit stalled minutes on a recovering standby is exactly what this
+    metric exists to expose, and must not collapse into +Inf."""
+    global_registry.histogram(
+        "job.latency.submit_commit_ack",
+        "seconds from submission arrival to durable commit ack",
+        buckets=LIFECYCLE_BUCKETS,
+    ).observe(seconds)
+
+
+class JobLifecycleTracker:
+    """Store watcher that turns lifecycle transitions into the job-latency
+    SLO histograms exported at /metrics:
+
+      * submit -> matched   (instance created for a waiting job)
+      * matched -> running  (backend reported the task running)
+      * submit -> completed (end-to-end)
+
+    Times come from the store clock (virtual in the simulator, epoch ms in
+    production), so the histograms measure scheduler-visible latency, not
+    wall time spent in this process.
+
+    `enabled` is the standby effect-gate (same pattern as the scheduler's
+    kill fan-out): a passive node applies REPLICATED events at apply
+    time, so a backlog replayed after downtime would observe latencies
+    inflated by the outage — and the contaminated process-global
+    histograms would survive promotion."""
+
+    def __init__(self, store: JobStore, enabled=None):
+        self.store = store
+        self._enabled = enabled
+        self._submit_to_matched = global_registry.histogram(
+            "job.latency.submit_to_matched",
+            "seconds from job submission to first match",
+            buckets=LIFECYCLE_BUCKETS)
+        self._matched_to_running = global_registry.histogram(
+            "job.latency.matched_to_running",
+            "seconds from match (instance created) to running",
+            buckets=LIFECYCLE_BUCKETS)
+        self._end_to_end = global_registry.histogram(
+            "job.latency.end_to_end",
+            "seconds from job submission to completion",
+            buckets=LIFECYCLE_BUCKETS)
+        store.add_watcher(self._on_event)
+
+    def _on_event(self, event: Event) -> None:
+        if self._enabled is not None and not self._enabled():
+            return
+        now_ms = self.store.clock()
+        if event.kind == "instance/created":
+            job = self.store.jobs.get(event.data.get("job", ""))
+            # first instance only: a retry matched days later must not
+            # re-observe the full submit->now interval into the
+            # first-match histogram
+            if job is not None and len(job.instance_ids) == 1:
+                self._submit_to_matched.observe(
+                    max(0.0, (now_ms - job.submit_time_ms) / 1000.0),
+                    {"pool": job.pool})
+        elif (event.kind == "instance/status"
+              and event.data.get("status") == "running"):
+            inst = self.store.instances.get(event.data.get("task_id", ""))
+            if inst is not None:
+                job = self.store.jobs.get(inst.job_uuid)
+                self._matched_to_running.observe(
+                    max(0.0, (now_ms - inst.start_time_ms) / 1000.0),
+                    {"pool": job.pool} if job is not None else None)
+        elif (event.kind == "job/state"
+              and event.data.get("state") == "completed"):
+            job = self.store.jobs.get(event.data.get("uuid", ""))
+            if job is not None:
+                self._end_to_end.observe(
+                    max(0.0, (now_ms - job.submit_time_ms) / 1000.0),
+                    {"pool": job.pool})
 
 
 @dataclass

@@ -51,6 +51,11 @@ def cmd_run(args) -> int:
     result = sim.run()
     with open(args.out, "w") as f:
         f.write(result.to_csv())
+    if args.cycles_out:
+        # flight-recorder dump: per-cycle decision records for offline
+        # analysis (same schema as GET /debug/cycles)
+        with open(args.cycles_out, "w") as f:
+            f.write(result.cycle_records_json())
     completed = sum(1 for r in result.rows if r["status"] == "success")
     p50 = (sorted(result.cycle_wall_s)[len(result.cycle_wall_s) // 2] * 1000
            if result.cycle_wall_s else 0.0)
@@ -150,6 +155,8 @@ def main(argv=None) -> int:
     r = sub.add_parser("run", help="replay a trace")
     r.add_argument("--trace", required=True)
     r.add_argument("--out", default="run.csv")
+    r.add_argument("--cycles-out", default="",
+                   help="dump flight-recorder cycle records (JSON) here")
     r.add_argument("--cycle-ms", type=int, default=30_000)
     r.add_argument("--rebalance-every", type=int, default=0)
     r.add_argument("--max-cycles", type=int, default=10_000)

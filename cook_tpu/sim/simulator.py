@@ -99,6 +99,13 @@ class SimResult:
     virtual_ms: int
     phase_wall_s: dict[str, float]
     cycle_wall_s: list[float]        # per-cycle total scheduling wall time
+    # flight-recorder dump: one structured record per match cycle (per-
+    # phase durations, per-job reason codes, preemptions) for offline
+    # analysis — same schema as GET /debug/cycles (docs/observability.md)
+    cycle_records: list[dict] = field(default_factory=list)
+
+    def cycle_records_json(self) -> str:
+        return json.dumps({"cycles": self.cycle_records}, indent=1)
 
     def utilization(self, hosts: Sequence[TraceHost]) -> float:
         """Fraction of total cpu-ms capacity actually used by completed
@@ -164,6 +171,17 @@ class Simulator:
         self.scheduler = Scheduler(
             self.store, [self.cluster], self.config.scheduler
         )
+        if self.scheduler.recorder is not None:
+            # the service default ring (512) would silently truncate the
+            # offline dump: size it to hold every cycle of every pool this
+            # run can produce (bounded — records only materialize for
+            # cycles that actually run)
+            from cook_tpu.scheduler.flight_recorder import FlightRecorder
+
+            wanted = min(self.config.max_cycles
+                         * max(1, len(self.config.pools)), 1_000_000)
+            if wanted > self.scheduler.recorder.capacity:
+                self.scheduler.recorder = FlightRecorder(capacity=wanted)
         self._runtime: dict[str, int] = {
             j.uuid: j.runtime_ms for j in self.trace_jobs
         }
@@ -240,12 +258,15 @@ class Simulator:
                     break
         # final flush so trailing completions land in the trace
         self.cluster.advance_to(self.now_ms)
+        recorder = self.scheduler.recorder
         return SimResult(
             rows=self._collect_rows(),
             cycles=cycle,
             virtual_ms=self.now_ms,
             phase_wall_s=phase_wall,
             cycle_wall_s=cycle_wall,
+            cycle_records=(recorder.records_json(limit=recorder.capacity)
+                           if recorder is not None else []),
         )
 
     def _collect_rows(self) -> list[dict]:

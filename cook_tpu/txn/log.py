@@ -31,6 +31,7 @@ from typing import Any, Optional
 from cook_tpu.models.store import JobStore
 from cook_tpu.txn.ops import OPS, UnknownOperation
 from cook_tpu.txn.transaction import Transaction, TxnOutcome, new_txn_id
+from cook_tpu.utils import tracing
 
 log = logging.getLogger(__name__)
 
@@ -85,8 +86,14 @@ class TransactionLog:
                             seq=cached.get("seq", 0),
                             result=cached.get("result"),
                             duplicate=True, attempts=attempts)
-                    result = handler(store, txn.payload)
-                    seq = store.note_txn(txn.txn_id, txn.op, result)
+                    # correlation scope: every span opened while the op
+                    # applies (including nested store spans) carries the
+                    # transaction id, linking the span ring back to the
+                    # client's X-Cook-Txn-Id
+                    with tracing.correlate(txn.txn_id), \
+                            tracing.span("txn.apply", op=txn.op):
+                        result = handler(store, txn.payload)
+                        seq = store.note_txn(txn.txn_id, txn.op, result)
                 break
             except TransientTxnError:
                 if attempts >= self.policy.max_attempts:

@@ -60,6 +60,9 @@ class Histogram:
         self.name = name
         self.help = help_
         self.buckets = tuple(buckets)
+        if not self.buckets or self.buckets[-1] != math.inf:
+            # every observation must land in a bucket or _count undercounts
+            self.buckets += (math.inf,)
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._lock = threading.Lock()
@@ -101,8 +104,12 @@ class Registry:
     def gauge(self, name: str, help_: str = "") -> Gauge:
         return self._get(name, lambda: Gauge(name, help_), Gauge)
 
-    def histogram(self, name: str, help_: str = "") -> Histogram:
-        return self._get(name, lambda: Histogram(name, help_), Histogram)
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        return self._get(
+            name,
+            lambda: Histogram(name, help_, buckets or _DEFAULT_BUCKETS),
+            Histogram)
 
     def _get(self, name, factory, cls):
         with self._lock:
@@ -115,20 +122,36 @@ class Registry:
             return m
 
     def render_prometheus(self) -> str:
+        # snapshot the metric set under the registry lock, then each
+        # metric's values under ITS lock: a writer mutating a dict (or a
+        # histogram's counts/sums pair) mid-render would corrupt (or
+        # tear) the exposition otherwise
+        with self._lock:
+            metrics = sorted(self._metrics.items())
         lines = []
-        for name, metric in sorted(self._metrics.items()):
+        for name, metric in metrics:
             pname = "cook_" + name.replace(".", "_").replace("-", "_")
+            if metric.help:
+                lines.append(f"# HELP {pname} {_escape_help(metric.help)}")
             if isinstance(metric, Counter):
                 lines.append(f"# TYPE {pname} counter")
-                for key, v in sorted(metric._values.items()):
+                with metric._lock:
+                    values = sorted(metric._values.items())
+                for key, v in values:
                     lines.append(f"{pname}{_fmt_labels(key)} {v}")
             elif isinstance(metric, Gauge):
                 lines.append(f"# TYPE {pname} gauge")
-                for key, v in sorted(metric._values.items()):
+                with metric._lock:
+                    values = sorted(metric._values.items())
+                for key, v in values:
                     lines.append(f"{pname}{_fmt_labels(key)} {v}")
             elif isinstance(metric, Histogram):
                 lines.append(f"# TYPE {pname} histogram")
-                for key, counts in sorted(metric._counts.items()):
+                with metric._lock:
+                    all_counts = sorted(
+                        (key, list(counts), metric._sums.get(key, 0.0))
+                        for key, counts in metric._counts.items())
+                for key, counts, total in all_counts:
                     cum = 0
                     for b, c in zip(metric.buckets, counts):
                         cum += c
@@ -137,16 +160,25 @@ class Registry:
                             f"{pname}_bucket{_fmt_labels(key + (('le', le),))} {cum}"
                         )
                     lines.append(f"{pname}_count{_fmt_labels(key)} {cum}")
-                    lines.append(
-                        f"{pname}_sum{_fmt_labels(key)} {metric._sums.get(key, 0.0)}"
-                    )
+                    lines.append(f"{pname}_sum{_fmt_labels(key)} {total}")
         return "\n".join(lines) + "\n"
+
+
+def _escape_label_value(value) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote, and newline would otherwise corrupt the output line."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(key: tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 

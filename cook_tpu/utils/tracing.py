@@ -5,6 +5,13 @@ Reference: opentracing spans around every match-cycle phase
 `tracing/with-span`).  Spans record wall durations into the metrics
 registry (histogram per span name) and an optional in-memory trace ring for
 debugging; `jax.profiler` can be layered on for device-side traces.
+
+Correlation: a thread-local correlation id (the transaction id from the
+commit pipeline, i.e. the client's `X-Cook-Txn-Id`) tags every span opened
+while it is set, so the span ring links a mutation's spans — REST commit,
+txn apply, store ops — back to the transaction.  The correlation tag is
+ring-only: it is excluded from metric labels (an unbounded-cardinality
+label would explode the registry).
 """
 from __future__ import annotations
 
@@ -12,11 +19,40 @@ import collections
 import threading
 import time
 from contextlib import contextmanager
+from typing import Optional
+
 from cook_tpu.utils.metrics import global_registry
 
 _trace_ring: collections.deque = collections.deque(maxlen=4096)
 _lock = threading.Lock()
 _active: dict[int, list[str]] = {}
+_correlation = threading.local()
+
+# tags that carry per-request identity: kept in the trace ring, stripped
+# from metric labels (label cardinality must stay bounded)
+_RING_ONLY_TAGS = ("txn_id", "error")
+
+
+def set_correlation(txn_id: Optional[str]) -> Optional[str]:
+    """Set the current thread's correlation id; returns the previous one
+    so nested scopes can restore it."""
+    prev = getattr(_correlation, "txn_id", None)
+    _correlation.txn_id = txn_id
+    return prev
+
+
+def current_correlation() -> Optional[str]:
+    return getattr(_correlation, "txn_id", None)
+
+
+@contextmanager
+def correlate(txn_id: Optional[str]):
+    """Scope a correlation id: every span opened inside carries it."""
+    prev = set_correlation(txn_id)
+    try:
+        yield
+    finally:
+        set_correlation(prev)
 
 
 @contextmanager
@@ -27,13 +63,28 @@ def span(name: str, **tags):
         stack = _active.setdefault(tid, [])
         parent = stack[-1] if stack else None
         stack.append(name)
+    corr = current_correlation()
+    if corr is not None and "txn_id" not in tags:
+        tags["txn_id"] = corr
+    error = False
     t0 = time.perf_counter()
     try:
         yield
+    except BaseException:
+        error = True
+        raise
     finally:
         duration = time.perf_counter() - t0
+        if error:
+            tags["error"] = True
         with _lock:
-            _active[tid].pop()
+            stack = _active.get(tid)
+            if stack:
+                stack.pop()
+                if not stack:
+                    # drop the empty entry: a pool of short-lived threads
+                    # would otherwise leak one dict slot per thread forever
+                    del _active[tid]
             _trace_ring.append({
                 "name": name,
                 "parent": parent,
@@ -41,14 +92,44 @@ def span(name: str, **tags):
                 "tags": tags,
                 "t": time.time(),
             })
+        metric_tags = {k: v for k, v in tags.items()
+                       if k not in _RING_ONLY_TAGS}
         global_registry.histogram(f"span.{name}").observe(
-            duration, labels=tags or None
+            duration, labels=metric_tags or None
         )
+
+
+def record_event(name: str, **tags) -> None:
+    """Append a zero-duration marker to the trace ring WITHOUT touching
+    the metrics registry — for correlation points (e.g. a replication
+    ack) where a duration histogram would be meaningless noise."""
+    corr = current_correlation()
+    if corr is not None and "txn_id" not in tags:
+        tags["txn_id"] = corr
+    with _lock:
+        _trace_ring.append({
+            "name": name,
+            "parent": None,
+            "duration_s": 0.0,
+            "tags": tags,
+            "t": time.time(),
+        })
+
+
+def ring_capacity() -> int:
+    return _trace_ring.maxlen
 
 
 def recent_spans(limit: int = 100) -> list[dict]:
     with _lock:
         return list(_trace_ring)[-limit:]
+
+
+def active_thread_count() -> int:
+    """Threads currently holding an open span (observability for the
+    leak regression test)."""
+    with _lock:
+        return len(_active)
 
 
 @contextmanager

@@ -1,0 +1,74 @@
+"""tools/lint_metrics.py: the static metrics-registry lint, wired into
+the tier-1 run — the repo itself must stay clean."""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+
+import lint_metrics  # noqa: E402
+
+REPO_ROOT = str(pathlib.Path(__file__).parent.parent)
+
+
+def lint_source(src: str):
+    return lint_metrics.lint_sites(lint_metrics.collect_sites(src, "x.py"))
+
+
+def test_repo_metrics_are_clean():
+    result = lint_metrics.lint_tree(REPO_ROOT)
+    assert result.ok, "\n".join(result.errors)
+    # sanity: the walker actually found the registry call sites
+    assert len(result.sites) > 10
+
+
+def test_conflicting_types_detected():
+    result = lint_source(
+        "global_registry.counter('match.matched')\n"
+        "global_registry.gauge('match.matched')\n")
+    assert not result.ok
+    assert "conflicting types" in result.errors[0]
+
+
+def test_same_type_duplicates_allowed():
+    result = lint_source(
+        "global_registry.counter('a.b')\n"
+        "global_registry.counter('a.b')\n")
+    assert result.ok
+
+
+def test_invalid_prometheus_identifier_detected():
+    result = lint_source("global_registry.counter('has space')\n")
+    assert not result.ok
+    assert "invalid Prometheus identifier" in result.errors[0]
+
+
+def test_dots_and_dashes_map_to_underscores():
+    assert lint_metrics.rendered_name("a.b-c") == "cook_a_b_c"
+    assert lint_source("global_registry.gauge('a.b-c')\n").ok
+
+
+def test_dynamic_names_skipped_but_fragments_checked():
+    ok = lint_source('global_registry.histogram(f"span.{name}")\n')
+    assert ok.ok
+    assert ok.sites[0].dynamic
+    bad = lint_source('global_registry.histogram(f"sp an.{name}")\n')
+    assert not bad.ok
+
+
+def test_attribute_qualified_registry_matches():
+    result = lint_source(
+        "metrics.global_registry.counter('x')\n"
+        "metrics.global_registry.histogram('x')\n")
+    assert not result.ok
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "a.py").write_text("global_registry.counter('fine.name')\n")
+    assert lint_metrics.main([str(clean)]) == 0
+    dirty = tmp_path / "dirty"
+    dirty.mkdir()
+    (dirty / "a.py").write_text(
+        "global_registry.counter('n')\nglobal_registry.gauge('n')\n")
+    assert lint_metrics.main([str(dirty)]) == 1

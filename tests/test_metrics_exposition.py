@@ -1,0 +1,124 @@
+"""Prometheus exposition correctness: label escaping, cumulative
+histogram buckets, +Inf, and render-under-write safety."""
+import math
+import threading
+
+import pytest
+
+from cook_tpu.utils.metrics import (
+    Histogram,
+    Registry,
+    _escape_label_value,
+    _fmt_labels,
+)
+
+
+def test_label_value_escaping():
+    assert _escape_label_value('say "hi"') == 'say \\"hi\\"'
+    assert _escape_label_value("a\\b") == "a\\\\b"
+    assert _escape_label_value("line1\nline2") == "line1\\nline2"
+    # non-string values stringify before escaping
+    assert _escape_label_value(7) == "7"
+
+
+def test_fmt_labels_escapes_into_exposition():
+    rendered = _fmt_labels((("cmd", 'echo "x\\y"\n'),))
+    assert rendered == '{cmd="echo \\"x\\\\y\\"\\n"}'
+
+
+def test_escaped_labels_render_one_line_each():
+    reg = Registry()
+    reg.counter("evil").inc(1.0, {"reason": 'oom "killer"\nretry'})
+    text = reg.render_prometheus()
+    [line] = [l for l in text.splitlines() if l.startswith("cook_evil{")]
+    assert '\\"killer\\"' in line and "\\n" in line
+    # the raw newline/quote never reach the output unescaped
+    assert "\n" not in line
+
+
+def test_histogram_cumulative_buckets_and_inf():
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0, math.inf))
+    for v in (0.05, 0.5, 0.7, 5.0):
+        h.observe(v)
+    text = reg.render_prometheus()
+    lines = [l for l in text.splitlines() if l.startswith("cook_lat")]
+    assert 'cook_lat_bucket{le="0.1"} 1' in lines
+    assert 'cook_lat_bucket{le="1.0"} 3' in lines
+    assert 'cook_lat_bucket{le="+Inf"} 4' in lines
+    assert "cook_lat_count 4" in lines
+    assert "cook_lat_sum 6.25" in lines
+
+
+def test_histogram_without_inf_bucket_still_counts_everything():
+    # a bucket list missing +Inf silently dropped large observations
+    # before; the constructor now appends it
+    h = Histogram("x", buckets=(1.0, 2.0))
+    assert h.buckets[-1] == math.inf
+    h.observe(100.0)
+    assert h.count() == 1
+
+
+def test_histogram_labeled_series_render_independently():
+    reg = Registry()
+    h = reg.histogram("per_pool", buckets=(1.0, math.inf))
+    h.observe(0.5, {"pool": "a"})
+    h.observe(5.0, {"pool": "b"})
+    text = reg.render_prometheus()
+    assert 'cook_per_pool_bucket{pool="a",le="1.0"} 1' in text
+    assert 'cook_per_pool_bucket{pool="b",le="1.0"} 0' in text
+    assert 'cook_per_pool_bucket{pool="b",le="+Inf"} 1' in text
+    assert 'cook_per_pool_count{pool="a"} 1' in text
+
+
+def test_help_lines_rendered_and_escaped():
+    reg = Registry()
+    reg.gauge("g", "multi\nline help")
+    reg.gauge("g").set(1.0)
+    text = reg.render_prometheus()
+    assert "# HELP cook_g multi\\nline help" in text
+    assert "# TYPE cook_g gauge" in text
+
+
+def test_render_concurrent_with_writes_never_corrupts():
+    reg = Registry()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            reg.counter("c").inc(1.0, {"k": f"v{i % 7}"})
+            reg.histogram("h").observe(0.01 * (i % 30))
+            i += 1
+
+    def reader():
+        try:
+            for _ in range(200):
+                text = reg.render_prometheus()
+                for line in text.splitlines():
+                    if line and not line.startswith("#"):
+                        # every sample line must parse: name{...} value
+                        name, _, value = line.rpartition(" ")
+                        assert name
+                        float(value)
+        except Exception as e:  # noqa: BLE001 — surfaced in the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)]
+    r = threading.Thread(target=reader)
+    for t in threads:
+        t.start()
+    r.start()
+    r.join()
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+def test_registry_type_conflict_still_raises():
+    reg = Registry()
+    reg.counter("dup")
+    with pytest.raises(TypeError):
+        reg.gauge("dup")

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Static metrics lint: every `global_registry.*` call site must agree.
+
+The registry raises at RUNTIME when one name is requested as two
+different metric types — but only when the second call site actually
+executes, which for cold paths can be mid-incident.  This linter walks
+the source tree instead and fails when:
+
+  * the same metric name is registered with conflicting types
+    (e.g. `counter("match.matched")` in one file and
+    `gauge("match.matched")` in another);
+  * a literal metric name does not render to a valid Prometheus
+    identifier under the exposition mapping
+    (`cook_` + name with `.`/`-` -> `_`).
+
+Dynamic names (f-strings like `f"span.{name}"`) can't be validated
+statically; their constant fragments are still checked for characters
+that could never be valid.
+
+Wired into the tier-1 test run via tests/test_lint_metrics.py.
+
+    python tools/lint_metrics.py [root]
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from dataclasses import dataclass, field
+
+METRIC_FACTORIES = ("counter", "gauge", "histogram")
+_VALID_RENDERED = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+# characters a name fragment may use pre-mapping (".", "-" map to "_")
+_VALID_FRAGMENT = re.compile(r"[a-zA-Z0-9_:.\-]*$")
+
+
+def rendered_name(name: str) -> str:
+    """The exposition-time mapping from utils/metrics.py render_prometheus."""
+    return "cook_" + name.replace(".", "_").replace("-", "_")
+
+
+@dataclass
+class CallSite:
+    path: str
+    line: int
+    metric_type: str
+    name: str            # literal, or the constant fragments of an f-string
+    dynamic: bool = False
+
+
+@dataclass
+class LintResult:
+    sites: list[CallSite] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+
+def _is_global_registry(node: ast.expr) -> bool:
+    # global_registry.counter(...) or <mod>.global_registry.counter(...)
+    if isinstance(node, ast.Name):
+        return node.id == "global_registry"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "global_registry"
+    return False
+
+
+def _name_arg(call: ast.Call) -> tuple[str, bool] | None:
+    """(name, dynamic) from the first positional arg; None when it isn't
+    a string-ish literal at all (a variable — nothing to check)."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, False
+    if isinstance(arg, ast.JoinedStr):
+        fragments = [v.value for v in arg.values
+                     if isinstance(v, ast.Constant) and isinstance(v.value, str)]
+        return "".join(fragments), True
+    return None
+
+
+def collect_sites(source: str, path: str) -> list[CallSite]:
+    sites: list[CallSite] = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return sites
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in METRIC_FACTORIES
+                and _is_global_registry(func.value)):
+            continue
+        parsed = _name_arg(node)
+        if parsed is None:
+            continue
+        name, dynamic = parsed
+        sites.append(CallSite(path=path, line=node.lineno,
+                              metric_type=func.attr, name=name,
+                              dynamic=dynamic))
+    return sites
+
+
+def lint_sites(sites: list[CallSite]) -> LintResult:
+    result = LintResult(sites=sites)
+    by_name: dict[str, dict[str, list[CallSite]]] = {}
+    for site in sites:
+        where = f"{site.path}:{site.line}"
+        if site.dynamic:
+            # can't validate the whole name; the constant fragments must
+            # still be mappable
+            if not _VALID_FRAGMENT.match(site.name):
+                result.errors.append(
+                    f"{where}: dynamic metric name has invalid constant "
+                    f"fragment {site.name!r}")
+            continue
+        pname = rendered_name(site.name)
+        if not _VALID_RENDERED.match(pname):
+            result.errors.append(
+                f"{where}: metric {site.name!r} renders to invalid "
+                f"Prometheus identifier {pname!r}")
+        by_name.setdefault(site.name, {}).setdefault(
+            site.metric_type, []).append(site)
+    for name, types in sorted(by_name.items()):
+        if len(types) > 1:
+            locations = "; ".join(
+                f"{t}@" + ",".join(f"{s.path}:{s.line}" for s in ss)
+                for t, ss in sorted(types.items()))
+            result.errors.append(
+                f"metric {name!r} registered with conflicting types: "
+                f"{locations}")
+    return result
+
+
+def lint_tree(root: str) -> LintResult:
+    root_path = pathlib.Path(root)
+    sites: list[CallSite] = []
+    scan_dirs = [d for d in (root_path / "cook_tpu", root_path / "tools")
+                 if d.is_dir()]
+    if not scan_dirs:   # linting an arbitrary directory
+        scan_dirs = [root_path]
+    for scan in scan_dirs:
+        for path in sorted(scan.rglob("*.py")):
+            try:
+                source = path.read_text()
+            except OSError:
+                continue
+            sites.extend(collect_sites(source, str(path)))
+    return lint_sites(sites)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else str(pathlib.Path(__file__).parent.parent)
+    result = lint_tree(root)
+    for error in result.errors:
+        print(f"lint_metrics: {error}", file=sys.stderr)
+    literal = sum(1 for s in result.sites if not s.dynamic)
+    print(f"lint_metrics: {len(result.sites)} call sites "
+          f"({literal} literal), {len(result.errors)} errors")
+    return 1 if result.errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
