@@ -11,13 +11,25 @@ reference-style Fenzo greedy; numpy fallback when no toolchain):
 
 Prints ONE JSON line:
   {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": speedup}
+
+Continuous-harness mode: every run also collects structured per-phase
+results ({"schema": "cook-bench/v1", "phases": {match, dru, rebalance,
+...}}) and writes them to a BENCH_r*.json record —
+`BENCH_r{NN}_phases.json` (next free round index) for full runs,
+`BENCH_rsmoke.json` for `python bench.py --smoke` (the tiny fast tier
+also exercised by tests/test_bench_smoke.py).  `tools/bench_gate.py`
+diffs the last two comparable records and exits non-zero on regression.
 """
+import glob
 import json
 import os
+import re
 import sys
 import time
 
 import numpy as np
+
+BENCH_SCHEMA = "cook-bench/v1"
 
 
 def log(*args):
@@ -46,12 +58,10 @@ def make_problem(j, n, seed=0):
 
 
 def time_fn(fn, repeats=5):
-    """Each fn MUST end with a device-to-host fetch (np.asarray on an
-    output): over the remote-device tunnel, jax.block_until_ready returns
-    without waiting (measured ~0.05 ms for a ~950 ms solve), so only a
-    materialized transfer observes completion.  Fetching the result is also
-    the honest cycle semantics — the scheduler consumes assignments
-    host-side."""
+    """Each fn MUST end in `cook_tpu.ops.common.fetch_result` (the one
+    shared definition of "the solve finished": a device-to-host fetch,
+    since block_until_ready returns early over remote-device tunnels and
+    the scheduler consumes results host-side anyway)."""
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -92,6 +102,7 @@ def load_tuned():
 
 def bench_match(jax, jnp, platform):
     from cook_tpu.ops import cpu_reference as ref
+    from cook_tpu.ops.common import fetch_result
     from cook_tpu.ops.match import MatchProblem, backend_flags, chunked_match
 
     if platform == "cpu":
@@ -131,7 +142,7 @@ def bench_match(jax, jnp, platform):
                                    rounds=cfg["rounds"], kc=cfg["kc"],
                                    passes=cfg["passes"],
                                    **backend_flags(cfg["backend"]))
-            return np.asarray(result.assignment)
+            return fetch_result(result.assignment)
         return solve
 
     solve = make_solve(tuned, chunk)
@@ -169,28 +180,67 @@ def bench_match(jax, jnp, platform):
     return p50, cpu_ms, eff, (j_real, n_real)
 
 
+def make_dru_problem(jnp, t, u, t_real=None, seed=3):
+    """DruTasks + divisors at any size — ONE construction for the full
+    and smoke tiers (same field semantics; a new DruTasks column changes
+    both or neither).  Returns (tasks, div, host) where `host` holds the
+    raw numpy columns for the C++ baseline."""
+    from cook_tpu.ops.dru import DruTasks
+
+    rng = np.random.default_rng(seed)
+    user = rng.integers(0, u, t).astype(np.int32)
+    mem = rng.uniform(100, 8000, t).astype(np.float32)
+    cpus = rng.uniform(0.5, 8, t).astype(np.float32)
+    order = rng.permutation(t).astype(np.float32)
+    valid = (np.ones(t, bool) if t_real is None
+             else np.arange(t) < t_real)
+    tasks = DruTasks(
+        user=jnp.asarray(user), mem=jnp.asarray(mem), cpus=jnp.asarray(cpus),
+        gpus=jnp.zeros(t, jnp.float32), order_key=jnp.asarray(order),
+        valid=jnp.asarray(valid),
+    )
+    div = jnp.asarray(rng.uniform(100, 1000, u).astype(np.float32))
+    host = {"user": user, "mem": mem, "cpus": cpus, "order": order}
+    return tasks, div, host
+
+
+def make_rebalance_state(jnp, t, h, t_real=None, h_real=None, seed=4):
+    """RebalanceState at any size — shared by the full and smoke tiers.
+    t_real/h_real mask the padded tail (None = everything live)."""
+    from cook_tpu.ops.rebalance import RebalanceState
+
+    rng = np.random.default_rng(seed)
+    h_live = h if h_real is None else h_real
+    task_host = rng.integers(0, h_live, t).astype(np.int32)
+    task_dru = rng.uniform(0, 5, t).astype(np.float32)
+    task_res = np.stack([rng.uniform(100, 8000, t),
+                         rng.uniform(0.5, 8, t),
+                         np.zeros(t)], axis=-1).astype(np.float32)
+    live = np.ones(t, bool) if t_real is None else np.arange(t) < t_real
+    task_eligible = live & (rng.uniform(size=t) > 0.2)
+    spare = np.stack([rng.uniform(0, 4000, h), rng.uniform(0, 4, h),
+                      np.zeros(h)], axis=-1).astype(np.float32)
+    host_ok = np.ones(h, bool) if h_real is None else np.arange(h) < h_real
+    return RebalanceState(
+        task_host=jnp.asarray(task_host), task_dru=jnp.asarray(task_dru),
+        task_res=jnp.asarray(task_res),
+        task_eligible=jnp.asarray(task_eligible),
+        spare=jnp.asarray(spare), host_ok=jnp.asarray(host_ok),
+    )
+
+
 def bench_dru(jax, jnp):
-    from cook_tpu.ops.common import BIG
-    from cook_tpu.ops.dru import DruTasks, dru_rank
+    from cook_tpu.ops.common import fetch_result
+    from cook_tpu.ops.dru import dru_rank
 
     T, U = 131072, 64
     t_real = 110_000
-    rng = np.random.default_rng(3)
-    user = rng.integers(0, U, T).astype(np.int32)
-    mem = rng.uniform(100, 8000, T).astype(np.float32)
-    cpus = rng.uniform(0.5, 8, T).astype(np.float32)
-    order = rng.permutation(T).astype(np.float32)
-    valid = np.zeros(T, bool)
-    valid[:t_real] = True
-    tasks = DruTasks(
-        user=jnp.asarray(user), mem=jnp.asarray(mem), cpus=jnp.asarray(cpus),
-        gpus=jnp.zeros(T, jnp.float32), order_key=jnp.asarray(order),
-        valid=jnp.asarray(valid),
-    )
-    div = jnp.asarray(rng.uniform(100, 1000, U).astype(np.float32))
+    tasks, div, host = make_dru_problem(jnp, T, U, t_real=t_real, seed=3)
+    user, mem, cpus, order = (host["user"], host["mem"], host["cpus"],
+                              host["order"])
 
     def solve():
-        return np.asarray(dru_rank(tasks, div, div, div).rank)
+        return fetch_result(dru_rank(tasks, div, div, div).rank)
 
     solve()
     p50, _ = time_fn(solve)
@@ -213,6 +263,7 @@ def bench_dru(jax, jnp):
 def bench_multipool(jax, jnp, tuned):
     """BASELINE config 3: multi-pool cpu+mem+gpu bin-packing, pools as the
     batch axis of one vmapped solve."""
+    from cook_tpu.ops.common import fetch_result
     from cook_tpu.ops.match import (MatchProblem, backend_flags,
                                     chunked_match, vmap_safe_backend)
 
@@ -251,7 +302,7 @@ def bench_multipool(jax, jnp, tuned):
     )
 
     def run():
-        return np.asarray(solve(problems).assignment)
+        return fetch_result(solve(problems).assignment)
 
     run()
     p50, _ = time_fn(run)
@@ -263,29 +314,18 @@ def bench_multipool(jax, jnp, tuned):
 
 
 def bench_rebalance(jax, jnp):
-    from cook_tpu.ops.rebalance import RebalanceState, find_preemption_decision
+    from cook_tpu.ops.common import fetch_result
+    from cook_tpu.ops.rebalance import find_preemption_decision
 
     T, H = 131072, 16384
     t_real, h_real = 100_000, 10_000
-    rng = np.random.default_rng(4)
-    state = RebalanceState(
-        task_host=jnp.asarray(rng.integers(0, h_real, T).astype(np.int32)),
-        task_dru=jnp.asarray(rng.uniform(0, 5, T).astype(np.float32)),
-        task_res=jnp.asarray(np.stack([
-            rng.uniform(100, 8000, T), rng.uniform(0.5, 8, T),
-            np.zeros(T)], axis=-1).astype(np.float32)),
-        task_eligible=jnp.asarray(
-            (np.arange(T) < t_real) & (rng.uniform(size=T) > 0.2)),
-        spare=jnp.asarray(np.stack([
-            rng.uniform(0, 4000, H), rng.uniform(0, 4, H), np.zeros(H)],
-            axis=-1).astype(np.float32)),
-        host_ok=jnp.asarray(np.arange(H) < h_real),
-    )
+    state = make_rebalance_state(jnp, T, H, t_real=t_real, h_real=h_real,
+                                 seed=4)
     demand = jnp.asarray([8000.0, 16.0, 0.0], dtype=jnp.float32)
 
     def solve():
-        decision = find_preemption_decision(state, demand, 0.3, 1.0, 0.5)
-        return jax.tree.map(np.asarray, decision)
+        return fetch_result(find_preemption_decision(state, demand,
+                                                     0.3, 1.0, 0.5))
 
     solve()
     p50, _ = time_fn(solve)
@@ -295,7 +335,7 @@ def bench_rebalance(jax, jnp):
     from cook_tpu.ops.rebalance import decide_from_sorted, sort_rebalance_state
 
     def sort_once():
-        return jax.tree.map(np.asarray, sort_rebalance_state(
+        return fetch_result(sort_rebalance_state(
             state.task_host, state.task_dru, state.task_res,
             state.task_eligible))
 
@@ -307,9 +347,9 @@ def bench_rebalance(jax, jnp):
     dru_sorted = state.task_dru[ss.perm]
 
     def decide():
-        decision = decide_from_sorted(ss, row_ok, dru_sorted, state.spare,
-                                      state.host_ok, demand, 0.3, 1.0, 0.5)
-        return jax.tree.map(np.asarray, decision)
+        return fetch_result(decide_from_sorted(ss, row_ok, dru_sorted,
+                                               state.spare, state.host_ok,
+                                               demand, 0.3, 1.0, 0.5))
 
     decide()
     dec_p50, _ = time_fn(decide)
@@ -358,6 +398,71 @@ def _result_line(match_p50, cpu_ms, eff, j_real, n_real, platform,
     }
 
 
+# ------------------------------------------------- structured bench records
+
+
+def make_record(mode: str, platform: str, phases: dict,
+                headline=None) -> dict:
+    """One structured bench record (schema cook-bench/v1): per-phase p50s
+    keyed by solve name, plus the headline line the driver scrapes.
+    `tools/bench_gate.py` diffs consecutive records phase by phase."""
+    return {
+        "schema": BENCH_SCHEMA,
+        "mode": mode,                 # "full" | "smoke"
+        "platform": platform,         # "tpu" | "cpu" | ...
+        "wall_time": time.time(),
+        "phases": phases,             # name -> {"p50_ms": ..., ...}
+        "headline": headline,
+    }
+
+
+def _next_phase_record_path(root: str) -> str:
+    """Next free BENCH_r{NN}_phases.json: one higher than every existing
+    BENCH_r<number>* round artifact (the driver's records included), so
+    bench.py's structured records interleave with — and never clobber —
+    the driver's round files."""
+    idx = 0
+    for path in glob.glob(os.path.join(root, "BENCH_r*")):
+        m = re.match(r"BENCH_r(\d+)", os.path.basename(path))
+        if m:
+            idx = max(idx, int(m.group(1)))
+    return os.path.join(root, f"BENCH_r{idx + 1:02d}_phases.json")
+
+
+def write_bench_record(record: dict, out: str = None,
+                       root: str = None) -> str:
+    """Write the structured record; destination precedence: explicit
+    `out` / $BENCH_OUT / the default family (BENCH_rsmoke.json for smoke
+    — a fixed name, so repeated smoke runs don't litter the repo root —
+    else the next free BENCH_r{NN}_phases.json).  The previous smoke
+    record rotates to BENCH_rsmoke_prev.json so `bench.py --smoke;
+    tools/bench_gate.py` always has a pair to diff — without the
+    rotation the overwrite would erase the baseline the gate needs."""
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    out = out or os.environ.get("BENCH_OUT")
+    if out is None:
+        if record["mode"] == "smoke":
+            out = os.path.join(root, "BENCH_rsmoke.json")
+            if os.path.exists(out):
+                os.replace(out, os.path.join(root,
+                                             "BENCH_rsmoke_prev.json"))
+        else:
+            out = _next_phase_record_path(root)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log(f"bench record -> {out}")
+    return out
+
+
+def _record_out_arg() -> str:
+    if "--out" in sys.argv:
+        i = sys.argv.index("--out")
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return None
+
+
 def device_main():
     """Full device bench; assumes the accelerator is reachable (probed by
     the caller).  Prints the one JSON line on stdout."""
@@ -369,12 +474,20 @@ def device_main():
     match_p50, cpu_ms, eff, (j_real, n_real) = bench_match(jax, jnp, platform)
     dru_p50 = bench_dru(jax, jnp)
     reb_p50 = bench_rebalance(jax, jnp)
-    bench_multipool(jax, jnp, load_tuned())
+    multi_p50 = bench_multipool(jax, jnp, load_tuned())
     log(f"full-cycle estimate (rank+match+rebalance): "
         f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
     extra = f", dru_ms={dru_p50:.1f}, rebalance_ms={reb_p50:.1f}"
-    print(json.dumps(_result_line(match_p50, cpu_ms, eff, j_real, n_real,
-                                  platform, extra=extra)), flush=True)
+    headline = _result_line(match_p50, cpu_ms, eff, j_real, n_real,
+                            platform, extra=extra)
+    write_bench_record(make_record("full", platform, {
+        "match": {"p50_ms": match_p50, "jobs": j_real, "nodes": n_real,
+                  "packing_eff": eff, "baseline_ms": cpu_ms},
+        "dru": {"p50_ms": dru_p50},
+        "rebalance": {"p50_ms": reb_p50},
+        "multipool": {"p50_ms": multi_p50},
+    }, headline), out=_record_out_arg())
+    print(json.dumps(headline), flush=True)
 
 
 def cpu_main():
@@ -391,8 +504,113 @@ def cpu_main():
     # numbers measured interactively (552 ms for 100k x 10k vs 5.3-6.3 s
     # C++, tpu_sweep_r2.jsonl)
     note = " [CPU FALLBACK — accelerator unreachable; see docs/status.md]"
-    print(json.dumps(_result_line(match_p50, cpu_ms, eff, j_real, n_real,
-                                  "cpu", note=note)), flush=True)
+    headline = _result_line(match_p50, cpu_ms, eff, j_real, n_real,
+                            "cpu", note=note)
+    write_bench_record(make_record("full", "cpu", {
+        "match": {"p50_ms": match_p50, "jobs": j_real, "nodes": n_real,
+                  "packing_eff": eff, "baseline_ms": cpu_ms},
+    }, headline), out=_record_out_arg())
+    print(json.dumps(headline), flush=True)
+
+
+def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
+    """Smoke tier: the same three solves at tiny padded sizes, warm p50s
+    after one compile run each.  Seconds, not minutes — fast enough for
+    the tier-1 suite (tests/test_bench_smoke.py), while still exercising
+    the real kernels, the fetch-to-observe-completion timing, and the
+    packing-parity check end to end."""
+    from cook_tpu.ops import cpu_reference as ref
+    from cook_tpu.ops.common import fetch_result
+    from cook_tpu.ops.dru import dru_rank
+    from cook_tpu.ops.match import MatchProblem, backend_flags, chunked_match
+    from cook_tpu.ops.rebalance import find_preemption_decision
+
+    phases = {}
+    # match: 1k x 128 padded, chunked xla backend
+    J, N = 1024, 128
+    j_real, n_real = 1000, 120
+    demands, avail, totals = make_problem(J, N, seed=7)
+    job_valid = np.zeros(J, bool)
+    job_valid[:j_real] = True
+    node_valid = np.zeros(N, bool)
+    node_valid[:n_real] = True
+    problem = MatchProblem(
+        demands=jnp.asarray(demands), job_valid=jnp.asarray(job_valid),
+        avail=jnp.asarray(avail), totals=jnp.asarray(totals),
+        node_valid=jnp.asarray(node_valid), feasible=None,
+    )
+
+    def solve_match():
+        # kc=32/rounds=3/passes=3: full parity (eff 1.0) with the CPU
+        # greedy at this saturated tiny shape; narrower candidate lists
+        # drop ~27% of placements and would read as a broken matcher
+        return fetch_result(chunked_match(
+            problem, chunk=256, rounds=3, kc=32, passes=3,
+            **backend_flags("xla")).assignment)
+
+    assignment = solve_match()
+    p50, _ = time_fn(solve_match, repeats=repeats)
+    cpu_assign = ref.np_greedy_match(demands[:j_real], avail[:n_real],
+                                     totals[:n_real])
+    q_dev = ref.packing_quality(demands[:j_real], assignment[:j_real])
+    q_cpu = ref.packing_quality(demands[:j_real], cpu_assign)
+    eff = (q_dev["cpus_placed"] / q_cpu["cpus_placed"]
+           if q_cpu["cpus_placed"] else 1.0)
+    phases["match"] = {"p50_ms": p50, "jobs": j_real, "nodes": n_real,
+                       "packing_eff": eff}
+    log(f"smoke match {j_real} x {n_real}: p50 {p50:.2f} ms, eff {eff:.4f}")
+
+    # dru rank: 2k tasks x 8 users (same construction as the full tier)
+    T, U = 2048, 8
+    tasks, div, _ = make_dru_problem(jnp, T, U, seed=8)
+
+    def solve_dru():
+        return fetch_result(dru_rank(tasks, div, div, div).rank)
+
+    solve_dru()
+    dru_p50, _ = time_fn(solve_dru, repeats=repeats)
+    phases["dru"] = {"p50_ms": dru_p50, "tasks": T}
+    log(f"smoke dru {T} tasks: p50 {dru_p50:.2f} ms")
+
+    # rebalance victim search: 2k tasks x 256 hosts (shared construction)
+    T2, H = 2048, 256
+    state = make_rebalance_state(jnp, T2, H, seed=9)
+    demand = jnp.asarray([8000.0, 16.0, 0.0], dtype=jnp.float32)
+
+    def solve_reb():
+        return fetch_result(
+            find_preemption_decision(state, demand, 0.3, 1.0, 0.5))
+
+    solve_reb()
+    reb_p50, _ = time_fn(solve_reb, repeats=repeats)
+    phases["rebalance"] = {"p50_ms": reb_p50, "tasks": T2, "hosts": H}
+    log(f"smoke rebalance {T2} x {H}: p50 {reb_p50:.2f} ms")
+    return phases
+
+
+def smoke_main(out: str = None) -> dict:
+    """`python bench.py --smoke`: run the smoke tier, write the
+    structured record, print the headline JSON line.  Returns the
+    record (tests call this in-process)."""
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    log(f"smoke bench on {jax.devices()[0]} ({platform})")
+    phases = bench_smoke(jax, jnp)
+    match = phases["match"]
+    headline = {
+        "metric": (f"smoke match-cycle p50 latency, {match['jobs']} jobs x "
+                   f"{match['nodes']} nodes (packing_eff="
+                   f"{match['packing_eff']:.4f}, platform={platform})"),
+        "value": round(match["p50_ms"], 2),
+        "unit": "ms",
+    }
+    record = make_record("smoke", platform, phases, headline)
+    write_bench_record(record, out=out if out is not None
+                       else _record_out_arg())
+    print(json.dumps(headline), flush=True)
+    return record
 
 
 def _try_device_upgrade(budget_s: float) -> bool:
@@ -436,6 +654,9 @@ def main():
          run the device bench in a subprocess, re-printing on success —
          the last JSON line on stdout wins.
     """
+    if "--smoke" in sys.argv or os.environ.get("BENCH_SMOKE"):
+        smoke_main()
+        return
     if "--device-only" in sys.argv:
         device_main()
         return

@@ -348,7 +348,7 @@ def start_leader_duties(process: CookProcess,
 
     def rank_all():
         for pool in pools():
-            with span("rank-cycle", pool=pool.name):
+            with span("rank_cycle", pool=pool.name):
                 scheduler.rank_cycle(pool)
 
     # round-robin match dispatch (scheduler.clj:2508)
@@ -359,7 +359,7 @@ def start_leader_duties(process: CookProcess,
         if not ps:
             return
         if settings.batched_match and len(ps) > 1:
-            with span("match-cycle-batched", pools=len(ps)):
+            with span("match_cycle_batched", pools=len(ps)):
                 scheduler.match_cycle_all_pools()
             return
         # rebuild the cycle if pools changed
@@ -369,12 +369,12 @@ def start_leader_duties(process: CookProcess,
             match_next._pools = [p.name for p in ps]
             pool_cycle = itertools.cycle(ps)
         pool = next(pool_cycle)
-        with span("match-cycle", pool=pool.name):
+        with span("match_cycle", pool=pool.name):
             scheduler.match_cycle(pool)
 
     def rebalance_all():
         for pool in pools():
-            with span("rebalance-cycle", pool=pool.name):
+            with span("rebalance_cycle", pool=pool.name):
                 scheduler.rebalance_cycle(pool)
 
     # aux publishers/monitors (progress.clj, heartbeat.clj, sandbox.clj,

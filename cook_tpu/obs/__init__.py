@@ -1,0 +1,53 @@
+"""Device telemetry observatory: the accelerator-side truth layer.
+
+PR 2's flight recorder made the host-side scheduling cycle legible; this
+package makes the DEVICE side legible:
+
+  * `compile_observatory.CompileObservatory` — JIT-compilation accounting
+    keyed by (op, shape-signature, backend), with recompile-storm
+    detection over a sliding window of solves (padding-bucket churn is
+    the storm generator: every new padded shape is a new XLA program).
+  * `baseline.RollingBaseline` — rolling median/MAD anomaly detection,
+    shared by the quality monitor (drift down) and the solve-latency
+    tracker (drift up).
+  * `quality_monitor.QualityMonitor` — shadow-solves a sampled fraction
+    of match cycles with the CPU reference greedy and tracks
+    packing-efficiency drift against the rolling baseline.
+  * `device_monitor` — live device-memory gauges (`memory_stats()` on
+    real accelerators) and the OOM-risk check.
+  * `health.HealthMonitor` — folds the above into one machine-readable
+    verdict served at `GET /debug/health` with four degradation reasons:
+    recompile-storm, quality-drift, solve-latency-regression,
+    device-oom-risk.
+  * `telemetry.DeviceTelemetry` — the facade the scheduler owns; match/
+    rank/rebalance cycles report every device solve through it.
+"""
+from cook_tpu.obs.baseline import RollingBaseline
+from cook_tpu.obs.compile_observatory import CompileObservatory
+from cook_tpu.obs.device_monitor import (
+    device_memory_stats,
+    update_device_memory_gauges,
+)
+from cook_tpu.obs.health import (
+    DEVICE_OOM_RISK,
+    HealthMonitor,
+    QUALITY_DRIFT,
+    RECOMPILE_STORM,
+    SOLVE_LATENCY_REGRESSION,
+)
+from cook_tpu.obs.quality_monitor import QualityMonitor
+from cook_tpu.obs.telemetry import DeviceTelemetry
+
+__all__ = [
+    "CompileObservatory",
+    "DeviceTelemetry",
+    "HealthMonitor",
+    "QualityMonitor",
+    "RollingBaseline",
+    "RECOMPILE_STORM",
+    "QUALITY_DRIFT",
+    "SOLVE_LATENCY_REGRESSION",
+    "DEVICE_OOM_RISK",
+    "device_memory_stats",
+    "update_device_memory_gauges",
+]

@@ -1,0 +1,63 @@
+"""Live device-memory gauges and the OOM-risk probe.
+
+Real accelerator runtimes (TPU, GPU) expose per-device allocator stats
+via `Device.memory_stats()`; CPU hosts return None (or raise), in which
+case the gauges simply aren't set and the OOM-risk check reports
+"unobservable" rather than healthy-by-default lying.
+
+A 100k x 10k match problem's [J, N] constraint mask alone is ~2 GB of
+HBM — the scheduler can genuinely OOM a shared device, and production
+DL-cluster schedulers treat device headroom as a scheduling input
+(Aryl; topology-aware preemptive scheduling for LLM workloads)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from cook_tpu.utils.metrics import global_registry
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """{bytes_in_use, bytes_limit, peak_bytes_in_use, utilization} for
+    the first (or given) device, or None when the runtime doesn't expose
+    allocator stats (CPU, some plugin backends)."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — a wedged device tunnel or a
+        # plugin without memory_stats must degrade to "unobservable",
+        # never take down the caller (this runs on the match path)
+        return None
+    if not stats:
+        return None
+    in_use = float(stats.get("bytes_in_use", 0.0))
+    limit = float(stats.get("bytes_limit", 0.0))
+    return {
+        "bytes_in_use": in_use,
+        "bytes_limit": limit,
+        "peak_bytes_in_use": float(stats.get("peak_bytes_in_use", in_use)),
+        "utilization": (in_use / limit) if limit > 0 else 0.0,
+    }
+
+
+def update_device_memory_gauges(stats_provider=device_memory_stats,
+                                ) -> Optional[dict]:
+    """Refresh the device-memory gauges from `stats_provider` and return
+    its stats dict (None when unobservable).  Called after every device
+    solve — one `memory_stats()` RPC, negligible next to the solve."""
+    stats = stats_provider()
+    if stats is None:
+        return None
+    g = global_registry.gauge
+    g("obs.device.mem_bytes_in_use",
+      "device allocator bytes currently in use").set(stats["bytes_in_use"])
+    g("obs.device.mem_bytes_limit",
+      "device allocator capacity in bytes").set(stats["bytes_limit"])
+    g("obs.device.mem_peak_bytes",
+      "high-water device allocator bytes").set(stats["peak_bytes_in_use"])
+    g("obs.device.mem_utilization",
+      "device memory fill fraction (in_use / limit)").set(
+        stats["utilization"])
+    return stats

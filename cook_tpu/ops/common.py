@@ -17,6 +17,22 @@ import numpy as np
 BIG = 1e30
 
 
+def fetch_result(tree):
+    """Materialize a device result (array or pytree) as host numpy.
+
+    This is THE definition of "the solve finished": over a remote-device
+    tunnel `jax.block_until_ready` returns without waiting (measured
+    ~0.05 ms for a ~950 ms solve), so only a device-to-host transfer
+    observes completion — and fetching is also the honest cycle
+    semantics, since the scheduler consumes assignments host-side.
+    Every timed solve (bench, smoke bench, match cycle, quality monitor)
+    must end in this call so timing means the same thing everywhere.
+    """
+    import jax
+
+    return jax.tree.map(np.asarray, tree)
+
+
 def binpack_fitness(used0, used1, d0, d1, denom0, denom1):
     """cpuMemBinPacker fitness (Fenzo's default, config.clj:108): mean
     post-placement utilization across mem and cpus.  Plain arithmetic so the

@@ -26,6 +26,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax < 0.6 ships shard_map under experimental only, where today's
+# check_vma knob is spelled check_rep; one shim keeps the call sites on
+# the modern surface either way
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+
 from cook_tpu.ops.common import BIG, binpack_fitness
 from cook_tpu.ops.dru import DruTasks, dru_rank
 from cook_tpu.ops.match import (
@@ -67,7 +79,7 @@ def pool_sharded_match(mesh: Mesh, problems: MatchProblem, *,
           if chunk else greedy_match)
     mapped = jax.vmap(fn)
     spec = P("pool")
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         mapped, mesh=mesh,
         in_specs=(MatchProblem(spec, spec, spec, spec, spec, spec),),
         out_specs=MatchResult(spec, spec),
@@ -79,7 +91,7 @@ def pool_sharded_dru(mesh: Mesh, tasks: DruTasks, mem_div, cpu_div, gpu_div):
     """Batched DRU ranking over pools, pool axis sharded."""
     mapped = jax.vmap(lambda t, m, c, g: dru_rank(t, m, c, g))
     spec = P("pool")
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         mapped, mesh=mesh,
         in_specs=(DruTasks(spec, spec, spec, spec, spec, spec),
                   spec, spec, spec),
@@ -162,7 +174,7 @@ def node_sharded_greedy_match(mesh: Mesh, problem: MatchProblem) -> MatchResult:
     j = problem.demands.shape[0]
     feas = (problem.feasible if problem.feasible is not None
             else jnp.ones((j, ndev), dtype=bool))  # [J,1] per shard
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_solve, mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis), P(None, axis)),
         out_specs=(P(), P(axis)),
@@ -283,7 +295,7 @@ def node_sharded_chunked_match(
     # the unconstrained placeholder mask ([C,1,1]) cannot shard its size-1
     # node axis; real masks shard so no device holds the full [J, N] bools
     feas_spec = P() if problem.feasible is None else P(None, None, axis)
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_solve, mesh=mesh,
         in_specs=(P(), P(), feas_spec, P(), P(axis), P(axis)),
         out_specs=(P(), P()),

@@ -205,6 +205,7 @@ class CookApi:
         r.add_get("/replication/snapshot", self.get_replication_snapshot)
         r.add_post("/replication/ack", self.post_replication_ack)
         r.add_get("/debug", self.get_debug)
+        r.add_get("/debug/health", self.get_debug_health)
         r.add_get("/debug/cycles", self.get_debug_cycles)
         r.add_get("/debug/cycles/{cycle_id}", self.get_debug_cycle)
         r.add_get("/debug/spans", self.get_debug_spans)
@@ -249,6 +250,30 @@ class CookApi:
     def _recorder(self):
         return getattr(self.scheduler, "recorder", None) \
             if self.scheduler is not None else None
+
+    def _telemetry(self):
+        return getattr(self.scheduler, "telemetry", None) \
+            if self.scheduler is not None else None
+
+    async def get_debug_health(self, request: web.Request) -> web.Response:
+        """Device-telemetry health verdict (cook_tpu/obs/): machine-
+        readable degradation reasons — recompile-storm, quality-drift,
+        solve-latency-regression, device-oom-risk — with per-check
+        evidence.  Always 200; `healthy`/`status` carry the verdict so
+        probes distinguish "degraded" from "down".  With telemetry
+        disabled (device_telemetry=False, or no scheduler attached — a
+        proxy-only node) the status is "unobserved": not degraded, but
+        explicitly not vouched for."""
+        telemetry = self._telemetry()
+        if telemetry is None:
+            return web.json_response({
+                "healthy": True,
+                "status": "unobserved",
+                "degradations": [],
+                "reasons": [],
+                "checks": {},
+            })
+        return web.json_response(telemetry.health())
 
     async def get_debug_cycles(self, request: web.Request) -> web.Response:
         """Flight-recorder ring: per-cycle structured decision records
@@ -340,7 +365,9 @@ class CookApi:
 
     def _auth_exempt(self, request: web.Request) -> bool:
         path = request.path
-        if path == "/debug":
+        if path in ("/debug", "/debug/health"):
+            # probe endpoints: LB liveness and the telemetry verdict both
+            # get scraped by unauthenticated monitors
             return True
         if request.method == "GET" and path == "/metrics":
             return True
@@ -411,7 +438,9 @@ class CookApi:
         if self.config.replication_sync_ack and not outcome.duplicate:
             outcome.replicated = await self._await_replication(outcome.seq)
             if not outcome.replicated:
-                global_registry.counter("replication_ack_timeouts").inc()
+                global_registry.counter(
+                    "replication_ack_timeouts",
+                    "sync-ack replication bounds missed").inc()
         return outcome
 
     @staticmethod
@@ -506,7 +535,9 @@ class CookApi:
             from cook_tpu.scheduler.monitor import observe_commit_ack
 
             observe_commit_ack(_time.perf_counter() - t_commit)
-            global_registry.counter("jobs_submitted").inc(len(jobs))
+            global_registry.counter(
+                "jobs_submitted", "jobs accepted via POST /jobs").inc(
+                len(jobs))
         body = dict(outcome.result or {"jobs": [j.uuid for j in jobs]})
         if outcome.replicated is False:
             # durable-on-ack (datomic.clj:79): the commit stands, but the
@@ -750,7 +781,9 @@ class CookApi:
                 return _err(403, f"not authorized to kill {uuid}")
         outcome = await self._commit(request, "jobs/kill", {"uuids": uuids})
         if not outcome.duplicate:
-            global_registry.counter("jobs_killed").inc(len(uuids))
+            global_registry.counter(
+                "jobs_killed", "jobs killed via DELETE /jobs").inc(
+                len(uuids))
         return self._no_content(outcome)
 
     # ------------------------------------------------------------- instances
@@ -989,7 +1022,9 @@ class CookApi:
             # one replication wait covers the whole batch (acks are
             # cumulative sequence numbers)
             if not await self._await_replication(last_seq):
-                global_registry.counter("replication_ack_timeouts").inc()
+                global_registry.counter(
+                    "replication_ack_timeouts",
+                    "sync-ack replication bounds missed").inc()
                 body_out["replicated"] = False
         return web.json_response(body_out, status=201)
 
@@ -1028,7 +1063,9 @@ class CookApi:
         # cumulative sequence numbers)
         if self.config.replication_sync_ack and duplicates < len(uuids):
             if not await self._await_replication(last_seq):
-                global_registry.counter("replication_ack_timeouts").inc()
+                global_registry.counter(
+                    "replication_ack_timeouts",
+                    "sync-ack replication bounds missed").inc()
                 body_out["replicated"] = False
         return web.json_response(body_out, status=201)
 
@@ -1083,15 +1120,24 @@ class CookApi:
 
     async def get_unscheduled(self, request: web.Request) -> web.Response:
         uuids = request.query.getall("job", [])
+        telemetry = self._telemetry()
         out = []
         for uuid in uuids:
             job = self.store.jobs.get(uuid)
             if job is None:
                 return _err(404, f"unknown job {uuid}")
-            out.append({
+            entry = {
                 "uuid": uuid,
                 "reasons": self._unscheduled_reasons(job),
-            })
+            }
+            if telemetry is not None:
+                # the pool's last device solve (padded problem shape,
+                # backend, compile flag) so a reason code correlates
+                # with compile behavior without a /debug/cycles join
+                solve = telemetry.solve_info(job.pool)
+                if solve is not None:
+                    entry["pool_solve"] = solve
+            out.append(entry)
         return web.json_response(out)
 
     def _unscheduled_reasons(self, job: Job) -> list[dict]:

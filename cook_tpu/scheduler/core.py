@@ -56,6 +56,23 @@ class SchedulerConfig:
     # flight recorder: bounded ring of per-cycle decision records served
     # at GET /debug/cycles (flight_recorder.py); 0 disables
     flight_recorder_capacity: int = 512
+    # device telemetry (cook_tpu/obs/): compile observatory, sampled CPU
+    # shadow-solve quality monitor, solve-latency baselines, device-memory
+    # gauges — the substrate of GET /debug/health.  False disables.
+    device_telemetry: bool = True
+    # shadow-solve every Nth solvable match cycle per pool (0 keeps the
+    # telemetry but never shadow-solves)
+    quality_sample_every: int = 25
+    # recompile storm: >= threshold new XLA programs within the last
+    # `window` solves of one op (padding-bucket churn signature); the
+    # op's first `warmup` solves never feed the trigger (first-boot
+    # compiles are expected — a page per deploy trains operators to
+    # ignore the signal).  None = one full window.
+    compile_storm_window: int = 32
+    compile_storm_threshold: int = 4
+    compile_storm_warmup: Optional[int] = None
+    # device-oom-risk fires above this allocator fill fraction
+    device_oom_threshold: float = 0.9
 
 
 class Scheduler:
@@ -123,6 +140,20 @@ class Scheduler:
         self.recorder = (
             FlightRecorder(capacity=self.config.flight_recorder_capacity)
             if self.config.flight_recorder_capacity > 0 else None)
+        # device telemetry (cook_tpu/obs/): every rank/match/rebalance
+        # solve reports its (op, padded shape, backend) here; /debug/health
+        # folds it into the degradation verdict
+        self.telemetry = None
+        if self.config.device_telemetry:
+            from cook_tpu.obs import DeviceTelemetry
+
+            self.telemetry = DeviceTelemetry(
+                storm_window=self.config.compile_storm_window,
+                storm_threshold=self.config.compile_storm_threshold,
+                storm_warmup=self.config.compile_storm_warmup,
+                quality_sample_every=self.config.quality_sample_every,
+                oom_threshold=self.config.device_oom_threshold,
+            )
         self._last_rank_s: dict[str, float] = {}
         from cook_tpu.scheduler.monitor import JobLifecycleTracker
 
@@ -231,12 +262,21 @@ class Scheduler:
             )
         self.pool_queues[pool.name] = queue
         self.metrics[f"rank.{pool.name}.queue_len"] = len(queue.jobs)
-        global_registry.gauge("rank.queue_len").set(
+        global_registry.gauge(
+            "rank.queue_len", "ranked queue length per pool").set(
             len(queue.jobs), {"pool": pool.name})
         # stash the duration so the NEXT match cycle's flight record can
         # claim its rank phase even when ranking is driven separately
         # (components.py rank trigger, the simulator's explicit rank step)
-        self._last_rank_s[pool.name] = _time.perf_counter() - t_rank
+        rank_s = _time.perf_counter() - t_rank
+        self._last_rank_s[pool.name] = rank_s
+        if self.telemetry is not None and queue.solve_shape is not None:
+            # compile accounting for the DRU kernel: its padded task
+            # bucket is the shape axis that churns as the queue grows.
+            # No seconds: rank_s is the whole rank cycle's wall (offer
+            # scans + host-side queue assembly), not device solve time —
+            # feeding it would corrupt the obs.solve.seconds histogram
+            self.telemetry.record_solve("rank", queue.solve_shape, "xla")
         return queue
 
     def _begin_cycle(self, pool_name: str):
@@ -287,6 +327,7 @@ class Scheduler:
             host_reservations=self.host_reservations,
             host_attrs=self.host_attr_cache,
             flight=flight,
+            telemetry=self.telemetry,
         )
         # charge launches against the per-user rate limiter (spend-through)
         if self.launch_rate_limiter is not None:
@@ -305,9 +346,11 @@ class Scheduler:
         self._cache_spare(pool)
         self.metrics[f"match.{pool.name}.matched"] = len(outcome.matched)
         self.metrics[f"match.{pool.name}.offers"] = outcome.offers_total
-        global_registry.counter("match.matched").inc(
+        global_registry.counter(
+            "match.matched", "jobs matched to hosts per pool").inc(
             len(outcome.matched), {"pool": pool.name})
-        global_registry.gauge("match.offers").set(
+        global_registry.gauge(
+            "match.offers", "offers seen by the last match cycle").set(
             outcome.offers_total, {"pool": pool.name})
         # per-cycle summary line (handle-match-cycle-metrics,
         # scheduler.clj:1210)
@@ -356,6 +399,7 @@ class Scheduler:
             host_attrs=self.host_attr_cache,
             mesh=mesh,
             flights=flights,
+            telemetry=self.telemetry,
         )
         for pool in pools:
             outcome = outcomes[pool.name]
@@ -428,6 +472,7 @@ class Scheduler:
         decisions = rebalance_pool(
             self.store, pool, queue.jobs, spare, self._rebalancer_params(),
             host_info=getattr(self, "last_host_info", {}).get(pool.name),
+            telemetry=self.telemetry,
         )
         if self.recorder is not None:
             self.recorder.annotate_preemptions(
@@ -447,7 +492,9 @@ class Scheduler:
                 self.host_reservations[decision.hostname] = decision.job.uuid
         n_preempted = sum(len(d.task_ids) for d in decisions)
         self.metrics[f"rebalance.{pool.name}.preempted"] = n_preempted
-        global_registry.counter("rebalance.preempted").inc(
+        global_registry.counter(
+            "rebalance.preempted",
+            "tasks preempted by the rebalancer per pool").inc(
             n_preempted, {"pool": pool.name})
         return decisions
 

@@ -91,6 +91,14 @@ class CycleRecord:
     device_s: float = 0.0
     host_s: float = 0.0
     total_s: float = 0.0
+    # device truth for the cycle's solve (obs/ telemetry): the padded
+    # problem shape the kernel actually compiled for ("jobs x nodes"),
+    # the candidate-pass backend, and whether THIS solve paid a JIT
+    # compile (first-seen shape) — so a slow cycle is attributable to
+    # compilation vs execution from the record alone
+    solve_shape: str = ""
+    backend: str = ""
+    compiled: bool = False
     offers: int = 0
     queue_len: int = 0
     considered: int = 0
@@ -115,6 +123,9 @@ class CycleRecord:
             "device_s": self.device_s,
             "host_s": self.host_s,
             "total_s": self.total_s,
+            "solve_shape": self.solve_shape,
+            "backend": self.backend,
+            "compiled": self.compiled,
             "offers": self.offers,
             "queue_len": self.queue_len,
             "considered": self.considered,
@@ -174,6 +185,14 @@ class CycleBuilder:
         if considered is not None:
             self.record.considered = considered
 
+    def note_solve(self, shape_sig: str, backend: str,
+                   compiled: bool) -> None:
+        """Record the cycle's device-solve identity (padded shape,
+        backend, compile-paid flag) from the obs/ telemetry layer."""
+        self.record.solve_shape = shape_sig
+        self.record.backend = backend
+        self.record.compiled = compiled
+
     def note_match(self, job_uuid: str, hostname: str, task_id: str) -> None:
         self.record.matched.append(
             {"job": job_uuid, "host": hostname, "task_id": task_id})
@@ -222,6 +241,9 @@ class NullCycle:
         pass
 
     def set_counts(self, **kw) -> None:
+        pass
+
+    def note_solve(self, *a) -> None:
         pass
 
     def note_match(self, *a) -> None:

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
 from cook_tpu.models.entities import GroupPlacementType, Job, JobState, Pool
 from cook_tpu.models.store import JobStore, TransactionVetoed
-from cook_tpu.ops.common import bucket_size, pad_to
+from cook_tpu.obs.compile_observatory import shape_signature
+from cook_tpu.ops.common import bucket_size, fetch_result, pad_to
 from cook_tpu.ops.match import (
     MatchProblem,
     backend_flags,
@@ -199,6 +200,18 @@ def build_match_problem(
         node_valid=jnp.asarray(pad_to(np.ones(n, dtype=bool), pad_n, fill=False)),
         feasible=jnp.asarray(feas),
     )
+
+
+def problem_shape(problem: MatchProblem) -> tuple[int, int]:
+    """(padded jobs, padded nodes) — the solve's XLA-visible shape."""
+    return (int(problem.demands.shape[0]), int(problem.avail.shape[0]))
+
+
+def solve_backend(config: "MatchConfig") -> str:
+    """The backend label telemetry/records report for a solve under this
+    config: the candidate-pass backend for the chunked matcher, "exact"
+    for the chunk=0 sequential-greedy kernel (a distinct XLA program)."""
+    return config.backend if config.chunk else "exact"
 
 
 def gather_group_context(
@@ -759,8 +772,11 @@ def match_pool(
     host_reservations: Optional[dict[str, str]] = None,
     host_attrs: Optional[dict[str, dict]] = None,
     flight=NULL_CYCLE,
+    telemetry=None,
 ) -> MatchOutcome:
     """One pool's match cycle end to end (prepare -> solve -> finalize)."""
+    import time as _time
+
     with flight.phase("tensor_build"):
         prepared = prepare_pool_problem(
             store, pool, queue, clusters, config, state,
@@ -769,9 +785,10 @@ def match_pool(
         )
     assignment = np.empty(0, dtype=np.int32)
     if prepared.solvable:
-        # the solve is the cycle's device section: np.asarray blocks until
-        # the kernel's result is materialized, so this phase's wall time
-        # covers dispatch + device execution + transfer
+        # the solve is the cycle's device section: fetch_result blocks
+        # until the kernel's result is materialized, so this phase's wall
+        # time covers dispatch + device execution + transfer
+        t_solve = _time.perf_counter()
         with flight.phase("solve", device=True):
             if config.chunk:
                 result = chunked_match(prepared.problem, chunk=config.chunk,
@@ -781,9 +798,18 @@ def match_pool(
                                        **backend_flags(config.backend))
             else:
                 result = greedy_match(prepared.problem)
-            assignment = np.asarray(
+            assignment = fetch_result(
                 result.assignment[: len(prepared.considerable)]
             )
+        solve_shape = problem_shape(prepared.problem)
+        backend = solve_backend(config)
+        compiled = False
+        if telemetry is not None:
+            compiled = telemetry.record_match_solve(
+                pool.name, solve_shape, backend,
+                _time.perf_counter() - t_solve)
+            telemetry.quality.observe_cycle(prepared, assignment, pool.name)
+        flight.note_solve(shape_signature(solve_shape), backend, compiled)
         if config.chunk:
             state.chunked_solves += 1
             if (config.quality_audit_every
@@ -814,6 +840,7 @@ def match_pools_batched(
     host_attrs: Optional[dict[str, dict]] = None,
     mesh=None,
     flights: Optional[dict] = None,
+    telemetry=None,
 ) -> dict[str, MatchOutcome]:
     """Solve EVERY pool's match problem in one batched device call.
 
@@ -897,14 +924,24 @@ def match_pools_batched(
             )(stacked)
         else:
             result = jax.vmap(greedy_match)(stacked)
-        assignments = np.asarray(result.assignment)
+        assignments = fetch_result(result.assignment)
         # one shared device call solved every pool: each participating
         # pool's record carries the full solve wall time (no pool's cycle
         # can finish sooner than the batch)
         solve_s = _time.perf_counter() - t_solve
+        batch_shape = (len(solvable), max_j, max_n)
+        backend = (vmap_safe_backend(config.backend) if config.chunk
+                   else "exact")
+        compiled = False
+        if telemetry is not None:
+            compiled = telemetry.record_batched_match_solve(
+                [p.pool.name for p in solvable], batch_shape, backend,
+                solve_s)
         for p in solvable:
-            pool_flight(p.pool.name).add_phase("solve", solve_s,
-                                               device=True)
+            flight = pool_flight(p.pool.name)
+            flight.add_phase("solve", solve_s, device=True)
+            flight.note_solve(shape_signature(batch_shape), backend,
+                              compiled)
 
     outcomes: dict[str, MatchOutcome] = {}
     solve_idx = 0
@@ -913,6 +950,9 @@ def match_pools_batched(
         if prepared.solvable:
             assignment = assignments[solve_idx][: len(prepared.considerable)]
             solve_idx += 1
+            if telemetry is not None:
+                telemetry.quality.observe_cycle(prepared, assignment,
+                                                prepared.pool.name)
             if config.chunk:
                 st = states[prepared.pool.name]
                 st.chunked_solves += 1

@@ -139,15 +139,25 @@ def collect_pool_stats(store: JobStore, pool: str) -> PoolStats:
     )
     labels = {"pool": pool}
     g = global_registry.gauge
-    g("monitor.running_jobs").set(stats.running_jobs, labels)
-    g("monitor.waiting_jobs").set(stats.waiting_jobs, labels)
-    g("monitor.running_users").set(stats.running_users, labels)
-    g("monitor.waiting_users").set(stats.waiting_users, labels)
-    g("monitor.starved_users").set(stats.starved_users, labels)
-    g("monitor.used_mem").set(stats.used.mem, labels)
-    g("monitor.used_cpus").set(stats.used.cpus, labels)
-    g("monitor.waiting_mem").set(stats.waiting_demand.mem, labels)
-    g("monitor.waiting_cpus").set(stats.waiting_demand.cpus, labels)
+    g("monitor.running_jobs", "running jobs per pool").set(
+        stats.running_jobs, labels)
+    g("monitor.waiting_jobs", "waiting jobs per pool").set(
+        stats.waiting_jobs, labels)
+    g("monitor.running_users", "users with running work per pool").set(
+        stats.running_users, labels)
+    g("monitor.waiting_users", "users with waiting work per pool").set(
+        stats.waiting_users, labels)
+    g("monitor.starved_users",
+      "users below their share with waiting work").set(
+        stats.starved_users, labels)
+    g("monitor.used_mem", "running memory usage (MB) per pool").set(
+        stats.used.mem, labels)
+    g("monitor.used_cpus", "running cpu usage per pool").set(
+        stats.used.cpus, labels)
+    g("monitor.waiting_mem", "waiting memory demand (MB) per pool").set(
+        stats.waiting_demand.mem, labels)
+    g("monitor.waiting_cpus", "waiting cpu demand per pool").set(
+        stats.waiting_demand.cpus, labels)
     return stats
 
 

@@ -39,7 +39,9 @@ class ProgressAggregator:
                 return False
             if existing is None and len(self._pending) >= self.max_pending:
                 self.dropped += 1
-                global_registry.counter("progress.dropped").inc()
+                global_registry.counter(
+                    "progress.dropped",
+                    "progress updates dropped at the pending cap").inc()
                 return False
             self._pending[update.task_id] = update
             return True
@@ -56,5 +58,7 @@ class ProgressAggregator:
                 update.task_id, update.percent, update.message
             ):
                 written += 1
-        global_registry.counter("progress.published").inc(written)
+        global_registry.counter(
+            "progress.published",
+            "progress updates flushed to the store").inc(written)
         return written

@@ -32,6 +32,9 @@ class RankedQueue:
     dru: dict[str, float]    # job uuid -> queue dru
     capped: list[str]        # job uuids dropped by quota capping
     quarantined: list[str] = None  # dropped by the offensive-job filter
+    # padded task-bucket shape of the DRU kernel call that ranked this
+    # queue (None when no kernel ran) — the compile observatory's rank key
+    solve_shape: tuple = None
 
     def __post_init__(self):
         if self.quarantined is None:
@@ -244,4 +247,5 @@ def rank_pool(
         job = job_refs[pos]
         ranked_jobs.append(job)
         dru_map[job.uuid] = float(dru[pos])
-    return RankedQueue(jobs=ranked_jobs, dru=dru_map, capped=capped, quarantined=quarantined)
+    return RankedQueue(jobs=ranked_jobs, dru=dru_map, capped=capped,
+                       quarantined=quarantined, solve_shape=(pad_t,))

@@ -161,4 +161,4 @@ def rank_pool_columnar(
         for job, pos in zip(ranked_jobs, pend_positions)
     }
     return RankedQueue(jobs=ranked_jobs, dru=dru_map, capped=capped,
-                       quarantined=quarantined)
+                       quarantined=quarantined, solve_shape=(pad_t,))

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from cook_tpu.models.entities import DruMode, Instance, Job, Pool, Resources
 from cook_tpu.models.store import JobStore
-from cook_tpu.ops.common import BIG
+from cook_tpu.ops.common import BIG, bucket_size
 from cook_tpu.ops.rebalance import (
     RebalanceState,
     decide_from_sorted,
@@ -101,7 +101,12 @@ class RebalanceCycle:
         )
         self.host_idx = {h: i for i, h in enumerate(self.hostnames)}
         h = len(self.hostnames)
-        spare = np.zeros((max(h, 1), 4), dtype=np.float32)
+        # bucket the host axis: an unbucketed H mints a fresh XLA program
+        # whenever the host count changes (the compile observatory's
+        # op=rebalance storm signature); padded rows are host_ok=False
+        # with zero spare, so the kernel can never pick them
+        h_pad = bucket_size(max(h, 1))
+        spare = np.zeros((h_pad, 4), dtype=np.float32)
         for hostname, res in host_spare.items():
             i = self.host_idx[hostname]
             spare[i] = (res.mem, res.cpus, res.gpus, res.disk)
@@ -122,9 +127,12 @@ class RebalanceCycle:
                 )
                 self.task_info[inst.task_id] = (job.user, inst.hostname)
 
-        # fixed-row flat layout: all tasks + slack rows for simulated launches
+        # fixed-row flat layout: all tasks + slack rows for simulated
+        # launches, bucketed so a churning running-task count reuses the
+        # same compiled program (pad rows: host -1, ineligible — the
+        # shape every task on an unknown host already takes)
         n_tasks = sum(len(ut.ids) for ut in self.users.values())
-        total = n_tasks + params.max_preemption
+        total = bucket_size(max(n_tasks + params.max_preemption, 1))
         self.row_ids: list[str] = [""] * total
         host_np = np.full(total, -1, np.int32)
         res_np = np.zeros((total, 4), np.float32)
@@ -155,7 +163,7 @@ class RebalanceCycle:
         self._dev_dru = jnp.asarray(self._dru_np)
         self._dev_elig = jnp.asarray(self._elig_np)
         self._dev_spare = jnp.asarray(spare)
-        self._dev_host_ok = jnp.ones(len(spare), dtype=bool)
+        self._dev_host_ok = jnp.asarray(np.arange(len(spare)) < h)
         self._spare_np = spare.copy()
         self.preempted: set[str] = set()
         self._sorted = None
@@ -261,7 +269,9 @@ class RebalanceCycle:
                          if job.checkpoint is not None else "")
         if not failed_hosts and not need_attrs and not need_location:
             return None
-        ok = np.ones(max(len(self.hostnames), 1), dtype=bool)
+        # padded host rows stay False (matching _dev_host_ok)
+        ok = np.zeros(len(self._spare_np), dtype=bool)
+        ok[:len(self.hostnames)] = True
         for i, hostname in enumerate(self.hostnames):
             if hostname in failed_hosts:
                 ok[i] = False
@@ -430,13 +440,26 @@ def rebalance_pool(
     host_spare: dict[str, Resources],
     params: RebalancerParams,
     host_info: Optional[dict] = None,
+    telemetry=None,
 ) -> list[Decision]:
     """One pool's rebalance cycle: returns the preemption decisions
     (rebalancer.clj:434-479 `rebalance`).  The caller transacts + kills."""
     cycle = RebalanceCycle(store, pool, host_spare, params,
                            host_info=host_info)
+    solve_shape = (int(cycle._dev_host.shape[0]),
+                   int(cycle._dev_spare.shape[0]))
     decisions = []
     for job in list(pending_in_dru_order)[: params.max_preemption]:
+        if telemetry is not None:
+            # one observation per compute_decision = per kernel dispatch
+            # (an idle pool dispatches nothing and must report nothing);
+            # the victim-search kernel compiles per (task rows, hosts)
+            # bucket; fast_cycle swaps in the sort-once kernel pair (own
+            # programs).  No pool= arg: the per-pool last-solve snapshot
+            # tracks the MATCH solve (the /unscheduled_jobs correlation)
+            telemetry.record_solve(
+                "rebalance", solve_shape,
+                "fast_cycle" if params.fast_cycle else "exact")
         decision = cycle.compute_decision(job)
         if decision is not None and decision.task_ids:
             decisions.append(decision)

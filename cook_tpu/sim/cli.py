@@ -68,7 +68,14 @@ def cmd_run(args) -> int:
         "cycle_wall_p50_ms": round(p50, 2),
         "phase_wall_s": {k: round(v, 3)
                          for k, v in result.phase_wall_s.items()},
+        # device-telemetry verdict: a run that storms the compiler or
+        # drifts from the CPU reference says so in its summary line
+        "health": result.health.get("status", "unknown"),
+        "health_reasons": result.health.get("reasons", []),
     }))
+    if args.health_out:
+        with open(args.health_out, "w") as f:
+            json.dump(result.health, f, indent=1)
     return 0
 
 
@@ -155,6 +162,8 @@ def main(argv=None) -> int:
     r = sub.add_parser("run", help="replay a trace")
     r.add_argument("--trace", required=True)
     r.add_argument("--out", default="run.csv")
+    r.add_argument("--health-out", default="",
+                   help="write the end-of-run /debug/health verdict here")
     r.add_argument("--cycles-out", default="",
                    help="dump flight-recorder cycle records (JSON) here")
     r.add_argument("--cycle-ms", type=int, default=30_000)

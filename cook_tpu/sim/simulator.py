@@ -103,6 +103,10 @@ class SimResult:
     # phase durations, per-job reason codes, preemptions) for offline
     # analysis — same schema as GET /debug/cycles (docs/observability.md)
     cycle_records: list[dict] = field(default_factory=list)
+    # device-telemetry health verdict at end of run (GET /debug/health
+    # schema): did the simulated workload drive the solver into
+    # recompile storms / quality drift / latency regression?
+    health: dict = field(default_factory=dict)
 
     def cycle_records_json(self) -> str:
         return json.dumps({"cycles": self.cycle_records}, indent=1)
@@ -267,6 +271,8 @@ class Simulator:
             cycle_wall_s=cycle_wall,
             cycle_records=(recorder.records_json(limit=recorder.capacity)
                            if recorder is not None else []),
+            health=(self.scheduler.telemetry.health()
+                    if self.scheduler.telemetry is not None else {}),
         )
 
     def _collect_rows(self) -> list[dict]:

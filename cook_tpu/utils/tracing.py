@@ -57,7 +57,7 @@ def correlate(txn_id: Optional[str]):
 
 @contextmanager
 def span(name: str, **tags):
-    """with span("match-cycle", pool="default"): ..."""
+    """with span("match_cycle", pool="default"): ..."""
     tid = threading.get_ident()
     with _lock:
         stack = _active.setdefault(tid, [])
@@ -94,7 +94,8 @@ def span(name: str, **tags):
             })
         metric_tags = {k: v for k, v in tags.items()
                        if k not in _RING_ONLY_TAGS}
-        global_registry.histogram(f"span.{name}").observe(
+        global_registry.histogram(
+            f"span.{name}", "wall seconds of the traced section").observe(
             duration, labels=metric_tags or None
         )
 

@@ -1,0 +1,159 @@
+"""The smoke-bench tier and the bench-regression gate: every PR runs the
+real kernels at tiny sizes and validates the BENCH record/gate machinery
+(tools/bench_gate.py)."""
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import bench_gate  # noqa: E402
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture(scope="module")
+def smoke_record(tmp_path_factory):
+    """One real smoke run for the whole module (compiles three tiny
+    kernels once)."""
+    bench = _load_bench()
+    out = tmp_path_factory.mktemp("bench") / "BENCH_rsmoke.json"
+    record = bench.smoke_main(out=str(out))
+    return record, out, bench
+
+
+def test_smoke_emits_structured_record(smoke_record):
+    record, out, _ = smoke_record
+    assert out.exists()
+    on_disk = json.loads(out.read_text())
+    assert on_disk["schema"] == "cook-bench/v1"
+    assert on_disk["mode"] == "smoke"
+    assert set(on_disk["phases"]) == {"match", "dru", "rebalance"}
+    for phase in on_disk["phases"].values():
+        assert phase["p50_ms"] > 0
+    assert on_disk["headline"]["unit"] == "ms"
+    assert record["phases"]["match"]["jobs"] == 1000
+
+
+def test_smoke_match_holds_packing_parity(smoke_record):
+    # the smoke shape is saturated on purpose; the chunked config must
+    # still match the CPU greedy (kc=32/rounds=3/passes=3 -> eff 1.0,
+    # see bench.bench_smoke) — a drop here is a real matcher regression
+    record, _, _ = smoke_record
+    assert record["phases"]["match"]["packing_eff"] >= 0.99
+
+
+def test_next_phase_record_path_skips_driver_rounds(tmp_path):
+    bench = _load_bench()
+    (tmp_path / "BENCH_r05.json").write_text("{}")
+    (tmp_path / "BENCH_r07_phases.json").write_text("{}")
+    assert bench._next_phase_record_path(str(tmp_path)).endswith(
+        "BENCH_r08_phases.json")
+
+
+def make_record(path, mode="smoke", platform="cpu", **phases):
+    payload = {
+        "schema": "cook-bench/v1", "mode": mode, "platform": platform,
+        "phases": {name: {"p50_ms": p50} for name, p50 in phases.items()},
+    }
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+class TestBenchGate:
+    def test_pass_within_threshold(self, tmp_path, capsys):
+        old = make_record(tmp_path / "a.json", match=10.0, dru=2.0)
+        new = make_record(tmp_path / "b.json", match=11.0, dru=2.1)
+        assert bench_gate.main([old, new, "--threshold", "0.2"]) == 0
+        assert "PASS" in capsys.readouterr().out
+
+    def test_injected_regression_fails(self, tmp_path, capsys):
+        """Acceptance: the gate exits non-zero on a synthetic regression."""
+        old = make_record(tmp_path / "a.json", match=10.0, dru=2.0)
+        new = make_record(tmp_path / "b.json", match=25.0, dru=2.0)
+        assert bench_gate.main([old, new]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "match" in out
+
+    def test_speedup_never_fails(self, tmp_path):
+        old = make_record(tmp_path / "a.json", match=20.0)
+        new = make_record(tmp_path / "b.json", match=5.0)
+        assert bench_gate.main([old, new]) == 0
+
+    def test_platform_mismatch_not_compared(self, tmp_path, capsys):
+        # a CPU-fallback round must not "regress" against a TPU round
+        old = make_record(tmp_path / "a.json", platform="tpu", match=0.5)
+        new = make_record(tmp_path / "b.json", platform="cpu", match=800.0)
+        assert bench_gate.main([old, new]) == 0
+        assert "nothing to compare" in capsys.readouterr().out
+
+    def test_smoke_singleton_does_not_shadow_full_rounds(self, tmp_path):
+        # BENCH_rsmoke.json is a fixed overwritten name that sorts after
+        # the numeric rounds; its singleton family must not disable the
+        # full-round comparison
+        make_record(tmp_path / "BENCH_r01_phases.json", mode="full",
+                    match=100.0)
+        make_record(tmp_path / "BENCH_r02_phases.json", mode="full",
+                    match=300.0)
+        make_record(tmp_path / "BENCH_rsmoke.json", mode="smoke", match=5.0)
+        assert bench_gate.main(["--dir", str(tmp_path)]) == 1
+
+    def test_comparable_ancestor_found_behind_mismatch(self, tmp_path):
+        a = make_record(tmp_path / "a.json", platform="cpu", match=10.0)
+        b = make_record(tmp_path / "b.json", platform="tpu", match=0.5)
+        c = make_record(tmp_path / "c.json", platform="cpu", match=30.0)
+        assert bench_gate.main([a, b, c]) == 1
+
+    def test_driver_wrapper_records_skipped(self, tmp_path):
+        # the round driver's BENCH_r{NN}.json wrappers carry no phases;
+        # the gate must ignore them, not crash or compare garbage
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "cmd": "python bench.py", "rc": 0,
+             "parsed": {"value": 800.0}}))
+        old = make_record(tmp_path / "BENCH_r02_phases.json", match=10.0)
+        assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+
+    def test_dir_glob_orders_by_round(self, tmp_path):
+        make_record(tmp_path / "BENCH_r01_phases.json", match=10.0)
+        make_record(tmp_path / "BENCH_r02_phases.json", match=50.0)
+        assert bench_gate.main(["--dir", str(tmp_path)]) == 1
+        # newest round is fine again -> pass (compared against r02)
+        make_record(tmp_path / "BENCH_r03_phases.json", match=50.0)
+        assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+
+    def test_missing_phase_fails_gate(self, tmp_path, capsys):
+        # a phase vanishing from the new record must not read as "no
+        # regression" — it could hide an arbitrarily large one
+        old = make_record(tmp_path / "a.json", match=10.0, dru=2.0)
+        new = make_record(tmp_path / "b.json", match=10.0)
+        assert bench_gate.main([old, new]) == 1
+        assert "missing from the new record" in capsys.readouterr().out
+
+    def test_smoke_rotation_gives_gate_a_pair(self, tmp_path):
+        """The documented CI workflow (`bench.py --smoke` then
+        `bench_gate.py`) must actually gate: the fixed smoke name
+        rotates to BENCH_rsmoke_prev.json instead of erasing the
+        baseline."""
+        import os
+
+        bench = _load_bench()
+        fast = {"schema": "cook-bench/v1", "mode": "smoke",
+                "platform": "cpu", "phases": {"match": {"p50_ms": 5.0}}}
+        slow = {**fast, "phases": {"match": {"p50_ms": 50.0}}}
+        bench.write_bench_record(dict(fast), root=str(tmp_path))
+        bench.write_bench_record(dict(slow), root=str(tmp_path))
+        assert (tmp_path / "BENCH_rsmoke_prev.json").exists()
+        os.utime(tmp_path / "BENCH_rsmoke.json")  # ensure newer mtime
+        assert bench_gate.main(["--dir", str(tmp_path)]) == 1
+
+    def test_bad_threshold_is_usage_error(self, tmp_path):
+        assert bench_gate.main(["--threshold", "0"]) == 2

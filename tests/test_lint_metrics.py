@@ -1,4 +1,4 @@
-"""tools/lint_metrics.py: the static metrics-registry lint, wired into
+"""tools/lint_metrics.py: the static metrics + tracing lint, wired into
 the tier-1 run — the repo itself must stay clean."""
 import pathlib
 import sys
@@ -11,64 +11,166 @@ REPO_ROOT = str(pathlib.Path(__file__).parent.parent)
 
 
 def lint_source(src: str):
-    return lint_metrics.lint_sites(lint_metrics.collect_sites(src, "x.py"))
+    return lint_metrics.lint_sites(
+        lint_metrics.collect_sites(src, "x.py"),
+        lint_metrics.collect_span_sites(src, "x.py"))
+
+
+def lint_files(**sources):
+    sites, span_sites = [], []
+    for path, src in sources.items():
+        sites.extend(lint_metrics.collect_sites(src, path))
+        span_sites.extend(lint_metrics.collect_span_sites(src, path))
+    return lint_metrics.lint_sites(sites, span_sites)
 
 
 def test_repo_metrics_are_clean():
     result = lint_metrics.lint_tree(REPO_ROOT)
     assert result.ok, "\n".join(result.errors)
-    # sanity: the walker actually found the registry call sites
+    # sanity: the walker actually found the registry + span call sites
     assert len(result.sites) > 10
+    assert len(result.span_sites) >= 4
 
 
 def test_conflicting_types_detected():
     result = lint_source(
-        "global_registry.counter('match.matched')\n"
-        "global_registry.gauge('match.matched')\n")
+        "global_registry.counter('match.matched', 'help')\n"
+        "global_registry.gauge('match.matched', 'help')\n")
     assert not result.ok
-    assert "conflicting types" in result.errors[0]
+    assert any("conflicting types" in e for e in result.errors)
 
 
 def test_same_type_duplicates_allowed():
     result = lint_source(
-        "global_registry.counter('a.b')\n"
+        "global_registry.counter('a.b', 'what a.b counts')\n"
         "global_registry.counter('a.b')\n")
     assert result.ok
 
 
 def test_invalid_prometheus_identifier_detected():
-    result = lint_source("global_registry.counter('has space')\n")
+    result = lint_source("global_registry.counter('has space', 'h')\n")
     assert not result.ok
     assert "invalid Prometheus identifier" in result.errors[0]
 
 
 def test_dots_and_dashes_map_to_underscores():
     assert lint_metrics.rendered_name("a.b-c") == "cook_a_b_c"
-    assert lint_source("global_registry.gauge('a.b-c')\n").ok
+    assert lint_source("global_registry.gauge('a.b-c', 'h')\n").ok
 
 
 def test_dynamic_names_skipped_but_fragments_checked():
-    ok = lint_source('global_registry.histogram(f"span.{name}")\n')
+    ok = lint_source('global_registry.histogram(f"span.{name}", "h")\n')
     assert ok.ok
     assert ok.sites[0].dynamic
-    bad = lint_source('global_registry.histogram(f"sp an.{name}")\n')
+    bad = lint_source('global_registry.histogram(f"sp an.{name}", "h")\n')
     assert not bad.ok
 
 
 def test_attribute_qualified_registry_matches():
     result = lint_source(
-        "metrics.global_registry.counter('x')\n"
-        "metrics.global_registry.histogram('x')\n")
+        "metrics.global_registry.counter('x', 'h')\n"
+        "metrics.global_registry.histogram('x', 'h')\n")
     assert not result.ok
+
+
+# ------------------------------------------------------------- HELP rule
+
+
+def test_metric_without_help_rejected():
+    result = lint_source("global_registry.counter('no.help')\n")
+    assert not result.ok
+    assert "without HELP" in result.errors[0]
+
+
+def test_help_at_one_site_vouches_for_siblings():
+    # .inc()-style re-registrations without help are fine as long as ONE
+    # site documents the name
+    result = lint_source(
+        "global_registry.counter('a.b', 'what a.b counts').inc()\n"
+        "global_registry.counter('a.b').inc(2)\n")
+    assert result.ok
+
+
+def test_help_keyword_counts():
+    assert lint_source(
+        "global_registry.gauge('a', help_='documented')\n").ok
+    assert not lint_source("global_registry.gauge('a', help_='')\n").ok
+
+
+def test_dynamic_metric_requires_help_at_site():
+    assert not lint_source(
+        'global_registry.histogram(f"span.{name}")\n').ok
+
+
+def test_aliased_factory_resolved():
+    # the monitor-gauge idiom: g = global_registry.gauge; g("name")
+    src = ("g = global_registry.gauge\n"
+           "g('monitor.x', 'help')\n"
+           "g('monitor.x')\n")
+    result = lint_source(src)
+    assert result.ok
+    assert len(result.sites) == 2
+    bad = lint_source("g = global_registry.gauge\ng('monitor.y')\n")
+    assert not bad.ok and "without HELP" in bad.errors[0]
+
+
+def test_alias_type_conflict_detected():
+    result = lint_source(
+        "g = global_registry.gauge\n"
+        "g('dual', 'h')\n"
+        "global_registry.counter('dual', 'h')\n")
+    assert not result.ok
+    assert any("conflicting types" in e for e in result.errors)
+
+
+# ------------------------------------------------------------ span rules
+
+
+def test_span_names_must_match_grammar():
+    assert lint_source("with span('match_cycle', pool=p): pass\n").ok
+    bad = lint_source("with span('match-cycle'): pass\n")
+    assert not bad.ok
+    assert "[a-z0-9_.]" in bad.errors[0]
+    assert not lint_source("tracing.span('Match.Cycle')\n").ok
+
+
+def test_record_event_names_linted():
+    assert lint_source("tracing.record_event('replication.ack')\n").ok
+    assert not lint_source("tracing.record_event('Replication Ack')\n").ok
+
+
+def test_span_reuse_within_one_file_allowed():
+    assert lint_source(
+        "span('cycle.work')\nspan('cycle.work')\n").ok
+
+
+def test_duplicate_span_across_files_rejected():
+    result = lint_files(**{
+        "a.py": "span('shared.name')\n",
+        "b.py": "span('shared.name')\n",
+    })
+    assert not result.ok
+    assert "multiple modules" in result.errors[0]
+
+
+def test_dynamic_span_fragments_checked():
+    assert lint_source('span(f"cycle.{phase}")\n').ok
+    assert not lint_source('span(f"Cycle {phase}")\n').ok
 
 
 def test_cli_exit_codes(tmp_path):
     clean = tmp_path / "clean"
     clean.mkdir()
-    (clean / "a.py").write_text("global_registry.counter('fine.name')\n")
+    (clean / "a.py").write_text(
+        "global_registry.counter('fine.name', 'help')\n")
     assert lint_metrics.main([str(clean)]) == 0
     dirty = tmp_path / "dirty"
     dirty.mkdir()
     (dirty / "a.py").write_text(
-        "global_registry.counter('n')\nglobal_registry.gauge('n')\n")
+        "global_registry.counter('n', 'h')\n"
+        "global_registry.gauge('n', 'h')\n")
     assert lint_metrics.main([str(dirty)]) == 1
+    spans = tmp_path / "spans"
+    spans.mkdir()
+    (spans / "a.py").write_text("span('Bad-Name')\n")
+    assert lint_metrics.main([str(spans)]) == 1

@@ -97,6 +97,46 @@ class TestMonitorGauges:
         assert stats.starved_users == 1
         assert stats.used.cpus == 4
 
+    def test_pool_stats_exported_as_gauges(self):
+        """collect_pool_stats must publish every PoolStats field it
+        computes to the monitor.* gauges, labeled by pool."""
+        from cook_tpu.utils.metrics import global_registry
+
+        clock, store, cluster, scheduler = setup(n_hosts=2, cpus=8.0)
+        run_job(store, scheduler, make_job(user="u1", mem=500, cpus=2))
+        store.submit_jobs([make_job(user="u2", mem=300, cpus=1)])
+        stats = collect_pool_stats(store, "default")
+        labels = {"pool": "default"}
+        g = global_registry.gauge
+        assert g("monitor.running_jobs").value(labels) == stats.running_jobs
+        assert g("monitor.waiting_jobs").value(labels) == stats.waiting_jobs == 1
+        assert g("monitor.running_users").value(labels) == 1
+        assert g("monitor.waiting_users").value(labels) == 1
+        assert g("monitor.starved_users").value(labels) == stats.starved_users
+        assert g("monitor.used_mem").value(labels) == stats.used.mem == 500
+        assert g("monitor.used_cpus").value(labels) == 2
+        assert g("monitor.waiting_mem").value(labels) == 300
+        assert g("monitor.waiting_cpus").value(labels) == 1
+        # the gauges render into the exposition with HELP lines
+        text = global_registry.render_prometheus()
+        assert 'cook_monitor_waiting_mem{pool="default"} 300' in text
+        assert "# HELP cook_monitor_starved_users" in text
+
+    def test_collect_all_covers_every_pool(self):
+        from cook_tpu.scheduler.monitor import collect_all
+        from cook_tpu.utils.metrics import global_registry
+
+        clock, store, cluster, scheduler = setup()
+        store.set_pool(Pool(name="batch"))
+        store.submit_jobs([make_job(user="u1")])
+        store.submit_jobs([make_job(user="u2", pool="batch")])
+        stats = collect_all(store)
+        assert set(stats) >= {"default", "batch"}
+        assert stats["batch"].waiting_jobs == 1
+        g = global_registry.gauge("monitor.waiting_jobs")
+        assert g.value({"pool": "batch"}) == 1
+        assert g.value({"pool": "default"}) == 1
+
 
 class TestSandboxPublisher:
     def test_batched_publish(self):

@@ -1,0 +1,308 @@
+"""cook_tpu/obs/: compile observatory, rolling baselines, quality
+monitor, device-memory probe, and the DeviceTelemetry facade."""
+import numpy as np
+
+from cook_tpu.obs import (
+    CompileObservatory,
+    DeviceTelemetry,
+    RollingBaseline,
+    QualityMonitor,
+    device_memory_stats,
+    update_device_memory_gauges,
+)
+from cook_tpu.obs.compile_observatory import shape_signature
+from cook_tpu.ops.common import bucket_size, fetch_result
+from cook_tpu.utils.metrics import global_registry
+
+
+class TestCompileObservatory:
+    def test_first_seen_key_is_a_compile(self):
+        obs = CompileObservatory()
+        assert obs.observe_solve("match", (1024, 128), "xla")
+        assert not obs.observe_solve("match", (1024, 128), "xla")
+        # a new shape, backend, or op each compile fresh programs
+        assert obs.observe_solve("match", (2048, 128), "xla")
+        assert obs.observe_solve("match", (1024, 128), "bucketed")
+        assert obs.observe_solve("rank", (1024, 128), "xla")
+
+    def test_shape_signature(self):
+        assert shape_signature((131072, 16384)) == "131072x16384"
+        assert shape_signature((64,)) == "64"
+
+    def test_storm_from_padding_bucket_churn(self):
+        """The acceptance scenario: a queue oscillating across padding
+        buckets mints a new padded shape almost every solve."""
+        obs = CompileObservatory(window=8, storm_threshold=3,
+                                 warmup_solves=0)
+        churn = [100, 1100, 2100, 4100, 100, 1100]  # queue length per cycle
+        for n in churn:
+            obs.observe_solve("match", (bucket_size(n), 2048), "xla")
+        storms = obs.storming_ops()
+        assert "match" in storms
+        assert storms["match"]["compiles_in_window"] >= 3
+        # counted, not just flagged
+        stats = obs.stats()["match"]
+        assert stats["programs"] == 4  # 128, 2048, 4096, 8192 buckets
+        assert stats["storming"]
+
+    def test_stable_shapes_never_storm_and_storms_clear(self):
+        obs = CompileObservatory(window=8, storm_threshold=3,
+                                 warmup_solves=0)
+        for n in [100, 1100, 2100, 4100]:
+            obs.observe_solve("match", (bucket_size(n), 2048), "xla")
+        assert "match" in obs.storming_ops()
+        # a full window of warm same-shape solves drains the storm
+        for _ in range(8):
+            obs.observe_solve("match", (128, 2048), "xla")
+        assert obs.storming_ops() == {}
+
+    def test_first_boot_warmup_never_storms(self):
+        """A fresh process compiles every pool's shape once by
+        construction; that must not page recompile-storm on each deploy.
+        Churn AFTER warmup still triggers."""
+        obs = CompileObservatory(window=8, storm_threshold=3)  # warmup=8
+        for i in range(6):  # boot: 6 distinct pool shapes, all compile
+            obs.observe_solve("match", (64 * (i + 1), 2048), "xla")
+        assert obs.storming_ops() == {}
+        for _ in range(4):  # steady state
+            obs.observe_solve("match", (64, 2048), "xla")
+        for i in range(4):  # post-warmup padding churn: a real storm
+            obs.observe_solve("match", (1 << (14 + i), 2048), "xla")
+        assert "match" in obs.storming_ops()
+        # compile COUNTS were honest throughout (warmup included)
+        assert obs.stats()["match"]["programs"] == 10
+
+    def test_per_key_compile_counts_exported(self):
+        # the counters are process-global across observatories: assert
+        # deltas, not absolutes (other suites run match solves too)
+        counter = global_registry.counter("obs.compile.count")
+        solves = global_registry.counter("obs.solve.count")
+        key = {"op": "match", "shape": "1024x256", "backend": "xla"}
+        skey = {"op": "match", "backend": "xla"}
+        c0, s0 = counter.value(key), solves.value(skey)
+        obs = CompileObservatory()
+        obs.observe_solve("match", (1024, 256), "xla")
+        obs.observe_solve("match", (1024, 256), "xla")
+        assert counter.value(key) == c0 + 1.0  # one compile, two solves
+        assert solves.value(skey) == s0 + 2.0
+
+
+class TestRollingBaseline:
+    def test_too_few_samples_returns_none(self):
+        b = RollingBaseline(window=16, recent=4, min_samples=8)
+        for _ in range(7):
+            b.add(1.0)
+        assert b.snapshot() is None
+
+    def test_flat_series_is_calm(self):
+        b = RollingBaseline(window=16, recent=4, min_samples=8)
+        for _ in range(16):
+            b.add(1.0)
+        snap = b.snapshot()
+        assert snap["deviation"] == 0.0
+        assert b.anomaly_high() is None and b.anomaly_low() is None
+
+    def test_rise_flags_high_not_low(self):
+        b = RollingBaseline(window=32, recent=4, min_samples=8)
+        for _ in range(20):
+            b.add(0.010)
+        for _ in range(4):
+            b.add(0.100)
+        assert b.anomaly_high() is not None
+        assert b.anomaly_low() is None
+
+    def test_drop_flags_low(self):
+        b = RollingBaseline(window=32, recent=4, min_samples=8)
+        for _ in range(20):
+            b.add(1.0)
+        for _ in range(4):
+            b.add(0.8)
+        anomaly = b.anomaly_low()
+        assert anomaly is not None and anomaly["deviation"] < 0
+
+    def test_rel_floor_absorbs_noise(self):
+        b = RollingBaseline(window=32, recent=4, min_samples=8,
+                            rel_floor=0.10)
+        for _ in range(20):
+            b.add(1.0)
+        for _ in range(4):
+            b.add(0.95)  # -5%: inside the 10% floor band
+        assert b.anomaly_low() is None
+
+
+class TestQualityMonitor:
+    def test_sampling_cadence(self):
+        q = QualityMonitor(sample_every=3)
+        due = [q.due("p") for _ in range(6)]
+        assert due == [False, False, True, False, False, True]
+        assert not any(QualityMonitor(sample_every=0).due("p")
+                       for _ in range(5))
+
+    def test_floor_breach_is_drift(self):
+        q = QualityMonitor(sample_every=1, floor=0.97)
+        q.record_sample("default", 0.90)
+        drift = q.drifting_pools()
+        assert drift["default"]["kind"] == "parity-floor"
+
+    def test_rolling_drop_is_drift_and_recovers(self):
+        q = QualityMonitor(sample_every=1, floor=0.5)  # floor out of play
+        for _ in range(12):
+            q.record_sample("default", 1.0)
+        assert q.drifting_pools() == {}
+        for _ in range(4):
+            q.record_sample("default", 0.90)
+        assert q.drifting_pools()["default"]["kind"] == "rolling-baseline"
+        for _ in range(8):
+            q.record_sample("default", 1.0)
+        assert q.drifting_pools() == {}
+
+    def test_drift_events_are_edge_triggered(self):
+        counter = global_registry.counter("obs.quality.drift_events")
+        before = counter.value({"pool": "edge"})
+        q = QualityMonitor(sample_every=1, floor=0.97)
+        for _ in range(5):
+            q.record_sample("edge", 0.80)  # one sustained episode
+        assert counter.value({"pool": "edge"}) == before + 1
+        q.record_sample("edge", 1.0)  # recover (floor ok, above band? no
+        # — band check needs min_samples; floor check clears)
+        for _ in range(2):
+            q.record_sample("edge", 0.80)  # second episode
+        assert counter.value({"pool": "edge"}) == before + 2
+
+    def test_shadow_solve_against_reference(self):
+        """A device assignment identical to the reference scores 1.0; an
+        empty one scores 0."""
+        import jax.numpy as jnp
+
+        from cook_tpu.ops import cpu_reference as ref
+        from cook_tpu.scheduler.matcher import PreparedPool
+
+        rng = np.random.default_rng(0)
+        j, n = 32, 8
+        demands = np.stack([rng.uniform(100, 1000, j),
+                            rng.uniform(0.5, 4, j),
+                            np.zeros(j), np.zeros(j)], axis=-1
+                           ).astype(np.float32)
+        totals = np.stack([np.full(n, 4000.0), np.full(n, 16.0)],
+                          axis=-1).astype(np.float32)
+        avail = np.concatenate([totals, np.zeros((n, 2), np.float32)],
+                               axis=-1)
+        ref_assign = ref.np_greedy_match(demands, avail, totals)
+
+        class Nodes:
+            pass
+
+        nodes = Nodes()
+        nodes.n = n
+        prepared = PreparedPool(pool=None, outcome=None)
+        prepared.considerable = list(range(j))
+        prepared.nodes = nodes
+        prepared.problem = type("P", (), {})()
+        prepared.problem.demands = jnp.asarray(demands)
+        prepared.problem.avail = jnp.asarray(avail)
+        prepared.problem.totals = jnp.asarray(totals)
+        prepared.feasible = None
+
+        q = QualityMonitor(sample_every=1)
+        assert q.shadow_solve(prepared, ref_assign, "p1") == 1.0
+        none_placed = np.full(j, -1)
+        assert q.shadow_solve(prepared, none_placed, "p1") == 0.0
+
+
+class TestDeviceMonitor:
+    def test_unobservable_returns_none(self):
+        # CPU devices expose no allocator stats; must degrade, not lie
+        assert update_device_memory_gauges(lambda: None) is None
+
+    def test_fake_device_stats(self):
+        class Dev:
+            def memory_stats(self):
+                return {"bytes_in_use": 600, "bytes_limit": 1000}
+
+        stats = device_memory_stats(Dev())
+        assert stats["utilization"] == 0.6
+        out = update_device_memory_gauges(lambda: stats)
+        assert out["bytes_in_use"] == 600
+        g = global_registry.gauge("obs.device.mem_utilization")
+        assert g.value() == 0.6
+
+    def test_raising_provider_degrades(self):
+        class Broken:
+            def memory_stats(self):
+                raise RuntimeError("tunnel wedged")
+
+        assert device_memory_stats(Broken()) is None
+
+
+class TestDeviceTelemetry:
+    def make(self, **kw):
+        kw.setdefault("memory_stats_fn", lambda: None)
+        return DeviceTelemetry(**kw)
+
+    def test_last_solve_snapshot(self):
+        t = self.make()
+        t.record_match_solve("default", (1024, 128), "xla", 0.02)
+        info = t.solve_info("default")
+        assert info == {"op": "match", "shape": "1024x128",
+                        "backend": "xla", "compiled": True,
+                        "seconds": 0.02}
+        assert t.solve_info("nope") is None
+
+    def test_compiles_excluded_from_latency_baseline(self):
+        t = self.make(latency_min_samples=4)
+        # alternating fresh shapes: every solve compiles, baseline stays
+        # empty, so a storm of compiles can't read as a latency regression
+        for i in range(8):
+            t.record_match_solve("p", (64 * (i + 1), 64), "xla", 5.0)
+        assert t.latency_regressions() == {}
+
+    def test_latency_regression_detected(self):
+        t = self.make(latency_window=32, latency_recent=4,
+                      latency_min_samples=8)
+        t.record_match_solve("p", (1024, 128), "xla", 9.0)  # compile run
+        for _ in range(16):
+            t.record_match_solve("p", (1024, 128), "xla", 0.010)
+        assert t.latency_regressions() == {}
+        for _ in range(4):
+            t.record_match_solve("p", (1024, 128), "xla", 0.100)
+        assert "p" in t.latency_regressions()
+        health = t.health()
+        assert not health["healthy"]
+        assert "solve-latency-regression" in health["reasons"]
+
+    def test_batched_solve_counts_once(self):
+        t = self.make()
+        before = global_registry.counter("obs.solve.count").value(
+            {"op": "match_batched", "backend": "xla"})
+        t.record_batched_match_solve(["a", "b"], (2, 1024, 128), "xla",
+                                     0.05)
+        after = global_registry.counter("obs.solve.count").value(
+            {"op": "match_batched", "backend": "xla"})
+        assert after == before + 1
+        assert t.solve_info("a")["shape"] == "2x1024x128"
+        assert t.solve_info("b")["op"] == "match_batched"
+
+    def test_health_oom_risk(self):
+        t = self.make(memory_stats_fn=lambda: {
+            "bytes_in_use": 95, "bytes_limit": 100,
+            "peak_bytes_in_use": 99, "utilization": 0.95})
+        health = t.health()
+        assert health["reasons"] == ["device-oom-risk"]
+        assert health["checks"]["device_memory"]["utilization"] == 0.95
+
+    def test_health_unobservable_memory(self):
+        health = self.make().health()
+        assert health["healthy"]
+        assert health["checks"]["device_memory"] == {"observable": False}
+
+
+def test_fetch_result_materializes_pytrees():
+    import jax.numpy as jnp
+
+    from cook_tpu.ops.match import MatchResult
+
+    result = MatchResult(assignment=jnp.arange(4), new_avail=jnp.ones((2, 3)))
+    fetched = fetch_result(result)
+    assert isinstance(fetched.assignment, np.ndarray)
+    assert isinstance(fetched.new_avail, np.ndarray)
+    assert fetch_result(jnp.arange(3)).tolist() == [0, 1, 2]

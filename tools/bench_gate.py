@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff the last two bench records, fail on slowdown.
+
+bench.py writes structured per-phase records ({"schema": "cook-bench/v1",
+"phases": {"match": {"p50_ms": ...}, ...}}) to BENCH_r*.json files —
+BENCH_r{NN}_phases.json per full round, BENCH_rsmoke.json for the smoke
+tier.  This gate:
+
+  1. collects records (explicit file args, or the BENCH_r*.json glob in
+     --dir, sorted by round number then mtime);
+  2. keeps only comparable pairs — same schema, same mode, same platform
+     (a CPU-fallback round must not "regress" against a real-TPU round);
+  3. compares each phase's p50_ms in the newest record against the
+     previous comparable one; any phase slower by more than --threshold
+     (default 20%) fails the gate.
+
+Exit codes: 0 pass / nothing to compare, 1 regression, 2 usage error.
+
+    python tools/bench_gate.py [--dir ROOT] [--threshold 0.2] [files...]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+SCHEMA = "cook-bench/v1"
+
+
+def load_record(path: str) -> dict | None:
+    """Parse one bench artifact; returns a normalized record or None for
+    files this gate can't judge (the driver's wrapper records carry only
+    the headline line, no per-phase results)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: skipping {path}: {e}", file=sys.stderr)
+        return None
+    if not isinstance(data, dict) or data.get("schema") != SCHEMA:
+        return None
+    phases = data.get("phases")
+    if not isinstance(phases, dict) or not phases:
+        return None
+    return {
+        "path": path,
+        "mode": data.get("mode", "full"),
+        "platform": data.get("platform", "unknown"),
+        "phases": {
+            name: float(info["p50_ms"])
+            for name, info in phases.items()
+            if isinstance(info, dict) and "p50_ms" in info
+        },
+    }
+
+
+def _round_key(path: str):
+    m = re.match(r"BENCH_r(\d+)", os.path.basename(path))
+    return (0, int(m.group(1))) if m else (1, 0)
+
+
+def collect_records(paths: list[str]) -> list[dict]:
+    records = []
+    for path in paths:
+        record = load_record(path)
+        if record is not None:
+            records.append(record)
+    return records
+
+
+def gate(records: list[dict], threshold: float) -> tuple[int, list[str]]:
+    """(exit_code, messages).  Records are grouped by (mode, platform) —
+    a CPU-fallback round must not "regress" against a real-TPU round,
+    and the singleton smoke record must not shadow the full-round family
+    — then EVERY family with >= 2 records compares its newest pair.  Any
+    family regressing fails the gate."""
+    families: dict[tuple, list[dict]] = {}
+    for record in records:
+        families.setdefault((record["mode"], record["platform"]),
+                            []).append(record)
+    messages: list[str] = []
+    regressed_families = 0
+    compared = False
+    for (mode, platform), family in sorted(families.items()):
+        if len(family) < 2:
+            continue
+        compared = True
+        old, new = family[-2], family[-1]
+        messages.append(
+            f"bench_gate: {old['path']} -> {new['path']} "
+            f"(mode={mode}, platform={platform}, "
+            f"threshold {threshold:.0%})")
+        regressions = []
+        for phase in sorted(set(old["phases"]) & set(new["phases"])):
+            before, after = old["phases"][phase], new["phases"][phase]
+            if before <= 0:
+                continue
+            delta = (after - before) / before
+            status = "REGRESSION" if delta > threshold else "ok"
+            messages.append(
+                f"bench_gate:   {phase}: {before:.2f} ms -> {after:.2f} ms "
+                f"({delta:+.1%}) {status}")
+            if delta > threshold:
+                regressions.append(phase)
+        dropped = sorted(set(old["phases"]) - set(new["phases"]))
+        if dropped:
+            # a silently vanished phase must not read as "no regression":
+            # an arbitrarily large slowdown in (or total loss of) a phase
+            # the new record simply omits would otherwise pass the gate
+            messages.append(f"bench_gate:   phases missing from the new "
+                            f"record: {dropped} — counted as regressed")
+            regressions.extend(f"{p} (missing)" for p in dropped)
+        if regressions:
+            regressed_families += 1
+            messages.append(
+                f"bench_gate: FAIL — {len(regressions)} phase(s) regressed "
+                f"past {threshold:.0%}: {', '.join(regressions)}")
+    if not compared:
+        return 0, ["bench_gate: no (mode, platform) family has two "
+                   "structured records; nothing to compare"]
+    if regressed_families:
+        return 1, messages
+    messages.append("bench_gate: PASS")
+    return 0, messages
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="fail when the newest bench record regressed")
+    parser.add_argument("files", nargs="*",
+                        help="explicit record paths (oldest first); "
+                             "default: BENCH_r*.json in --dir")
+    parser.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    parser.add_argument("--threshold", type=float, default=0.2,
+                        help="max tolerated relative slowdown (0.2 = 20%%)")
+    args = parser.parse_args(argv)
+    if args.threshold <= 0:
+        print("bench_gate: --threshold must be positive", file=sys.stderr)
+        return 2
+    paths = args.files or sorted(
+        glob.glob(os.path.join(args.dir, "BENCH_r*.json")),
+        key=lambda p: (_round_key(p), os.path.getmtime(p)))
+    code, messages = gate(collect_records(paths), args.threshold)
+    for message in messages:
+        print(message)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

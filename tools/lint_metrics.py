@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static metrics lint: every `global_registry.*` call site must agree.
+"""Static metrics + tracing lint: every registration site must agree.
 
 The registry raises at RUNTIME when one name is requested as two
 different metric types — but only when the second call site actually
@@ -11,11 +11,23 @@ the source tree instead and fails when:
     `gauge("match.matched")` in another);
   * a literal metric name does not render to a valid Prometheus
     identifier under the exposition mapping
-    (`cook_` + name with `.`/`-` -> `_`).
+    (`cook_` + name with `.`/`-` -> `_`);
+  * a metric name is registered WITHOUT HELP text anywhere (every name
+    needs at least one site passing the help argument — an exposition
+    without `# HELP` is a metric nobody can interpret mid-incident);
+  * a tracing span name (`span(...)` / `record_event(...)` literal)
+    doesn't match `^[a-z0-9_.]+$` (span names become
+    `cook_span_<name>` histograms and ring entries — one flat grammar);
+  * the same span name is introduced from more than one module (each
+    span has one owner; a shared name would merge two different
+    sections into one histogram with nobody noticing).
 
+Aliased registrations (`g = global_registry.gauge; g("name", ...)`) are
+resolved file-locally, so the monitor-gauge idiom stays covered.
 Dynamic names (f-strings like `f"span.{name}"`) can't be validated
 statically; their constant fragments are still checked for characters
-that could never be valid.
+that could never be valid, and dynamic metric sites must each carry
+help (they can't be vouched for by a sibling site).
 
 Wired into the tier-1 test run via tests/test_lint_metrics.py.
 
@@ -30,9 +42,12 @@ import sys
 from dataclasses import dataclass, field
 
 METRIC_FACTORIES = ("counter", "gauge", "histogram")
+SPAN_FUNCTIONS = ("span", "record_event")
 _VALID_RENDERED = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 # characters a name fragment may use pre-mapping (".", "-" map to "_")
 _VALID_FRAGMENT = re.compile(r"[a-zA-Z0-9_:.\-]*$")
+_VALID_SPAN = re.compile(r"[a-z0-9_.]+$")
+_VALID_SPAN_FRAGMENT = re.compile(r"[a-z0-9_.]*$")
 
 
 def rendered_name(name: str) -> str:
@@ -47,11 +62,21 @@ class CallSite:
     metric_type: str
     name: str            # literal, or the constant fragments of an f-string
     dynamic: bool = False
+    has_help: bool = False
+
+
+@dataclass
+class SpanSite:
+    path: str
+    line: int
+    name: str
+    dynamic: bool = False
 
 
 @dataclass
 class LintResult:
     sites: list[CallSite] = field(default_factory=list)
+    span_sites: list[SpanSite] = field(default_factory=list)
     errors: list[str] = field(default_factory=list)
 
     @property
@@ -83,42 +108,120 @@ def _name_arg(call: ast.Call) -> tuple[str, bool] | None:
     return None
 
 
+def _has_help(call: ast.Call) -> bool:
+    """True when the registration passes non-empty help (2nd positional
+    or help_= keyword) — "can't tell statically" (a variable) counts as
+    help, only a knowably-empty/missing argument fails."""
+    arg = None
+    if len(call.args) >= 2:
+        arg = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "help_":
+                arg = kw.value
+    if arg is None:
+        return False
+    if isinstance(arg, ast.Constant):
+        return bool(arg.value)
+    return True
+
+
+def _registry_aliases(tree: ast.AST) -> dict[str, str]:
+    """File-local names bound to a registry factory
+    (`g = global_registry.gauge` -> {"g": "gauge"})."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        value = node.value
+        if (isinstance(value, ast.Attribute)
+                and value.attr in METRIC_FACTORIES
+                and _is_global_registry(value.value)):
+            aliases[node.targets[0].id] = value.attr
+    return aliases
+
+
+def _is_span_call(func: ast.expr) -> bool:
+    # span(...) / record_event(...) / tracing.span(...) /
+    # <mod>.tracing.record_event(...)
+    if isinstance(func, ast.Name):
+        return func.id in SPAN_FUNCTIONS
+    if isinstance(func, ast.Attribute) and func.attr in SPAN_FUNCTIONS:
+        value = func.value
+        if isinstance(value, ast.Name):
+            return value.id == "tracing"
+        if isinstance(value, ast.Attribute):
+            return value.attr == "tracing"
+    return False
+
+
 def collect_sites(source: str, path: str) -> list[CallSite]:
     sites: list[CallSite] = []
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError:
         return sites
+    aliases = _registry_aliases(tree)
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
-        if not (isinstance(func, ast.Attribute)
+        metric_type = None
+        if (isinstance(func, ast.Attribute)
                 and func.attr in METRIC_FACTORIES
                 and _is_global_registry(func.value)):
+            metric_type = func.attr
+        elif isinstance(func, ast.Name) and func.id in aliases:
+            metric_type = aliases[func.id]
+        if metric_type is None:
             continue
         parsed = _name_arg(node)
         if parsed is None:
             continue
         name, dynamic = parsed
         sites.append(CallSite(path=path, line=node.lineno,
-                              metric_type=func.attr, name=name,
+                              metric_type=metric_type, name=name,
+                              dynamic=dynamic, has_help=_has_help(node)))
+    return sites
+
+
+def collect_span_sites(source: str, path: str) -> list[SpanSite]:
+    sites: list[SpanSite] = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return sites
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_span_call(node.func)):
+            continue
+        parsed = _name_arg(node)
+        if parsed is None:
+            continue
+        name, dynamic = parsed
+        sites.append(SpanSite(path=path, line=node.lineno, name=name,
                               dynamic=dynamic))
     return sites
 
 
-def lint_sites(sites: list[CallSite]) -> LintResult:
-    result = LintResult(sites=sites)
+def lint_sites(sites: list[CallSite],
+               span_sites: list[SpanSite] = ()) -> LintResult:
+    result = LintResult(sites=sites, span_sites=list(span_sites))
     by_name: dict[str, dict[str, list[CallSite]]] = {}
     for site in sites:
         where = f"{site.path}:{site.line}"
         if site.dynamic:
             # can't validate the whole name; the constant fragments must
-            # still be mappable
+            # still be mappable — and help can't be vouched for by a
+            # sibling site, so each dynamic site carries its own
             if not _VALID_FRAGMENT.match(site.name):
                 result.errors.append(
                     f"{where}: dynamic metric name has invalid constant "
                     f"fragment {site.name!r}")
+            if not site.has_help:
+                result.errors.append(
+                    f"{where}: dynamic metric f\"...{site.name}...\" "
+                    f"registered without HELP text")
             continue
         pname = rendered_name(site.name)
         if not _VALID_RENDERED.match(pname):
@@ -135,12 +238,47 @@ def lint_sites(sites: list[CallSite]) -> LintResult:
             result.errors.append(
                 f"metric {name!r} registered with conflicting types: "
                 f"{locations}")
+        all_sites = [s for ss in types.values() for s in ss]
+        if not any(s.has_help for s in all_sites):
+            locations = ",".join(f"{s.path}:{s.line}" for s in all_sites)
+            result.errors.append(
+                f"metric {name!r} registered without HELP text at every "
+                f"site ({locations}); add help to at least one")
+    _lint_spans(result)
     return result
+
+
+def _lint_spans(result: LintResult) -> None:
+    """Span-name rules: flat `^[a-z0-9_.]+$` grammar, one owning module
+    per name (a span name reused across files merges two different code
+    sections into one histogram)."""
+    by_name: dict[str, list[SpanSite]] = {}
+    for site in result.span_sites:
+        where = f"{site.path}:{site.line}"
+        if site.dynamic:
+            if not _VALID_SPAN_FRAGMENT.match(site.name):
+                result.errors.append(
+                    f"{where}: dynamic span name has invalid constant "
+                    f"fragment {site.name!r}")
+            continue
+        if not _VALID_SPAN.match(site.name):
+            result.errors.append(
+                f"{where}: span name {site.name!r} does not match "
+                f"^[a-z0-9_.]+$")
+        by_name.setdefault(site.name, []).append(site)
+    for name, sites in sorted(by_name.items()):
+        files = sorted({s.path for s in sites})
+        if len(files) > 1:
+            result.errors.append(
+                f"span {name!r} opened from multiple modules "
+                f"({', '.join(files)}); give each span one owner (or "
+                f"hoist a shared helper)")
 
 
 def lint_tree(root: str) -> LintResult:
     root_path = pathlib.Path(root)
     sites: list[CallSite] = []
+    span_sites: list[SpanSite] = []
     scan_dirs = [d for d in (root_path / "cook_tpu", root_path / "tools")
                  if d.is_dir()]
     if not scan_dirs:   # linting an arbitrary directory
@@ -152,7 +290,8 @@ def lint_tree(root: str) -> LintResult:
             except OSError:
                 continue
             sites.extend(collect_sites(source, str(path)))
-    return lint_sites(sites)
+            span_sites.extend(collect_span_sites(source, str(path)))
+    return lint_sites(sites, span_sites)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -162,8 +301,9 @@ def main(argv: list[str] | None = None) -> int:
     for error in result.errors:
         print(f"lint_metrics: {error}", file=sys.stderr)
     literal = sum(1 for s in result.sites if not s.dynamic)
-    print(f"lint_metrics: {len(result.sites)} call sites "
-          f"({literal} literal), {len(result.errors)} errors")
+    print(f"lint_metrics: {len(result.sites)} metric call sites "
+          f"({literal} literal), {len(result.span_sites)} span sites, "
+          f"{len(result.errors)} errors")
     return 1 if result.errors else 0
 
 
