@@ -313,6 +313,51 @@ def bench_multipool(jax, jnp, tuned):
     return p50
 
 
+def make_elastic_problem(jnp, p, j, p_real=None, seed=6):
+    """Padded capacity-plan inputs at any size — ONE construction for
+    the full and smoke tiers (ops/elastic.py solve shapes)."""
+    from cook_tpu.ops.elastic import ElasticProblem
+
+    rng = np.random.default_rng(seed)
+    res = rng.uniform(100, 8000, (p, j, 3)).astype(np.float32)
+    res[:, :, 2] = 0.0
+    valid = rng.uniform(size=(p, j)) < 0.6
+    demand_supply = rng.uniform(0, 500_000, (2, p, 3)).astype(np.float32)
+    outstanding = np.zeros((p, p, 3), np.float32)
+    live = p if p_real is None else p_real
+    outstanding[0, 1 % p] = (5000.0, 8.0, 0.0)
+    pool_valid = np.arange(p) < live
+    problem = ElasticProblem(
+        demand=jnp.asarray(demand_supply[0]),
+        supply=jnp.asarray(demand_supply[1]),
+        outstanding=jnp.asarray(outstanding),
+        pool_valid=jnp.asarray(pool_valid),
+    )
+    return jnp.asarray(res), jnp.asarray(valid), problem
+
+
+def bench_elastic(jax, jnp, p=64, j=16384, repeats=5):
+    """Elastic capacity-plane planner solve (ops/elastic.py): the
+    rank-weighted demand fold + the loan/reclaim assignment, timed as
+    one fetch-terminated unit (what Scheduler.elastic_cycle dispatches
+    per planning interval).  tools/bench_gate.py guards this phase."""
+    from cook_tpu.ops.common import fetch_result
+    from cook_tpu.ops.elastic import solve_capacity_plan, weighted_demand
+
+    res, valid, problem = make_elastic_problem(jnp, p, j)
+
+    def solve():
+        demand = weighted_demand(res, valid, jnp.float32(64))
+        plan = solve_capacity_plan(problem._replace(demand=demand),
+                                   jnp.float32(0.1))
+        return fetch_result((plan.reclaim, plan.loan))
+
+    solve()
+    p50, _ = time_fn(solve, repeats=repeats)
+    log(f"elastic plan {p} pools x {j} queued jobs: p50 {p50:.2f} ms")
+    return p50
+
+
 def bench_rebalance(jax, jnp):
     from cook_tpu.ops.common import fetch_result
     from cook_tpu.ops.rebalance import find_preemption_decision
@@ -475,6 +520,7 @@ def device_main():
     dru_p50 = bench_dru(jax, jnp)
     reb_p50 = bench_rebalance(jax, jnp)
     multi_p50 = bench_multipool(jax, jnp, load_tuned())
+    elastic_p50 = bench_elastic(jax, jnp)
     log(f"full-cycle estimate (rank+match+rebalance): "
         f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
     extra = f", dru_ms={dru_p50:.1f}, rebalance_ms={reb_p50:.1f}"
@@ -486,6 +532,7 @@ def device_main():
         "dru": {"p50_ms": dru_p50},
         "rebalance": {"p50_ms": reb_p50},
         "multipool": {"p50_ms": multi_p50},
+        "elastic_plan": {"p50_ms": elastic_p50, "pools": 64, "jobs": 16384},
     }, headline), out=_record_out_arg())
     print(json.dumps(headline), flush=True)
 
@@ -585,6 +632,10 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     reb_p50, _ = time_fn(solve_reb, repeats=repeats)
     phases["rebalance"] = {"p50_ms": reb_p50, "tasks": T2, "hosts": H}
     log(f"smoke rebalance {T2} x {H}: p50 {reb_p50:.2f} ms")
+
+    # elastic capacity plan: 8 pools x 256 queued jobs (shared construction)
+    elastic_p50 = bench_elastic(jax, jnp, p=8, j=256, repeats=repeats)
+    phases["elastic_plan"] = {"p50_ms": elastic_p50, "pools": 8, "jobs": 256}
     return phases
 
 

@@ -159,6 +159,21 @@ class KillLock:
         return self._Write(self)
 
 
+def scan_pool_offers(clusters, pool: str):
+    """Yield every offer the pool's work-accepting clusters currently
+    make.  THE one spare/capacity offer scan — the scheduler's spare
+    cache, the cycle-start capacity snapshot, and the elastic planner's
+    supply tensors all consume this, so offer-semantics changes (clamps,
+    synthesized fields) happen in exactly one traversal.  Note each call
+    re-queries the backends; per-cycle callers should scan once and
+    share the result."""
+    for cluster in clusters:
+        if not cluster.accepts_work:
+            continue
+        for offer in cluster.pending_offers(pool):
+            yield cluster, offer
+
+
 class ComputeCluster(abc.ABC):
     """Backend interface.  Implementations: `cluster.mock.MockCluster` (the
     simulator backbone, reference mesos_mock.clj) and `cluster.k8s`
@@ -213,6 +228,24 @@ class ComputeCluster(abc.ABC):
     def autoscale(self, pool: str, pending_demand: Sequence[TaskSpec]) -> None:
         """Request capacity for unmatched demand (reference: synthetic pods,
         kubernetes/compute_cluster.clj:606)."""
+
+    # --- elastic capacity (cook_tpu/elastic/) ---
+    def supports_scale(self) -> bool:
+        """True when this backend can apply elastic pool-capacity
+        adjustments (scale())."""
+        return False
+
+    def scale(self, pool: str, adjustment: dict) -> dict:
+        """Converge the pool's ELASTIC capacity to `adjustment` — a
+        declarative target ({"mem": MB, "cpus": n, "gpus": n}; positive
+        grows the pool with loaned-in capacity, negative withholds
+        loaned-out capacity from its offers).  Declarative (a target,
+        not a delta) so the call is idempotent: a promoted leader
+        replays the ledger-derived net per pool and converges, no
+        matter where the old leader died between commit and resize.
+        Returns the adjustment actually in force.  Default: inelastic
+        backend, nothing applied."""
+        return {}
 
     # --- capacity limits ---
     def max_launchable(self) -> int:

@@ -141,6 +141,20 @@ class FakeKubeApi(KubeApi):
         if self._watch is not None:
             self._watch(name, self.pods.get(name))
 
+    def add_node(self, node: KubeNode) -> None:
+        """Node-pool grow (the piece a real deployment's node-pool
+        controller does in response to a resize request)."""
+        with self._lock:
+            self.nodes[node.name] = node
+
+    def set_schedulable(self, name: str, schedulable: bool) -> None:
+        """Cordon/uncordon a node (loaned-out capacity is withheld by
+        cordoning, never by killing pods)."""
+        with self._lock:
+            node = self.nodes.get(name)
+            if node is not None:
+                node.schedulable = schedulable
+
     # ----- test/simulation controls -----
 
     def tick(self) -> None:
@@ -213,6 +227,12 @@ class KubeCluster(ComputeCluster):
         }
         self._synthetic_seq = 0
         self._lock = threading.RLock()
+        # elastic capacity (cook_tpu/elastic/): node-pool resize requests
+        # issued by scale(), newest last (bounded); nodes cordoned to
+        # withhold loaned-out capacity, per pool
+        self.resize_requests: list[dict] = []
+        self._last_requested: dict[str, dict] = {}
+        self._cordoned_for_loan: dict[str, set[str]] = {}
         api.set_pod_watch(self._pod_event)
 
     # ------------------------------------------------------------- offers
@@ -395,6 +415,110 @@ class KubeCluster(ComputeCluster):
 
     def synthetic_pods(self) -> list[KubePod]:
         return [p for p in self.api.list_pods() if p.synthetic]
+
+    # --------------------------------------------------- elastic capacity
+
+    # fallback per-node shape when a pool has no template node to copy
+    ELASTIC_NODE_SHAPE = {"mem": 65536.0, "cpus": 32.0, "gpus": 0.0}
+    MAX_RESIZE_REQUESTS = 256
+
+    def supports_scale(self) -> bool:
+        return True
+
+    def _node_busy(self, name: str) -> bool:
+        return any(
+            p.node_name == name
+            and p.phase in (PodPhase.PENDING, PodPhase.RUNNING,
+                            PodPhase.UNKNOWN)
+            for p in self.api.list_all_pods()
+        )
+
+    def scale(self, pool: str, adjustment: dict) -> dict:
+        """Elastic capacity as a NODE-POOL RESIZE REQUEST (the k8s
+        analog of Aryl's loaned nodes): positive targets grow the pool
+        with `elastic-{pool}-{i}` nodes sized like the pool's template
+        node; negative targets cordon empty nodes so the loaned-out
+        capacity stops being offered — pods are never killed (reclaim
+        is non-disruptive; a cordoned node drains as work finishes).
+        The request itself is always recorded (`resize_requests`) so a
+        deployment whose node-pool controller lives outside this
+        process can act on it; against an api exposing node CRUD
+        (FakeKubeApi) it is applied immediately."""
+        adj = {d: float(adjustment.get(d, 0.0))
+               for d in ("mem", "cpus", "gpus")}
+        # the request ring is for an EXTERNAL node-pool controller: only
+        # target changes are worth recording — the planner reconciles
+        # every interval, and a stream of unchanged/all-zero requests
+        # would rotate real ones out of the bounded ring.  Convergence
+        # work below still runs every call (a prior shrink may have
+        # skipped then-busy nodes that have since drained).
+        if self._last_requested.get(pool) != adj and (
+                any(adj.values()) or pool in self._last_requested):
+            self.resize_requests.append(
+                {"pool": pool, "adjustment": dict(adj),
+                 "t_ms": self.clock()})
+            del self.resize_requests[:-self.MAX_RESIZE_REQUESTS]
+            self._last_requested[pool] = dict(adj)
+
+        prefix = f"elastic-{pool}-"
+        nodes = self.api.list_nodes()
+        regular = sorted((n for n in nodes
+                          if n.pool == pool and not n.name.startswith(prefix)),
+                         key=lambda n: n.name)
+        # ownership = prefix AND pool: with pools "gpu" and "gpu-west",
+        # "elastic-gpu-west-0" startswith "elastic-gpu-" — the prefix
+        # alone would let pool "gpu" shrink away gpu-west's loaned nodes
+        elastic = sorted((n for n in nodes
+                          if n.pool == pool and n.name.startswith(prefix)),
+                         key=lambda n: n.name)
+        template = (regular[0] if regular else None)
+        shape = ({"mem": template.mem, "cpus": template.cpus,
+                  "gpus": template.gpus} if template is not None
+                 else dict(self.ELASTIC_NODE_SHAPE))
+
+        # grow: enough elastic nodes to cover every positive dimension
+        want = 0
+        for dim in adj:
+            if adj[dim] > 0 and shape.get(dim, 0.0) > 0:
+                want = max(want, -(-adj[dim] // shape[dim]))
+        want = int(want)
+        add_node = getattr(self.api, "add_node", None)
+        if add_node is not None:
+            seq = len(elastic)
+            while len(elastic) < want:
+                node = KubeNode(name=f"{prefix}{seq}", mem=shape["mem"],
+                                cpus=shape["cpus"], gpus=shape["gpus"],
+                                pool=pool)
+                add_node(node)
+                elastic.append(node)
+                seq += 1
+            # shrink: drop only EMPTY elastic nodes (drain, don't kill)
+            remove_node = getattr(self.api, "remove_node", None)
+            for node in elastic[want:]:
+                if remove_node is not None and not self._node_busy(node.name):
+                    remove_node(node.name)
+
+        # negative dims: cordon empty regular nodes until the withheld
+        # capacity covers the loaned-out amount; uncordon on reclaim
+        set_schedulable = getattr(self.api, "set_schedulable", None)
+        if set_schedulable is not None:
+            need = {d: max(-v, 0.0) for d, v in adj.items()}
+            cordoned = self._cordoned_for_loan.setdefault(pool, set())
+            for name in sorted(cordoned):
+                set_schedulable(name, True)
+            cordoned.clear()
+            if any(v > 0 for v in need.values()):
+                for node in regular:
+                    if all(v <= 0 for v in need.values()):
+                        break
+                    if self._node_busy(node.name):
+                        continue
+                    set_schedulable(node.name, False)
+                    cordoned.add(node.name)
+                    need["mem"] -= node.mem
+                    need["cpus"] -= node.cpus
+                    need["gpus"] -= node.gpus
+        return adj
 
     # ------------------------------------------------------------- misc
 

@@ -237,16 +237,31 @@ def build_process(
     from cook_tpu.scheduler.plugins import registry_from_config
 
     plugins = registry_from_config(settings.plugins)
+    from cook_tpu.txn import TransactionLog
+
+    # ONE commit pipeline for the process: REST mutations and the
+    # elastic capacity plane's pool/capacity-delta commits share the
+    # journal-backed log (durable-on-ack for both)
+    txn = TransactionLog(store, journal=journal)
+    from cook_tpu.elastic import ElasticParams
+
+    elastic_conf = settings.elastic
+    elastic_params = ElasticParams(
+        enabled=bool(elastic_conf.get(
+            "enabled", settings.elastic_interval_s > 0)),
+        headroom=float(elastic_conf.get("headroom", 0.1)),
+        rank_half_life=int(elastic_conf.get("rank_half_life", 64)),
+        reclaim_window=int(elastic_conf.get("reclaim_window", 100)),
+    )
     scheduler = Scheduler(
         store,
         clusters,
-        SchedulerConfig(match=settings.match, rebalancer=settings.rebalancer),
+        SchedulerConfig(match=settings.match, rebalancer=settings.rebalancer,
+                        elastic=elastic_params),
         plugins=plugins,
+        txn=txn,
     )
     from cook_tpu.rest.auth import authenticator_from_config
-    from cook_tpu.txn import TransactionLog
-
-    txn = TransactionLog(store, journal=journal)
     api = CookApi(store, scheduler, ApiConfig(
         default_pool=settings.default_pool,
         admins=settings.admins,
@@ -333,6 +348,12 @@ def start_leader_duties(process: CookProcess,
     if columnar is not None and not columnar.consistent_with_store():
         log.warning("columnar index inconsistent at promotion; rebuilding")
         columnar.rebuild()
+    # elastic promotion invariant: converge every cluster's capacity to
+    # the replicated loan ledger — the old leader may have died between
+    # a pool/capacity-delta commit and the cluster resize (scale() is
+    # declarative, so this replay is idempotent)
+    if process.scheduler.elastic is not None:
+        process.scheduler.elastic.reconcile()
     process.scheduler.active = True
     process.api.leader = True
     process.api.leader_url = ""
@@ -455,6 +476,16 @@ def start_leader_duties(process: CookProcess,
                     match_next).start(),
         TriggerLoop("rebalancer", settings.rebalancer_interval_s,
                     rebalance_all).start(),
+    ]
+    if scheduler.elastic is not None and settings.elastic_interval_s > 0:
+        def elastic_plan():
+            with span("elastic_cycle"):
+                scheduler.elastic_cycle()
+
+        process.loops.append(
+            TriggerLoop("elastic", settings.elastic_interval_s,
+                        elastic_plan).start())
+    process.loops += [
         TriggerLoop("lingering", settings.lingering_interval_s,
                     lambda: scheduler.kill_lingering_tasks(store.clock())
                     ).start(),

@@ -1,0 +1,17 @@
+"""Elastic capacity plane: TPU-solved pool loaning and cluster autoscaling.
+
+Pools partition the fleet statically; this subsystem loans idle capacity
+between them (Aryl's elastic-scheduler design, arXiv:2202.07896) with
+durable, failover-safe deltas (cook_tpu/txn), observable decisions
+(`GET /debug/elastic`), and a non-disruptive reclaim path that runs
+BEFORE in-pool preemption.  See docs/elastic.md.
+"""
+from cook_tpu.elastic.planner import CapacityPlanner, ElasticParams
+from cook_tpu.elastic.recorder import ElasticRecorder, PlanRecord
+
+__all__ = [
+    "CapacityPlanner",
+    "ElasticParams",
+    "ElasticRecorder",
+    "PlanRecord",
+]

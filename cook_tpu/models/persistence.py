@@ -48,9 +48,15 @@ def snapshot_state(store: JobStore) -> dict:
         quotas = list(store.quotas.values())
         dynamic_config = dict(store.dynamic_config)
         txns = dict(store.txn_results)
+        capacity_ledger = [
+            {"from": lender, "to": borrower, **amounts}
+            for (lender, borrower), amounts
+            in sorted(store.capacity_ledger.items())
+        ]
     return {
         "txns": txns,
         "seq": seq,
+        "capacity_ledger": capacity_ledger,
         "jobs": {k: codec.encode(v) for k, v in jobs.items()},
         "instances": {k: codec.encode(v) for k, v in instances.items()},
         "groups": {k: codec.encode(v) for k, v in groups.items()},
@@ -99,6 +105,7 @@ def restore_into(store: JobStore, state: dict) -> None:
         store.quotas.clear()
         store.dynamic_config = {}
         store.txn_results.clear()
+        store.capacity_ledger.clear()
         store._user_jobs.clear()
         store._pool_pending.clear()
         store._pool_running.clear()
@@ -127,6 +134,7 @@ def _populate(store: JobStore, state: dict) -> None:
         store.quotas[(quota.user, quota.pool)] = quota
     store.dynamic_config = state.get("dynamic_config", {})
     store.txn_results.update(state.get("txns", {}))
+    store.set_capacity_ledger(state.get("capacity_ledger", []))
     store.reset_seq(state["seq"])
 
 
@@ -328,6 +336,11 @@ def apply_journal(store: JobStore, events: list[dict],
             store.quotas.pop((data["user"], data["pool"]), None)
         elif kind == "config/updated":
             store.dynamic_config.update(data.get("updates", {}))
+        elif kind == "pool/capacity":
+            # the event carries the full post-transaction ledger, so
+            # replay is a pure upsert (no move re-application, no
+            # double-count on overlapping snapshot+journal replay)
+            store.set_capacity_ledger(data.get("ledger", []))
         elif kind == "txn/committed":
             # rebuild the idempotency table: a promoted standby (or a
             # recovered leader) must answer retried commits of acked

@@ -104,6 +104,13 @@ class JobStore:
         # re-applying them.  Insertion-ordered; bounded by
         # TXN_RESULTS_WINDOW.
         self.txn_results: dict[str, dict[str, Any]] = {}
+        # elastic capacity ledger (cook_tpu/elastic/): (lender, borrower)
+        # -> {mem, cpus, gpus} currently on loan.  Mutated only through
+        # the pool/capacity-delta txn op; every mutation's event carries
+        # the full post-transaction ledger so journal replay and standby
+        # replication are pure upserts — a promoted leader reconciles
+        # cluster capacity from THIS table.
+        self.capacity_ledger: dict[tuple[str, str], dict[str, float]] = {}
 
         # secondary indexes
         self._user_jobs: dict[str, set[str]] = {}
@@ -570,6 +577,87 @@ class JobStore:
             self.dynamic_config.update(updates)
             self._fan_out([self._emit("config/updated",
                                       {"updates": updates})])
+
+    # ------------------------------------------------------ capacity ledger
+
+    CAPACITY_DIMS = ("mem", "cpus", "gpus")
+    # loan amounts below this are float dust, not capacity: entries whose
+    # every dimension sits under it are dropped from the ledger
+    CAPACITY_EPSILON = 1e-6
+
+    def apply_capacity_moves(self, moves: Sequence[dict]) -> dict:
+        """Apply a capacity plan's loan/reclaim moves to the ledger (the
+        pool/capacity-delta txn op's handler target).  Each move is
+        {"kind": "loan"|"reclaim", "from": lender, "to": borrower,
+        "mem"/"cpus"/"gpus": amounts}; reclaims are clamped to what is
+        actually outstanding so a replayed or racing plan can never
+        drive the ledger negative.  Emits one pool/capacity event
+        carrying the full post-transaction ledger (replay = upsert)."""
+        with self._lock:
+            for move in moves:
+                kind = move.get("kind", "loan")
+                key = (move["from"], move["to"])
+                entry = self.capacity_ledger.get(
+                    key, {d: 0.0 for d in self.CAPACITY_DIMS})
+                for dim in self.CAPACITY_DIMS:
+                    amount = float(move.get(dim, 0.0))
+                    if kind == "reclaim":
+                        entry[dim] = max(entry[dim] - amount, 0.0)
+                    else:
+                        entry[dim] = entry[dim] + amount
+                if any(v > self.CAPACITY_EPSILON for v in entry.values()):
+                    self.capacity_ledger[key] = entry
+                else:
+                    self.capacity_ledger.pop(key, None)
+            ledger = self.encoded_capacity_ledger()
+            event = self._emit("pool/capacity",
+                               {"moves": [dict(m) for m in moves],
+                                "ledger": ledger})
+            self._fan_out([event])
+            return {"applied": len(moves), "ledger": ledger}
+
+    def encoded_capacity_ledger(self) -> list[dict]:
+        """JSON-able ledger rows (snapshot / event / REST payloads)."""
+        with self._lock:
+            return [
+                {"from": lender, "to": borrower, **amounts}
+                for (lender, borrower), amounts
+                in sorted(self.capacity_ledger.items())
+            ]
+
+    def set_capacity_ledger(self, entries: Sequence[dict]) -> None:
+        """Replace the ledger wholesale (journal replay / snapshot
+        restore — entries are the encoded post-transaction rows)."""
+        with self._lock:
+            self.capacity_ledger = {
+                (e["from"], e["to"]): {d: float(e.get(d, 0.0))
+                                       for d in self.CAPACITY_DIMS}
+                for e in entries
+            }
+
+    def net_capacity_adjustment(self, pool: str) -> dict[str, float]:
+        """Ledger-derived net elastic capacity for a pool: inbound loans
+        minus outbound (negative = the pool is a net lender).  This is
+        the declarative target clusters converge their elastic capacity
+        to (ComputeCluster.scale)."""
+        net = {d: 0.0 for d in self.CAPACITY_DIMS}
+        with self._lock:
+            for (lender, borrower), amounts in self.capacity_ledger.items():
+                if borrower == pool:
+                    for dim in self.CAPACITY_DIMS:
+                        net[dim] += amounts[dim]
+                if lender == pool:
+                    for dim in self.CAPACITY_DIMS:
+                        net[dim] -= amounts[dim]
+        return net
+
+    def outstanding_loans_from(self, pool: str) -> dict[str, dict[str, float]]:
+        """borrower -> amounts currently on loan FROM `pool` (the
+        reclaim-on-demand input)."""
+        with self._lock:
+            return {borrower: dict(amounts)
+                    for (lender, borrower), amounts
+                    in self.capacity_ledger.items() if lender == pool}
 
     def get_quota(self, user: str, pool: str) -> Quota:
         with self._lock:

@@ -164,6 +164,84 @@ def packing_quality(
     }
 
 
+# ----------------------------------------------------------------- elastic
+
+
+def ref_weighted_demand(res: np.ndarray, valid: np.ndarray,
+                        half_life: float) -> np.ndarray:
+    """Sequential oracle for ops.elastic.weighted_demand: [P, J, R]
+    rank-ordered queued resources -> [P, R], queue position i discounted
+    by 0.5 ** (i / half_life)."""
+    p, j, r = res.shape
+    out = np.zeros((p, r), dtype=np.float64)
+    for pi in range(p):
+        for ji in range(j):
+            if not valid[pi, ji]:
+                continue
+            out[pi] += res[pi, ji] * 0.5 ** (ji / max(half_life, 1.0))
+    return out
+
+
+def ref_capacity_plan(demand: np.ndarray, supply: np.ndarray,
+                      outstanding: np.ndarray, pool_valid: np.ndarray,
+                      headroom: float):
+    """Sequential oracle for ops.elastic.solve_capacity_plan: the same
+    reclaim-first + proportional-loan plan, in plain numpy loops.
+    Returns (reclaim [P,P,R], loan [P,P,R], unmet_shortage [P,R])."""
+    p, r = demand.shape
+    demand = np.where(pool_valid[:, None], demand, 0.0).astype(np.float64)
+    supply = np.where(pool_valid[:, None], supply, 0.0).astype(np.float64)
+    outstanding = np.where(
+        (pool_valid[:, None] & pool_valid[None, :])[:, :, None],
+        outstanding, 0.0).astype(np.float64)
+
+    def safe_div(num, den):
+        return num / den if den > 0 else 0.0
+
+    # phase 1: lenders short on capacity reclaim proportionally across
+    # their borrowers, capped by each borrower's free capacity
+    reclaim = np.zeros((p, p, r))
+    want = np.zeros((p, p, r))
+    for lender in range(p):
+        shortage = np.maximum(demand[lender] - supply[lender], 0.0)
+        out_total = outstanding[lender].sum(axis=0)
+        for ri in range(r):
+            frac = min(safe_div(shortage[ri], out_total[ri]), 1.0)
+            for b in range(p):
+                want[lender, b, ri] = outstanding[lender, b, ri] * frac
+    for b in range(p):
+        asked = want[:, b, :].sum(axis=0)
+        for ri in range(r):
+            frac = min(safe_div(max(supply[b, ri], 0.0), asked[ri]), 1.0)
+            for lender in range(p):
+                if lender == b:
+                    continue
+                reclaim[lender, b, ri] = want[lender, b, ri] * frac
+    supply_after = (supply + reclaim.sum(axis=1) - reclaim.sum(axis=0))
+
+    # phase 2: new loans from net lenders (no inbound loans), keeping a
+    # headroom fraction home; proportional lender-surplus x
+    # borrower-shortage split
+    loan = np.zeros((p, p, r))
+    shortage2 = np.maximum(demand - supply_after, 0.0)
+    holds_borrowed = (outstanding - reclaim).sum(axis=(0, 2)) > 0
+    surplus = np.maximum(supply_after - demand, 0.0) * (1.0 - headroom)
+    surplus[~(pool_valid & ~holds_borrowed)] = 0.0
+    for ri in range(r):
+        tot_surplus = surplus[:, ri].sum()
+        tot_shortage = shortage2[:, ri].sum()
+        move = min(tot_surplus, tot_shortage)
+        for lender in range(p):
+            for b in range(p):
+                if lender == b or not (pool_valid[lender] and pool_valid[b]):
+                    continue
+                loan[lender, b, ri] = (
+                    safe_div(surplus[lender, ri], tot_surplus)
+                    * safe_div(shortage2[b, ri], tot_shortage) * move)
+    unmet = np.maximum(shortage2 - loan.sum(axis=0), 0.0)
+    return reclaim, loan, unmet
+
+
 # --------------------------------------------------------------- rebalance
 
 

@@ -1,0 +1,128 @@
+"""Elastic capacity plane: the loan/reclaim assignment as a tensor solve.
+
+Cook's pools partition a fixed fleet, so one pool starves while another
+idles (the gap Aryl's capacity loaning closes, arXiv:2202.07896).  The
+CapacityPlanner (cook_tpu/elastic/planner.py) assembles per-pool demand
+and supply tensors each planning interval and solves the loan/reclaim
+assignment here, as one bucket-padded batched problem:
+
+  * `weighted_demand` — fold each pool's DRU-ranked pending queue
+    ([P, J, R] resource vectors, rank order along J) into a [P, R]
+    demand tensor.  Rank position discounts demand exponentially: the
+    queue head counts at full weight (it is about to run), the deep
+    tail barely counts (loaning a fleet for it would thrash).
+  * `solve_capacity_plan` — given demand/supply [P, R] and the
+    outstanding-loan ledger [P, P, R], produce reclaim and new-loan
+    matrices.  Reclaim-first: a lender short on capacity calls its
+    outstanding loans home (proportionally across borrowers, capped by
+    each borrower's free capacity — reclaim is non-disruptive; pressure
+    inside the borrower is the borrower's own rebalancer's problem).
+    Remaining shortage is then covered by new loans from pools with
+    surplus, split proportionally (a rank-1 outer product over
+    lender-surplus x borrower-shortage), with a headroom fraction of
+    every surplus kept home so the plan never strips a pool bare.
+
+Both kernels take fixed padded shapes (pool axis padded to a bucket,
+job axis to a bucket) so a churning pool/queue count reuses the same
+XLA program — solves report to the CompileObservatory exactly like
+match/rank/rebalance, and the storm detector would catch unbucketed
+shapes here too.
+
+CPU parity oracles: `ops.cpu_reference.ref_weighted_demand` /
+`ref_capacity_plan` (tests/test_elastic.py asserts equality).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# resource dimensions a capacity plan moves (mem MB, cpus, gpus)
+ELASTIC_RESOURCE_DIMS = ("mem", "cpus", "gpus")
+
+
+class ElasticProblem(NamedTuple):
+    """Padded per-pool tensors for one planning interval."""
+
+    demand: jnp.ndarray       # [P, R] rank-weighted queued demand
+    supply: jnp.ndarray       # [P, R] spare (offerable) capacity
+    outstanding: jnp.ndarray  # [P, P, R] outstanding[l, b]: loaned l -> b
+    pool_valid: jnp.ndarray   # [P] bool (padded rows False)
+
+
+class ElasticPlan(NamedTuple):
+    reclaim: jnp.ndarray    # [P, P, R] reclaim[l, b]: b returns to l
+    loan: jnp.ndarray       # [P, P, R] new loans l -> b
+    shortage: jnp.ndarray   # [P, R] unmet shortage after the plan (diagnostic)
+
+
+def _safe_div(num, den):
+    return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)
+
+
+@jax.jit
+def weighted_demand(res: jnp.ndarray, valid: jnp.ndarray,
+                    half_life: jnp.ndarray) -> jnp.ndarray:
+    """[P, J, R] rank-ordered queued-job resources -> [P, R] demand.
+
+    Weight of queue position i is 0.5 ** (i / half_life): the head of
+    the DRU order counts fully, demand `half_life` positions deep counts
+    half.  `half_life` is a traced scalar so tuning it never mints a new
+    XLA program.
+    """
+    j = res.shape[1]
+    w = jnp.power(0.5, jnp.arange(j, dtype=jnp.float32)
+                  / jnp.maximum(half_life, 1.0))
+    return jnp.sum(res * valid[:, :, None] * w[None, :, None], axis=1)
+
+
+@jax.jit
+def solve_capacity_plan(problem: ElasticProblem,
+                        headroom: jnp.ndarray) -> ElasticPlan:
+    """One device call plans every pool's loans and reclaims at once."""
+    valid = problem.pool_valid
+    pair_valid = valid[:, None] & valid[None, :]
+    demand = jnp.where(valid[:, None], problem.demand, 0.0)
+    supply = jnp.where(valid[:, None], problem.supply, 0.0)
+    outstanding = jnp.where(pair_valid[:, :, None], problem.outstanding, 0.0)
+
+    # ---- phase 1: reclaim-first.  Lenders short on capacity call loans
+    # home before anyone considers new loans (or in-pool preemption).
+    shortage = jnp.maximum(demand - supply, 0.0)                  # [P, R]
+    out_total = jnp.sum(outstanding, axis=1)                      # [P, R]
+    want_frac = jnp.minimum(_safe_div(shortage, out_total), 1.0)  # [P, R]
+    want = outstanding * want_frac[:, None, :]                    # [P, b, R]
+    # borrower b can only return capacity it is not running work on:
+    # cap total returns from b at b's free (spare) capacity, scaling
+    # every lender's claim proportionally when they compete for it
+    asked_of = jnp.sum(want, axis=0)                              # [b, R]
+    free = jnp.maximum(supply, 0.0)
+    return_frac = jnp.minimum(_safe_div(free, asked_of), 1.0)     # [b, R]
+    reclaim = want * return_frac[None, :, :]
+    # no self-loans can exist, but keep the diagonal structurally zero
+    eye = jnp.eye(reclaim.shape[0], dtype=bool)
+    reclaim = jnp.where(eye[:, :, None], 0.0, reclaim)
+
+    supply_after = supply + jnp.sum(reclaim, axis=1) - jnp.sum(reclaim, axis=0)
+
+    # ---- phase 2: new loans cover what reclaim could not.  Only pools
+    # with no inbound loans may lend (a pool holding borrowed capacity
+    # returns it via reclaim, never re-loans it — no loan chains), and a
+    # headroom fraction of every surplus stays home.
+    shortage2 = jnp.maximum(demand - supply_after, 0.0)
+    holds_borrowed = jnp.sum(outstanding - reclaim, axis=(0, 2)) > 0  # [b]
+    can_lend = valid & ~holds_borrowed
+    surplus = jnp.maximum(supply_after - demand, 0.0) * (1.0 - headroom)
+    surplus = jnp.where(can_lend[:, None], surplus, 0.0)
+    tot_surplus = jnp.sum(surplus, axis=0)                        # [R]
+    tot_shortage = jnp.sum(shortage2, axis=0)                     # [R]
+    move = jnp.minimum(tot_surplus, tot_shortage)                 # [R]
+    loan = (_safe_div(surplus, tot_surplus)[:, None, :]
+            * _safe_div(shortage2, tot_shortage)[None, :, :]
+            * move[None, None, :])
+    loan = jnp.where(eye[:, :, None], 0.0, loan)
+    loan = jnp.where(pair_valid[:, :, None], loan, 0.0)
+
+    unmet = jnp.maximum(shortage2 - jnp.sum(loan, axis=0), 0.0)
+    return ElasticPlan(reclaim=reclaim, loan=loan, shortage=unmet)

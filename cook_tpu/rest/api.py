@@ -206,6 +206,7 @@ class CookApi:
         r.add_post("/replication/ack", self.post_replication_ack)
         r.add_get("/debug", self.get_debug)
         r.add_get("/debug/health", self.get_debug_health)
+        r.add_get("/debug/elastic", self.get_debug_elastic)
         r.add_get("/debug/cycles", self.get_debug_cycles)
         r.add_get("/debug/cycles/{cycle_id}", self.get_debug_cycle)
         r.add_get("/debug/spans", self.get_debug_spans)
@@ -274,6 +275,30 @@ class CookApi:
                 "checks": {},
             })
         return web.json_response(telemetry.health())
+
+    async def get_debug_elastic(self, request: web.Request) -> web.Response:
+        """Elastic capacity plane state (cook_tpu/elastic/): the durable
+        loan ledger, the ledger-derived net adjustment per pool, and the
+        planner's recent decisions (interval plans + on-demand reclaims,
+        `?limit=` bounds, `?kind=` filters).  The ledger renders even
+        when the planner is disabled — a standby's replicated ledger is
+        inspectable before promotion."""
+        try:
+            limit = max(1, int(request.query.get("limit", "50")))
+        except ValueError:
+            return _err(400, "limit must be an integer")
+        elastic = getattr(self.scheduler, "elastic", None) \
+            if self.scheduler is not None else None
+        body = {
+            "enabled": elastic is not None,
+            "ledger": self.store.encoded_capacity_ledger(),
+            "net": {pool: self.store.net_capacity_adjustment(pool)
+                    for pool in sorted(self.store.pools)},
+            "plans": (elastic.recorder.records_json(
+                limit=limit, kind=request.query.get("kind"))
+                if elastic is not None else []),
+        }
+        return web.json_response(body)
 
     async def get_debug_cycles(self, request: web.Request) -> web.Response:
         """Flight-recorder ring: per-cycle structured decision records

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from cook_tpu.cluster.base import ComputeCluster
+from cook_tpu.elastic.planner import ElasticParams
 from cook_tpu.models.entities import (
     InstanceStatus,
     Job,
@@ -73,6 +74,10 @@ class SchedulerConfig:
     compile_storm_warmup: Optional[int] = None
     # device-oom-risk fires above this allocator fill fraction
     device_oom_threshold: float = 0.9
+    # elastic capacity plane (cook_tpu/elastic/): pool-to-pool capacity
+    # loaning with durable ledger deltas and reclaim-before-preemption.
+    # Disabled by default; enable via ElasticParams(enabled=True)
+    elastic: ElasticParams = field(default_factory=ElasticParams)
 
 
 class Scheduler:
@@ -85,6 +90,7 @@ class Scheduler:
         clusters: Sequence[ComputeCluster],
         config: Optional[SchedulerConfig] = None,
         plugins=None,
+        txn=None,
     ):
         from cook_tpu.scheduler.plugins import PluginRegistry
 
@@ -155,6 +161,18 @@ class Scheduler:
                 oom_threshold=self.config.device_oom_threshold,
             )
         self._last_rank_s: dict[str, float] = {}
+        # elastic capacity plane: capacity deltas commit through the txn
+        # pipeline (components.py wires the journal-backed log in; a bare
+        # Scheduler gets an in-memory pipeline with the same op registry)
+        self.elastic = None
+        if self.config.elastic.enabled:
+            from cook_tpu.elastic import CapacityPlanner
+            from cook_tpu.txn import TransactionLog
+
+            self.txn = txn or TransactionLog(store)
+            self.elastic = CapacityPlanner(
+                store, self.clusters, self.txn, self.config.elastic,
+                telemetry=self.telemetry)
         from cook_tpu.scheduler.monitor import JobLifecycleTracker
 
         # effect-gated like _on_event: a standby applying replicated
@@ -284,7 +302,36 @@ class Scheduler:
 
         if self.recorder is None:
             return NULL_CYCLE
-        return self.recorder.begin(pool_name, self.store.clock())
+        flight = self.recorder.begin(pool_name, self.store.clock())
+        # per-pool capacity snapshot at cycle start + the capacity plan
+        # the cycle ran under, so elastic deltas correlate with match
+        # outcomes straight off the record (docs/elastic.md)
+        flight.record.pool_capacity = self._pool_capacity_snapshot(pool_name)
+        if self.elastic is not None:
+            flight.record.elastic_plan = self.elastic.recorder.last_plan_id()
+        return flight
+
+    def _pool_capacity_snapshot(self, pool_name: str) -> dict:
+        """Host count + total/spare capacity the pool holds right now
+        (one extra offer scan per recorded cycle — the record's claim is
+        capacity AT CYCLE START, which the post-match spare cache can't
+        provide; totals for gpus are not carried by offers, so only
+        spare is reported there)."""
+        from cook_tpu.cluster.base import scan_pool_offers
+
+        hosts = 0
+        mem = cpus = 0.0
+        spare = {"mem": 0.0, "cpus": 0.0, "gpus": 0.0}
+        for _cluster, offer in scan_pool_offers(self.clusters, pool_name):
+            hosts += 1
+            mem += offer.total_mem or offer.mem
+            cpus += offer.total_cpus or offer.cpus
+            spare["mem"] += max(offer.mem, 0.0)
+            spare["cpus"] += max(offer.cpus, 0.0)
+            spare["gpus"] += max(offer.gpus, 0.0)
+        return {"hosts": hosts, "mem": mem, "cpus": cpus,
+                "spare_mem": spare["mem"], "spare_cpus": spare["cpus"],
+                "spare_gpus": spare["gpus"]}
 
     def _commit_cycle(self, flight) -> None:
         if self.recorder is not None and flight.record is not None:
@@ -420,20 +467,19 @@ class Scheduler:
         return outcomes
 
     def _cache_spare(self, pool: Pool) -> None:
+        from cook_tpu.cluster.base import scan_pool_offers
+
         spare: dict[str, Resources] = {}
         host_info: dict[str, tuple[dict, str]] = {}  # host -> (attrs, location)
-        for cluster in self.clusters:
-            if not cluster.accepts_work:
-                continue
-            for offer in cluster.pending_offers(pool.name):
-                spare[offer.hostname] = Resources(
-                    mem=offer.mem, cpus=offer.cpus, gpus=offer.gpus,
-                    disk=offer.disk,
-                )
-                host_info[offer.hostname] = (dict(offer.attributes),
-                                             cluster.location)
-                self.host_attr_cache[offer.hostname] = dict(offer.attributes)
-                self.host_attr_cache.move_to_end(offer.hostname)
+        for cluster, offer in scan_pool_offers(self.clusters, pool.name):
+            spare[offer.hostname] = Resources(
+                mem=offer.mem, cpus=offer.cpus, gpus=offer.gpus,
+                disk=offer.disk,
+            )
+            host_info[offer.hostname] = (dict(offer.attributes),
+                                         cluster.location)
+            self.host_attr_cache[offer.hostname] = dict(offer.attributes)
+            self.host_attr_cache.move_to_end(offer.hostname)
         while len(self.host_attr_cache) > self.host_attr_cache_max:
             self.host_attr_cache.popitem(last=False)
         self.last_unmatched_offers[pool.name] = spare
@@ -473,6 +519,10 @@ class Scheduler:
             self.store, pool, queue.jobs, spare, self._rebalancer_params(),
             host_info=getattr(self, "last_host_info", {}).get(pool.name),
             telemetry=self.telemetry,
+            # reclaim-before-preemption: loaned-out capacity comes home
+            # (non-disruptively) before any victim search considers a kill
+            reclaimer=(self.elastic.reclaim_for
+                       if self.elastic is not None else None),
         )
         if self.recorder is not None:
             self.recorder.annotate_preemptions(
@@ -497,6 +547,23 @@ class Scheduler:
             "tasks preempted by the rebalancer per pool").inc(
             n_preempted, {"pool": pool.name})
         return decisions
+
+    def elastic_cycle(self):
+        """One capacity-plane planning interval (cook_tpu/elastic/):
+        rank queues feed the demand tensors, the plan commits durable
+        pool-capacity deltas and converges cluster capacity.  Driven by
+        a trigger loop in components.py (and the simulator); returns
+        the PlanRecord (None when elastic is disabled or single-pool)."""
+        if self.elastic is None:
+            return None
+        for pool in [p for p in self.store.pools.values()
+                     if p.schedules_jobs]:
+            if pool.name not in self.pool_queues:
+                self.rank_cycle(pool)
+        record = self.elastic.plan_cycle(self.pool_queues)
+        if record is not None:
+            self.metrics["elastic.last_plan"] = record.plan_id
+        return record
 
     def _transact_preemption(self, decision: Decision) -> None:
         """transact-preemption! + safe-kill-task (rebalancer.clj:482-533)."""

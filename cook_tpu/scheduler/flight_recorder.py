@@ -99,6 +99,11 @@ class CycleRecord:
     solve_shape: str = ""
     backend: str = ""
     compiled: bool = False
+    # per-pool capacity snapshot at cycle start ({hosts, mem, cpus,
+    # spare_*}) + the elastic plan id in force — so a capacity delta
+    # (cook_tpu/elastic/) correlates with match outcomes record-to-record
+    pool_capacity: dict = field(default_factory=dict)
+    elastic_plan: int = 0
     offers: int = 0
     queue_len: int = 0
     considered: int = 0
@@ -126,6 +131,8 @@ class CycleRecord:
             "solve_shape": self.solve_shape,
             "backend": self.backend,
             "compiled": self.compiled,
+            "pool_capacity": dict(self.pool_capacity),
+            "elastic_plan": self.elastic_plan,
             "offers": self.offers,
             "queue_len": self.queue_len,
             "considered": self.considered,

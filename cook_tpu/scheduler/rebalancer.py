@@ -441,9 +441,22 @@ def rebalance_pool(
     params: RebalancerParams,
     host_info: Optional[dict] = None,
     telemetry=None,
+    reclaimer=None,
 ) -> list[Decision]:
     """One pool's rebalance cycle: returns the preemption decisions
-    (rebalancer.clj:434-479 `rebalance`).  The caller transacts + kills."""
+    (rebalancer.clj:434-479 `rebalance`).  The caller transacts + kills.
+
+    `reclaimer` is the elastic capacity plane's pre-preemption hook
+    (cook_tpu/elastic/planner.py reclaim_for): when the pool has
+    capacity on loan and its pending demand exceeds spare, loaned
+    capacity is reclaimed — durably, non-disruptively — and the victim
+    search below runs against the REFRESHED spare map, so returned
+    capacity yields spare-only decisions (no victims) instead of
+    kills."""
+    if reclaimer is not None:
+        refreshed = reclaimer(pool.name, pending_in_dru_order, host_spare)
+        if refreshed is not None:
+            host_spare = refreshed
     cycle = RebalanceCycle(store, pool, host_spare, params,
                            host_info=host_info)
     solve_shape = (int(cycle._dev_host.shape[0]),

@@ -30,6 +30,7 @@ def cmd_run(args) -> int:
     config = SimConfig(
         cycle_ms=args.cycle_ms,
         rebalance_every=args.rebalance_every,
+        elastic_every=(args.elastic_every if args.elastic else 0),
         max_cycles=args.max_cycles,
         batched_match=args.batched,
         scheduler=SchedulerConfig(
@@ -72,6 +73,12 @@ def cmd_run(args) -> int:
         # drifts from the CPU reference says so in its summary line
         "health": result.health.get("status", "unknown"),
         "health_reasons": result.health.get("reasons", []),
+        # capacity-plane summary: committed plans + queued-wait p50, the
+        # number the elastic A/B moves
+        "elastic_plans": sum(1 for p in result.elastic_plans if p["moves"]),
+        "queued_wait_p50_ms": (
+            sorted(waits)[len(waits) // 2]
+            if (waits := result.queued_wait_ms()) else None),
     }))
     if args.health_out:
         with open(args.health_out, "w") as f:
@@ -80,11 +87,19 @@ def cmd_run(args) -> int:
 
 
 def cmd_synth(args) -> int:
-    jobs, hosts = synth_trace(
-        args.jobs, args.hosts, n_users=args.users, seed=args.seed,
-        mean_runtime_ms=args.mean_runtime_ms,
-        submit_span_ms=args.submit_span_ms,
-    )
+    if args.imbalanced:
+        # the elastic capacity plane's two-pool starving/idle scenario
+        # (sim/loadgen.py imbalanced_pool_trace); pair with `run --elastic`
+        from cook_tpu.sim.loadgen import imbalanced_pool_trace
+
+        jobs, hosts = imbalanced_pool_trace(
+            busy_jobs=args.jobs, seed=args.seed)
+    else:
+        jobs, hosts = synth_trace(
+            args.jobs, args.hosts, n_users=args.users, seed=args.seed,
+            mean_runtime_ms=args.mean_runtime_ms,
+            submit_span_ms=args.submit_span_ms,
+        )
     with open(args.out, "w") as f:
         json.dump({
             "jobs": [vars(j) for j in jobs],
@@ -180,6 +195,11 @@ def main(argv=None) -> int:
     r.add_argument("--safe-dru-threshold", type=float, default=1.0)
     r.add_argument("--min-dru-diff", type=float, default=0.5)
     r.add_argument("--max-preemption", type=int, default=100)
+    r.add_argument("--elastic", action="store_true",
+                   help="enable the elastic capacity plane (pool "
+                        "loaning + reclaim, cook_tpu/elastic/)")
+    r.add_argument("--elastic-every", type=int, default=1,
+                   help="cycles between capacity plans (with --elastic)")
     r.set_defaults(fn=cmd_run)
 
     s = sub.add_parser("synth", help="generate a synthetic trace")
@@ -189,6 +209,9 @@ def main(argv=None) -> int:
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--mean-runtime-ms", type=int, default=120_000)
     s.add_argument("--submit-span-ms", type=int, default=300_000)
+    s.add_argument("--imbalanced", action="store_true",
+                   help="two-pool starving/idle elastic scenario instead "
+                        "of the skewed single-pool workload")
     s.add_argument("--out", default="trace.json")
     s.set_defaults(fn=cmd_synth)
 

@@ -86,6 +86,9 @@ class TraceHost:
 class SimConfig:
     cycle_ms: int = 30_000           # virtual time per cycle
     rebalance_every: int = 0         # cycles between rebalances (0 = off)
+    # cycles between elastic capacity plans (0 = off); setting this
+    # enables the scheduler's capacity plane (cook_tpu/elastic/)
+    elastic_every: int = 0
     max_cycles: int = 10_000
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     pools: tuple = (("default", "default"),)  # (name, dru_mode)
@@ -107,6 +110,16 @@ class SimResult:
     # schema): did the simulated workload drive the solver into
     # recompile storms / quality drift / latency regression?
     health: dict = field(default_factory=dict)
+    # elastic capacity-plane dump: planner decisions (GET /debug/elastic
+    # schema) + the final loan ledger
+    elastic_plans: list[dict] = field(default_factory=list)
+    capacity_ledger: list[dict] = field(default_factory=list)
+
+    def queued_wait_ms(self) -> list[int]:
+        """Per-started-task queued wait (start - submit): the metric the
+        elastic A/B compares (lower p50 with loaning enabled)."""
+        return [r["start_ms"] - r["submit_ms"] for r in self.rows
+                if r["start_ms"] is not None]
 
     def cycle_records_json(self) -> str:
         return json.dumps({"cycles": self.cycle_records}, indent=1)
@@ -153,6 +166,12 @@ class Simulator:
         self.config.pools = tuple(self.config.pools) + tuple(
             (name, "default") for name in extra
         )
+        if self.config.elastic_every > 0 \
+                and not self.config.scheduler.elastic.enabled:
+            import dataclasses as _dc
+
+            self.config.scheduler.elastic = _dc.replace(
+                self.config.scheduler.elastic, enabled=True)
         self.store = JobStore(clock=lambda: self.now_ms)
         for name, mode in self.config.pools:
             self.store.set_pool(Pool(name=name, dru_mode=DruMode(mode)))
@@ -194,7 +213,7 @@ class Simulator:
         cfg = self.config
         submitted = 0
         phase_wall: dict[str, float] = {"rank": 0.0, "match": 0.0,
-                                        "rebalance": 0.0}
+                                        "rebalance": 0.0, "elastic": 0.0}
         cycle_wall: list[float] = []
         pools = [self.store.pools[name] for name, _ in cfg.pools]
         cycle = 0
@@ -249,6 +268,14 @@ class Simulator:
                     if cfg.rebalance_every and cycle % cfg.rebalance_every == 0:
                         self.scheduler.rebalance_cycle(pool)
                         phase_wall["rebalance"] += time.perf_counter() - t2
+            # 3b. elastic capacity plan (after matching, so demand is the
+            # genuinely-unmatched queue; loans land in the NEXT cycle's
+            # offers — node-provisioning latency, one cycle coarse)
+            if (cfg.elastic_every and cycle % cfg.elastic_every == 0
+                    and self.scheduler.elastic is not None):
+                t3 = time.perf_counter()
+                self.scheduler.elastic_cycle()
+                phase_wall["elastic"] += time.perf_counter() - t3
             cycle_wall.append(time.perf_counter() - t_cycle)
             # 4. advance virtual time
             self.now_ms += cfg.cycle_ms
@@ -273,6 +300,10 @@ class Simulator:
                            if recorder is not None else []),
             health=(self.scheduler.telemetry.health()
                     if self.scheduler.telemetry is not None else {}),
+            elastic_plans=(
+                self.scheduler.elastic.recorder.records_json(limit=10_000)
+                if self.scheduler.elastic is not None else []),
+            capacity_ledger=self.store.encoded_capacity_ledger(),
         )
 
     def _collect_rows(self) -> list[dict]:

@@ -94,6 +94,30 @@ def _quota_retract(store: JobStore, payload: dict) -> Any:
     return {"user": payload["user"], "pool": payload["pool"]}
 
 
+@txn_op("pool/capacity-delta")
+def _capacity_delta(store: JobStore, payload: dict) -> Any:
+    """Elastic capacity plan deltas (cook_tpu/elastic/): loan/reclaim
+    moves apply to the capacity ledger durably BEFORE any cluster is
+    resized, so a failover between commit and resize leaves the new
+    leader a consistent ledger to reconcile capacity from.  Idempotent
+    like pool-move: a retried commit (same txn id) is answered from the
+    transaction table; reclaims clamp at outstanding amounts."""
+    moves = payload["moves"]
+    for move in moves:
+        if move.get("kind", "loan") not in ("loan", "reclaim"):
+            raise TransactionVetoed(f"bad capacity move kind {move!r}")
+        for side in ("from", "to"):
+            if move.get(side) not in store.pools:
+                raise TransactionVetoed(
+                    f"unknown pool {move.get(side)!r} in capacity move")
+        if move["from"] == move["to"]:
+            raise TransactionVetoed("capacity move from a pool to itself")
+        if any(float(move.get(d, 0.0)) < 0.0
+               for d in store.CAPACITY_DIMS):
+            raise TransactionVetoed("negative capacity move amount")
+    return store.apply_capacity_moves(moves)
+
+
 @txn_op("instance/cancel")
 def _instance_cancel(store: JobStore, payload: dict) -> Any:
     cancelled = [tid for tid in payload["task_ids"]

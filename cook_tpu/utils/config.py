@@ -146,6 +146,11 @@ class Settings:
     # plugin seams: dotted paths per seam + pool-mover rules
     # (scheduler/plugins.py registry_from_config)
     plugins: dict = field(default_factory=dict)
+    # elastic capacity plane (cook_tpu/elastic/): planning-interval
+    # trigger (0 = disabled) + planner knobs ({"headroom": ...,
+    # "rank_half_life": ..., "reclaim_window": ...})
+    elastic_interval_s: float = 0.0
+    elastic: dict = field(default_factory=dict)
 
     def match_config_for_pool(self, pool_name: str) -> MatchConfig:
         for ps in self.pool_schedulers:
@@ -198,7 +203,7 @@ def read_config(path: Optional[str] = None,
                 "replication_sync_ack", "replication_min_acks",
                 "replication_ack_timeout_s", "replication_ack_liveness_s",
                 "data_dir", "snapshot_interval_s", "platform",
-                "batched_match",
+                "batched_match", "elastic_interval_s",
                 "queue_limit_per_pool",
                 "queue_limit_per_user", "submission_rate_per_minute"):
         if key in data:
@@ -211,6 +216,8 @@ def read_config(path: Optional[str] = None,
         settings.auth = dict(data["auth"])
     if "plugins" in data:
         settings.plugins = dict(data["plugins"])
+    if "elastic" in data:
+        settings.elastic = dict(data["elastic"])
     if "executor_token" in data:
         settings.executor_token = str(data["executor_token"])
     if "pools" in data:

@@ -37,7 +37,8 @@ def test_smoke_emits_structured_record(smoke_record):
     on_disk = json.loads(out.read_text())
     assert on_disk["schema"] == "cook-bench/v1"
     assert on_disk["mode"] == "smoke"
-    assert set(on_disk["phases"]) == {"match", "dru", "rebalance"}
+    assert set(on_disk["phases"]) == {"match", "dru", "rebalance",
+                                      "elastic_plan"}
     for phase in on_disk["phases"].values():
         assert phase["p50_ms"] > 0
     assert on_disk["headline"]["unit"] == "ms"

@@ -1,0 +1,66 @@
+"""tools/ci_checks.py: one entry point for lint + smoke bench + gate."""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+
+import ci_checks  # noqa: E402
+
+REPO_ROOT = str(pathlib.Path(__file__).parent.parent)
+
+
+def run(argv, calls=None, codes=None):
+    """Drive main() with stubbed steps; record invocation order."""
+    calls = [] if calls is None else calls
+    codes = codes or {}
+
+    def step(name):
+        def fn():
+            calls.append(name)
+            return codes.get(name, 0)
+        return fn
+
+    steps = {name: step(name)
+             for name in ("lint_metrics", "smoke_bench", "bench_gate")}
+    return ci_checks.main(argv, steps=steps), calls
+
+
+def test_runs_all_steps_in_order_and_passes():
+    code, calls = run(["--root", REPO_ROOT])
+    assert code == 0
+    assert calls == ["lint_metrics", "smoke_bench", "bench_gate"]
+
+
+def test_skip_bench_runs_lint_only():
+    code, calls = run(["--root", REPO_ROOT, "--skip-bench"])
+    assert code == 0
+    assert calls == ["lint_metrics"]
+
+
+def test_failure_does_not_mask_later_steps():
+    code, calls = run(["--root", REPO_ROOT],
+                      codes={"lint_metrics": 1})
+    assert code == 1
+    # later steps still ran (one verdict, every step's result reported)
+    assert calls == ["lint_metrics", "smoke_bench", "bench_gate"]
+
+
+def test_gate_failure_fails_the_pipeline():
+    code, calls = run(["--root", REPO_ROOT], codes={"bench_gate": 1})
+    assert code == 1
+
+
+def test_step_exception_counts_as_failure():
+    def boom():
+        raise RuntimeError("accelerator on fire")
+
+    steps = {"lint_metrics": boom,
+             "smoke_bench": lambda: 0,
+             "bench_gate": lambda: 0}
+    assert ci_checks.main(["--root", REPO_ROOT], steps=steps) == 1
+
+
+def test_real_lint_step_runs_clean_on_this_repo():
+    """The wired lint target actually lints this tree (the smoke/gate
+    steps pay a real bench run and are covered by test_bench_smoke)."""
+    assert ci_checks.run_lint(REPO_ROOT) == 0

