@@ -313,6 +313,143 @@ def bench_multipool(jax, jnp, tuned):
     return p50
 
 
+def _pipeline_scenario(n_pools, hosts_per_pool, jobs_per_pool, seed=11,
+                       chunk=512, rounds=6, kc=128):
+    """Fresh multi-pool scheduler + deterministically seeded job set for
+    the pipelined-vs-serial cycle comparison.  Same seed -> identical
+    problem, so serial and pipelined runs are parity-comparable."""
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.entities import Job, Pool, Resources
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from cook_tpu.scheduler.matcher import MatchConfig
+
+    rng = np.random.default_rng(seed)
+    store = JobStore(clock=lambda: 1_000_000)
+    hosts = []
+    for p in range(n_pools):
+        store.set_pool(Pool(name=f"pool{p}"))
+        for i in range(hosts_per_pool):
+            hosts.append(MockHost(node_id=f"p{p}h{i}", hostname=f"p{p}h{i}",
+                                  mem=32768.0, cpus=16.0, pool=f"pool{p}"))
+    cluster = MockCluster("bench", hosts, clock=store.clock)
+    config = SchedulerConfig(
+        match=MatchConfig(chunk=chunk, chunk_rounds=rounds, chunk_passes=2,
+                          chunk_kc=kc, quality_audit_every=0),
+        device_telemetry=False,
+    )
+    scheduler = Scheduler(store, [cluster], config)
+    jobs = []
+    mems = rng.choice([512.0, 1024.0, 2048.0, 4096.0],
+                      (n_pools, jobs_per_pool))
+    cpus = rng.choice([1.0, 2.0, 4.0], (n_pools, jobs_per_pool))
+    for p in range(n_pools):
+        for i in range(jobs_per_pool):
+            jobs.append(Job(
+                uuid=f"bench-{p}-{i}", user=f"u{i % 8}", pool=f"pool{p}",
+                priority=50,
+                resources=Resources(mem=float(mems[p, i]),
+                                    cpus=float(cpus[p, i])),
+                command="true",
+            ))
+    store.submit_jobs(jobs)
+    return store, scheduler
+
+
+def _run_match_pass(store, scheduler, pipelined: bool):
+    """One multi-pool match pass; returns (wall_ms, phase_sum_ms,
+    overlap_fraction, matched set).  Rank runs outside the timed section
+    — the compared quantity is the cycle's tensor_build+solve+launch.
+    GC is paused across the timed section (collections land between
+    passes, not inside one — a gen-2 sweep mid-cycle is 100+ ms of pure
+    measurement noise at this object count).  The pipelined wall is the
+    engine's own pass wall (record.pipeline_wall_s): both sides of the
+    comparison then exclude the identical multi-pool epilogue
+    (spare-cache refresh, queue filtering, record commit), which the
+    serial side's summed phases never contained either."""
+    import gc
+
+    from cook_tpu.scheduler.pipeline import PIPELINE_PHASES
+
+    pools = [p for p in store.pools.values() if p.schedules_jobs]
+    for pool in pools:
+        scheduler.rank_cycle(pool)
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        if pipelined:
+            outcomes = scheduler.match_cycle_pipelined()
+        else:
+            outcomes = {p.name: scheduler.match_cycle(p) for p in pools}
+        wall_ms = (time.perf_counter() - t0) * 1000
+    finally:
+        gc.enable()
+    records = scheduler.recorder.records(limit=len(pools))
+    phase_sum_ms = sum(
+        r.phases.get(name, 0.0) for r in records for name in PIPELINE_PHASES
+    ) * 1000
+    overlap = max((r.overlap_fraction for r in records), default=0.0)
+    if pipelined and records:
+        wall_ms = records[-1].pipeline_wall_s * 1000
+    matched = {(j.uuid, o.hostname)
+               for out in outcomes.values() for j, o in out.matched}
+    return wall_ms, phase_sum_ms, overlap, matched
+
+
+def bench_pipeline(jax, jnp, *, n_pools=6, hosts_per_pool=24,
+                   jobs_per_pool=1800, rounds=8, repeats=5) -> dict:
+    """Pipelined match cycle vs the serial per-pool loop on the SAME
+    seeded multi-pool problem (scheduler/pipeline.py).  Reports the
+    serial pass's wall and summed phases, the pipelined pass's wall, the
+    recorded device/host overlap fraction, and decision parity — the
+    ISSUE-5 acceptance bar is pipelined wall < 0.8 x the summed serial
+    tensor_build+solve+launch phases, with a nonzero overlap fraction."""
+    serial_walls, serial_sums = [], []
+    pipe_walls, overlaps = [], []
+    parity = True
+    serial_matched = None
+    # warmup run per mode pays the XLA compiles (shapes repeat across
+    # runs; fresh schedulers per run keep the problem identical)
+    for warm_pipelined in (False, True):
+        store, scheduler = _pipeline_scenario(n_pools, hosts_per_pool,
+                                              jobs_per_pool, rounds=rounds)
+        _run_match_pass(store, scheduler, warm_pipelined)
+    for _ in range(repeats):
+        store, scheduler = _pipeline_scenario(n_pools, hosts_per_pool,
+                                              jobs_per_pool, rounds=rounds)
+        wall, phase_sum, _, matched = _run_match_pass(store, scheduler,
+                                                      False)
+        serial_walls.append(wall)
+        serial_sums.append(phase_sum)
+        serial_matched = matched
+        store, scheduler = _pipeline_scenario(n_pools, hosts_per_pool,
+                                              jobs_per_pool, rounds=rounds)
+        wall, _, overlap, matched = _run_match_pass(store, scheduler, True)
+        pipe_walls.append(wall)
+        overlaps.append(overlap)
+        parity = parity and matched == serial_matched
+    p50_pipe = float(np.percentile(pipe_walls, 50))
+    p50_serial = float(np.percentile(serial_walls, 50))
+    serial_sum = float(np.percentile(serial_sums, 50))
+    overlap = float(np.percentile(overlaps, 50))
+    log(f"pipeline {n_pools} pools x ({jobs_per_pool} jobs x "
+        f"{hosts_per_pool} hosts): pipelined p50 {p50_pipe:.1f} ms vs "
+        f"serial {p50_serial:.1f} ms (summed phases {serial_sum:.1f} ms); "
+        f"overlap {overlap:.2f}, parity {parity}, "
+        f"wall/serial_sum {p50_pipe / max(serial_sum, 1e-9):.2f}")
+    return {
+        "pipeline": {"p50_ms": p50_pipe, "pools": n_pools,
+                     "jobs": jobs_per_pool, "hosts": hosts_per_pool,
+                     "overlap_fraction": overlap,
+                     "serial_phase_sum_ms": serial_sum,
+                     "parity": bool(parity)},
+        "pipeline_serial": {"p50_ms": p50_serial, "pools": n_pools,
+                            "jobs": jobs_per_pool,
+                            "hosts": hosts_per_pool},
+    }
+
+
 def make_elastic_problem(jnp, p, j, p_real=None, seed=6):
     """Padded capacity-plan inputs at any size — ONE construction for
     the full and smoke tiers (ops/elastic.py solve shapes)."""
@@ -521,6 +658,8 @@ def device_main():
     reb_p50 = bench_rebalance(jax, jnp)
     multi_p50 = bench_multipool(jax, jnp, load_tuned())
     elastic_p50 = bench_elastic(jax, jnp)
+    pipeline_phases = bench_pipeline(jax, jnp, n_pools=8, hosts_per_pool=96,
+                                     jobs_per_pool=1536)
     log(f"full-cycle estimate (rank+match+rebalance): "
         f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
     extra = f", dru_ms={dru_p50:.1f}, rebalance_ms={reb_p50:.1f}"
@@ -533,6 +672,7 @@ def device_main():
         "rebalance": {"p50_ms": reb_p50},
         "multipool": {"p50_ms": multi_p50},
         "elastic_plan": {"p50_ms": elastic_p50, "pools": 64, "jobs": 16384},
+        **pipeline_phases,
     }, headline), out=_record_out_arg())
     print(json.dumps(headline), flush=True)
 
@@ -639,16 +779,28 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     return phases
 
 
-def smoke_main(out: str = None) -> dict:
+def smoke_main(out: str = None, pipeline: bool = None) -> dict:
     """`python bench.py --smoke`: run the smoke tier, write the
     structured record, print the headline JSON line.  Returns the
-    record (tests call this in-process)."""
+    record (tests call this in-process).  The pipelined-vs-serial
+    match-cycle tier (phases `pipeline` + `pipeline_serial`) is included
+    BY DEFAULT so every smoke record carries the same phase set and
+    bench_gate's dropped-phase rule never misreads a flag mismatch as a
+    regression; `--no-pipeline` (or BENCH_NO_PIPELINE) skips it for
+    quick kernel-only iterations — but a gate run against a
+    pipeline-bearing baseline will then fail on the missing phases, by
+    design."""
     import jax
     import jax.numpy as jnp
 
+    if pipeline is None:
+        pipeline = ("--no-pipeline" not in sys.argv
+                    and not os.environ.get("BENCH_NO_PIPELINE"))
     platform = jax.devices()[0].platform
     log(f"smoke bench on {jax.devices()[0]} ({platform})")
     phases = bench_smoke(jax, jnp)
+    if pipeline:
+        phases.update(bench_pipeline(jax, jnp))
     match = phases["match"]
     headline = {
         "metric": (f"smoke match-cycle p50 latency, {match['jobs']} jobs x "
@@ -707,6 +859,29 @@ def main():
     """
     if "--smoke" in sys.argv or os.environ.get("BENCH_SMOKE"):
         smoke_main()
+        return
+    if "--pipeline" in sys.argv:
+        # standalone pipeline tier (quick iteration on the engine); a
+        # record is written only to an explicit --out/$BENCH_OUT, under
+        # its own mode so it never shadows the smoke/full families
+        import jax
+        import jax.numpy as jnp
+
+        phases = bench_pipeline(jax, jnp)
+        headline = {
+            "metric": ("pipelined match-cycle wall, "
+                       f"{phases['pipeline']['pools']} pools "
+                       f"(overlap={phases['pipeline']['overlap_fraction']:.2f}, "
+                       f"parity={phases['pipeline']['parity']})"),
+            "value": round(phases["pipeline"]["p50_ms"], 2),
+            "unit": "ms",
+        }
+        out = _record_out_arg() or os.environ.get("BENCH_OUT")
+        if out:
+            write_bench_record(
+                make_record("pipeline", jax.devices()[0].platform, phases,
+                            headline), out=out)
+        print(json.dumps(headline), flush=True)
         return
     if "--device-only" in sys.argv:
         device_main()

@@ -219,6 +219,15 @@ class KubeCluster(ComputeCluster):
         self.clock = clock
         self.expected: dict[str, ExpectedState] = {}
         self.task_pods: dict[str, KubePod] = {}  # task id -> last actual
+        # kill tombstones consulted by launch_tasks: process() pops the
+        # KILLED expected entry as soon as the kill is reported, so a
+        # batch still queued on the async launch executor needs this
+        # longer-lived marker or it would create a pod for a task the
+        # store already drove terminal (a leaked pod — nothing would
+        # ever delete it).  FIFO-bounded; consumed on launch skip.
+        from collections import OrderedDict
+
+        self._killed_tombstones: "OrderedDict[str, None]" = OrderedDict()
         self.status_callback = None
         self.synthetic_limits = {
             "max-pods-outstanding": 128,
@@ -274,8 +283,24 @@ class KubeCluster(ComputeCluster):
     # ----------------------------------------------------- task lifecycle
 
     def launch_tasks(self, pool: str, specs: Sequence[TaskSpec]) -> None:
+        """Create one pod per spec.  Safe under the async launch contract
+        (ComputeCluster.launch_tasks_async): `expected` mutations are
+        lock-guarded, per-spec API errors are reported as
+        pod-submission-api-error without aborting the batch, and the
+        status callback chain never runs while this cluster's internal
+        lock is held."""
         for spec in specs:
             with self._lock:
+                if (spec.task_id in self._killed_tombstones
+                        or self.expected.get(spec.task_id)
+                        is ExpectedState.KILLED):
+                    # a kill raced this batch while it sat in the async
+                    # launch queue (the kill-lock only excludes kills
+                    # during the backend call itself): the store
+                    # instance is already terminal, so creating the pod
+                    # now would leak it — nothing would ever delete it
+                    self._killed_tombstones.pop(spec.task_id, None)
+                    continue
                 self.expected[spec.task_id] = ExpectedState.STARTING
             try:
                 self.api.create_pod(KubePod(
@@ -301,6 +326,9 @@ class KubeCluster(ComputeCluster):
     def kill_task(self, task_id: str) -> None:
         with self._lock:
             self.expected[task_id] = ExpectedState.KILLED
+            if len(self._killed_tombstones) >= 10_000:
+                self._killed_tombstones.popitem(last=False)
+            self._killed_tombstones[task_id] = None
         self.process(task_id)
 
     # -------------------------------------------------------- controller

@@ -9,6 +9,8 @@ reported to a callback, exactly like a real backend's watch/callback feed.
 """
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
@@ -54,6 +56,19 @@ class MockCluster(ComputeCluster):
         self.clock = clock
         self.default_runtime_ms = default_runtime_ms
         self.running: dict[str, _RunningTask] = {}
+        # async launch workers (ComputeCluster.launch_tasks_async) mutate
+        # `running` off the scheduler thread; this lock keeps offer scans
+        # from iterating a dict mid-mutation.  Status callbacks are always
+        # emitted OUTSIDE it — the callback chain re-enters the store (and
+        # from there possibly this cluster's kill path), and holding the
+        # lock across it would invert lock order against kill_lock/store
+        self._mutate_lock = threading.RLock()
+        # kills that raced a launch batch still queued (or about to be
+        # queued — the kill can land between the match transaction and
+        # launch_tasks_async) on the async executor: the launch must not
+        # resurrect them.  Recorded unconditionally; FIFO-ordered so the
+        # capacity bound evicts the OLDEST (stalest) entry
+        self._killed_before_launch: "OrderedDict[str, None]" = OrderedDict()
         self.status_callback: Optional[StatusCallback] = None
         self.launched_count = 0
         self.killed_count = 0
@@ -70,26 +85,9 @@ class MockCluster(ComputeCluster):
 
     # ------------------------------------------------------------- offers
 
-    def _host_used(self, node_id: str) -> tuple[float, float, float, float]:
-        mem = cpus = gpus = disk = 0.0
-        for rt in self.running.values():
-            if rt.spec.node_id == node_id:
-                mem += rt.spec.mem
-                cpus += rt.spec.cpus
-                gpus += rt.spec.gpus
-                disk += rt.spec.disk
-        return mem, cpus, gpus, disk
-
-    def _free_port_ranges(self, host: MockHost) -> tuple:
-        """Host ranges minus ports held by running tasks (the range
-        subtraction of mesos_mock.clj:184)."""
-        if not host.ports:
-            return ()
-        taken = set()
-        for rt in self.running.values():
-            if rt.spec.node_id == host.node_id:
-                taken.update(rt.spec.ports)
-        return subtract_ports(host.ports, taken)
+    def _running_snapshot(self) -> list[_RunningTask]:
+        with self._mutate_lock:
+            return list(self.running.values())
 
     def pending_offers(self, pool: str) -> list[Offer]:
         offers = []
@@ -100,10 +98,27 @@ class MockCluster(ComputeCluster):
         adj = self.pool_adjust.get(pool, {})
         deficit = {d: max(-float(adj.get(d, 0.0)), 0.0)
                    for d in ("mem", "cpus", "gpus")}
-        for h in self.hosts.values():
+        with self._mutate_lock:
+            hosts = list(self.hosts.values())
+            running = list(self.running.values())
+        # ONE pass over the running tasks builds per-node usage and taken
+        # ports — per-host _host_used/_free_port_ranges calls would make
+        # the offer scan O(hosts x tasks) in snapshot copies alone
+        used: dict[str, list[float]] = {}
+        ports_taken: dict[str, set] = {}
+        for rt in running:
+            u = used.setdefault(rt.spec.node_id, [0.0, 0.0, 0.0, 0.0])
+            u[0] += rt.spec.mem
+            u[1] += rt.spec.cpus
+            u[2] += rt.spec.gpus
+            u[3] += rt.spec.disk
+            if rt.spec.ports:
+                ports_taken.setdefault(rt.spec.node_id,
+                                       set()).update(rt.spec.ports)
+        for h in hosts:
             if h.pool != pool:
                 continue
-            um, uc, ug, ud = self._host_used(h.node_id)
+            um, uc, ug, ud = used.get(h.node_id, (0.0, 0.0, 0.0, 0.0))
             free = {"mem": max(h.mem - um, 0.0),
                     "cpus": max(h.cpus - uc, 0.0),
                     "gpus": max(h.gpus - ug, 0.0)}
@@ -122,7 +137,9 @@ class MockCluster(ComputeCluster):
                     attributes=h.attributes,
                     total_mem=h.mem,
                     total_cpus=h.cpus,
-                    ports=self._free_port_ranges(h),
+                    ports=(subtract_ports(
+                        h.ports, ports_taken.get(h.node_id, ()))
+                        if h.ports else ()),
                 )
             )
         return offers
@@ -161,7 +178,7 @@ class MockCluster(ComputeCluster):
                 host.gpus = positive["gpus"]
         elif host is not None:
             if any(rt.spec.node_id == node_id
-                   for rt in self.running.values()):
+                   for rt in self._running_snapshot()):
                 host.mem = host.cpus = host.gpus = 0.0  # drain
             else:
                 self.hosts.pop(node_id, None)
@@ -172,25 +189,40 @@ class MockCluster(ComputeCluster):
     def launch_tasks(self, pool: str, specs: Sequence[TaskSpec]) -> None:
         now = self.clock()
         for spec in specs:
-            if spec.node_id not in self.hosts:
+            with self._mutate_lock:
+                if spec.task_id in self._killed_before_launch:
+                    # a kill raced this batch in the async launch queue;
+                    # the killer already drove the store transition —
+                    # launching now would resurrect a terminal task
+                    self._killed_before_launch.pop(spec.task_id, None)
+                    continue
+                known = spec.node_id in self.hosts
+                if known:
+                    runtime = (spec.expected_runtime_ms
+                               or self.default_runtime_ms)
+                    self.running[spec.task_id] = _RunningTask(
+                        spec=spec, started_ms=now, ends_ms=now + runtime
+                    )
+                    self.launched_count += 1
+            if known:
+                self._report(spec.task_id, InstanceStatus.RUNNING, None)
+            else:
                 self._report(spec.task_id, InstanceStatus.FAILED,
                              "scheduling-failed-on-host")
-                continue
-            runtime = spec.expected_runtime_ms or self.default_runtime_ms
-            self.running[spec.task_id] = _RunningTask(
-                spec=spec, started_ms=now, ends_ms=now + runtime
-            )
-            self.launched_count += 1
-            self._report(spec.task_id, InstanceStatus.RUNNING, None)
 
     def kill_task(self, task_id: str) -> None:
-        rt = self.running.pop(task_id, None)
-        self.killed_count += 1
+        with self._mutate_lock:
+            rt = self.running.pop(task_id, None)
+            self.killed_count += 1
+            if rt is None:
+                if len(self._killed_before_launch) >= 10_000:
+                    self._killed_before_launch.popitem(last=False)
+                self._killed_before_launch[task_id] = None
         if rt is not None:
             self._report(task_id, InstanceStatus.FAILED, "killed-by-user")
 
     def num_tasks_on_host(self, hostname: str) -> int:
-        return sum(1 for rt in self.running.values()
+        return sum(1 for rt in self._running_snapshot()
                    if rt.spec.hostname == hostname)
 
     # --------------------------------------------------------- virtual time
@@ -198,25 +230,32 @@ class MockCluster(ComputeCluster):
     def advance_to(self, now_ms: int) -> list[str]:
         """Complete every task whose simulated runtime has elapsed; returns
         the completed task ids (mesos_mock.clj `complete-task!`)."""
-        done = [tid for tid, rt in self.running.items() if rt.ends_ms <= now_ms]
+        with self._mutate_lock:
+            done = [tid for tid, rt in self.running.items()
+                    if rt.ends_ms <= now_ms]
+            for tid in done:
+                self.running.pop(tid)
         for tid in sorted(done):  # deterministic order
-            self.running.pop(tid)
             self._report(tid, InstanceStatus.SUCCESS, "normal-exit")
         return done
 
     def fail_task(self, task_id: str, reason: str = "unknown") -> None:
         """Test/fault-injection hook."""
-        if self.running.pop(task_id, None) is not None:
+        with self._mutate_lock:
+            removed = self.running.pop(task_id, None)
+        if removed is not None:
             self._report(task_id, InstanceStatus.FAILED, reason)
 
     def remove_host(self, node_id: str) -> list[str]:
         """Simulate node loss: fail all its tasks mea-culpa."""
-        lost = [tid for tid, rt in self.running.items()
-                if rt.spec.node_id == node_id]
+        with self._mutate_lock:
+            lost = [tid for tid, rt in self.running.items()
+                    if rt.spec.node_id == node_id]
+            for tid in lost:
+                self.running.pop(tid)
+            self.hosts.pop(node_id, None)
         for tid in sorted(lost):
-            self.running.pop(tid)
             self._report(tid, InstanceStatus.FAILED, "node-removed")
-        self.hosts.pop(node_id, None)
         return lost
 
     def _report(self, task_id: str, status: InstanceStatus,

@@ -379,6 +379,10 @@ def start_leader_duties(process: CookProcess,
         ps = pools()
         if not ps:
             return
+        if settings.pipelined_match and len(ps) > 1:
+            with span("match_cycle_pipelined", pools=len(ps)):
+                scheduler.match_cycle_pipelined()
+            return
         if settings.batched_match and len(ps) > 1:
             with span("match_cycle_batched", pools=len(ps)):
                 scheduler.match_cycle_all_pools()

@@ -43,6 +43,9 @@ _REASONS: list[Reason] = [
     Reason(1009, "container-readiness-timed-out", True,
            "Container readiness probe timed out"),
     Reason(1010, "pod-submission-api-error", True, "Backend API error at launch"),
+    Reason(1011, "launch-failed", True,
+           "Backend launch RPC failed after the match transacted",
+           failure_limit=5),
     Reason(2000, "container-limitation", False, "Container resource limitation"),
     Reason(2001, "container-limitation-disk", False, "Container disk limit exceeded"),
     Reason(2002, "container-limitation-memory", False, "Container memory limit exceeded"),

@@ -77,12 +77,23 @@ class DeviceTelemetry:
         return compiled
 
     def record_match_solve(self, pool: str, shape, backend: str,
-                           seconds: float) -> bool:
+                           seconds: float,
+                           overlapped: bool = False) -> bool:
         """The per-pool match path's entry point: compile accounting +
-        per-pool latency baseline + device-memory gauge refresh."""
-        compiled = self.record_solve("match", shape, backend, seconds,
-                                     pool=pool)
-        self._observe_latency(pool, seconds, compiled)
+        per-pool latency baseline + device-memory gauge refresh.
+        `overlapped=True` (the pipelined cycle) keeps the wall out of
+        EVERY latency surface — regression baseline, solve histogram,
+        and the per-pool last-solve snapshot: the pipelined solve wall
+        (dispatch -> fetch) deliberately spans neighbor pools' host
+        work, so there is no honest device-latency scalar to export —
+        publishing the inflated one would fire phantom regressions the
+        moment the pipeline is enabled.  Compile accounting still runs
+        (it is shape-keyed, not time-keyed)."""
+        compiled = self.record_solve(
+            "match", shape, backend,
+            None if overlapped else seconds, pool=pool)
+        if not overlapped:
+            self._observe_latency(pool, seconds, compiled)
         self._refresh_memory_gauges()
         return compiled
 

@@ -33,6 +33,40 @@ def fetch_result(tree):
     return jax.tree.map(np.asarray, tree)
 
 
+class PendingResult:
+    """Handle to an asynchronously dispatched device computation.
+
+    JAX dispatches eagerly and asynchronously: calling a jitted kernel
+    returns device buffers immediately while the accelerator executes in
+    the background.  Holding those buffers in a PendingResult makes the
+    dispatch/fetch split explicit — the pipelined match cycle
+    (scheduler/pipeline.py) dispatches pool k's solve, does host work for
+    pools k±1, and only then fetches — instead of the historical
+    dispatch-then-immediately-`fetch_result` pattern that serialized host
+    and device.  `fetch()` is the ONE completion observation (same
+    semantics as `fetch_result`); it may be called exactly once per
+    logical consume and re-raises any deferred device error there, so
+    failures surface at the fetch site, not at dispatch.
+    """
+
+    __slots__ = ("_tree",)
+
+    def __init__(self, tree):
+        self._tree = tree
+
+    def fetch(self):
+        """Block until the device result is materialized host-side."""
+        return fetch_result(self._tree)
+
+
+def dispatch(fn, *args, **kwargs) -> PendingResult:
+    """Run a kernel entry point and wrap its (still in-flight) device
+    output without observing completion.  The counterpart of
+    `fetch_result`: dispatch() starts the solve, PendingResult.fetch()
+    ends it."""
+    return PendingResult(fn(*args, **kwargs))
+
+
 def binpack_fitness(used0, used1, d0, d1, denom0, denom1):
     """cpuMemBinPacker fitness (Fenzo's default, config.clj:108): mean
     post-placement utilization across mem and cpus.  Plain arithmetic so the

@@ -65,6 +65,23 @@ def shard_pools(mesh: Mesh, tree, axis: str = "pool"):
     return jax.device_put(tree, sharding)
 
 
+def invalid_match_problem(j: int, n: int, n_res: int = 4) -> MatchProblem:
+    """An all-invalid padded problem used to fill the pool axis up to a
+    mesh multiple (matcher.match_pools_batched): job_valid/node_valid are
+    all False so the kernels place nothing, and the sharded path engages
+    for ANY solvable-pool count instead of only exact mesh multiples.
+    `totals` is ones so the binpack fitness arithmetic stays finite on
+    the dead lanes."""
+    return MatchProblem(
+        demands=jnp.zeros((j, n_res), jnp.float32),
+        job_valid=jnp.zeros((j,), bool),
+        avail=jnp.zeros((n, n_res), jnp.float32),
+        totals=jnp.ones((n, 2), jnp.float32),
+        node_valid=jnp.zeros((n,), bool),
+        feasible=jnp.zeros((j, n), bool),
+    )
+
+
 def pool_sharded_match(mesh: Mesh, problems: MatchProblem, *,
                        chunk: int = 0, rounds: int = 4,
                        passes: int = 2, kc: int = 128,

@@ -54,6 +54,17 @@ class SchedulerConfig:
     user_launch_burst: float = 0.0
     # columnar host-side state: O(delta) rank-cycle encoding
     use_columnar_index: bool = True
+    # host-encode cache (scheduler/encode_cache.py): incremental
+    # encode_nodes + feasibility rows keyed by offer-set fingerprint,
+    # store-event invalidated — an unchanged pool re-encodes O(delta)
+    use_encode_cache: bool = True
+    # pipelined multi-pool match pass (scheduler/pipeline.py): overlap
+    # host encode/launch with the device solve; depth = max in-flight
+    # solves (2 = double-buffered)
+    pipeline_depth: int = 2
+    # fan backend launches out on the per-cluster launch executors during
+    # the pipelined pass (kills still exclude via the kill-lock)
+    async_launch: bool = True
     # flight recorder: bounded ring of per-cycle decision records served
     # at GET /debug/cycles (flight_recorder.py); 0 disables
     flight_recorder_capacity: int = 512
@@ -123,6 +134,11 @@ class Scheduler:
             from cook_tpu.models.columnar import ColumnarJobIndex
 
             self.columnar = ColumnarJobIndex(store)
+        self.encode_cache = None
+        if self.config.use_encode_cache:
+            from cook_tpu.scheduler.encode_cache import EncodeCache
+
+            self.encode_cache = EncodeCache(store)
         self.pool_queues: dict[str, RankedQueue] = {}
         self.pool_match_state: dict[str, PoolMatchState] = {}
         self.last_unmatched_offers: dict[str, dict[str, Resources]] = {}
@@ -375,6 +391,7 @@ class Scheduler:
             host_attrs=self.host_attr_cache,
             flight=flight,
             telemetry=self.telemetry,
+            encode_cache=self.encode_cache,
         )
         # charge launches against the per-user rate limiter (spend-through)
         if self.launch_rate_limiter is not None:
@@ -424,6 +441,62 @@ class Scheduler:
         path; see matcher.match_pools_batched)."""
         from cook_tpu.scheduler.matcher import match_pools_batched
 
+        pools, flights = self._begin_multi_pool_cycle()
+        outcomes = match_pools_batched(
+            self.store, pools, self.pool_queues, self.clusters,
+            self.config.match, self.pool_match_state,
+            make_task_id=self._make_task_id,
+            launch_filter=self._make_launch_filter(),
+            record_placement_failure=self._record_placement_failure,
+            host_reservations=self.host_reservations,
+            host_attrs=self.host_attr_cache,
+            mesh=mesh,
+            flights=flights,
+            telemetry=self.telemetry,
+            encode_cache=self.encode_cache,
+        )
+        self._finish_multi_pool_cycle(pools, outcomes, flights)
+        return outcomes
+
+    def match_cycle_pipelined(self) -> dict[str, MatchOutcome]:
+        """Pipelined multi-pool match pass (scheduler/pipeline.py): pool
+        k's device solve overlaps pool k+1's host encode and pool k-1's
+        finalize/launch; transactions still commit in pool order and
+        launches fan out on the per-cluster executors."""
+        from cook_tpu.scheduler.pipeline import (
+            PipelineParams,
+            match_pools_pipelined,
+        )
+
+        pools, flights = self._begin_multi_pool_cycle()
+        outcomes = match_pools_pipelined(
+            self.store, pools, self.pool_queues, self.clusters,
+            self.config.match, self.pool_match_state,
+            make_task_id=self._make_task_id,
+            launch_filter=self._make_launch_filter(),
+            record_placement_failure=self._record_placement_failure,
+            host_reservations=self.host_reservations,
+            host_attrs=self.host_attr_cache,
+            flights=flights,
+            telemetry=self.telemetry,
+            encode_cache=self.encode_cache,
+            recorder=self.recorder,
+            params=PipelineParams(depth=self.config.pipeline_depth,
+                                  async_launch=self.config.async_launch),
+        )
+        self._finish_multi_pool_cycle(pools, outcomes, flights)
+        return outcomes
+
+    def drain_launches(self, timeout: Optional[float] = None) -> bool:
+        """Wait for every cluster's in-flight async launch batches."""
+        from cook_tpu.cluster.base import wait_all_launches
+
+        return not wait_all_launches(self.clusters, timeout=timeout)
+
+    def _begin_multi_pool_cycle(self):
+        """Shared prologue of the batched and pipelined multi-pool
+        passes: flight builders, rank-if-missing, rank/quarantine
+        credit, per-pool match state."""
         pools = [p for p in self.store.pools.values() if p.schedules_jobs]
         flights = {pool.name: self._begin_cycle(pool.name) for pool in pools}
         for pool in pools:
@@ -436,20 +509,21 @@ class Scheduler:
                 PoolMatchState(
                     num_considerable=self.config.match.max_jobs_considered),
             )
-        outcomes = match_pools_batched(
-            self.store, pools, self.pool_queues, self.clusters,
-            self.config.match, self.pool_match_state,
-            make_task_id=self._make_task_id,
-            launch_filter=self._make_launch_filter(),
-            record_placement_failure=self._record_placement_failure,
-            host_reservations=self.host_reservations,
-            host_attrs=self.host_attr_cache,
-            mesh=mesh,
-            flights=flights,
-            telemetry=self.telemetry,
-        )
+        return pools, flights
+
+    def _finish_multi_pool_cycle(self, pools, outcomes, flights) -> None:
+        """Shared epilogue of the batched and pipelined multi-pool
+        passes: per-user rate-limiter spend-through, per-pool
+        queue/reservation upkeep, spare cache, record commit."""
         for pool in pools:
             outcome = outcomes[pool.name]
+            # charge launches against the per-user rate limiter exactly
+            # like the serial path — without the spend-through the bucket
+            # refills to full burst every cycle and the configured
+            # sustained rate is never enforced
+            if self.launch_rate_limiter is not None:
+                for job, _ in outcome.matched:
+                    self.launch_rate_limiter.spend((job.user, job.pool))
             matched_uuids = {j.uuid for j, _ in outcome.matched}
             queue = self.pool_queues[pool.name]
             queue.jobs = [j for j in queue.jobs if j.uuid not in matched_uuids]
@@ -464,7 +538,6 @@ class Scheduler:
             if flight.record is not None:
                 flight.record.head_matched = outcome.head_matched
             self._commit_cycle(flight)
-        return outcomes
 
     def _cache_spare(self, pool: Pool) -> None:
         from cook_tpu.cluster.base import scan_pool_offers

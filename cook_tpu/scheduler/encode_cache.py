@@ -1,0 +1,281 @@
+"""Incremental host-encode cache for the match cycle's tensor build.
+
+`prepare_pool_problem` historically re-ran `encode_nodes` (O(N × attrs))
+and `feasibility_mask` (O(J × N) bitwork) from scratch every cycle, even
+when neither the pool's offers nor its considerable window had changed.
+At the headline scale that host work is what the device waits on.  This
+cache makes the encode incremental, the same store-event-driven pattern
+as the columnar job index (models/columnar.py, ranking_columnar.py):
+
+  * the node encoding is keyed by an OFFER-SET FINGERPRINT — the
+    structure-relevant fields of the pool's offers (hostname/node id
+    order, attributes, gpu-present flag, free-port count, cluster
+    location).  Spare mem/cpus amounts are deliberately excluded: the
+    resource fit is the kernel's job, so the encoding only changes when
+    offer STRUCTURE changes (host added/removed/rescinded, attrs or
+    ports changed);
+  * feasibility rows are cached per job against that fingerprint — the
+    considerable-window fingerprint is implicit: each cycle looks up
+    exactly the rows of its window's jobs, so an unchanged pool
+    re-encodes O(delta) rows (new jobs only) instead of O(J × N);
+  * store events invalidate: an instance status change drops its job's
+    rows (the novel-host constraint depends on failed-instance history),
+    a job kill / pool move drops rows, quota/share/config/pool mutations
+    bump a global epoch (conservative full invalidation — they can
+    change which constraints apply).
+
+Jobs in a placement group are never cached: their rows depend on other
+members' running placements, which change outside this job's own event
+stream.  Rows also bypass the cache entirely while the estimated-
+completion constraint is active (rows become clock-dependent).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from cook_tpu.models.store import Event, JobStore
+from cook_tpu.utils.metrics import global_registry
+
+# events that can change which quota/share/config-derived constraints
+# apply; cheap to honor conservatively (an epoch bump = one full
+# re-encode, amortized away the next cycle)
+_EPOCH_EVENTS = frozenset((
+    "quota/set", "quota/retracted", "share/set", "share/retracted",
+    "config/updated", "pool/set", "pool/capacity",
+))
+
+
+class _PoolEntry:
+    __slots__ = ("nodes_fp", "has_gpus", "attr_codes", "attr_vocab",
+                 "hostname_to_idx", "rows", "dropped", "computing")
+
+    def __init__(self):
+        self.nodes_fp = None
+        self.has_gpus = None
+        self.attr_codes = None
+        self.attr_vocab = None
+        self.hostname_to_idx = None
+        # job uuid -> (epoch, [N] bool row); LRU-bounded
+        self.rows: collections.OrderedDict[str, tuple[int, np.ndarray]] = \
+            collections.OrderedDict()
+        # uuids invalidated WHILE the scheduler thread computes rows (the
+        # compute read the store before the invalidating event): such a
+        # drop must veto the row's write-back, or the stale row would be
+        # served until the next event happens to drop it again.  Only
+        # populated while a compute is in flight (`computing` > 0) and
+        # cleared when it ends — recording every terminal-instance event
+        # unconditionally would grow the set by dead jobs that never
+        # recompute, and its overflow fallback would wipe the whole cache
+        # on a steady churn of completions
+        self.dropped: set[str] = set()
+        self.computing: int = 0
+
+
+def offers_fingerprint(cluster_offers: Sequence[tuple]) -> int:
+    """Hash of the encode-relevant structure of a pool's (cluster, offer)
+    list.  Everything `encode_nodes` + the static feasibility columns
+    read, nothing the kernel reads (spare amounts churn every launch)."""
+    return hash(tuple(
+        (cluster.location, o.node_id, o.hostname, o.attributes,
+         o.gpus > 0, o.port_count(), o.disk > 0)
+        for cluster, o in cluster_offers
+    ))
+
+
+class EncodeCache:
+    """Per-pool incremental encode state, invalidated by store events."""
+
+    def __init__(self, store: Optional[JobStore] = None, *,
+                 max_rows_per_pool: int = 100_000):
+        self._pools: dict[str, _PoolEntry] = {}
+        self._epoch = 0
+        self._lock = threading.Lock()
+        self._max_rows = max_rows_per_pool
+        self._rows_counter = global_registry.counter(
+            "match.encode_cache.rows",
+            "feasibility rows served from / recomputed into the host-"
+            "encode cache, by result")
+        self._nodes_counter = global_registry.counter(
+            "match.encode_cache.nodes",
+            "node encodings served from / recomputed into the host-"
+            "encode cache, by result")
+        if store is not None:
+            store.add_watcher(self._on_event)
+            resync = getattr(store, "add_resync_listener", None)
+            if resync is not None:
+                resync(self.clear)
+
+    # ------------------------------------------------------- invalidation
+
+    def _on_event(self, event: Event) -> None:
+        kind = event.kind
+        if kind in _EPOCH_EVENTS:
+            with self._lock:
+                self._epoch += 1
+            return
+        if kind == "instance/status":
+            # failed-instance history feeds the novel-host constraint.
+            # (instance/cancelled is deliberately NOT handled: a cancel
+            # only marks intent — the row's inputs change at the terminal
+            # instance/status transition that follows)
+            self._drop_job(event.data.get("job"))
+        elif kind in ("job/state", "job/pool-moved"):
+            self._drop_job(event.data.get("uuid"))
+
+    def _drop_job(self, job_uuid: Optional[str]) -> None:
+        if not job_uuid:
+            return
+        with self._lock:
+            for entry in self._pools.values():
+                entry.rows.pop(job_uuid, None)
+                if not entry.computing:
+                    continue  # no in-flight compute to veto
+                if len(entry.dropped) < 10_000:
+                    entry.dropped.add(job_uuid)
+                else:
+                    # overflow (event storm within ONE compute): fall
+                    # back to a conservative epoch bump rather than
+                    # forgetting an invalidation
+                    self._epoch += 1
+                    entry.dropped.clear()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._pools.clear()
+            self._epoch += 1
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    # ------------------------------------------------------------- encode
+
+    def encoded_nodes(self, pool: str, cluster_offers: Sequence[tuple]):
+        """(EncodedNodes, fingerprint) for the pool's current offers,
+        reusing the attribute/vocab encoding when the offer structure is
+        unchanged (the offers list itself is always refreshed — spare
+        amounts feed the kernel tensors and change every cycle)."""
+        from cook_tpu.scheduler.constraints import EncodedNodes, encode_nodes
+
+        offers = [o for _, o in cluster_offers]
+        fp = offers_fingerprint(cluster_offers)
+        with self._lock:
+            entry = self._pools.setdefault(pool, _PoolEntry())
+            hit = entry.nodes_fp == fp
+            if hit:
+                nodes = EncodedNodes(
+                    offers=offers,
+                    hostname_to_idx=entry.hostname_to_idx,
+                    has_gpus=entry.has_gpus,
+                    attr_codes=entry.attr_codes,
+                    attr_vocab=entry.attr_vocab,
+                )
+        if not hit:
+            nodes = encode_nodes(offers)
+            with self._lock:
+                entry = self._pools.setdefault(pool, _PoolEntry())
+                entry.nodes_fp = fp
+                entry.hostname_to_idx = nodes.hostname_to_idx
+                entry.has_gpus = nodes.has_gpus
+                entry.attr_codes = nodes.attr_codes
+                entry.attr_vocab = nodes.attr_vocab
+                # rows encode against a specific node set; a structural
+                # change invalidates every cached row of the pool
+                entry.rows.clear()
+        self._nodes_counter.inc(1, {"result": "hit" if hit else "miss"})
+        return nodes, fp
+
+    # -------------------------------------------------------- feasibility
+
+    @staticmethod
+    def cacheable_job(job) -> bool:
+        """Group members' rows depend on sibling placements that change
+        outside this job's event stream — never cached."""
+        return not job.group_uuid
+
+    def feasibility(
+        self,
+        pool: str,
+        jobs: Sequence,
+        n_nodes: int,
+        nodes_fp: int,
+        compute: Callable[[list, dict[int, np.ndarray]], np.ndarray],
+        balanced_pre_rows: Optional[dict[int, np.ndarray]] = None,
+    ) -> np.ndarray:
+        """Assemble the [J, N] mask from cached rows plus a delta
+        computation.
+
+        `compute(subset_jobs, subset_pre_rows)` must return the mask for
+        just the uncached jobs (the caller closes over group context
+        etc.); its balanced_pre_rows (keyed by subset index) are remapped
+        into the caller's dict keyed by full-window index.  Returns a
+        FRESH array — callers may mutate it (host reservations) without
+        corrupting the cache."""
+        j = len(jobs)
+        feasible = np.empty((j, n_nodes), dtype=bool)
+        with self._lock:
+            epoch = self._epoch
+            entry = self._pools.setdefault(pool, _PoolEntry())
+            rows = entry.rows if entry.nodes_fp == nodes_fp else None
+            subset_idx: list[int] = []
+            for ji, job in enumerate(jobs):
+                cached = (rows.get(job.uuid)
+                          if rows is not None and self.cacheable_job(job)
+                          else None)
+                if (cached is not None and cached[0] == epoch
+                        and cached[1].shape[0] == n_nodes):
+                    feasible[ji] = cached[1]
+                    rows.move_to_end(job.uuid)
+                else:
+                    subset_idx.append(ji)
+            if subset_idx:
+                # open the veto window: drops landing from here until the
+                # write-back completes must not be overwritten by a row
+                # computed from pre-event store state
+                entry.computing += 1
+        if subset_idx:
+            subset = [jobs[i] for i in subset_idx]
+            sub_pre_rows: dict[int, np.ndarray] = {}
+            try:
+                submask = np.asarray(compute(subset, sub_pre_rows),
+                                     dtype=bool)
+                with self._lock:
+                    entry = self._pools.setdefault(pool, _PoolEntry())
+                    store_rows = (entry.rows if entry.nodes_fp == nodes_fp
+                                  and self._epoch == epoch else None)
+                    for k, ji in enumerate(subset_idx):
+                        feasible[ji] = submask[k]
+                        if (store_rows is not None
+                                and self.cacheable_job(jobs[ji])
+                                # a row with an open pre-closure variant
+                                # is cycle-dependent; don't cache it
+                                and k not in sub_pre_rows
+                                # an event invalidated this job while the
+                                # row was being computed: the compute may
+                                # predate the event's effect — don't cache
+                                and jobs[ji].uuid not in entry.dropped):
+                            store_rows[jobs[ji].uuid] = (epoch,
+                                                         submask[k].copy())
+                    if store_rows is not None:
+                        while len(store_rows) > self._max_rows:
+                            store_rows.popitem(last=False)
+            finally:
+                with self._lock:
+                    entry = self._pools.setdefault(pool, _PoolEntry())
+                    entry.computing = max(entry.computing - 1, 0)
+                    if entry.computing == 0:
+                        entry.dropped.clear()
+            if balanced_pre_rows is not None:
+                for k, row in sub_pre_rows.items():
+                    balanced_pre_rows[subset_idx[k]] = row
+        hits = j - len(subset_idx)
+        if hits:
+            self._rows_counter.inc(hits, {"result": "hit"})
+        if subset_idx:
+            self._rows_counter.inc(len(subset_idx), {"result": "miss"})
+        return feasible

@@ -44,6 +44,8 @@ INSUFFICIENT_RESOURCES = "insufficient-resources"
 LAUNCH_CAP = "cluster-launch-cap"
 PORTS_EXHAUSTED = "ports-exhausted"
 LAUNCH_VETOED = "launch-vetoed"
+LAUNCH_FAILED = "launch-failed"
+SOLVE_FAILED = "solve-failed"
 NOT_CONSIDERED = "not-considered"
 EXCEEDS_POOL_CAPACITY = "exceeds-pool-capacity"
 
@@ -54,6 +56,8 @@ REASON_TEXT = {
     LAUNCH_CAP: "cluster launch rate/cap reached this cycle",
     PORTS_EXHAUSTED: "insufficient free ports on the matched node",
     LAUNCH_VETOED: "launch transaction vetoed (job changed state mid-cycle)",
+    LAUNCH_FAILED: "backend launch RPC failed after the match transacted",
+    SOLVE_FAILED: "the pool's device solve raised; jobs wait a cycle",
     NOT_CONSIDERED: "not in this cycle's considerable window",
     EXCEEDS_POOL_CAPACITY:
         "the job's resource demands exceed every host in the pool",
@@ -87,6 +91,17 @@ class CycleRecord:
     t_ms: int                     # store clock at cycle start (virtual ms)
     wall_time: float              # epoch seconds at cycle start
     batched: bool = False         # solved via the pool-batched device call
+    # pipelined-cycle overlap accounting (scheduler/pipeline.py): the
+    # pass dispatches pool k's solve asynchronously and runs pool k±1's
+    # host phases while the device executes, so the summed per-pool phase
+    # time exceeds the pass's wall time.  pipeline_wall_s is the WHOLE
+    # pipelined pass's wall (shared by every participating record);
+    # overlap_s / overlap_fraction quantify how much host+device time ran
+    # concurrently (0 on the serial paths).
+    pipelined: bool = False
+    pipeline_wall_s: float = 0.0
+    overlap_s: float = 0.0
+    overlap_fraction: float = 0.0
     phases: dict[str, float] = field(default_factory=dict)   # name -> seconds
     device_s: float = 0.0
     host_s: float = 0.0
@@ -124,6 +139,10 @@ class CycleRecord:
             "t_ms": self.t_ms,
             "wall_time": self.wall_time,
             "batched": self.batched,
+            "pipelined": self.pipelined,
+            "pipeline_wall_s": self.pipeline_wall_s,
+            "overlap_s": self.overlap_s,
+            "overlap_fraction": self.overlap_fraction,
             "phases": dict(self.phases),
             "device_s": self.device_s,
             "host_s": self.host_s,
@@ -216,12 +235,13 @@ class CycleBuilder:
         self.record.preemptions.append(preemption)
 
     def finish(self) -> CycleRecord:
-        if self.record.batched:
-            # the pool-batched path starts every pool's builder before any
-            # pool's work begins, so builder-lifetime elapsed would report
-            # the whole BATCH's wall time for each pool; the sum of this
-            # pool's attributed phases (shared solve included) is the
-            # honest per-pool figure
+        if self.record.batched or self.record.pipelined:
+            # the pool-batched and pipelined paths start every pool's
+            # builder before any pool's work begins, so builder-lifetime
+            # elapsed would report the whole PASS's wall time for each
+            # pool; the sum of this pool's attributed phases (shared or
+            # overlapped solve included) is the honest per-pool figure
+            # (the pass wall lives in record.pipeline_wall_s)
             self.record.total_s = self.record.device_s + self.record.host_s
             return self.record
         # rank may have been credited via add_phase from BEFORE the
@@ -321,7 +341,44 @@ class FlightRecorder:
             "cycle.host_seconds",
             "host matchmaking time of the last match cycle").set(
             record.host_s, {"pool": record.pool})
+        if record.pipelined:
+            global_registry.gauge(
+                "cycle.overlap_fraction",
+                "fraction of the last pipelined pass's summed phase time "
+                "that ran concurrently (host/device overlap)").set(
+                record.overlap_fraction, {"pool": record.pool})
         return record
+
+    def note_job_reason(self, job_uuid: str, cycle_id: int, code: str,
+                        detail: str = "") -> None:
+        """Update a job's last-decision index entry outside a cycle
+        commit — the async launch fan-out's failure path lands after the
+        cycle's record may already be committed, and /unscheduled_jobs
+        must still answer `launch-failed` rather than a stale
+        `matched`."""
+        with self._lock:
+            self._note_reason(job_uuid, cycle_id, code,
+                              detail or REASON_TEXT.get(code, ""))
+
+    def note_async_launch_failure(self, record: Optional[CycleRecord],
+                                  job_uuid: str, code: str,
+                                  detail: str = "") -> None:
+        """Record an async launch-fan-out failure: appends the skip to
+        the cycle record AND updates the per-job index, both under the
+        recorder lock.  The callback runs on a cluster launch-worker
+        thread and may land before OR after the record committed, so it
+        must not touch the CycleBuilder directly (single-threaded by
+        construction) — this is the same locked mutate-committed-record
+        pattern annotate_preemptions uses, serialized against
+        records_json renders and commit."""
+        detail = detail or REASON_TEXT.get(code, "")
+        with self._lock:
+            cycle_id = 0
+            if record is not None:
+                cycle_id = record.cycle_id
+                record.skipped.append(
+                    {"job": job_uuid, "code": code, "detail": detail})
+            self._note_reason(job_uuid, cycle_id, code, detail)
 
     def _note_reason(self, job_uuid: str, cycle_id: int, code: str,
                      detail: str) -> None:

@@ -27,10 +27,21 @@ import numpy as np
 import jax.numpy as jnp
 
 from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
-from cook_tpu.models.entities import GroupPlacementType, Job, JobState, Pool
+from cook_tpu.models.entities import (
+    GroupPlacementType,
+    InstanceStatus,
+    Job,
+    JobState,
+    Pool,
+)
 from cook_tpu.models.store import JobStore, TransactionVetoed
 from cook_tpu.obs.compile_observatory import shape_signature
-from cook_tpu.ops.common import bucket_size, fetch_result, pad_to
+from cook_tpu.ops.common import (
+    PendingResult,
+    bucket_size,
+    fetch_result,
+    pad_to,
+)
 from cook_tpu.ops.match import (
     MatchProblem,
     backend_flags,
@@ -214,6 +225,75 @@ def solve_backend(config: "MatchConfig") -> str:
     return config.backend if config.chunk else "exact"
 
 
+def dispatch_pool_solve(prepared: "PreparedPool",
+                        config: "MatchConfig") -> PendingResult:
+    """Dispatch the pool's match kernel WITHOUT observing completion.
+
+    JAX's async dispatch returns device buffers immediately; the returned
+    PendingResult's `fetch()` is the one completion observation (same
+    semantics as `fetch_result`, including deferred-error surfacing).
+    The serial path fetches inline; the pipelined engine
+    (scheduler/pipeline.py) interleaves other pools' host phases between
+    dispatch and fetch."""
+    if config.chunk:
+        result = chunked_match(prepared.problem, chunk=config.chunk,
+                               rounds=config.chunk_rounds,
+                               passes=config.chunk_passes,
+                               kc=config.chunk_kc,
+                               **backend_flags(config.backend))
+    else:
+        result = greedy_match(prepared.problem)
+    return PendingResult(result.assignment[: len(prepared.considerable)])
+
+
+def record_solve_outcome(prepared: "PreparedPool", assignment: np.ndarray,
+                         config: "MatchConfig", state: "PoolMatchState",
+                         pool_name: str, solve_s: float, flight,
+                         telemetry, *, overlapped: bool = False) -> None:
+    """The post-solve protocol shared by the serial and pipelined paths:
+    compile/latency telemetry, quality sampling, the cycle record's
+    solve identity, and the periodic exact-kernel quality audit.
+    `overlapped=True` for walls measured under overlap (they span
+    neighbor pools' host work and must not feed any latency surface —
+    see DeviceTelemetry.record_match_solve)."""
+    shape = problem_shape(prepared.problem)
+    backend = solve_backend(config)
+    compiled = False
+    if telemetry is not None:
+        compiled = telemetry.record_match_solve(
+            pool_name, shape, backend, solve_s, overlapped=overlapped)
+        telemetry.quality.observe_cycle(prepared, assignment, pool_name)
+    flight.note_solve(shape_signature(shape), backend, compiled)
+    if config.chunk:
+        state.chunked_solves += 1
+        if (config.quality_audit_every
+                and state.chunked_solves % config.quality_audit_every == 0):
+            start_quality_audit(prepared, assignment, pool_name)
+
+
+def fail_launched_specs(store: JobStore, specs: Sequence[TaskSpec],
+                        exc: BaseException,
+                        note_reason: Optional[Callable[[str, str], None]]
+                        = None) -> None:
+    """Launch-failure flow-back: a backend launch RPC that raised must
+    not leave already-transacted tasks dangling in the store — each spec's
+    instance transitions to failed with the mea-culpa `launch-failed`
+    reason (the job re-queues without consuming its retry budget).
+    `note_reason(job_uuid, detail)` lets callers thread the outcome into
+    the flight recorder's per-job index."""
+    detail = f"{type(exc).__name__}: {exc}"
+    for spec in specs:
+        try:
+            store.update_instance_state(spec.task_id, InstanceStatus.FAILED,
+                                        "launch-failed")
+        except Exception:  # noqa: BLE001 — one bad transition must not
+            # strand the rest of the batch in limbo
+            log.exception("launch-failed transition for %s did not apply",
+                          spec.task_id)
+        if note_reason is not None:
+            note_reason(spec.job_uuid, detail)
+
+
 def gather_group_context(
     store: JobStore,
     jobs: Sequence[Job],
@@ -376,8 +456,15 @@ def prepare_pool_problem(
     host_reservations: Optional[dict[str, str]] = None,
     host_attrs: Optional[dict[str, dict]] = None,
     flight=NULL_CYCLE,
+    encode_cache=None,
 ) -> PreparedPool:
-    """Gather offers + considerable jobs and encode the tensor problem."""
+    """Gather offers + considerable jobs and encode the tensor problem.
+
+    With `encode_cache` (scheduler/encode_cache.py) the node encoding and
+    per-job feasibility rows are incremental: an unchanged pool re-encodes
+    O(delta) rows instead of O(J×N).  The cache is bypassed while the
+    estimated-completion constraint is active (rows become clock-
+    dependent)."""
     prepared = PreparedPool(pool=pool, outcome=MatchOutcome())
 
     # offers from every running cluster (scheduler.clj:1574-1585)
@@ -409,7 +496,13 @@ def prepare_pool_problem(
     if not considerable or not prepared.cluster_offers:
         return prepared
 
-    nodes = encode_nodes([o for _, o in prepared.cluster_offers])
+    est_end_ms = estimated_end_times(store, considerable, config)
+    use_cache = encode_cache is not None and est_end_ms is None
+    if use_cache:
+        nodes, nodes_fp = encode_cache.encoded_nodes(
+            pool.name, prepared.cluster_offers)
+    else:
+        nodes = encode_nodes([o for _, o in prepared.cluster_offers])
     prepared.nodes = nodes
     # every host in this cycle's offers contributes attrs, written back
     # into the caller's accumulated cache HERE (pre-match) — a host whose
@@ -425,19 +518,40 @@ def prepare_pool_problem(
      prepared.group_attr_value,
      prepared.group_balance_counts) = gather_group_context(
         store, considerable, host_attrs=merged_attrs)
-    feasible = feasibility_mask(
-        considerable,
-        nodes,
-        previous_hosts=previous_failed_hosts(store, considerable),
-        group_used_hosts=prepared.group_used_hosts,
-        group_attr_value=prepared.group_attr_value,
-        group_balance_counts=prepared.group_balance_counts,
-        groups=prepared.groups,
-        offer_locations=[c.location for c, _ in prepared.cluster_offers],
-        job_est_end_ms=estimated_end_times(store, considerable, config),
-        host_lifetime_mins=config.host_lifetime_mins,
-        balanced_pre_rows=prepared.balanced_pre_rows,
-    )
+    offer_locations = [c.location for c, _ in prepared.cluster_offers]
+    if use_cache:
+        def compute_rows(subset, pre_rows):
+            return feasibility_mask(
+                subset,
+                nodes,
+                previous_hosts=previous_failed_hosts(store, subset),
+                group_used_hosts=prepared.group_used_hosts,
+                group_attr_value=prepared.group_attr_value,
+                group_balance_counts=prepared.group_balance_counts,
+                groups=prepared.groups,
+                offer_locations=offer_locations,
+                host_lifetime_mins=config.host_lifetime_mins,
+                balanced_pre_rows=pre_rows,
+            )
+
+        feasible = encode_cache.feasibility(
+            pool.name, considerable, nodes.n, nodes_fp, compute_rows,
+            balanced_pre_rows=prepared.balanced_pre_rows,
+        )
+    else:
+        feasible = feasibility_mask(
+            considerable,
+            nodes,
+            previous_hosts=previous_failed_hosts(store, considerable),
+            group_used_hosts=prepared.group_used_hosts,
+            group_attr_value=prepared.group_attr_value,
+            group_balance_counts=prepared.group_balance_counts,
+            groups=prepared.groups,
+            offer_locations=offer_locations,
+            job_est_end_ms=est_end_ms,
+            host_lifetime_mins=config.host_lifetime_mins,
+            balanced_pre_rows=prepared.balanced_pre_rows,
+        )
     if host_reservations:
         # rebalancer reservations (constraints.clj:242 + reserve-hosts!,
         # rebalancer.clj:419): a reserved host only accepts its reserving job
@@ -470,9 +584,17 @@ def finalize_pool_match(
     make_task_id: Callable[[Job], str],
     record_placement_failure: Optional[Callable[[Job, str], None]] = None,
     flight=NULL_CYCLE,
+    async_launch: bool = False,
+    launch_failure_cb: Optional[Callable] = None,
 ) -> MatchOutcome:
     """Apply a solved assignment: group validation, launch transactions,
-    backend launches, autoscaling, head-of-queue backoff."""
+    backend launches, autoscaling, head-of-queue backoff.
+
+    `async_launch` moves each cluster's backend launch onto that
+    cluster's bounded launch executor (ComputeCluster.launch_tasks_async)
+    so RPC latency leaves the cycle's critical path; failures flow
+    through `launch_failure_cb(specs, exc)` (default: the same
+    fail_launched_specs flow-back the synchronous path uses)."""
     outcome = prepared.outcome
     considerable = prepared.considerable
     pool = prepared.pool
@@ -626,15 +748,43 @@ def finalize_pool_match(
         outcome.launched_task_ids.append(task_id)
         flight.note_match(job.uuid, offer.hostname, task_id)
 
+    if launch_failure_cb is None:
+        # the synchronous default may write the builder (same thread);
+        # an async default must not — the callback runs on the cluster's
+        # launch-worker thread, and CycleBuilder is single-threaded by
+        # construction (the pipelined engine supplies a recorder-locked
+        # callback instead)
+        sync_note = (None if async_launch
+                     else lambda uuid, detail: flight.note_skip(
+                         uuid, flight_codes.LAUNCH_FAILED, detail))
+
+        def launch_failure_cb(specs, exc):
+            fail_launched_specs(store, specs, exc, note_reason=sync_note)
+
     for cname, specs in launches_per_cluster.items():
         cluster = cluster_by_name[cname]
         limiter = getattr(cluster, "launch_rate_limiter", None)
         if limiter is not None:
             # spend-through: charge the work that is about to happen
             limiter.spend(cname, float(len(specs)))
-        # read side of the kill-lock: kills can't interleave mid-launch
-        with cluster.kill_lock.read():
-            cluster.launch_tasks(pool.name, specs)
+        if async_launch:
+            # the worker holds the kill-lock read side itself; failures
+            # arrive on the worker thread via the callback
+            cluster.launch_tasks_async(
+                pool.name, specs,
+                done_cb=lambda sp, exc, _cb=launch_failure_cb:
+                    _cb(sp, exc) if exc is not None else None)
+            continue
+        try:
+            # read side of the kill-lock: kills can't interleave mid-launch
+            with cluster.kill_lock.read():
+                cluster.launch_tasks(pool.name, specs)
+        except Exception as exc:  # noqa: BLE001 — one cluster's RPC
+            # failure must not abort the remaining clusters' launches
+            log.exception("launch_tasks failed (cluster %s, pool %s, "
+                          "%d specs); failing its specs and continuing",
+                          cname, pool.name, len(specs))
+            launch_failure_cb(specs, exc)
 
     # 4. autoscaling: surface unmatched demand to autoscaling clusters
     # (trigger-autoscaling!, scheduler.clj:1178,1509)
@@ -773,6 +923,7 @@ def match_pool(
     host_attrs: Optional[dict[str, dict]] = None,
     flight=NULL_CYCLE,
     telemetry=None,
+    encode_cache=None,
 ) -> MatchOutcome:
     """One pool's match cycle end to end (prepare -> solve -> finalize)."""
     import time as _time
@@ -781,41 +932,20 @@ def match_pool(
         prepared = prepare_pool_problem(
             store, pool, queue, clusters, config, state,
             launch_filter=launch_filter, host_reservations=host_reservations,
-            host_attrs=host_attrs, flight=flight,
+            host_attrs=host_attrs, flight=flight, encode_cache=encode_cache,
         )
     assignment = np.empty(0, dtype=np.int32)
     if prepared.solvable:
-        # the solve is the cycle's device section: fetch_result blocks
+        # the solve is the cycle's device section: the inline fetch blocks
         # until the kernel's result is materialized, so this phase's wall
-        # time covers dispatch + device execution + transfer
+        # time covers dispatch + device execution + transfer (the
+        # pipelined engine splits these two calls across pools instead)
         t_solve = _time.perf_counter()
         with flight.phase("solve", device=True):
-            if config.chunk:
-                result = chunked_match(prepared.problem, chunk=config.chunk,
-                                       rounds=config.chunk_rounds,
-                                       passes=config.chunk_passes,
-                                       kc=config.chunk_kc,
-                                       **backend_flags(config.backend))
-            else:
-                result = greedy_match(prepared.problem)
-            assignment = fetch_result(
-                result.assignment[: len(prepared.considerable)]
-            )
-        solve_shape = problem_shape(prepared.problem)
-        backend = solve_backend(config)
-        compiled = False
-        if telemetry is not None:
-            compiled = telemetry.record_match_solve(
-                pool.name, solve_shape, backend,
-                _time.perf_counter() - t_solve)
-            telemetry.quality.observe_cycle(prepared, assignment, pool.name)
-        flight.note_solve(shape_signature(solve_shape), backend, compiled)
-        if config.chunk:
-            state.chunked_solves += 1
-            if (config.quality_audit_every
-                    and state.chunked_solves
-                    % config.quality_audit_every == 0):
-                start_quality_audit(prepared, assignment, pool.name)
+            assignment = dispatch_pool_solve(prepared, config).fetch()
+        record_solve_outcome(prepared, assignment, config, state, pool.name,
+                             _time.perf_counter() - t_solve, flight,
+                             telemetry)
     with flight.phase("launch"):
         return finalize_pool_match(
             store, prepared, assignment, config, state, clusters,
@@ -841,6 +971,7 @@ def match_pools_batched(
     mesh=None,
     flights: Optional[dict] = None,
     telemetry=None,
+    encode_cache=None,
 ) -> dict[str, MatchOutcome]:
     """Solve EVERY pool's match problem in one batched device call.
 
@@ -872,7 +1003,7 @@ def match_pools_batched(
                 store, pool, queues[pool.name], clusters, config,
                 states[pool.name], launch_filter=launch_filter,
                 host_reservations=host_reservations, host_attrs=host_attrs,
-                flight=flight,
+                flight=flight, encode_cache=encode_cache,
             ))
     solvable = [p for p in prepared_list if p.solvable]
     if solvable:
@@ -895,9 +1026,23 @@ def match_pools_batched(
                                  ((0, max_j - j), (0, max_n - n))),
             )
 
+        padded_problems = [pad_problem(p.problem) for p in solvable]
+        if mesh is not None:
+            # pool-axis padding: the sharded path previously only engaged
+            # when the pool count happened to divide the mesh size; pad
+            # with all-invalid problems (job_valid/node_valid False — the
+            # kernels place nothing there) so it engages for ANY count,
+            # and the padded batch shape stays one XLA program per
+            # (ceil-multiple, J, N) bucket instead of one per pool count
+            from cook_tpu.parallel.mesh import invalid_match_problem
+
+            n_pad = (-len(solvable)) % mesh.devices.size
+            if n_pad:
+                pad_p = invalid_match_problem(
+                    max_j, max_n, n_res=int(solvable[0].problem.demands.shape[-1]))
+                padded_problems.extend([pad_p] * n_pad)
         stacked = jax.tree.map(
-            lambda *leaves: jnp.stack(leaves),
-            *[pad_problem(p.problem) for p in solvable],
+            lambda *leaves: jnp.stack(leaves), *padded_problems,
         )
         # the shared pad/stack is host work, not solve time — credit it
         # as tensor_build so device_s stays an honest accelerator figure
@@ -905,7 +1050,7 @@ def match_pools_batched(
         for p in solvable:
             pool_flight(p.pool.name).add_phase("tensor_build", stack_s)
         t_solve = _time.perf_counter()
-        if mesh is not None and len(solvable) % mesh.devices.size == 0:
+        if mesh is not None:
             stacked = shard_pools(mesh, stacked)
             result = pool_sharded_match(mesh, stacked,
                                         chunk=config.chunk or 0,
@@ -927,9 +1072,11 @@ def match_pools_batched(
         assignments = fetch_result(result.assignment)
         # one shared device call solved every pool: each participating
         # pool's record carries the full solve wall time (no pool's cycle
-        # can finish sooner than the batch)
+        # can finish sooner than the batch).  The recorded shape is the
+        # PADDED pool axis — the device truth the compile observatory
+        # keys programs by
         solve_s = _time.perf_counter() - t_solve
-        batch_shape = (len(solvable), max_j, max_n)
+        batch_shape = (len(padded_problems), max_j, max_n)
         backend = (vmap_safe_backend(config.backend) if config.chunk
                    else "exact")
         compiled = False

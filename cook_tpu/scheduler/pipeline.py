@@ -1,0 +1,298 @@
+"""Pipelined match cycle: overlap host encode/launch with the device solve.
+
+The serial cycle (matcher.match_pool) runs tensor_build -> blocking fetch
+-> launch strictly in sequence: the device idles while the host builds
+tensors and fans out launches, and the host idles while the device
+solves.  Prediction-assisted online schedulers (arXiv:2501.05563) and
+elastic DL schedulers like Aryl (arXiv:2202.07896) pipeline scheduler
+phases so accelerator and host work overlap and decision latency stays
+inside the cluster's offer cadence; this module is that structure for the
+multi-pool match pass:
+
+    pool k:    prepare ----> dispatch . . . . [device solves] . . fetch -> finalize
+    pool k+1:               prepare -> dispatch . . [device] . . . . fetch -> ...
+                 ^ host                  ^ overlaps pool k's solve
+
+  * `dispatch_pool_solve` starts pool k's kernel asynchronously (JAX's
+    async dispatch — no inline `fetch_result`), then the host runs pool
+    k+1's `prepare_pool_problem` and pool k-1's `finalize_pool_match`
+    while the device executes;
+  * a double-buffered stage queue bounds in-flight solves (depth 2 by
+    default: one solving, one just dispatched), so device memory holds at
+    most `depth` pools' problems;
+  * the ORDERING RULE: store transactions commit in pool order — stages
+    drain FIFO, so pool k's `finalize_pool_match` (where create_instance
+    transacts) always completes before pool k+1's begins;
+  * the per-cluster `launch_tasks` fan-out runs on each cluster's bounded
+    launch executor (ComputeCluster.launch_tasks_async) with the
+    kill-lock read side held by the worker, so backend RPC latency leaves
+    the cycle's critical path while kills still exclude mid-launch;
+    launch failures flow back into the store's state machine
+    (task -> failed, `launch-failed` reason) — never swallowed by the
+    async boundary;
+  * a solve raising for pool k surfaces at ITS fetch: the pool's jobs are
+    skipped with `solve-failed` and pools k±1 proceed untouched.
+
+Overlap accounting: each participating CycleRecord keeps per-phase times
+with the serial path's semantics (solve = dispatch -> fetch-complete
+interval), plus the shared pass wall and the device/host overlap
+fraction (summed phase time beyond the wall), visible at
+`GET /debug/cycles` — see docs/observability.md.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from cook_tpu.cluster.base import ComputeCluster
+from cook_tpu.models.entities import Job, Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler import flight_recorder as flight_codes
+from cook_tpu.scheduler.flight_recorder import NULL_CYCLE
+from cook_tpu.scheduler.matcher import (
+    MatchConfig,
+    MatchOutcome,
+    PoolMatchState,
+    dispatch_pool_solve,
+    fail_launched_specs,
+    finalize_pool_match,
+    prepare_pool_problem,
+    record_solve_outcome,
+)
+from cook_tpu.scheduler.ranking import RankedQueue
+
+log = logging.getLogger(__name__)
+
+# the phases whose summed time the overlap accounting compares against
+# the pass wall (rank/preemption_search run outside the pipelined pass).
+# The four walls are DISJOINT per pool: the solve interval starts where
+# the dispatch phase ends, so nothing is double-counted and a pass that
+# degenerated to serial genuinely reports overlap 0
+PIPELINE_PHASES = ("tensor_build", "dispatch", "solve", "launch")
+
+
+@dataclass
+class PipelineParams:
+    """Knobs of the pipelined pass."""
+
+    # max in-flight solves (double-buffered by default: one pool solving
+    # while the next is being prepared/dispatched)
+    depth: int = 2
+    # fan launches out via each cluster's launch executor instead of
+    # blocking the cycle on backend RPCs
+    async_launch: bool = True
+    # wait for every async launch batch before the pass returns — the
+    # per-pool overlap is already banked; draining at the END keeps the
+    # cycle's externally visible semantics identical to the serial path
+    # (callers observe launched tasks in the store).  False = launches
+    # may still be in flight when the pass returns.
+    drain_launches: bool = True
+    drain_timeout_s: float = 30.0
+
+
+@dataclass
+class _Stage:
+    pool: Pool
+    prepared: object
+    state: PoolMatchState
+    flight: object
+    pending: object = None          # PendingResult or None
+    t_dispatch: float = 0.0
+
+
+def match_pools_pipelined(
+    store: JobStore,
+    pools: Sequence[Pool],
+    queues: dict[str, RankedQueue],
+    clusters: Sequence[ComputeCluster],
+    config: MatchConfig,
+    states: dict[str, PoolMatchState],
+    *,
+    make_task_id: Callable[[Job], str],
+    launch_filter: Optional[Callable[[Job], bool]] = None,
+    record_placement_failure: Optional[Callable[[Job, str], None]] = None,
+    host_reservations: Optional[dict[str, str]] = None,
+    host_attrs: Optional[dict[str, dict]] = None,
+    flights: Optional[dict] = None,
+    telemetry=None,
+    encode_cache=None,
+    recorder=None,
+    params: Optional[PipelineParams] = None,
+) -> dict[str, MatchOutcome]:
+    """Run every pool's match cycle through the pipelined engine.
+
+    Same decision semantics as looping `matcher.match_pool` over the
+    pools (the parity test pins this); only the schedule differs.
+    """
+    params = params or PipelineParams()
+    flights = flights or {}
+    outcomes: dict[str, MatchOutcome] = {}
+
+    def pool_flight(pool_name: str):
+        return flights.get(pool_name, NULL_CYCLE)
+
+    for f in flights.values():
+        if f.record is not None:
+            f.record.pipelined = True
+
+    def launch_failure_cb_for(flight):
+        # the callback runs on a cluster launch-worker thread and can
+        # land before OR after the cycle record commits — record + index
+        # writes go through the recorder lock, never the builder
+        record = flight.record
+
+        def cb(specs, exc):
+            def note(job_uuid, detail):
+                if recorder is not None:
+                    recorder.note_async_launch_failure(
+                        record, job_uuid, flight_codes.LAUNCH_FAILED,
+                        detail)
+            fail_launched_specs(store, specs, exc, note_reason=note)
+        return cb
+
+    def finish(stage: _Stage) -> None:
+        """Fetch + finalize one pool.  Called strictly in pool order."""
+        flight = stage.flight
+        assignment = np.empty(0, dtype=np.int32)
+        if stage.pending is not None:
+            solve_failed = False
+            t_fetch = time.perf_counter()
+            try:
+                assignment = stage.pending.fetch()
+            except Exception:  # noqa: BLE001 — pool k's kernel raising
+                # (deferred device error surfaces at fetch) must not
+                # wedge pools k±1; its jobs simply wait a cycle
+                log.exception("pipelined solve failed (pool %s)",
+                              stage.pool.name)
+                solve_failed = True
+            t_end = time.perf_counter()
+            # solve phase wall = dispatch-end -> fetch-complete; under
+            # overlap it also spans the host work interleaved between
+            # dispatch and fetch, which is exactly what the overlap
+            # fraction quantifies.  Only the blocking fetch WAIT is
+            # device-attributed: the overlapped span is not accelerator
+            # time, and crediting it would inflate cycle.device_seconds
+            # the moment the pipeline turns on (the un-overlapped device
+            # execution is covered by the wait; fully hidden device time
+            # is the pipeline working as designed)
+            wait_s = t_end - t_fetch
+            solve_s = t_end - stage.t_dispatch
+            flight.add_phase("solve", wait_s, device=True)
+            if solve_s > wait_s:
+                flight.add_phase("solve", solve_s - wait_s, device=False)
+            if solve_failed:
+                outcome = stage.prepared.outcome
+                outcome.unmatched = list(stage.prepared.considerable)
+                outcome.head_matched = False
+                for job in stage.prepared.considerable:
+                    flight.note_skip(job.uuid, flight_codes.SOLVE_FAILED)
+                    if record_placement_failure is not None:
+                        record_placement_failure(
+                            job, flight_codes.REASON_TEXT[
+                                flight_codes.SOLVE_FAILED])
+                from cook_tpu.scheduler.matcher import _apply_backoff
+
+                _apply_backoff(config, stage.state, False)
+                outcomes[stage.pool.name] = outcome
+                return
+            record_solve_outcome(stage.prepared, assignment, config,
+                                 stage.state, stage.pool.name, solve_s,
+                                 flight, telemetry, overlapped=True)
+        with flight.phase("launch"):
+            outcomes[stage.pool.name] = finalize_pool_match(
+                store, stage.prepared, assignment, config, stage.state,
+                clusters,
+                make_task_id=make_task_id,
+                record_placement_failure=record_placement_failure,
+                flight=flight,
+                async_launch=params.async_launch,
+                launch_failure_cb=(launch_failure_cb_for(flight)
+                                   if params.async_launch else None),
+            )
+
+    t_pass = time.perf_counter()
+    inflight: collections.deque[_Stage] = collections.deque()
+    depth = max(1, params.depth)
+    for pool in pools:
+        flight = pool_flight(pool.name)
+        state = states[pool.name]
+        with flight.phase("tensor_build"):
+            prepared = prepare_pool_problem(
+                store, pool, queues[pool.name], clusters, config, state,
+                launch_filter=launch_filter,
+                host_reservations=host_reservations,
+                host_attrs=host_attrs, flight=flight,
+                encode_cache=encode_cache,
+            )
+        stage = _Stage(pool=pool, prepared=prepared, state=state,
+                       flight=flight)
+        if prepared.solvable:
+            with flight.phase("dispatch"):
+                try:
+                    stage.pending = dispatch_pool_solve(prepared, config)
+                except Exception:  # noqa: BLE001 — a dispatch-time raise
+                    # (tracing/compile error) is this pool's solve failing
+                    # eagerly; mark it failed at finish() like a deferred
+                    # device error
+                    log.exception("pipelined dispatch failed (pool %s)",
+                                  pool.name)
+                    stage.pending = _FailedDispatch()
+            # the solve interval starts where the dispatch phase ends —
+            # disjoint walls, so phase sums never double-count
+            stage.t_dispatch = time.perf_counter()
+        inflight.append(stage)
+        # the double-buffered stage queue: once `depth` solves are in
+        # flight, the oldest pool's fetch+finalize runs NOW — its device
+        # wait overlaps the pool just prepared/dispatched, and the FIFO
+        # drain keeps transactions committing in pool order.  Unsolvable
+        # pools (nothing dispatched) finalize as soon as they reach the
+        # head; they never hold a buffer slot
+        while inflight and (
+                inflight[0].pending is None
+                or sum(1 for s in inflight if s.pending is not None)
+                >= depth):
+            finish(inflight.popleft())
+    while inflight:
+        finish(inflight.popleft())
+
+    if params.async_launch and params.drain_launches:
+        from cook_tpu.cluster.base import wait_all_launches
+
+        for cluster in wait_all_launches(clusters,
+                                         timeout=params.drain_timeout_s):
+            log.warning("pipelined pass: cluster %s still has launches "
+                        "in flight after %.0fs drain timeout",
+                        cluster.name, params.drain_timeout_s)
+
+    # ------------------------------------------------ overlap accounting
+    wall_s = time.perf_counter() - t_pass
+    summed = 0.0
+    for pool in pools:
+        record = pool_flight(pool.name).record
+        if record is None:
+            continue
+        summed += sum(record.phases.get(name, 0.0)
+                      for name in PIPELINE_PHASES)
+    overlap_s = max(0.0, summed - wall_s)
+    overlap_fraction = overlap_s / summed if summed > 0 else 0.0
+    for pool in pools:
+        record = pool_flight(pool.name).record
+        if record is None:
+            continue
+        record.pipeline_wall_s = wall_s
+        record.overlap_s = overlap_s
+        record.overlap_fraction = overlap_fraction
+    return outcomes
+
+
+class _FailedDispatch:
+    """Stand-in pending result for a solve that raised at dispatch time:
+    fetch() re-raises so finish() takes the one solve-failed path."""
+
+    def fetch(self):
+        raise RuntimeError("solve dispatch failed (see log)")

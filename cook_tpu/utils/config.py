@@ -103,6 +103,10 @@ class Settings:
     # one batched device call for all pools per match tick instead of
     # round-robin one-pool-per-tick (docs/tpu-design.md pool sharding)
     batched_match: bool = False
+    # pipelined multi-pool match pass (scheduler/pipeline.py): overlap
+    # host encode/launch with the device solve; takes precedence over
+    # batched_match when both are set
+    pipelined_match: bool = False
     leader_lease_path: str = ""
     # networked election (control/lease_server.py — the ZK role): takes
     # precedence over leader_lease_path when set
@@ -203,7 +207,7 @@ def read_config(path: Optional[str] = None,
                 "replication_sync_ack", "replication_min_acks",
                 "replication_ack_timeout_s", "replication_ack_liveness_s",
                 "data_dir", "snapshot_interval_s", "platform",
-                "batched_match", "elastic_interval_s",
+                "batched_match", "pipelined_match", "elastic_interval_s",
                 "queue_limit_per_pool",
                 "queue_limit_per_user", "submission_rate_per_minute"):
         if key in data:

@@ -27,7 +27,9 @@ def smoke_record(tmp_path_factory):
     kernels once)."""
     bench = _load_bench()
     out = tmp_path_factory.mktemp("bench") / "BENCH_rsmoke.json"
-    record = bench.smoke_main(out=str(out))
+    # pipeline=False: the pipelined-vs-serial tier costs ~45 s and has
+    # its own functional coverage in tests/test_pipeline.py
+    record = bench.smoke_main(out=str(out), pipeline=False)
     return record, out, bench
 
 
@@ -89,6 +91,17 @@ class TestBenchGate:
         old = make_record(tmp_path / "a.json", match=20.0)
         new = make_record(tmp_path / "b.json", match=5.0)
         assert bench_gate.main([old, new]) == 0
+
+    def test_tiny_phase_jitter_inside_min_delta_passes(self, tmp_path,
+                                                       capsys):
+        # +50% on a 2 ms phase is inside OS scheduler jitter on a loaded
+        # box; the absolute --min-delta-ms floor keeps it from flapping
+        old = make_record(tmp_path / "a.json", dru=2.0)
+        new = make_record(tmp_path / "b.json", dru=3.0)
+        assert bench_gate.main([old, new]) == 0
+        assert "within min-delta" in capsys.readouterr().out
+        # but an explicit zero floor restores the pure relative gate
+        assert bench_gate.main([old, new, "--min-delta-ms", "0"]) == 1
 
     def test_platform_mismatch_not_compared(self, tmp_path, capsys):
         # a CPU-fallback round must not "regress" against a TPU round

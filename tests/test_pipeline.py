@@ -1,0 +1,431 @@
+"""Pipelined match cycle invariants (scheduler/pipeline.py): decision
+parity with the serial path, transactions committing in pool order under
+overlap, solve/launch failure isolation, the kill-lock honored across
+async launches, encode-cache invalidation, and the batched path's
+pool-axis padding keeping one XLA program across pool counts."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import (
+    InstanceStatus,
+    JobState,
+    Pool,
+    Quota,
+    Resources,
+)
+from cook_tpu.models.reasons import REASONS_BY_NAME
+from cook_tpu.models.store import JobStore
+from cook_tpu.ops.common import PendingResult
+from cook_tpu.scheduler import flight_recorder as flight_codes
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.scheduler.encode_cache import EncodeCache
+from cook_tpu.scheduler.matcher import MatchConfig
+from tests.conftest import FakeClock, make_job
+
+
+def setup_multi(n_pools=4, hosts_per_pool=3, jobs_per_pool=5, chunk=0,
+                cluster_cls=MockCluster, **config_kw):
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    hosts = []
+    for p in range(n_pools):
+        store.set_pool(Pool(name=f"pool{p}"))
+        for i in range(hosts_per_pool):
+            hosts.append(MockHost(node_id=f"p{p}h{i}", hostname=f"p{p}h{i}",
+                                  mem=4000, cpus=8, pool=f"pool{p}"))
+    cluster = cluster_cls("mock", hosts, clock=clock)
+    scheduler = Scheduler(
+        store, [cluster],
+        SchedulerConfig(match=MatchConfig(chunk=chunk), **config_kw))
+    jobs = []
+    for p in range(n_pools):
+        for i in range(jobs_per_pool):
+            job = make_job(user=f"u{i % 3}", pool=f"pool{p}",
+                           mem=100 * (i % 4 + 1), cpus=1)
+            jobs.append(job.with_(uuid=f"job-{p}-{i}"))
+    store.submit_jobs(jobs)
+    return clock, store, cluster, scheduler, jobs
+
+
+# ------------------------------------------------------------- the engine
+
+
+def test_pipelined_matches_all_pools():
+    _, store, _, scheduler, jobs = setup_multi()
+    outcomes = scheduler.match_cycle_pipelined()
+    assert set(outcomes) == {f"pool{p}" for p in range(4)}
+    assert sum(len(o.matched) for o in outcomes.values()) == len(jobs)
+    for job in jobs:
+        # drain_launches is on by default: backend effects are visible
+        # when the pass returns, like the serial path
+        assert store.jobs[job.uuid].state == JobState.RUNNING
+        [inst] = store.job_instances(job.uuid)
+        assert inst.hostname.startswith(f"p{job.pool[-1]}")
+
+
+def test_pipelined_equals_serial_decisions():
+    _, s1, _, sched1, _ = setup_multi()
+    _, s2, _, sched2, _ = setup_multi()
+    pipelined = sched1.match_cycle_pipelined()
+    serial = {p.name: sched2.match_cycle(p) for p in s2.pools.values()}
+    for name in pipelined:
+        a = {(j.uuid, o.hostname) for j, o in pipelined[name].matched}
+        b = {(j.uuid, o.hostname) for j, o in serial[name].matched}
+        assert a == b
+
+
+def test_transactions_commit_in_pool_order():
+    _, store, _, scheduler, _ = setup_multi(n_pools=4)
+    created_pools = []
+    store.add_watcher(
+        lambda e: created_pools.append(store.jobs[e.data["job"]].pool)
+        if e.kind == "instance/created" else None)
+    scheduler.match_cycle_pipelined()
+    assert created_pools, "no launch transactions observed"
+    # pool k's create transactions all land before pool k+1's first one
+    assert created_pools == sorted(created_pools)
+
+
+def test_overlap_accounting_fields():
+    _, store, _, scheduler, _ = setup_multi()
+    scheduler.match_cycle_pipelined()
+    records = scheduler.recorder.records_json(limit=4)
+    assert len(records) == 4
+    for r in records:
+        assert r["pipelined"] is True
+        assert r["pipeline_wall_s"] > 0
+        assert 0.0 <= r["overlap_fraction"] < 1.0
+        assert "dispatch" in r["phases"] and "solve" in r["phases"]
+        # every record of the pass shares the pass-level accounting
+        assert r["pipeline_wall_s"] == records[0]["pipeline_wall_s"]
+    # summed per-pool phase time can only exceed the wall by the overlap
+    summed = sum(r["device_s"] + r["host_s"] for r in records)
+    assert records[0]["overlap_s"] <= summed
+
+
+def test_solve_failure_does_not_wedge_neighbor_pools(monkeypatch):
+    _, store, _, scheduler, jobs = setup_multi(n_pools=3)
+    from cook_tpu.scheduler import pipeline as pipeline_mod
+
+    real_dispatch = pipeline_mod.dispatch_pool_solve
+
+    class Boom:
+        def fetch(self):
+            raise RuntimeError("injected device error")
+
+    def dispatch(prepared, config):
+        if prepared.pool.name == "pool1":
+            return Boom()
+        return real_dispatch(prepared, config)
+
+    monkeypatch.setattr(pipeline_mod, "dispatch_pool_solve", dispatch)
+    outcomes = scheduler.match_cycle_pipelined()
+    # pools 0 and 2 matched normally
+    for p in (0, 2):
+        assert len(outcomes[f"pool{p}"].matched) == 5
+    # pool1's jobs wait a cycle with the solve-failed reason
+    assert outcomes["pool1"].matched == []
+    assert len(outcomes["pool1"].unmatched) == 5
+    for job in jobs:
+        if job.pool == "pool1":
+            assert store.jobs[job.uuid].state == JobState.WAITING
+            cycle_id, code, _ = scheduler.recorder.job_reason(job.uuid)
+            assert code == flight_codes.SOLVE_FAILED
+
+
+# --------------------------------------------------------- launch fan-out
+
+
+class FailingCluster(MockCluster):
+    """launch_tasks raises mid fan-out (backend RPC failure)."""
+
+    def launch_tasks(self, pool, specs):
+        raise ConnectionError("backend unreachable")
+
+
+def test_async_launch_failure_flows_to_store():
+    _, store, _, scheduler, jobs = setup_multi(n_pools=2,
+                                               cluster_cls=FailingCluster)
+    scheduler.match_cycle_pipelined()
+    assert scheduler.drain_launches(timeout=10)
+    expected_code = REASONS_BY_NAME["launch-failed"].code
+    for job in jobs:
+        live = store.jobs[job.uuid]
+        # launch-failed is mea-culpa: the instance failed, the job
+        # re-queues without consuming its retry budget
+        assert live.state == JobState.WAITING
+        [inst] = store.job_instances(job.uuid)
+        assert inst.status == InstanceStatus.FAILED
+        assert inst.reason_code == expected_code
+        _, code, _ = scheduler.recorder.job_reason(job.uuid)
+        assert code == flight_codes.LAUNCH_FAILED
+
+
+def test_serial_launch_failure_caught_per_cluster():
+    """A raising cluster fails ITS specs with launch-failed and the other
+    clusters' launches still happen (the historic behavior aborted the
+    remaining clusters and left transacted tasks dangling)."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    bad = FailingCluster(
+        "bad", [MockHost(node_id="b0", hostname="b0", mem=4000, cpus=8)],
+        clock=clock)
+    good = MockCluster(
+        "good", [MockHost(node_id="g0", hostname="g0", mem=4000, cpus=8)],
+        clock=clock)
+    scheduler = Scheduler(store, [bad, good], SchedulerConfig())
+    jobs = [make_job(user="a", mem=3000, cpus=6),   # fills one host
+            make_job(user="b", mem=3000, cpus=6)]
+    store.submit_jobs(jobs)
+    outcome = scheduler.match_cycle(store.pools["default"])
+    assert len(outcome.matched) == 2
+    by_host = {inst.hostname: inst
+               for job in jobs for inst in store.job_instances(job.uuid)}
+    assert by_host["b0"].status == InstanceStatus.FAILED
+    assert by_host["b0"].reason_code == REASONS_BY_NAME["launch-failed"].code
+    assert by_host["g0"].status == InstanceStatus.RUNNING
+
+
+class SlowCluster(MockCluster):
+    """Instrumented backend: records whether a kill ever interleaved a
+    mid-flight launch (the kill-lock must make that impossible)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.in_launch = False
+        self.kill_during_launch = False
+
+    def launch_tasks(self, pool, specs):
+        self.in_launch = True
+        time.sleep(0.3)
+        super().launch_tasks(pool, specs)
+        self.in_launch = False
+
+    def kill_task(self, task_id):
+        self.kill_during_launch |= self.in_launch
+        super().kill_task(task_id)
+
+
+def test_async_launch_completion_races_kill():
+    clock = FakeClock()
+    cluster = SlowCluster(
+        "slow", [MockHost(node_id="h0", hostname="h0", mem=4000, cpus=8)],
+        clock=clock)
+    from cook_tpu.cluster.base import TaskSpec
+
+    spec = TaskSpec(task_id="t-1", job_uuid="j-1", user="u", command="true",
+                    mem=100, cpus=1, gpus=0, node_id="h0", hostname="h0")
+    cluster.launch_tasks_async("default", [spec])
+    # let the worker enter launch_tasks, then race a kill against it
+    deadline = time.time() + 5
+    while not cluster.in_launch and time.time() < deadline:
+        time.sleep(0.005)
+    assert cluster.in_launch
+    t0 = time.perf_counter()
+    cluster.safe_kill_task("t-1")
+    waited = time.perf_counter() - t0
+    assert cluster.wait_launches(timeout=5)
+    assert not cluster.kill_during_launch
+    # the kill blocked on the kill-lock until the launch finished
+    assert waited > 0.05
+    assert "t-1" not in cluster.running
+
+
+def test_kill_racing_queued_launch_batch_is_not_resurrected():
+    """The kill-lock only excludes kills during the backend call itself;
+    a kill landing while the batch still sits in the async launch queue
+    must not be undone when the batch finally runs."""
+    clock = FakeClock()
+    cluster = SlowCluster(
+        "slow", [MockHost(node_id="h0", hostname="h0", mem=4000, cpus=8)],
+        clock=clock)
+    from cook_tpu.cluster.base import TaskSpec
+
+    def spec(n):
+        return TaskSpec(task_id=f"t-{n}", job_uuid=f"j-{n}", user="u",
+                        command="true", mem=100, cpus=1, gpus=0,
+                        node_id="h0", hostname="h0")
+
+    cluster.launch_tasks_async("default", [spec(1)])   # occupies the worker
+    cluster.launch_tasks_async("default", [spec(2)])   # sits in the queue
+    deadline = time.time() + 5
+    while not cluster.in_launch and time.time() < deadline:
+        time.sleep(0.005)
+    cluster.safe_kill_task("t-2")                      # races the queued batch
+    assert cluster.wait_launches(timeout=5)
+    assert "t-1" in cluster.running
+    assert "t-2" not in cluster.running                # not resurrected
+
+
+def test_launch_executor_completion_tracking():
+    clock = FakeClock()
+    cluster = SlowCluster(
+        "slow", [MockHost(node_id="h0", hostname="h0", mem=4000, cpus=8)],
+        clock=clock)
+    from cook_tpu.cluster.base import TaskSpec
+
+    spec = TaskSpec(task_id="t-2", job_uuid="j-2", user="u", command="true",
+                    mem=100, cpus=1, gpus=0, node_id="h0", hostname="h0")
+    cluster.launch_tasks_async("default", [spec])
+    assert cluster.pending_launches() >= 1
+    assert cluster.wait_launches(timeout=5)
+    assert cluster.pending_launches() == 0
+    assert "t-2" in cluster.running
+
+
+# ---------------------------------------------------------- encode cache
+
+
+def one_pool_store(n_hosts=3, n_jobs=4):
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    hosts = [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=8)
+             for i in range(n_hosts)]
+    cluster = MockCluster("mock", hosts, clock=clock)
+    jobs = [make_job(user="a", mem=50_000, cpus=1) for _ in range(n_jobs)]
+    store.submit_jobs(jobs)  # too big to match: stay considerable forever
+    return clock, store, cluster, jobs
+
+
+def prepare_once(store, cluster, cache):
+    from cook_tpu.scheduler.matcher import (
+        PoolMatchState,
+        prepare_pool_problem,
+    )
+    from cook_tpu.scheduler.ranking import rank_pool
+
+    pool = store.pools["default"]
+    queue = rank_pool(store, pool)
+    state = PoolMatchState(num_considerable=1000)
+    return prepare_pool_problem(store, pool, queue, [cluster], MatchConfig(),
+                                state, encode_cache=cache)
+
+
+def test_encode_cache_rows_reused_and_correct():
+    _, store, cluster, jobs = one_pool_store()
+    cache = EncodeCache(store)
+    p1 = prepare_once(store, cluster, cache)
+    assert set(cache._pools["default"].rows) == {j.uuid for j in jobs}
+    p2 = prepare_once(store, cluster, cache)
+    np.testing.assert_array_equal(p1.feasible, p2.feasible)
+    # cached rows match a cold (cache-less) encode exactly
+    p3 = prepare_once(store, cluster, None)
+    np.testing.assert_array_equal(p2.feasible, p3.feasible)
+
+
+def test_encode_cache_invalidates_on_job_kill():
+    _, store, cluster, jobs = one_pool_store()
+    cache = EncodeCache(store)
+    prepare_once(store, cluster, cache)
+    victim = jobs[0]
+    store.kill_jobs([victim.uuid])
+    assert victim.uuid not in cache._pools["default"].rows
+
+
+def test_encode_cache_invalidates_on_offer_rescind():
+    _, store, cluster, _ = one_pool_store()
+    cache = EncodeCache(store)
+    p1 = prepare_once(store, cluster, cache)
+    fp1 = cache._pools["default"].nodes_fp
+    cluster.remove_host("h2")
+    p2 = prepare_once(store, cluster, cache)
+    assert cache._pools["default"].nodes_fp != fp1
+    assert p2.feasible.shape[1] == p1.feasible.shape[1] - 1
+    # rows re-encoded against the new node set
+    parity = prepare_once(store, cluster, None)
+    np.testing.assert_array_equal(p2.feasible, parity.feasible)
+
+
+def test_encode_cache_vetoes_row_cached_during_invalidation():
+    """An event dropping a job's rows WHILE its row is being recomputed
+    (the compute read the store before the event) must veto that row's
+    write-back — otherwise the stale row is served until the next
+    event."""
+    _, store, cluster, jobs = one_pool_store()
+    cache = EncodeCache(store)
+    from cook_tpu.scheduler.constraints import encode_nodes
+
+    offers = [(cluster, o) for o in cluster.pending_offers("default")]
+    nodes, fp = cache.encoded_nodes("default", offers)
+    victim = jobs[0]
+
+    def compute(subset, pre_rows):
+        # the invalidating event lands mid-compute
+        cache._on_event(type("E", (), {
+            "kind": "instance/status",
+            "data": {"job": victim.uuid}})())
+        return np.ones((len(subset), nodes.n), dtype=bool)
+
+    cache.feasibility("default", jobs, nodes.n, fp, compute)
+    rows = cache._pools["default"].rows
+    assert victim.uuid not in rows
+    assert all(j.uuid in rows for j in jobs[1:])
+    # the next cycle recomputes and re-caches the victim's row normally
+    cache.feasibility("default", jobs, nodes.n, fp,
+                      lambda subset, pre: np.ones((len(subset), nodes.n),
+                                                  dtype=bool))
+    assert victim.uuid in rows
+
+
+def test_encode_cache_invalidates_on_quota_change():
+    _, store, cluster, _ = one_pool_store()
+    cache = EncodeCache(store)
+    prepare_once(store, cluster, cache)
+    epoch = cache.epoch
+    # a generous quota still admits the jobs — the point is the EVENT
+    # conservatively invalidates, not that the jobs stop being considered
+    store.set_quota(Quota(user="a", pool="default",
+                          resources=Resources(mem=1e9, cpus=1e9, gpus=1e9)))
+    assert cache.epoch > epoch
+    # stale-epoch rows are not served: the next prepare recomputes them
+    entry = cache._pools["default"]
+    stale = {uuid: tag for uuid, (tag, _) in entry.rows.items()}
+    prepare_once(store, cluster, cache)
+    for uuid, (tag, _) in entry.rows.items():
+        assert tag == cache.epoch, f"row {uuid} kept stale epoch {stale}"
+
+
+# ------------------------------------------------- batched pool-axis pad
+
+
+def test_batched_mesh_pads_any_pool_count():
+    """The sharded batched path engages for pool counts that don't divide
+    the mesh size, and the padded batch keeps ONE XLA program across pool
+    counts (CompileObservatory-inducing, same pattern as ops/elastic)."""
+    from cook_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()  # 8 virtual cpu devices
+    telemetry = None
+    for n_pools in (3, 5, 8):
+        clock = FakeClock()
+        store = JobStore(clock=clock)
+        hosts = []
+        for p in range(n_pools):
+            store.set_pool(Pool(name=f"pool{p}"))
+            for i in range(3):
+                hosts.append(MockHost(node_id=f"p{p}h{i}",
+                                      hostname=f"p{p}h{i}",
+                                      mem=4000, cpus=8, pool=f"pool{p}"))
+        cluster = MockCluster("mock", hosts, clock=clock)
+        scheduler = Scheduler(store, [cluster], SchedulerConfig())
+        if telemetry is None:
+            telemetry = scheduler.telemetry
+        else:
+            scheduler.telemetry = telemetry  # shared compile observatory
+        jobs = []
+        for p in range(n_pools):
+            for i in range(4):
+                jobs.append(make_job(user=f"u{i % 2}", pool=f"pool{p}",
+                                     mem=500, cpus=1))
+        store.submit_jobs(jobs)
+        outcomes = scheduler.match_cycle_all_pools(mesh=mesh)
+        assert sum(len(o.matched) for o in outcomes.values()) == len(jobs)
+    stats = telemetry.observatory.stats()
+    # 3, 5, and 8 pools all padded to one 8-pool program
+    assert stats["match_batched"]["programs"] == 1

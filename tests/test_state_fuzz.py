@@ -52,6 +52,7 @@ def test_store_fuzz():
         live = [t for t, i in store.instances.items() if not i.status.terminal]
         return live[rng.integers(len(live))] if live else None
 
+    states_seen: set = set()
     for step in range(4000):
         op = rng.integers(0, 100)
         try:
@@ -91,8 +92,13 @@ def test_store_fuzz():
             pass  # rejected ops are fine; invariants must still hold
         if step % 200 == 0:
             check_invariants(store)
+            states_seen.update(j.state for j in store.jobs.values())
     check_invariants(store)
-    # sanity: the fuzz actually exercised all op kinds
+    states_seen.update(j.state for j in store.jobs.values())
+    # sanity: the fuzz actually exercised all op kinds.  Checked over the
+    # whole run, not the final snapshot — whether any job happens to be
+    # RUNNING at step 4000 exactly depends on the rng trajectory, which
+    # shifts whenever the reason registry grows a code
     assert len(job_ids) > 100
-    assert any(j.state == JobState.COMPLETED for j in store.jobs.values())
-    assert any(j.state == JobState.RUNNING for j in store.jobs.values())
+    assert JobState.COMPLETED in states_seen
+    assert JobState.RUNNING in states_seen

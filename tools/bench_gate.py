@@ -12,11 +12,15 @@ tier.  This gate:
      (a CPU-fallback round must not "regress" against a real-TPU round);
   3. compares each phase's p50_ms in the newest record against the
      previous comparable one; any phase slower by more than --threshold
-     (default 20%) fails the gate.
+     (default 20%) AND by more than --min-delta-ms (default 2 ms,
+     absolute) fails the gate — the absolute floor keeps sub-10 ms
+     phases, whose 20% band sits inside OS scheduler jitter on a loaded
+     box, from flapping the gate.
 
 Exit codes: 0 pass / nothing to compare, 1 regression, 2 usage error.
 
-    python tools/bench_gate.py [--dir ROOT] [--threshold 0.2] [files...]
+    python tools/bench_gate.py [--dir ROOT] [--threshold 0.2]
+                               [--min-delta-ms 2.0] [files...]
 """
 from __future__ import annotations
 
@@ -71,7 +75,8 @@ def collect_records(paths: list[str]) -> list[dict]:
     return records
 
 
-def gate(records: list[dict], threshold: float) -> tuple[int, list[str]]:
+def gate(records: list[dict], threshold: float,
+         min_delta_ms: float = 2.0) -> tuple[int, list[str]]:
     """(exit_code, messages).  Records are grouped by (mode, platform) —
     a CPU-fallback round must not "regress" against a real-TPU round,
     and the singleton smoke record must not shadow the full-round family
@@ -99,11 +104,17 @@ def gate(records: list[dict], threshold: float) -> tuple[int, list[str]]:
             if before <= 0:
                 continue
             delta = (after - before) / before
-            status = "REGRESSION" if delta > threshold else "ok"
+            # both bounds must trip: the relative band alone would flap
+            # on sub-10 ms phases whose 20% is inside scheduler jitter
+            regressed = (delta > threshold
+                         and after - before > min_delta_ms)
+            status = ("REGRESSION" if regressed
+                      else "ok (within min-delta)"
+                      if delta > threshold else "ok")
             messages.append(
                 f"bench_gate:   {phase}: {before:.2f} ms -> {after:.2f} ms "
                 f"({delta:+.1%}) {status}")
-            if delta > threshold:
+            if regressed:
                 regressions.append(phase)
         dropped = sorted(set(old["phases"]) - set(new["phases"]))
         if dropped:
@@ -137,14 +148,22 @@ def main(argv: list[str] | None = None) -> int:
         os.path.dirname(os.path.abspath(__file__))))
     parser.add_argument("--threshold", type=float, default=0.2,
                         help="max tolerated relative slowdown (0.2 = 20%%)")
+    parser.add_argument("--min-delta-ms", type=float, default=2.0,
+                        help="absolute slowdown below this never counts "
+                             "as a regression (jitter floor for tiny "
+                             "phases)")
     args = parser.parse_args(argv)
     if args.threshold <= 0:
         print("bench_gate: --threshold must be positive", file=sys.stderr)
         return 2
+    if args.min_delta_ms < 0:
+        print("bench_gate: --min-delta-ms must be >= 0", file=sys.stderr)
+        return 2
     paths = args.files or sorted(
         glob.glob(os.path.join(args.dir, "BENCH_r*.json")),
         key=lambda p: (_round_key(p), os.path.getmtime(p)))
-    code, messages = gate(collect_records(paths), args.threshold)
+    code, messages = gate(collect_records(paths), args.threshold,
+                          args.min_delta_ms)
     for message in messages:
         print(message)
     return code

@@ -7,8 +7,10 @@ gate — each with its own invocation and exit-code convention.  This
 wrapper runs them as one pipeline with one verdict:
 
   1. `tools/lint_metrics.py`   — metric/span registration lint;
-  2. `python bench.py --smoke` — the tiny three-solve bench tier
-     (writes BENCH_rsmoke.json, rotating the previous record to
+  2. `python bench.py --smoke` — the tiny bench tier:
+     match/dru/rebalance/elastic solves plus the pipelined-vs-serial
+     match-cycle comparison, included by default (writes
+     BENCH_rsmoke.json, rotating the previous record to
      BENCH_rsmoke_prev.json so step 3 has a pair to diff);
   3. `tools/bench_gate.py`     — phase-by-phase regression gate over
      the latest comparable record pair.
@@ -41,7 +43,9 @@ def run_lint(root: str) -> int:
 def run_smoke_bench(root: str) -> int:
     """Smoke bench in a SUBPROCESS: bench.py initializes jax, and a
     wedged accelerator plugin must kill the step's budget, not this
-    process (the same isolation bench.py's own probe uses)."""
+    process (the same isolation bench.py's own probe uses).  The smoke
+    tier includes the pipelined-vs-serial match-cycle phases by default,
+    so bench_gate diffs pipeline-on vs pipeline-off walls run to run."""
     proc = subprocess.run(
         [sys.executable, os.path.join(root, "bench.py"), "--smoke"],
         cwd=root,
