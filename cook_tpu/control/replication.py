@@ -35,8 +35,14 @@ from typing import Callable, Optional
 
 from cook_tpu.models import persistence
 from cook_tpu.models.store import JobStore
+from cook_tpu.utils.metrics import global_registry
 
 log = logging.getLogger(__name__)
+
+# follower-side apply walls: a batch is normally sub-ms, but a snapshot-
+# sized backlog page can take seconds
+_APPLY_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                  30.0, float("inf"))
 
 
 class JournalFollower:
@@ -196,6 +202,8 @@ class JournalFollower:
         return self.journal is not None or bool(self.data_dir)
 
     def _apply(self, events: list[dict]) -> int:
+        import time as _time
+
         # live mode: each entry becomes an ordinary committed event on our
         # store — retained in the event window and fanned out to watchers
         # (columnar index, attached journal writer, passport), so the
@@ -203,9 +211,18 @@ class JournalFollower:
         # promotion needs no rebuild.  Journal persistence rides the
         # watcher fan-out (persistence.attach_journal), same as a local
         # transaction.
+        t0 = _time.perf_counter()
         with self.store._lock:
             applied = persistence.apply_journal(self.store, events,
                                                 live=True)
+        global_registry.histogram(
+            "replication.apply_seconds",
+            "follower wall seconds applying one replicated event batch",
+            buckets=_APPLY_BUCKETS).observe(_time.perf_counter() - t0)
+        global_registry.counter(
+            "replication.events_applied",
+            "events this follower applied from the leader's feed").inc(
+            applied)
         for e in reversed(events):
             if e.get("kind") == "txn/committed":
                 txn_id = (e.get("data") or {}).get("txn_id")
@@ -225,6 +242,9 @@ class JournalFollower:
         # supersedes; carrying it into the next ack would misattribute
         # which txn the ack makes durable
         self.last_txn_id = ""
+        global_registry.counter(
+            "replication.full_resyncs",
+            "snapshot bootstraps this follower performed").inc()
         persistence.restore_into(self.store, state)
         if self.data_dir:
             # the local snapshot now IS the bootstrap point; the journal

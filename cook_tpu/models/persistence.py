@@ -23,6 +23,7 @@ from typing import Optional
 
 from cook_tpu.models import codec
 from cook_tpu.models.store import Event, JobStore
+from cook_tpu.obs.contention import JournalTelemetry
 
 _encode = codec.encode  # back-compat aliases
 _dec_resources = codec.dec_resources
@@ -188,6 +189,13 @@ class JournalWriter:
         self.fsync_every = fsync_every
         self._count = 0
         self._dirty = False
+        # events flushed to the OS but not yet covered by an fsync: the
+        # append "queue" the contention observatory reports, and the
+        # group-commit batch size the next fsync covers
+        self._pending = 0
+        # per-writer so the observatory reads ITS journal's stalls, not
+        # some other process-resident writer's (obs/contention.py)
+        self.telemetry = JournalTelemetry()
         import threading
 
         self._lock = threading.Lock()
@@ -195,7 +203,13 @@ class JournalWriter:
         self._f = open(path, "a")
 
     def _fsync_locked(self) -> None:
+        import time as _time
+
+        batch = self._pending
+        t0 = _time.perf_counter()
         os.fsync(self._f.fileno())
+        self.telemetry.note_fsync(batch, _time.perf_counter() - t0)
+        self._pending = 0
         self._dirty = False
 
     def __call__(self, event: Event) -> None:
@@ -207,10 +221,13 @@ class JournalWriter:
         encoded, and routing them through this writer keeps one lock and
         one file handle on the journal)."""
         with self._lock:
-            self._f.write(line.rstrip("\n") + "\n")
+            payload = line.rstrip("\n") + "\n"
+            self._f.write(payload)
             self._f.flush()
             self._count += 1
+            self._pending += 1
             self._dirty = True
+            self.telemetry.note_append(len(payload), self._pending)
             if self.fsync_every and self._count % self.fsync_every == 0:
                 self._fsync_locked()
 
@@ -231,6 +248,11 @@ class JournalWriter:
                 os.replace(self.path, self.path + ".1")
             self._f = open(self.path, "a")
             self._dirty = False
+            # the unfsynced tail went aside with the prefix (the
+            # snapshot supersedes it); carrying _pending forward would
+            # report a phantom fsync queue and inflate the next batch
+            self._pending = 0
+            self.telemetry.note_rotate()
 
     def close(self) -> None:
         with self._lock:

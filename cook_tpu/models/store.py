@@ -13,11 +13,11 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
-import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 from cook_tpu.models import state as state_mod
+from cook_tpu.obs.contention import profiled_store_lock
 from cook_tpu.models.entities import (
     DEFAULT_USER,
     Group,
@@ -74,7 +74,11 @@ class JobStore:
     them out to watchers (the tx-report-queue analog)."""
 
     def __init__(self, *, mea_culpa_limit: int = 5, clock: Callable[[], int] = None):
-        self._lock = threading.RLock()
+        # every `with store._lock:` in the tree reports its wait/hold to
+        # the contention observatory, labeled by calling function — the
+        # single-store-lock bottleneck ROADMAP item 2 is sharding away
+        # must be measurable before (and after) that refactor
+        self._lock = profiled_store_lock("store")
         self._seq = itertools.count(1)
         self._last_seq = 0
         self.recovered_stats: dict[str, int] = {}

@@ -1,7 +1,7 @@
-"""Device telemetry observatory: the accelerator-side truth layer.
+"""Observability: device telemetry observatory + control-plane contention.
 
 PR 2's flight recorder made the host-side scheduling cycle legible; this
-package makes the DEVICE side legible:
+package makes the DEVICE side and the CONTROL-PLANE write path legible:
 
   * `compile_observatory.CompileObservatory` — JIT-compilation accounting
     keyed by (op, shape-signature, backend), with recompile-storm
@@ -16,38 +16,71 @@ package makes the DEVICE side legible:
   * `device_monitor` — live device-memory gauges (`memory_stats()` on
     real accelerators) and the OOM-risk check.
   * `health.HealthMonitor` — folds the above into one machine-readable
-    verdict served at `GET /debug/health` with four degradation reasons:
-    recompile-storm, quality-drift, solve-latency-regression,
+    verdict served at `GET /debug/health` with four device degradation
+    reasons: recompile-storm, quality-drift, solve-latency-regression,
     device-oom-risk.
   * `telemetry.DeviceTelemetry` — the facade the scheduler owns; match/
     rank/rebalance cycles report every device solve through it.
-"""
-from cook_tpu.obs.baseline import RollingBaseline
-from cook_tpu.obs.compile_observatory import CompileObservatory
-from cook_tpu.obs.device_monitor import (
-    device_memory_stats,
-    update_device_memory_gauges,
-)
-from cook_tpu.obs.health import (
-    DEVICE_OOM_RISK,
-    HealthMonitor,
-    QUALITY_DRIFT,
-    RECOMPILE_STORM,
-    SOLVE_LATENCY_REGRESSION,
-)
-from cook_tpu.obs.quality_monitor import QualityMonitor
-from cook_tpu.obs.telemetry import DeviceTelemetry
+  * `contention.ContentionObservatory` — the control-plane side: store-
+    lock wait/hold profiling, journal fsync telemetry, replication lag,
+    per-endpoint REST latency, commit-ack SLO burn rate — served at
+    `GET /debug/contention` and folded into `/debug/health` with five
+    more reasons (store-lock-saturation, fsync-stall, replication-lag,
+    commit-ack-slo-burn, job-starvation).
 
-__all__ = [
-    "CompileObservatory",
-    "DeviceTelemetry",
-    "HealthMonitor",
-    "QualityMonitor",
-    "RollingBaseline",
-    "RECOMPILE_STORM",
-    "QUALITY_DRIFT",
-    "SOLVE_LATENCY_REGRESSION",
-    "DEVICE_OOM_RISK",
-    "device_memory_stats",
-    "update_device_memory_gauges",
-]
+Exports resolve lazily (PEP 562): `models/store.py` and
+`models/persistence.py` import `cook_tpu.obs.contention` at module
+level for the lock/journal instruments, and that import must not drag
+jax in through the device-side modules (quality_monitor imports
+ops.common) — the same cheap-import discipline `cook_tpu/__init__.py`
+keeps for REST-client-only consumers.
+"""
+
+_EXPORTS = {
+    "RollingBaseline": ("cook_tpu.obs.baseline", "RollingBaseline"),
+    "CompileObservatory": ("cook_tpu.obs.compile_observatory",
+                           "CompileObservatory"),
+    "device_memory_stats": ("cook_tpu.obs.device_monitor",
+                            "device_memory_stats"),
+    "update_device_memory_gauges": ("cook_tpu.obs.device_monitor",
+                                    "update_device_memory_gauges"),
+    "HealthMonitor": ("cook_tpu.obs.health", "HealthMonitor"),
+    "RECOMPILE_STORM": ("cook_tpu.obs.health", "RECOMPILE_STORM"),
+    "QUALITY_DRIFT": ("cook_tpu.obs.health", "QUALITY_DRIFT"),
+    "SOLVE_LATENCY_REGRESSION": ("cook_tpu.obs.health",
+                                 "SOLVE_LATENCY_REGRESSION"),
+    "DEVICE_OOM_RISK": ("cook_tpu.obs.health", "DEVICE_OOM_RISK"),
+    "QualityMonitor": ("cook_tpu.obs.quality_monitor", "QualityMonitor"),
+    "DeviceTelemetry": ("cook_tpu.obs.telemetry", "DeviceTelemetry"),
+    "ContentionObservatory": ("cook_tpu.obs.contention",
+                              "ContentionObservatory"),
+    "ContentionParams": ("cook_tpu.obs.contention", "ContentionParams"),
+    "EndpointTelemetry": ("cook_tpu.obs.contention", "EndpointTelemetry"),
+    "LockProfiler": ("cook_tpu.obs.contention", "LockProfiler"),
+    "ProfiledRLock": ("cook_tpu.obs.contention", "ProfiledRLock"),
+    "SloBurnTracker": ("cook_tpu.obs.contention", "SloBurnTracker"),
+    "STORE_LOCK_SATURATION": ("cook_tpu.obs.contention",
+                              "STORE_LOCK_SATURATION"),
+    "FSYNC_STALL": ("cook_tpu.obs.contention", "FSYNC_STALL"),
+    "REPLICATION_LAG": ("cook_tpu.obs.contention", "REPLICATION_LAG"),
+    "COMMIT_ACK_SLO_BURN": ("cook_tpu.obs.contention",
+                            "COMMIT_ACK_SLO_BURN"),
+    "JOB_STARVATION": ("cook_tpu.obs.contention", "JOB_STARVATION"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'cook_tpu.obs' has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)
+
+
+def __dir__():
+    return __all__

@@ -45,6 +45,11 @@ from cook_tpu.models.entities import (
 )
 from cook_tpu.models.reasons import _REASONS, REASONS_BY_CODE
 from cook_tpu.models.store import JobStore, TransactionVetoed
+from cook_tpu.obs.contention import (
+    ContentionObservatory,
+    ContentionParams,
+    EndpointTelemetry,
+)
 from cook_tpu.scheduler.core import Scheduler
 from cook_tpu.scheduler.plugins import PluginRegistry
 from cook_tpu.scheduler.queue_limit import QueueLimitChecker
@@ -97,6 +102,10 @@ class ApiConfig:
     # pruned): a decommissioned standby's last ack must not satisfy the
     # durability bound forever.  <= 0 disables liveness qualification.
     replication_ack_liveness_s: float = 30.0
+    # thresholds for the control-plane contention health checks
+    # (store-lock-saturation, fsync-stall, replication-lag,
+    # commit-ack-slo-burn, job-starvation); None = defaults
+    contention: Optional[ContentionParams] = None
 
 
 class CookApi:
@@ -155,11 +164,35 @@ class CookApi:
         # store's watcher thread via call_soon_threadsafe
         self._repl_waiters: set = set()
         self._repl_loop = None
+        # control-plane contention observatory (cook_tpu/obs/contention):
+        # per-route REST telemetry (fed by the outermost middleware),
+        # store-lock / journal / replication / commit-ack attribution —
+        # served at GET /debug/contention and folded into /debug/health
+        self.endpoints = EndpointTelemetry()
+        self.contention = ContentionObservatory(
+            store,
+            params=self.config.contention,
+            endpoints=self.endpoints,
+            journal_fn=lambda: getattr(
+                getattr(self.txn, "journal", None), "telemetry", None),
+            replication_meta_fn=lambda: self.replication_ack_meta,
+            starvation_fn=self._starvation_view,
+        )
+
+    def _starvation_view(self) -> dict:
+        from cook_tpu.scheduler.monitor import starvation_stats
+
+        return {pool: starvation_stats(self.store, pool)
+                for pool in sorted(self.store.pools)}
 
     # ------------------------------------------------------------ app wiring
 
     def build_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._auth_middleware])
+        # endpoint telemetry sits OUTSIDE auth so rejected requests are
+        # measured too (an auth-storm is control-plane load like any
+        # other); aiohttp applies middlewares in list order
+        app = web.Application(middlewares=[self._endpoint_middleware,
+                                           self._auth_middleware])
         r = app.router
         for path in ("/rawscheduler", "/jobs"):
             r.add_get(path, self.get_jobs)
@@ -206,6 +239,7 @@ class CookApi:
         r.add_post("/replication/ack", self.post_replication_ack)
         r.add_get("/debug", self.get_debug)
         r.add_get("/debug/health", self.get_debug_health)
+        r.add_get("/debug/contention", self.get_debug_contention)
         r.add_get("/debug/elastic", self.get_debug_elastic)
         r.add_get("/debug/cycles", self.get_debug_cycles)
         r.add_get("/debug/cycles/{cycle_id}", self.get_debug_cycle)
@@ -257,24 +291,54 @@ class CookApi:
             if self.scheduler is not None else None
 
     async def get_debug_health(self, request: web.Request) -> web.Response:
-        """Device-telemetry health verdict (cook_tpu/obs/): machine-
-        readable degradation reasons — recompile-storm, quality-drift,
-        solve-latency-regression, device-oom-risk — with per-check
+        """Health verdict: the device-telemetry degradations (recompile-
+        storm, quality-drift, solve-latency-regression, device-oom-risk)
+        merged with the control-plane contention degradations (store-
+        lock-saturation, fsync-stall, replication-lag,
+        commit-ack-slo-burn, job-starvation), each with per-check
         evidence.  Always 200; `healthy`/`status` carry the verdict so
-        probes distinguish "degraded" from "down".  With telemetry
+        probes distinguish "degraded" from "down".  With device telemetry
         disabled (device_telemetry=False, or no scheduler attached — a
-        proxy-only node) the status is "unobserved": not degraded, but
-        explicitly not vouched for."""
+        proxy-only node) the device side reports "unobserved" while the
+        contention checks still run — the control plane is observable on
+        every node."""
         telemetry = self._telemetry()
         if telemetry is None:
-            return web.json_response({
+            verdict = {
                 "healthy": True,
                 "status": "unobserved",
                 "degradations": [],
                 "reasons": [],
                 "checks": {},
-            })
-        return web.json_response(telemetry.health())
+            }
+        else:
+            verdict = telemetry.health()
+        degradations, checks = self.contention.evaluate()
+        verdict["degradations"] = verdict["degradations"] + degradations
+        verdict["checks"]["contention"] = checks
+        verdict["reasons"] = sorted(
+            set(verdict["reasons"]) | {d["reason"] for d in degradations})
+        if degradations:
+            verdict["healthy"] = False
+            verdict["status"] = "degraded"
+        # the rollup gauge must reflect the MERGED verdict (the device-
+        # side HealthMonitor already set it from its own half)
+        global_registry.gauge(
+            "obs.health.degraded",
+            "1 while /debug/health reports any degradation reason").set(
+            0.0 if verdict["healthy"] else 1.0)
+        return web.json_response(verdict)
+
+    async def get_debug_contention(self, request: web.Request
+                                   ) -> web.Response:
+        """Control-plane contention snapshot (cook_tpu/obs/contention):
+        where the write path's time goes — store-lock wait/hold per call
+        site (current holder, longest waiter, contention ratio), journal
+        append/fsync pipeline, per-follower replication lag, per-route
+        REST latency/RPS/in-flight, and the commit-ack SLO burn rate.
+        The before/after instrument for the control-plane sharding work
+        (ROADMAP item 2)."""
+        return web.json_response(self.contention.snapshot())
 
     async def get_debug_elastic(self, request: web.Request) -> web.Response:
         """Elastic capacity plane state (cook_tpu/elastic/): the durable
@@ -348,6 +412,32 @@ class CookApi:
             spans = [s for s in spans
                      if s.get("tags", {}).get("txn_id") == txn_id][-limit:]
         return web.json_response({"spans": spans})
+
+    @web.middleware
+    async def _endpoint_middleware(self, request: web.Request, handler):
+        """Per-endpoint REST telemetry: latency / RPS / in-flight /
+        error-rate per matched route template (bounded label set — the
+        route table, not the workload).  HTTPExceptions ARE responses
+        here, counted under their status."""
+        import time as _time
+
+        resource = request.match_info.route.resource \
+            if request.match_info.route is not None else None
+        route = resource.canonical if resource is not None else "__unmatched__"
+        method = request.method
+        self.endpoints.begin(route, method)
+        t0 = _time.perf_counter()
+        status = 500
+        try:
+            response = await handler(request)
+            status = response.status
+            return response
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        finally:
+            self.endpoints.done(route, method, status,
+                                _time.perf_counter() - t0)
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
@@ -559,7 +649,11 @@ class CookApi:
             # would flood the histogram with samples no durable commit saw.
             from cook_tpu.scheduler.monitor import observe_commit_ack
 
-            observe_commit_ack(_time.perf_counter() - t_commit)
+            commit_ack_s = _time.perf_counter() - t_commit
+            observe_commit_ack(commit_ack_s)
+            # the same sample, windowed: the contention observatory's
+            # SLO burn-rate evaluation (commit-ack-slo-burn)
+            self.contention.commit_ack.observe(commit_ack_s)
             global_registry.counter(
                 "jobs_submitted", "jobs accepted via POST /jobs").inc(
                 len(jobs))
@@ -1144,6 +1238,8 @@ class CookApi:
         return web.json_response(out)
 
     async def get_unscheduled(self, request: web.Request) -> web.Response:
+        from cook_tpu.scheduler.monitor import starvation_stats
+
         uuids = request.query.getall("job", [])
         telemetry = self._telemetry()
         out = []
@@ -1155,6 +1251,19 @@ class CookApi:
                 "uuid": uuid,
                 "reasons": self._unscheduled_reasons(job),
             }
+            if job.state.value == "waiting":
+                # starvation echo: how long THIS job has queued, against
+                # its pool's oldest wait — so "why isn't it running" and
+                # "is the whole pool starving" answer in one reply
+                sv = starvation_stats(self.store, job.pool)
+                start = (job.last_waiting_start_time_ms
+                         or job.submit_time_ms)
+                entry["starvation"] = {
+                    "job_wait_s": max(
+                        0.0, (self.store.clock() - start) / 1000.0),
+                    "pool_oldest_wait_s": sv["oldest_age_s"],
+                    "pool_worst_user": sv.get("worst_user", ""),
+                }
             if telemetry is not None:
                 # the pool's last device solve (padded problem shape,
                 # backend, compile flag) so a reason code correlates
@@ -1583,6 +1692,10 @@ class CookApi:
         self.replication_ack_meta[follower] = {
             "seq": seq, "durable": durable, "time": _time.monotonic(),
             "last_txn_id": last_txn_id}
+        global_registry.counter(
+            "replication.acks",
+            "replication acks received, split durable vs memory-only").inc(
+            1, {"durable": str(durable).lower()})
         if durable:
             prev = self.replication_acks.get(follower, 0)
             self.replication_acks[follower] = max(prev, seq)

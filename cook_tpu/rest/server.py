@@ -61,3 +61,53 @@ class ServerThread:
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+class InprocessControlPlane:
+    """A full control-plane write path in one process: JobStore + journal
+    (real fsyncs) + transaction pipeline + CookApi on a ServerThread —
+    no scheduler, no device.  The harness `tools/loadtest.py --smoke`,
+    the bench `control_plane` phase, and the contention tests drive:
+    every serialization point the contention observatory instruments
+    (store lock, journal fsync, REST) is real; only the match cycle is
+    absent, which submission/query/kill traffic never touches."""
+
+    def __init__(self, *, data_dir: Optional[str] = None,
+                 pools: tuple = ("default",), config=None, clock=None):
+        import tempfile
+        import time as _time
+
+        from cook_tpu.models import persistence
+        from cook_tpu.models.entities import Pool
+        from cook_tpu.models.store import JobStore
+        from cook_tpu.rest.api import ApiConfig, CookApi
+        from cook_tpu.txn import TransactionLog
+
+        self._own_dir = data_dir is None
+        self.data_dir = data_dir or tempfile.mkdtemp(prefix="cook-cp-")
+        self.store = JobStore(
+            clock=clock or (lambda: int(_time.time() * 1000)))
+        for pool in pools:
+            self.store.set_pool(Pool(name=pool))
+        self.journal = persistence.attach_journal(
+            self.store, f"{self.data_dir}/journal.jsonl")
+        self.txn = TransactionLog(self.store, journal=self.journal)
+        self.api = CookApi(self.store, None, config or ApiConfig(),
+                           txn=self.txn)
+        self.server = ServerThread(self.api)
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def start(self) -> "InprocessControlPlane":
+        self.server.start()
+        return self
+
+    def stop(self) -> None:
+        import shutil
+
+        self.server.stop()
+        self.journal.close()
+        if self._own_dir:
+            shutil.rmtree(self.data_dir, ignore_errors=True)

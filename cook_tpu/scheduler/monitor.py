@@ -24,7 +24,11 @@ def observe_commit_ack(seconds: float) -> None:
     """submit -> commit-ack: wall time the REST layer spent committing a
     submission (apply + journal fsync + replication wait).  Wide buckets:
     a commit stalled minutes on a recovering standby is exactly what this
-    metric exists to expose, and must not collapse into +Inf."""
+    metric exists to expose, and must not collapse into +Inf.  The REST
+    layer additionally feeds the same sample into its contention
+    observatory's windowed SLO burn-rate tracker (rest/api.py) — the
+    cumulative histogram can't answer "how fast are we burning budget
+    RIGHT NOW"."""
     global_registry.histogram(
         "job.latency.submit_commit_ack",
         "seconds from submission arrival to durable commit ack",
@@ -97,6 +101,42 @@ class JobLifecycleTracker:
                     {"pool": job.pool})
 
 
+def starvation_stats(store: JobStore, pool: str,
+                     *, top_users: int = 10) -> dict:
+    """Queued-wait visibility for one pool: the oldest queued job's age,
+    and per-user max waits (how long each user's most-starved job has
+    sat WAITING, measured from `last_waiting_start_time_ms` on the store
+    clock — a retried job's wait restarts when it re-queues).  Shared by
+    `collect_pool_stats` (gauges), the contention observatory's
+    `job-starvation` health check, and the `/unscheduled_jobs` echo."""
+    now = store.clock()
+    oldest_age_s = 0.0
+    oldest_job = ""
+    user_waits: dict[str, float] = {}
+    waiting = store.pending_jobs(pool)
+    for job in waiting:
+        start = job.last_waiting_start_time_ms or job.submit_time_ms
+        age_s = max(0.0, (now - start) / 1000.0)
+        if age_s > oldest_age_s:
+            oldest_age_s, oldest_job = age_s, job.uuid
+        user_waits[job.user] = max(user_waits.get(job.user, 0.0), age_s)
+    ranked = sorted(user_waits.items(), key=lambda kv: kv[1], reverse=True)
+    stats = {
+        "waiting_jobs": len(waiting),
+        "oldest_age_s": oldest_age_s,
+        "oldest_job": oldest_job,
+        "user_max_wait_s": dict(ranked[:top_users]),
+    }
+    if ranked:
+        stats["worst_user"], stats["worst_user_wait_s"] = ranked[0]
+    return stats
+
+
+# pool -> user labels currently exported on monitor.user_max_wait_seconds
+# (so collect_pool_stats can retract users who stopped waiting)
+_exported_user_waits: dict[str, set] = {}
+
+
 @dataclass
 class PoolStats:
     running_jobs: int
@@ -158,6 +198,25 @@ def collect_pool_stats(store: JobStore, pool: str) -> PoolStats:
         stats.waiting_demand.mem, labels)
     g("monitor.waiting_cpus", "waiting cpu demand per pool").set(
         stats.waiting_demand.cpus, labels)
+    # starvation visibility: the age of the pool's oldest queued job and
+    # each (top-10) user's most-starved wait — the signal that flips the
+    # `job-starvation` degradation at /debug/health
+    sv = starvation_stats(store, pool)
+    g("monitor.oldest_waiting_age_seconds",
+      "age of the oldest queued job per pool").set(
+        sv["oldest_age_s"], labels)
+    user_gauge = g("monitor.user_max_wait_seconds",
+                   "longest current queued wait per user (top waiting "
+                   "users)")
+    # a user who scheduled (or fell out of the top set) must stop being
+    # exported — a frozen last value reads as ongoing starvation, and
+    # the label set would otherwise grow with workload user churn
+    for user in _exported_user_waits.get(pool, set()) - \
+            set(sv["user_max_wait_s"]):
+        user_gauge.remove({"pool": pool, "user": user})
+    for user, wait_s in sv["user_max_wait_s"].items():
+        user_gauge.set(wait_s, {"pool": pool, "user": user})
+    _exported_user_waits[pool] = set(sv["user_max_wait_s"])
     return stats
 
 

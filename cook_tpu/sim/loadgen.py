@@ -149,6 +149,111 @@ def imbalanced_pool_trace(
     return jobs, hosts
 
 
+@dataclass(frozen=True)
+class TrafficOp:
+    """One control-plane request in a rest_traffic_trace schedule."""
+
+    offset_s: float
+    kind: str                     # "submit" | "query" | "kill"
+    user: str
+    spec: Optional[dict] = None   # submit payload
+    ref: int = -1                 # trace index of the submit this
+    #                               query/kill targets
+
+
+def rest_traffic_trace(
+    *,
+    duration_s: float = 10.0,
+    rps: float = 50.0,
+    mix: tuple = (0.7, 0.2, 0.1),   # submit : query : kill
+    n_users: int = 8,
+    burst_every_s: float = 2.0,
+    burst_len_s: float = 0.4,
+    burstiness: float = 4.0,
+    seed: int = 0,
+    pool: Optional[str] = None,
+) -> list[TrafficOp]:
+    """Seeded bursty submit/query/kill schedule — the ONE load shape
+    shared by `tools/loadtest.py` (replayed over HTTP against a live
+    control plane) and the simulator (`traffic_trace_jobs` converts the
+    submit ops to TraceJobs), so bench rounds and offline replays drive
+    the same reproducible traffic.
+
+    Arrivals are a non-homogeneous Poisson process: every
+    `burst_every_s` a `burst_len_s` window runs at `burstiness` x the
+    base rate (the base is scaled down so the long-run average stays at
+    `rps`) — the thundering-herd pattern that exposes lock and fsync
+    contention, which a smooth arrival stream hides.  Query/kill ops
+    target a uniformly-drawn earlier submit (before any submit exists
+    they degrade to submits), so the trace is self-contained."""
+    rng = np.random.default_rng(seed)
+    frac = min(burst_len_s / max(burst_every_s, 1e-9), 1.0)
+    # solve mean rate == rps: frac*burst_rate + (1-frac)*base == rps
+    base = max(rps * (1.0 - burstiness * frac) / max(1.0 - frac, 1e-9),
+               rps * 0.05)
+    burst_rate = rps * burstiness
+    kinds = ("submit", "query", "kill")
+    p = np.asarray(mix, dtype=float)
+    p = p / p.sum()
+    ops: list[TrafficOp] = []
+    submit_indices: list[int] = []
+    t = 0.0
+    i = 0
+    while True:
+        rate = burst_rate if (t % burst_every_s) < burst_len_s else base
+        t += float(rng.exponential(1.0 / max(rate, 1e-9)))
+        if t >= duration_s:
+            break
+        kind = kinds[int(rng.choice(3, p=p))]
+        user = f"user{int(rng.integers(n_users))}"
+        if kind != "submit" and not submit_indices:
+            kind = "submit"
+        if kind == "submit":
+            spec = {
+                "command": "true",
+                "name": f"loadtest-{i}",
+                "mem": float(rng.choice((128, 256, 512, 1024))),
+                "cpus": float(rng.choice((0.5, 1, 2))),
+                "max_retries": 1,
+                **({"pool": pool} if pool else {}),
+            }
+            ops.append(TrafficOp(offset_s=t, kind=kind, user=user,
+                                 spec=spec))
+            submit_indices.append(i)
+        else:
+            ref = int(submit_indices[int(rng.integers(
+                len(submit_indices)))])
+            ops.append(TrafficOp(offset_s=t, kind=kind, user=user,
+                                 ref=ref))
+        i += 1
+    return ops
+
+
+def traffic_trace_jobs(ops: list[TrafficOp], *, runtime_ms: int = 1000,
+                       mem=None, cpus=None):
+    """The simulator view of a rest_traffic_trace: submit ops become
+    TraceJobs at their arrival offsets (kills/queries are REST-side
+    concerns the trace simulator's completion model doesn't replay), so
+    the same seeded load shape drives both the live harness and
+    offline sim runs."""
+    from cook_tpu.sim.simulator import TraceJob
+
+    jobs = []
+    for i, op in enumerate(ops):
+        if op.kind != "submit":
+            continue
+        jobs.append(TraceJob(
+            uuid=f"traffic-{i:06d}",
+            user=op.user,
+            submit_time_ms=int(op.offset_s * 1000),
+            runtime_ms=runtime_ms,
+            mem=float(mem if mem is not None else op.spec["mem"]),
+            cpus=float(cpus if cpus is not None else op.spec["cpus"]),
+            pool=op.spec.get("pool", "default"),
+        ))
+    return jobs
+
+
 def run_load(url: str, config: LoadConfig, *,
              wait_timeout_s: float = 120.0,
              log=lambda *a: None) -> LoadReport:

@@ -32,8 +32,14 @@ from cook_tpu.models.store import JobStore
 from cook_tpu.txn.ops import OPS, UnknownOperation
 from cook_tpu.txn.transaction import Transaction, TxnOutcome, new_txn_id
 from cook_tpu.utils import tracing
+from cook_tpu.utils.metrics import global_registry
 
 log = logging.getLogger(__name__)
+
+# commits span lock-acquire + apply + group fsync: µs (in-memory dupe
+# answer) to seconds (fsync stall on a loaded disk)
+_COMMIT_BUCKETS = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, float("inf"))
 
 
 class TransientTxnError(Exception):
@@ -70,11 +76,14 @@ class TransactionLog:
         return self.commit_txn(txn)
 
     def commit_txn(self, txn: Transaction) -> TxnOutcome:
+        import time as _time
+
         handler = OPS.get(txn.op)
         if handler is None:
             raise UnknownOperation(txn.op)
         store = self.store
         attempts = 0
+        t_commit = _time.perf_counter()
         while True:
             attempts += 1
             try:
@@ -104,5 +113,14 @@ class TransactionLog:
                 time.sleep(self.policy.retry_backoff_s)
         if self.journal is not None and self.policy.sync_journal:
             self.journal.sync()
+        # commit wall per op (apply under the store lock + group fsync;
+        # idempotent replays answered from the txn table are excluded —
+        # they pay neither), the txn-side half of the commit-ack latency
+        # /debug/contention attributes
+        global_registry.histogram(
+            "txn.commit_seconds",
+            "transaction commit wall seconds per op (apply + fsync)",
+            buckets=_COMMIT_BUCKETS).observe(
+            _time.perf_counter() - t_commit, {"op": txn.op})
         return TxnOutcome(txn_id=txn.txn_id, op=txn.op, seq=seq,
                           result=result, attempts=attempts)

@@ -40,11 +40,16 @@ def test_smoke_emits_structured_record(smoke_record):
     assert on_disk["schema"] == "cook-bench/v1"
     assert on_disk["mode"] == "smoke"
     assert set(on_disk["phases"]) == {"match", "dru", "rebalance",
-                                      "elastic_plan"}
+                                      "elastic_plan", "control_plane"}
     for phase in on_disk["phases"].values():
         assert phase["p50_ms"] > 0
     assert on_disk["headline"]["unit"] == "ms"
     assert record["phases"]["match"]["jobs"] == 1000
+    # the control-plane phase gates commit-ack p50 and records the p99
+    # the sharding work (ROADMAP item 2) is judged against
+    control = record["phases"]["control_plane"]
+    assert control["commit_ack_p99_ms"] >= control["p50_ms"]
+    assert control["errors"] == 0 and control["submits"] > 0
 
 
 def test_smoke_match_holds_packing_parity(smoke_record):

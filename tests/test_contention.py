@@ -1,0 +1,443 @@
+"""GET /debug/contention and the control-plane degradation reasons:
+each serialization point's instrument, and the /debug/health transitions
+they drive (store-lock-saturation, fsync-stall, replication-lag,
+commit-ack-slo-burn, job-starvation) — the inducing-test pattern of
+tests/test_health_endpoint.py, control-plane edition.
+
+The server here runs WITHOUT a scheduler on purpose: the contention
+observatory must work on proxy-only nodes (device telemetry reports
+"unobserved" while the control-plane checks still run)."""
+import threading
+import time
+
+import pytest
+import requests
+
+from cook_tpu.models.entities import Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.obs.contention import (
+    ContentionParams,
+    JournalTelemetry,
+    SloBurnTracker,
+)
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import ServerThread
+from tests.conftest import FakeClock, make_job
+
+PARAMS = ContentionParams(
+    lock_contention_ratio=0.4,
+    lock_min_acquisitions=32,
+    fsync_stall_s=0.05,
+    replication_lag_events=10,
+    replication_ack_age_s=5.0,
+    commit_ack_slo_s=0.5,
+    starvation_age_s=60.0,
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    api = CookApi(store, None,
+                  ApiConfig(admins=("admin",), contention=PARAMS))
+    srv = ServerThread(api).start()
+    srv.clock = clock
+    srv.store = store
+    srv.cook_api = api
+    yield srv
+    srv.stop()
+
+
+def hdr(user="alice"):
+    return {"X-Cook-Requesting-User": user}
+
+
+def get_health(server):
+    r = requests.get(f"{server.url}/debug/health")
+    assert r.status_code == 200
+    return r.json()
+
+
+def get_contention(server):
+    r = requests.get(f"{server.url}/debug/contention", headers=hdr())
+    assert r.status_code == 200
+    return r.json()
+
+
+def kill_all_pending(server):
+    pending = [j.uuid for j in server.store.pending_jobs("default")]
+    if pending:
+        server.store.kill_jobs(pending)
+
+
+# --------------------------------------------------------------- snapshot
+
+
+def test_contention_endpoint_sections(server):
+    """Real REST traffic shows up in every section of the snapshot."""
+    r = requests.post(
+        f"{server.url}/jobs",
+        json={"jobs": [{"command": "true", "mem": 64, "cpus": 0.5}]},
+        headers=hdr())
+    assert r.status_code == 201
+    snap = get_contention(server)
+    assert set(snap) >= {"store_lock", "journal", "replication",
+                         "endpoints", "commit_ack", "starvation",
+                         "wall_time"}
+    lock = snap["store_lock"]
+    assert lock["acquisitions"] > 0
+    # per-call-site attribution: the submit path's store sites are named
+    assert any(site.startswith("store.") for site in lock["sites"])
+    post_jobs = snap["endpoints"]["POST /jobs"]
+    assert post_jobs["count"] >= 1 and post_jobs["p50_ms"] > 0
+    assert snap["commit_ack"]["slow_samples"] >= 1
+    assert snap["commit_ack"]["p50_ms"] > 0
+    assert "default" in snap["starvation"]
+
+
+def test_unobserved_device_side_still_reports_contention(server):
+    """No scheduler attached: device telemetry is 'unobserved', but the
+    contention checks run and report evidence."""
+    health = get_health(server)
+    assert health["status"] in ("unobserved", "degraded")
+    assert set(health["checks"]["contention"]) == {
+        "store_lock", "journal", "replication", "commit_ack",
+        "starvation"}
+
+
+def test_rest_and_lock_metrics_exposed(server):
+    requests.get(f"{server.url}/pools", headers=hdr())
+    requests.get(f"{server.url}/nope", headers=hdr())  # unmatched: safe
+    text = requests.get(f"{server.url}/metrics").text
+    assert "cook_rest_request_seconds_bucket" in text
+    assert 'route="/pools"' in text
+    assert "cook_store_lock_wait_seconds_bucket" in text
+    assert "cook_store_lock_hold_seconds_bucket" in text
+    snap = get_contention(server)
+    assert "GET __unmatched__" in snap["endpoints"]
+
+
+# ------------------------------------------------- store-lock-saturation
+
+
+def induce_lock_contention(store, rounds=8, waiters=50):
+    """Hold the store lock while a batch of threads parks on it: every
+    waiter records a contended outermost acquisition."""
+    for _ in range(rounds):
+        with store._lock:
+            threads = [threading.Thread(
+                target=lambda: store.pending_count("default"))
+                for _ in range(waiters)]
+            for t in threads:
+                t.start()
+            time.sleep(0.02)  # let them park on the lock
+        for t in threads:
+            t.join()
+
+
+def test_store_lock_saturation_transition(server):
+    induce_lock_contention(server.store)
+    profiler = server.store._lock.profiler
+    assert profiler.contention_ratio() >= PARAMS.lock_contention_ratio
+    health = get_health(server)
+    assert not health["healthy"]
+    assert "store-lock-saturation" in health["reasons"]
+    [degradation] = [d for d in health["degradations"]
+                     if d["reason"] == "store-lock-saturation"]
+    assert degradation["contention_ratio"] >= PARAMS.lock_contention_ratio
+    # recovery: a clean window of uncontended acquisitions
+    for _ in range(600):
+        with server.store._lock:
+            pass
+    assert get_health(server)["healthy"]
+
+
+# ------------------------------------------------------------ fsync-stall
+
+
+def test_fsync_stall_transition(server):
+    observatory = server.cook_api.contention
+    old = observatory.journal_fn
+    telemetry = JournalTelemetry()
+    observatory.journal_fn = lambda: telemetry
+    try:
+        telemetry.note_fsync(4, 0.2)  # 200 ms >> the 50 ms bound
+        health = get_health(server)
+        assert "fsync-stall" in health["reasons"]
+        [d] = [d for d in health["degradations"]
+               if d["reason"] == "fsync-stall"]
+        assert d["recent_fsync_max_s"] == pytest.approx(0.2)
+        # recovery: the stall ages out of the recent-fsync window
+        for _ in range(64):
+            telemetry.note_fsync(1, 0.0005)
+        assert get_health(server)["healthy"]
+    finally:
+        observatory.journal_fn = old
+
+
+def test_journal_writer_reports_into_telemetry(tmp_path):
+    """The real write path feeds the writer's telemetry: append + group
+    fsync land in the counters the snapshot serves."""
+    from cook_tpu.models import persistence
+
+    writer = persistence.JournalWriter(str(tmp_path / "j.jsonl"))
+    writer.write_line('{"seq": 1, "kind": "test"}')
+    writer.write_line('{"seq": 2, "kind": "test"}')
+    writer.sync()
+    after = writer.telemetry.snapshot()
+    assert after["appends"] == 2
+    assert after["bytes_written"] > 0
+    assert after["fsyncs"] == 1
+    assert after["last_batch_events"] == 2  # one barrier covered both
+    # rotation drops the unfsynced tail with the old file: the next
+    # fsync's batch covers only post-rotate appends, no phantom carry
+    writer.write_line('{"seq": 3, "kind": "test"}')
+    writer.rotate()
+    writer.write_line('{"seq": 4, "kind": "test"}')
+    writer.sync()
+    assert writer.telemetry.snapshot()["last_batch_events"] == 1
+    writer.close()
+
+
+# -------------------------------------------------------- replication-lag
+
+
+def test_replication_lag_transition(server):
+    api = server.cook_api
+    api.replication_ack_meta["standby-1"] = {
+        "seq": server.store.last_seq(), "durable": True,
+        "time": time.monotonic(), "last_txn_id": ""}
+    try:
+        assert get_health(server)["healthy"]
+        # the leader commits 12 more events; the follower's ack stands
+        server.store.submit_jobs([make_job() for _ in range(12)])
+        health = get_health(server)
+        assert "replication-lag" in health["reasons"]
+        [d] = [d for d in health["degradations"]
+               if d["reason"] == "replication-lag"]
+        assert d["follower"] == "standby-1"
+        assert d["lag_events"] >= PARAMS.replication_lag_events
+        assert d["durable"] is True
+        # the leader-side gauges track the lag
+        snap = get_contention(server)
+        [row] = snap["replication"]
+        assert row["lag_events"] >= 12
+        # recovery: the follower catches up
+        api.replication_ack_meta["standby-1"]["seq"] = \
+            server.store.last_seq()
+        assert get_health(server)["healthy"]
+    finally:
+        api.replication_ack_meta.pop("standby-1", None)
+        kill_all_pending(server)
+
+
+def test_silent_behind_follower_degrades(server):
+    """A follower only 1 event behind but silent past the ack-age bound
+    is a lag too: sync-ack commits are timing out against it."""
+    api = server.cook_api
+    api.replication_ack_meta["standby-2"] = {
+        "seq": server.store.last_seq(), "durable": True,
+        "time": time.monotonic() - 30.0, "last_txn_id": ""}
+    try:
+        server.store.submit_jobs([make_job()])
+        health = get_health(server)
+        assert "replication-lag" in health["reasons"]
+    finally:
+        api.replication_ack_meta.pop("standby-2", None)
+        kill_all_pending(server)
+    assert get_health(server)["healthy"]
+
+
+# --------------------------------------------------- commit-ack-slo-burn
+
+
+def test_commit_ack_burn_transition(server):
+    observatory = server.cook_api.contention
+    old = observatory.commit_ack
+    observatory.commit_ack = SloBurnTracker()
+    try:
+        for _ in range(20):
+            observatory.commit_ack.observe(2.0)   # 2 s >> 0.5 s SLO
+        health = get_health(server)
+        assert "commit-ack-slo-burn" in health["reasons"]
+        [d] = [d for d in health["degradations"]
+               if d["reason"] == "commit-ack-slo-burn"]
+        assert d["fast_burn"] > 1.0 and d["slow_burn"] > 1.0
+        # recovery: burn is a violating FRACTION — a flood of in-SLO
+        # samples dilutes the burst below the budget in both windows
+        for _ in range(4096):
+            observatory.commit_ack.observe(0.001)
+        assert get_health(server)["healthy"]
+    finally:
+        observatory.commit_ack = old
+
+
+def test_burn_requires_both_windows():
+    """A blip trips the fast window only; the multi-window rule keeps it
+    from paging."""
+    tracker = SloBurnTracker()
+    now = time.time()
+    # old, in-SLO history fills the slow window
+    for i in range(400):
+        tracker.observe(0.01, t=now - 2000 + i)
+    # a recent blip: 3 slow samples among 10 fast
+    for i in range(10):
+        tracker.observe(0.01, t=now - 5 + i * 0.1)
+    for i in range(3):
+        tracker.observe(2.0, t=now - 1 + i * 0.1)
+    stats = tracker.stats(threshold_s=0.5, budget=0.01, fast_s=300.0,
+                          slow_s=3600.0, now=now)
+    assert stats["fast_burn"] > 1.0
+    assert stats["slow_burn"] < 1.0  # diluted by the healthy history
+
+
+def test_slow_window_honest_past_ring_capacity():
+    """Burn counts come from time buckets, not the percentile ring: a
+    commit rate high enough to overflow the ring must not shrink the
+    slow window onto the fast window's samples (which would page on
+    exactly the blip the multi-window rule exists to suppress)."""
+    tracker = SloBurnTracker(capacity=256)
+    now = time.time()
+    # 2000 in-SLO samples spread over ~33 min — 8x the ring capacity
+    for i in range(2000):
+        tracker.observe(0.01, t=now - 2000 + i)
+    # a 20 s blip of violations at the end
+    for i in range(40):
+        tracker.observe(2.0, t=now - 20 + i * 0.5)
+    stats = tracker.stats(threshold_s=0.5, budget=0.01, fast_s=300.0,
+                          slow_s=3600.0, now=now)
+    assert stats["slow_samples"] == 2040     # all counted, ring is 256
+    assert stats["fast_burn"] > 1.0
+    assert stats["slow_burn"] > 1.0          # 40/2040 = 2% of a 1% budget
+    # the same blip against a full hour of healthy history stays quiet
+    tracker2 = SloBurnTracker(capacity=256)
+    for i in range(3500):
+        tracker2.observe(0.01, t=now - 3500 + i)
+    for i in range(40):
+        tracker2.observe(2.0, t=now - 20 + i * 0.5)
+    stats2 = tracker2.stats(threshold_s=0.5, budget=0.01, fast_s=300.0,
+                            slow_s=3600.0, now=now)
+    assert stats2["fast_burn"] > 1.0
+    assert stats2["slow_burn"] > 1.0  # 40/3540 still > 1% budget
+    # dilute below budget: violations under 1% of the slow window
+    tracker3 = SloBurnTracker(capacity=256)
+    for i in range(3500):
+        tracker3.observe(0.01, t=now - 3500 + i)
+        tracker3.observe(0.01, t=now - 3500 + i + 0.5)
+    for i in range(40):
+        tracker3.observe(2.0, t=now - 20 + i * 0.5)
+    stats3 = tracker3.stats(threshold_s=0.5, budget=0.01, fast_s=300.0,
+                            slow_s=3600.0, now=now)
+    assert stats3["fast_burn"] > 1.0
+    assert stats3["slow_burn"] < 1.0  # 40/7040 < 1% budget: blip only
+
+
+def test_endpoint_rps_not_capped_by_sample_window():
+    """A route busier than maxlen/window_s must report its true rate:
+    the divisor is the retained history span, not the nominal window."""
+    from cook_tpu.obs.contention import EndpointTelemetry
+
+    t = EndpointTelemetry(samples_per_route=64)
+    for _ in range(64):
+        t.begin("/jobs", "POST")
+        t.done("/jobs", "POST", 201, 0.002)
+    snap = t.snapshot(window_s=60.0)
+    row = snap["POST /jobs"]
+    # 64 requests landed in well under a second; a 60 s divisor would
+    # report ~1 rps
+    assert row["rps"] > 60.0
+
+
+def test_job_starvation_transition(server):
+    kill_all_pending(server)
+    job = make_job(user="starved-user")
+    server.store.submit_jobs([job])
+    assert get_health(server)["healthy"]  # just queued
+    server.clock.advance(120_000)         # 120 s > the 60 s bound
+    health = get_health(server)
+    assert "job-starvation" in health["reasons"]
+    [d] = [d for d in health["degradations"]
+           if d["reason"] == "job-starvation"]
+    assert d["pool"] == "default"
+    assert d["oldest_age_s"] == pytest.approx(120.0)
+    assert d["oldest_job"] == job.uuid
+    assert d["worst_user"] == "starved-user"
+    # the /unscheduled_jobs echo carries the same view
+    r = requests.get(f"{server.url}/unscheduled_jobs",
+                     params={"job": job.uuid}, headers=hdr())
+    [entry] = r.json()
+    assert entry["starvation"]["job_wait_s"] == pytest.approx(120.0)
+    assert entry["starvation"]["pool_oldest_wait_s"] == \
+        pytest.approx(120.0)
+    assert entry["starvation"]["pool_worst_user"] == "starved-user"
+    # recovery: the job leaves the queue
+    server.store.kill_jobs([job.uuid])
+    assert get_health(server)["healthy"]
+
+
+def test_starvation_gauges(store, clock):
+    from cook_tpu.scheduler.monitor import collect_pool_stats, \
+        starvation_stats
+    from cook_tpu.utils.metrics import global_registry
+
+    store.submit_jobs([make_job(user="u1"), make_job(user="u2")])
+    clock.advance(45_000)
+    store.submit_jobs([make_job(user="u2")])
+    sv = starvation_stats(store, "default")
+    assert sv["oldest_age_s"] == pytest.approx(45.0)
+    assert sv["user_max_wait_s"]["u1"] == pytest.approx(45.0)
+    assert sv["user_max_wait_s"]["u2"] == pytest.approx(45.0)
+    assert sv["worst_user_wait_s"] == pytest.approx(45.0)
+    collect_pool_stats(store, "default")
+    g = global_registry.gauge
+    assert g("monitor.oldest_waiting_age_seconds").value(
+        {"pool": "default"}) == pytest.approx(45.0)
+    assert g("monitor.user_max_wait_seconds").value(
+        {"pool": "default", "user": "u1"}) == pytest.approx(45.0)
+
+
+def test_user_wait_gauge_retracted_when_user_stops_waiting(store, clock):
+    """A scheduled (or killed) user's max-wait gauge must disappear, not
+    freeze at its last value — a frozen 900 s reads as live starvation
+    forever, and user labels would accumulate with workload churn."""
+    from cook_tpu.scheduler.monitor import collect_pool_stats
+    from cook_tpu.utils.metrics import global_registry
+
+    jobs = [make_job(user="transient"), make_job(user="sticky")]
+    store.submit_jobs(jobs)
+    clock.advance(30_000)
+    collect_pool_stats(store, "default")
+    gauge = global_registry.gauge("monitor.user_max_wait_seconds")
+    labels = {"pool": "default", "user": "transient"}
+    assert gauge.value(labels) == pytest.approx(30.0)
+    store.kill_jobs([jobs[0].uuid])
+    collect_pool_stats(store, "default")
+    assert gauge.value(labels) == 0.0
+    assert gauge.value({"pool": "default",
+                        "user": "sticky"}) == pytest.approx(30.0)
+
+
+# ------------------------------------------------------------- profiling
+
+
+def test_reentrant_acquisitions_not_double_counted(store):
+    """store.submit_jobs holds the lock and calls locked helpers; only
+    the outermost acquisition may count (re-entrant waits are zero by
+    construction and would dilute the contention ratio)."""
+    profiler = store._lock.profiler
+    before = profiler.acquisitions
+    with store._lock:
+        with store._lock:       # re-entrant: passes straight through
+            store.pending_count("default")
+    assert profiler.acquisitions == before + 1
+
+
+def test_lock_profiler_attributes_call_sites(store):
+    store.submit_jobs([make_job()])
+    snap = store._lock.profiler.snapshot()
+    assert "store.submit_jobs" in snap["sites"]
+    site = snap["sites"]["store.submit_jobs"]
+    assert site["acquisitions"] >= 1
+    assert site["hold_s"] > 0

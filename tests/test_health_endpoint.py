@@ -66,9 +66,12 @@ def test_healthy_by_default(server, fresh_telemetry):
     health = get_health(server)
     assert health["healthy"] and health["status"] == "ok"
     assert health["degradations"] == []
-    # the check evidence is present even when green
+    # the check evidence is present even when green — device-telemetry
+    # checks plus the merged control-plane contention checks
     assert set(health["checks"]) == {"compile", "quality", "solve_latency",
-                                     "device_memory"}
+                                     "device_memory", "contention"}
+    assert set(health["checks"]["contention"]) == {
+        "store_lock", "journal", "replication", "commit_ack", "starvation"}
 
 
 def test_recompile_storm_transition(server, fresh_telemetry):

@@ -158,6 +158,58 @@ def test_dynamic_span_fragments_checked():
     assert not lint_source('span(f"Cycle {phase}")\n').ok
 
 
+# ----------------------------------------------------- doc-drift rule
+
+
+def test_documented_names_parsing():
+    exact, prefixes = lint_metrics.documented_names(
+        "| `jobs_submitted` | counter |\n"
+        "| `monitor.*` | gauge |\n"
+        "| `obs.device.mem_*` | gauge |\n"
+        "| `a.b` / `c-d` | counter |\n")
+    assert {"jobs_submitted", "a.b", "c-d"} <= exact
+    assert "monitor." in prefixes and "obs.device.mem_" in prefixes
+
+
+def test_doc_coverage_flags_undocumented_metric():
+    result = lint_source("global_registry.counter('brand.new', 'h')\n")
+    assert result.ok
+    lint_metrics.lint_doc_coverage(result, "| `other.metric` | counter |",
+                                   "docs/observability.md")
+    assert not result.ok
+    assert "not in the docs/observability.md catalog" in result.errors[0]
+
+
+def test_doc_coverage_accepts_exact_and_wildcard():
+    result = lint_source(
+        "global_registry.counter('covered.exact', 'h')\n"
+        "global_registry.gauge('family.member.x', 'h')\n")
+    lint_metrics.lint_doc_coverage(
+        result, "`covered.exact` and `family.*`", "docs/observability.md")
+    assert result.ok
+
+
+def test_doc_coverage_skips_dynamic_names():
+    result = lint_source('global_registry.histogram(f"span.{n}", "h")\n')
+    lint_metrics.lint_doc_coverage(result, "nothing documented",
+                                   "docs/observability.md")
+    assert result.ok
+
+
+def test_tree_lint_checks_repo_doc_catalog(tmp_path):
+    """A cook_tpu/-shaped tree with a catalog gets the drift check; the
+    same tree without the doc is linted without it."""
+    (tmp_path / "cook_tpu").mkdir()
+    (tmp_path / "cook_tpu" / "a.py").write_text(
+        "global_registry.counter('undocumented.name', 'h')\n")
+    assert lint_metrics.lint_tree(str(tmp_path)).ok  # no catalog -> skip
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text("| `other` |")
+    result = lint_metrics.lint_tree(str(tmp_path))
+    assert not result.ok
+    assert "undocumented.name" in result.errors[0]
+
+
 def test_cli_exit_codes(tmp_path):
     clean = tmp_path / "clean"
     clean.mkdir()

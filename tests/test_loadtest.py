@@ -1,0 +1,120 @@
+"""tools/loadtest.py + sim/loadgen.rest_traffic_trace: the sustained
+control-plane load harness and its shared reproducible traffic shape."""
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+
+import loadtest  # noqa: E402
+
+from cook_tpu.sim.loadgen import (  # noqa: E402
+    rest_traffic_trace,
+    traffic_trace_jobs,
+)
+
+
+# ------------------------------------------------------------ trace shape
+
+
+def test_trace_is_deterministic_by_seed():
+    a = rest_traffic_trace(duration_s=5.0, rps=40.0, seed=7)
+    b = rest_traffic_trace(duration_s=5.0, rps=40.0, seed=7)
+    assert a == b
+    c = rest_traffic_trace(duration_s=5.0, rps=40.0, seed=8)
+    assert a != c
+
+
+def test_trace_ops_well_formed():
+    ops = rest_traffic_trace(duration_s=5.0, rps=60.0, seed=3)
+    assert len(ops) > 100  # ~300 expected at 60 rps over 5 s
+    submits = set()
+    last_offset = 0.0
+    for i, op in enumerate(ops):
+        assert op.offset_s >= last_offset
+        last_offset = op.offset_s
+        assert op.kind in ("submit", "query", "kill")
+        if op.kind == "submit":
+            assert op.spec["command"] == "true"
+            submits.add(i)
+        else:
+            # query/kill always target an EARLIER submit
+            assert op.ref in submits and op.ref < i
+    # the mix produced all three kinds
+    kinds = {op.kind for op in ops}
+    assert kinds == {"submit", "query", "kill"}
+
+
+def test_trace_is_bursty():
+    """Burst windows must carry visibly more arrivals per second than
+    the off-burst base — the thundering-herd shape is the point."""
+    ops = rest_traffic_trace(duration_s=20.0, rps=50.0, seed=1,
+                             burst_every_s=2.0, burst_len_s=0.4,
+                             burstiness=4.0)
+    in_burst = sum(1 for op in ops if (op.offset_s % 2.0) < 0.4)
+    out_burst = len(ops) - in_burst
+    burst_rate = in_burst / (20.0 * 0.2)        # 20% of wall is burst
+    base_rate = out_burst / (20.0 * 0.8)
+    assert burst_rate > 2.0 * base_rate
+
+
+def test_trace_converts_to_sim_jobs():
+    ops = rest_traffic_trace(duration_s=5.0, rps=40.0, seed=2)
+    jobs = traffic_trace_jobs(ops, runtime_ms=500)
+    assert len(jobs) == sum(1 for op in ops if op.kind == "submit")
+    assert all(j.runtime_ms == 500 for j in jobs)
+    # arrival offsets survive the conversion
+    assert jobs[0].submit_time_ms == int(ops[0].offset_s * 1000)
+
+
+# --------------------------------------------------------- live harness
+
+
+@pytest.fixture(scope="module")
+def plane():
+    from cook_tpu.rest.server import InprocessControlPlane
+
+    plane = InprocessControlPlane().start()
+    yield plane
+    plane.stop()
+
+
+def test_loadtest_reports_commit_ack_and_attribution(plane):
+    report = loadtest.run_loadtest(
+        plane.url, rps=40.0, duration_s=1.0, mode="closed", workers=2,
+        seed=5, warmup=3)
+    assert report["errors"] == 0
+    ack = report["commit_ack"]
+    assert ack["count"] > 0
+    assert ack["p50_ms"] > 0 and ack["p99_ms"] >= ack["p50_ms"]
+    # the run closes with the server's own attribution
+    contention = report["contention"]
+    assert contention["store_lock"]["acquisitions"] > 0
+    # the in-process plane journals every commit: fsyncs happened
+    assert contention["journal"]["fsyncs"] > 0
+    assert "POST /jobs" in contention["endpoints"]
+
+
+def test_open_loop_paces_arrivals(plane):
+    """Open loop takes at least the trace's span of wall time (requests
+    start at their offsets; closed loop would finish much sooner)."""
+    import time
+
+    t0 = time.perf_counter()
+    report = loadtest.run_loadtest(
+        plane.url, rps=30.0, duration_s=1.0, mode="open", workers=8,
+        seed=6)
+    wall = time.perf_counter() - t0
+    assert report["errors"] == 0
+    assert wall >= 0.5  # paced, not back-to-back
+
+
+def test_inprocess_smoke_round_trip():
+    """What bench.py's control_plane phase runs: a fresh in-process
+    plane, driven and torn down."""
+    report = loadtest.run_inprocess(rps=30.0, duration_s=0.5,
+                                    mode="closed", workers=1, seed=9,
+                                    warmup=2)
+    assert report["errors"] == 0
+    assert report["commit_ack"]["count"] > 0

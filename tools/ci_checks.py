@@ -6,14 +6,19 @@ metrics/tracing lint, the smoke bench tier, and the bench regression
 gate — each with its own invocation and exit-code convention.  This
 wrapper runs them as one pipeline with one verdict:
 
-  1. `tools/lint_metrics.py`   — metric/span registration lint;
+  1. `tools/lint_metrics.py`   — metric/span registration lint + the
+     docs/observability.md catalog drift check;
   2. `python bench.py --smoke` — the tiny bench tier:
-     match/dru/rebalance/elastic solves plus the pipelined-vs-serial
-     match-cycle comparison, included by default (writes
-     BENCH_rsmoke.json, rotating the previous record to
-     BENCH_rsmoke_prev.json so step 3 has a pair to diff);
+     match/dru/rebalance/elastic solves, the pipelined-vs-serial
+     match-cycle comparison, AND the `control_plane` phase — the
+     loadtest (`tools/loadtest.py`, serial closed-loop so the gated p50
+     is commit SERVICE time, not same-process queueing jitter) against
+     an in-process control plane, so commit-ack p50/p99 is measured
+     every CI run (writes BENCH_rsmoke.json, rotating the previous
+     record to BENCH_rsmoke_prev.json so step 3 has a pair to diff);
   3. `tools/bench_gate.py`     — phase-by-phase regression gate over
-     the latest comparable record pair.
+     the latest comparable record pair (commit-ack p50 included, via
+     the control_plane phase).
 
     python tools/ci_checks.py [--root DIR] [--threshold 0.2]
                               [--skip-bench]
@@ -44,8 +49,9 @@ def run_smoke_bench(root: str) -> int:
     """Smoke bench in a SUBPROCESS: bench.py initializes jax, and a
     wedged accelerator plugin must kill the step's budget, not this
     process (the same isolation bench.py's own probe uses).  The smoke
-    tier includes the pipelined-vs-serial match-cycle phases by default,
-    so bench_gate diffs pipeline-on vs pipeline-off walls run to run."""
+    tier includes the pipelined-vs-serial match-cycle phases AND the
+    control_plane loadtest phase by default, so bench_gate diffs
+    pipeline walls and commit-ack latency run to run."""
     proc = subprocess.run(
         [sys.executable, os.path.join(root, "bench.py"), "--smoke"],
         cwd=root,

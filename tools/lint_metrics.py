@@ -20,7 +20,14 @@ the source tree instead and fails when:
     `cook_span_<name>` histograms and ring entries — one flat grammar);
   * the same span name is introduced from more than one module (each
     span has one owner; a shared name would merge two different
-    sections into one histogram with nobody noticing).
+    sections into one histogram with nobody noticing);
+  * **doc drift** — a literal metric name registered in code does not
+    appear in the docs/observability.md catalog (exact backticked name,
+    or a documented `prefix.*` wildcard).  A metric nobody documented
+    is a metric nobody can interpret mid-incident; the catalog is the
+    contract, so it must grow WITH the code.  Only checked when the
+    linted root carries docs/observability.md (arbitrary-directory
+    lints skip it).
 
 Aliased registrations (`g = global_registry.gauge; g("name", ...)`) are
 resolved file-locally, so the monitor-gauge idiom stays covered.
@@ -275,6 +282,45 @@ def _lint_spans(result: LintResult) -> None:
                 f"hoist a shared helper)")
 
 
+DOC_CATALOG = pathlib.Path("docs") / "observability.md"
+# a backticked doc token that can name a registry metric: the literal
+# name, or a trailing-`*` wildcard row covering a family
+# (`monitor.*`, `obs.device.mem_*`)
+_DOC_NAME = re.compile(r"`([a-zA-Z0-9_][a-zA-Z0-9_.\-]*\*?)`")
+
+
+def documented_names(doc_text: str) -> tuple[set[str], list[str]]:
+    """(exact names, wildcard prefixes) the catalog vouches for.  A
+    `monitor.*` row covers every `monitor.`-prefixed registration."""
+    exact: set[str] = set()
+    prefixes: list[str] = []
+    for token in _DOC_NAME.findall(doc_text):
+        if token.endswith("*"):
+            prefixes.append(token[:-1])
+        else:
+            exact.add(token)
+    return exact, prefixes
+
+
+def lint_doc_coverage(result: LintResult, doc_text: str,
+                      doc_path: str) -> None:
+    """Fail literal metric registrations missing from the catalog.
+    Dynamic names can't be matched exactly and are skipped (their
+    fragments were already character-checked)."""
+    exact, prefixes = documented_names(doc_text)
+    missing: dict[str, CallSite] = {}
+    for site in result.sites:
+        if site.dynamic or site.name in exact or site.name in missing:
+            continue
+        if any(site.name.startswith(p) for p in prefixes):
+            continue
+        missing[site.name] = site
+    for name, site in sorted(missing.items()):
+        result.errors.append(
+            f"{site.path}:{site.line}: metric {name!r} is not in the "
+            f"{doc_path} catalog (add a row, or a `family.*` wildcard)")
+
+
 def lint_tree(root: str) -> LintResult:
     root_path = pathlib.Path(root)
     sites: list[CallSite] = []
@@ -291,7 +337,14 @@ def lint_tree(root: str) -> LintResult:
                 continue
             sites.extend(collect_sites(source, str(path)))
             span_sites.extend(collect_span_sites(source, str(path)))
-    return lint_sites(sites, span_sites)
+    result = lint_sites(sites, span_sites)
+    doc = root_path / DOC_CATALOG
+    if doc.is_file():
+        try:
+            lint_doc_coverage(result, doc.read_text(), str(doc))
+        except OSError:
+            pass
+    return result
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,0 +1,305 @@
+#!/usr/bin/env python
+"""Sustained control-plane load harness: p50/p99 commit-ack at target RPS.
+
+ROADMAP item 2 (sharded control plane) is judged by one number — p99
+commit-ack latency under sustained concurrent submit/query/kill traffic
+— and by WHERE the time goes when it degrades.  This driver produces
+both: it replays a seeded bursty traffic schedule
+(`cook_tpu.sim.loadgen.rest_traffic_trace`, shared with the simulator so
+load shapes reproduce across bench rounds) against a live server, and
+closes by scraping `GET /debug/contention` so the report attributes the
+run's latency to store-lock wait, journal fsync stalls, and replication
+lag.
+
+Two loop disciplines:
+
+  * ``open``  (default) — requests start at the trace's arrival offsets
+    regardless of completions: constant-rate pressure, what "p99 at
+    target RPS" means.  A saturated server grows client-side queueing,
+    which the latency numbers then honestly include.
+  * ``closed`` — N workers issue back-to-back with no pacing: the
+    throughput ceiling probe.
+
+    python tools/loadtest.py --url http://host:port --rps 100 --duration 10
+    python tools/loadtest.py --smoke      # tiny run against an
+                                          # in-process control plane
+                                          # (rest/server.InprocessControlPlane)
+
+The smoke form is what `bench.py`'s `control_plane` phase (full and
+`--smoke` tiers, run from `tools/ci_checks.py`) wraps, so
+`tools/bench_gate.py` tracks commit-ack latency round over round.
+
+Commit-ack latency here is CLIENT-observed POST /jobs wall time — apply
+under the store lock + journal group-fsync + (sync-ack mode) the
+replication wait — the same interval the server-side
+`cook_job_latency_submit_commit_ack` histogram measures from its end.
+"""
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import threading
+import time
+import uuid as uuid_mod
+
+# runnable as `python tools/loadtest.py` from anywhere: the repo root
+# (one level up) carries the cook_tpu package
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _percentile(sorted_values, q):
+    if not sorted_values:
+        return None
+    idx = min(len(sorted_values) - 1,
+              max(0, round(q / 100 * (len(sorted_values) - 1))))
+    return sorted_values[idx]
+
+
+class _Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.latency_ms: dict[str, list] = {}
+        self.errors: dict[str, int] = {}      # transport + 5xx
+        self.not_found: dict[str, int] = {}   # 4xx races (kill-before-
+        #                                       submit-visible etc.)
+
+    def note(self, kind: str, ms: float, status: int,
+             transport_error: bool = False) -> None:
+        with self._lock:
+            if transport_error or status >= 500:
+                self.errors[kind] = self.errors.get(kind, 0) + 1
+            elif status >= 400:
+                self.not_found[kind] = self.not_found.get(kind, 0) + 1
+            else:
+                self.latency_ms.setdefault(kind, []).append(ms)
+
+    def kind_summary(self) -> dict:
+        out = {}
+        with self._lock:
+            kinds = set(self.latency_ms) | set(self.errors) \
+                | set(self.not_found)
+            for kind in sorted(kinds):
+                lat = sorted(self.latency_ms.get(kind, []))
+                out[kind] = {
+                    "count": len(lat),
+                    "errors": self.errors.get(kind, 0),
+                    "rejected_4xx": self.not_found.get(kind, 0),
+                    "p50_ms": _percentile(lat, 50),
+                    "p99_ms": _percentile(lat, 99),
+                    "max_ms": lat[-1] if lat else None,
+                }
+        return out
+
+
+def _execute_op(session_factory, url, op, uuids, recorder):
+    import requests
+
+    session = session_factory()
+    headers = {"X-Cook-Requesting-User": op.user}
+    t0 = time.perf_counter()
+    status, transport_error = 0, False
+    try:
+        if op.kind == "submit":
+            spec = dict(op.spec)
+            spec["uuid"] = uuids[op.index]
+            r = session.post(f"{url}/jobs", json={"jobs": [spec]},
+                             headers=headers, timeout=30)
+            status = r.status_code
+        elif op.kind == "query":
+            r = session.get(f"{url}/jobs", params={"uuid": uuids[op.ref]},
+                            headers=headers, timeout=30)
+            status = r.status_code
+        else:  # kill — admin impersonates nobody; the submitting user
+            # owns the job, so kill as that user
+            r = session.delete(f"{url}/jobs",
+                               params={"uuid": uuids[op.ref]},
+                               headers=headers, timeout=30)
+            status = r.status_code
+    except requests.RequestException:
+        transport_error = True
+    recorder.note(op.kind, (time.perf_counter() - t0) * 1000, status,
+                  transport_error)
+
+
+def _thread_sessions():
+    """One requests.Session per worker thread (sessions are not
+    thread-safe; per-op sessions would pay a TCP handshake each)."""
+    import requests
+
+    local = threading.local()
+
+    def factory():
+        session = getattr(local, "session", None)
+        if session is None:
+            session = local.session = requests.Session()
+        return session
+
+    return factory
+
+
+def run_loadtest(url: str, *, rps: float = 50.0, duration_s: float = 5.0,
+                 mode: str = "open", workers: int = 32,
+                 mix: tuple = (0.7, 0.2, 0.1), n_users: int = 8,
+                 seed: int = 0, pool=None, admin_user: str = "admin",
+                 warmup: int = 0, log=lambda *a: None) -> dict:
+    """Drive the trace against a live server; return the report dict.
+    `warmup` serial submits are issued first and NOT recorded — they pay
+    the connection setup and first-touch code paths (JSON, route
+    resolution, journal open) that would otherwise skew a short run's
+    percentiles."""
+    import requests
+
+    from cook_tpu.sim.loadgen import rest_traffic_trace
+
+    if warmup:
+        session = requests.Session()
+        for i in range(warmup):
+            try:
+                session.post(
+                    f"{url}/jobs",
+                    json={"jobs": [{"command": "true", "mem": 64,
+                                    "cpus": 0.5,
+                                    "uuid": str(uuid_mod.uuid4()),
+                                    **({"pool": pool} if pool else {})}]},
+                    headers={"X-Cook-Requesting-User": "warmup"},
+                    timeout=30)
+            except requests.RequestException:
+                break
+
+    ops = rest_traffic_trace(duration_s=duration_s, rps=rps, mix=mix,
+                             n_users=n_users, seed=seed, pool=pool)
+    # pre-assign every submit's uuid so query/kill ops can target their
+    # referenced submit even while it is still in flight (a lost race
+    # shows up as a 4xx, counted separately from real failures)
+    uuids: dict[int, str] = {
+        i: str(uuid_mod.uuid4()) for i, op in enumerate(ops)
+        if op.kind == "submit"}
+
+    class _Op:
+        __slots__ = ("index", "offset_s", "kind", "user", "spec", "ref")
+
+        def __init__(self, index, src):
+            self.index = index
+            self.offset_s = src.offset_s
+            self.kind = src.kind
+            self.user = src.user
+            self.spec = src.spec
+            self.ref = src.ref
+
+    run_ops = [_Op(i, op) for i, op in enumerate(ops)]
+    for op in run_ops:
+        if op.kind == "kill":
+            # only the owner (or an admin) may kill: issue the kill as
+            # the user who submitted the referenced job
+            op.user = ops[op.ref].user
+    recorder = _Recorder()
+    session_factory = _thread_sessions()
+    start = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as pool_:
+        if mode == "open":
+            for op in run_ops:
+                lag = op.offset_s - (time.perf_counter() - start)
+                if lag > 0:
+                    time.sleep(lag)
+                pool_.submit(_execute_op, session_factory, url, op, uuids,
+                             recorder)
+        else:  # closed loop: no pacing, back-to-back pressure
+            for op in run_ops:
+                pool_.submit(_execute_op, session_factory, url, op, uuids,
+                             recorder)
+    wall_s = time.perf_counter() - start
+    kinds = recorder.kind_summary()
+    submit = kinds.get("submit", {})
+    total = sum(k["count"] + k["errors"] + k["rejected_4xx"]
+                for k in kinds.values())
+    report = {
+        "mode": mode,
+        "target_rps": rps,
+        "achieved_rps": round(total / wall_s, 2) if wall_s else 0.0,
+        "duration_s": round(wall_s, 3),
+        "ops": kinds,
+        "commit_ack": {"p50_ms": submit.get("p50_ms"),
+                       "p99_ms": submit.get("p99_ms"),
+                       "count": submit.get("count", 0)},
+        "errors": sum(k["errors"] for k in kinds.values()),
+    }
+    # close with the server's own attribution: where the run's write-
+    # path time went (store lock / fsync / replication / per-endpoint)
+    try:
+        import requests
+
+        r = requests.get(f"{url}/debug/contention",
+                         headers={"X-Cook-Requesting-User": admin_user},
+                         timeout=10)
+        if r.status_code == 200:
+            report["contention"] = r.json()
+    except Exception as e:  # noqa: BLE001 — attribution is best-effort;
+        # the latency numbers stand on their own
+        log(f"loadtest: /debug/contention scrape failed: {e}")
+    return report
+
+
+def run_inprocess(**kw) -> dict:
+    """Smoke form: spin an InprocessControlPlane (real store lock, real
+    journal fsyncs, real REST stack — no scheduler/device) and drive it.
+    What bench.py's `control_plane` phase wraps."""
+    from cook_tpu.rest.server import InprocessControlPlane
+
+    plane = InprocessControlPlane().start()
+    try:
+        return run_loadtest(plane.url, **kw)
+    finally:
+        plane.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="sustained control-plane load harness")
+    parser.add_argument("--url", default="",
+                        help="target server; omit with --smoke to use an "
+                             "in-process control plane")
+    parser.add_argument("--rps", type=float, default=50.0,
+                        help="target request rate (open-loop pacing)")
+    parser.add_argument("--duration", type=float, default=5.0)
+    parser.add_argument("--mode", choices=("open", "closed"),
+                        default="open")
+    parser.add_argument("--workers", type=int, default=32)
+    parser.add_argument("--users", type=int, default=8)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--pool", default=None)
+    parser.add_argument("--mix", default="0.7,0.2,0.1",
+                        help="submit:query:kill fractions")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny in-process run (rps 40, 2 s)")
+    parser.add_argument("--out", default="",
+                        help="write the JSON report here too")
+    args = parser.parse_args(argv)
+
+    mix = tuple(float(x) for x in args.mix.split(","))
+    kw = dict(rps=args.rps, duration_s=args.duration, mode=args.mode,
+              workers=args.workers, mix=mix, n_users=args.users,
+              seed=args.seed, pool=args.pool,
+              log=lambda *a: print(*a, file=sys.stderr))
+    if args.smoke:
+        kw.update(rps=min(args.rps, 40.0), duration_s=min(args.duration, 2.0))
+        report = run_inprocess(**kw)
+    elif args.url:
+        report = run_loadtest(args.url, **kw)
+    else:
+        parser.error("--url required (or --smoke for in-process)")
+    summary = {k: report[k] for k in ("mode", "target_rps", "achieved_rps",
+                                      "duration_s", "commit_ack", "errors")}
+    print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    return 1 if report["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
