@@ -16,6 +16,9 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
+from cook_tpu import faults
+from cook_tpu.faults.breaker import BreakerParams, CircuitBreaker
+
 log = logging.getLogger(__name__)
 
 
@@ -175,6 +178,27 @@ def wait_all_launches(clusters, timeout: Optional[float] = None) -> list:
     return stuck
 
 
+def safe_pool_offers(cluster, pool: str) -> Optional[list]:
+    """One cluster's offers for one pool, fault-injectable: an offer RPC
+    raising returns None (the cluster is skipped this scan) instead of
+    taking the whole rank/match cycle down — one flapping backend must
+    not starve every pool.  Offer outcomes deliberately do NOT feed the
+    circuit breaker: its window watches launch/kill RPC outcomes only
+    (BreakerParams), and scans report no successes, so rare scan blips
+    would accumulate one-sidedly until they opened the breaker on a
+    healthy cluster."""
+    try:
+        fault_schedule = faults.ACTIVE
+        if fault_schedule is not None:
+            fault_schedule.hit(faults.CLUSTER_OFFERS, cluster=cluster.name,
+                               pool=pool)
+        return cluster.pending_offers(pool)
+    except Exception:  # noqa: BLE001 — backend RPC boundary
+        log.exception("pending_offers failed (cluster %s, pool %s); "
+                      "skipping this scan", cluster.name, pool)
+        return None
+
+
 def scan_pool_offers(clusters, pool: str):
     """Yield every offer the pool's work-accepting clusters currently
     make.  THE one spare/capacity offer scan — the scheduler's spare
@@ -186,7 +210,10 @@ def scan_pool_offers(clusters, pool: str):
     for cluster in clusters:
         if not cluster.accepts_work:
             continue
-        for offer in cluster.pending_offers(pool):
+        offers = safe_pool_offers(cluster, pool)
+        if offers is None:
+            continue
+        for offer in offers:
             yield cluster, offer
 
 
@@ -221,6 +248,37 @@ class ComputeCluster(abc.ABC):
         self._launch_pending: set = set()
         self._launch_sema: Optional[threading.BoundedSemaphore] = None
         self._launch_lock = threading.Lock()
+        # circuit breaker over this backend's launch/kill RPC outcomes
+        # (cook_tpu/faults/breaker.py): open = accepts_work False, so a
+        # failing backend stops receiving offers/launches until a
+        # half-open probe succeeds.  Replaceable (tests/chaos tune
+        # params); kills are never gated, only counted.
+        self.breaker = CircuitBreaker(name)
+
+    def configure_breaker(self, params: BreakerParams,
+                          clock=None) -> CircuitBreaker:
+        """Swap in a breaker with custom thresholds (chaos/test knob)."""
+        import time as _time
+
+        self.breaker = CircuitBreaker(self.name, params,
+                                      clock=clock or _time.monotonic)
+        return self.breaker
+
+    def run_launch(self, pool: str, specs: Sequence[TaskSpec]) -> None:
+        """THE backend launch entry: the `cluster.launch` fault point and
+        breaker accounting around `launch_tasks`.  Callers hold whatever
+        kill-lock side they need (the serial matcher path and the async
+        worker both hold the read side around this call)."""
+        try:
+            fault_schedule = faults.ACTIVE
+            if fault_schedule is not None:
+                fault_schedule.hit(faults.CLUSTER_LAUNCH, cluster=self.name,
+                                   pool=pool)
+            self.launch_tasks(pool, specs)
+        except Exception:
+            self.breaker.note_failure(probe=True)
+            raise
+        self.breaker.note_success(probe=True)
 
     # --- offers ---
     @abc.abstractmethod
@@ -240,12 +298,21 @@ class ComputeCluster(abc.ABC):
         ...
 
     def safe_kill_task(self, task_id: str) -> None:
-        """Kill that tolerates backend errors (reference safe-kill-task)."""
+        """Kill that tolerates backend errors (reference safe-kill-task).
+        Never gated by the circuit breaker — a sick cluster must still
+        honor kills — but outcomes feed its error window (the
+        `cluster.kill` fault point sits in front of the RPC)."""
         try:
             with self.kill_lock.write():
+                fault_schedule = faults.ACTIVE
+                if fault_schedule is not None:
+                    fault_schedule.hit(faults.CLUSTER_KILL,
+                                       cluster=self.name, task_id=task_id)
                 self.kill_task(task_id)
         except Exception:  # noqa: BLE001 — kill must never propagate
-            pass
+            self.breaker.note_failure()
+            return
+        self.breaker.note_success()
 
     # --- async launch fan-out (scheduler/pipeline.py) ---
 
@@ -278,7 +345,7 @@ class ComputeCluster(abc.ABC):
             exc = None
             try:
                 with self.kill_lock.read():
-                    self.launch_tasks(pool, specs)
+                    self.run_launch(pool, specs)
             except Exception as e:  # noqa: BLE001 — flows to done_cb
                 exc = e
             finally:
@@ -366,7 +433,11 @@ class ComputeCluster(abc.ABC):
 
     @property
     def accepts_work(self) -> bool:
-        return self.state == ClusterState.RUNNING
+        """RUNNING and circuit-closed (or half-open — offers flowing
+        again IS the probe).  An open breaker withholds this cluster
+        from every offer scan and launch path until its cooldown."""
+        return self.state == ClusterState.RUNNING \
+            and self.breaker.allows_work()
 
     def retrieve_sandbox_url_path(self, task_id: str) -> str:
         return ""

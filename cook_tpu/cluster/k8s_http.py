@@ -35,9 +35,32 @@ import time
 from typing import Callable, Optional
 from urllib.parse import urlencode, urlsplit
 
+from cook_tpu import faults
 from cook_tpu.cluster.k8s import KubeApi, KubeNode, KubePod, PodPhase
+from cook_tpu.utils.retry import RetryPolicy, call_with_retry
 
 log = logging.getLogger(__name__)
+
+
+class ApiError(OSError):
+    """A non-2xx apiserver answer; `status` distinguishes client errors
+    (4xx — never retried) from server errors (5xx — retryable on
+    idempotent requests)."""
+
+    def __init__(self, message: str, status: int):
+        super().__init__(message)
+        self.status = status
+
+
+def _retryable_get_error(exc: BaseException) -> bool:
+    """GET/LIST retry classification: transport failures and 5xx are
+    transient; 4xx means the request itself is wrong (and a 410 WatchGap
+    has its own re-list recovery)."""
+    if isinstance(exc, WatchGap):
+        return False
+    if isinstance(exc, ApiError):
+        return exc.status >= 500
+    return isinstance(exc, OSError)
 
 COOK_MANAGED_LABEL = "cook.scheduler/managed"
 COOK_POOL_LABEL = "cook.scheduler/pool"
@@ -169,6 +192,11 @@ class HttpKubeApi(KubeApi):
         self._watch_thread: Optional[threading.Thread] = None
         self._all_watch_thread: Optional[threading.Thread] = None
         self._lock = threading.RLock()
+        # bounded retry for idempotent GET/LIST only (see _request);
+        # deadline keeps attempts + backoff inside ~2 request budgets
+        self._get_retry_policy = RetryPolicy(
+            max_attempts=2, base_s=0.2, cap_s=1.0,
+            deadline_s=request_timeout_s * 2)
 
     # ----------------------------------------------------------- plumbing
 
@@ -195,24 +223,44 @@ class HttpKubeApi(KubeApi):
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  query: Optional[dict] = None) -> dict:
+        """One apiserver call.  Idempotent GET/LIST requests get a
+        bounded, deadline-aware retry (2 attempts, utils/retry.py shared
+        policy) on transport errors and 5xx; MUTATING requests stay
+        single-shot — a retried POST whose first attempt actually landed
+        would double-create, and the watch/expected-state machinery
+        already reconciles uncertainty."""
         path = self._path_prefix + path
         if query:
             path = f"{path}?{urlencode(query)}"
-        conn = self._connection(self.request_timeout_s)
-        try:
-            conn.request(method, path,
-                         body=json.dumps(body) if body is not None else None,
-                         headers=self._headers())
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status == 410:
-                raise WatchGap(path)
-            if resp.status >= 400:
-                raise OSError(
-                    f"{method} {path} -> {resp.status}: {data[:200]!r}")
-            return json.loads(data) if data else {}
-        finally:
-            conn.close()
+
+        def once() -> dict:
+            fault_schedule = faults.ACTIVE
+            if fault_schedule is not None:
+                fault_schedule.hit(faults.K8S_REQUEST, method=method,
+                                   path=path)
+            conn = self._connection(self.request_timeout_s)
+            try:
+                conn.request(
+                    method, path,
+                    body=json.dumps(body) if body is not None else None,
+                    headers=self._headers())
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status == 410:
+                    raise WatchGap(path)
+                if resp.status >= 400:
+                    raise ApiError(
+                        f"{method} {path} -> {resp.status}: "
+                        f"{data[:200]!r}", resp.status)
+                return json.loads(data) if data else {}
+            finally:
+                conn.close()
+
+        if method != "GET":
+            return once()
+        return call_with_retry(once, self._get_retry_policy,
+                               op="k8s.get",
+                               retry_on=_retryable_get_error)
 
     # ------------------------------------------------------------ parsing
 
@@ -444,8 +492,8 @@ class HttpKubeApi(KubeApi):
                 "DELETE",
                 f"/api/v1/namespaces/{self.namespace}/pods/{name}",
                 body={"gracePeriodSeconds": 30})
-        except OSError as e:
-            if "404" not in str(e):
+        except ApiError as e:
+            if e.status != 404:
                 raise
 
     def set_pod_watch(self, callback) -> None:

@@ -218,7 +218,8 @@ def build_process(
         from cook_tpu.models import persistence
 
         journal = persistence.attach_journal(
-            store, os.path.join(settings.data_dir, "journal.jsonl")
+            store, os.path.join(settings.data_dir, "journal.jsonl"),
+            fsync_policy=settings.journal_fsync_policy,
         )
     from cook_tpu.utils.logging import attach_passport
 
@@ -274,7 +275,16 @@ def build_process(
         replication_min_acks=settings.replication_min_acks,
         replication_ack_timeout_s=settings.replication_ack_timeout_s,
         replication_ack_liveness_s=settings.replication_ack_liveness_s,
+        load_shedding=settings.load_shedding,
+        fault_injection=settings.fault_injection,
     ), plugins=plugins, txn=txn)
+    # close the overload loop (docs/resilience.md reaction (d)): the
+    # contention observatory's shed signal also drives the scheduler's
+    # considerable-window scaleback.  One flag governs BOTH halves of
+    # the reaction — load_shedding: false must not leave the scheduler
+    # silently shrinking considerable windows with no knob to stop it
+    if settings.load_shedding:
+        scheduler.admission.overload_fn = api.shedder.overloaded
     api.queue_limits.limits.per_pool = settings.queue_limit_per_pool
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user
     process = CookProcess(settings=settings, store=store, clusters=clusters,
@@ -359,6 +369,33 @@ def start_leader_duties(process: CookProcess,
     process.api.leader_url = ""
     log_info("leadership acquired", component="leader",
              member=process.member_id)
+    if process.journal is not None and \
+            getattr(process.journal, "fsync_policy", "") == "fail-stop":
+        # reaction (e), docs/resilience.md: under the fail-stop policy a
+        # journal fsync FAILURE demotes this leader (fail-fast,
+        # mesos.clj:296-313) so a standby with a working disk takes
+        # over; the failing commit itself already surfaced the error to
+        # its client
+        def _fsync_fail_stop(exc, _p=process):
+            log.error("journal fsync failed (%s): fail-stop leader "
+                      "demotion", exc)
+            sel = _p.selector
+            if sel is None or not sel.is_leader:
+                return
+
+            def _demote():
+                _p.scheduler.active = False
+                _p.api.leader = False
+                sel.demote()
+
+            # the hook fires on the committing request's thread, UNDER
+            # the journal writer's lock: demote on its own thread so the
+            # lease release / on_loss callback never run under that lock
+            # and the failing commit's error reaches its client first
+            threading.Thread(target=_demote, daemon=True,
+                             name="fsync-fail-stop").start()
+
+        process.journal.on_fsync_error = _fsync_fail_stop
     process.selector.start_heartbeat_thread()
 
     scheduler = process.scheduler

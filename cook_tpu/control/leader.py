@@ -23,6 +23,7 @@ Implementations:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -30,6 +31,10 @@ import urllib.error
 import urllib.request
 from abc import ABC, abstractmethod
 from typing import Callable, Optional
+
+from cook_tpu import faults
+
+log = logging.getLogger(__name__)
 
 
 class LeaderElector(ABC):
@@ -272,6 +277,8 @@ class LeaderSelector:
         self.poll_s = poll_s
         self.on_loss = on_loss or (lambda: os._exit(0))
         self._stop = threading.Event()
+        self._lost = threading.Event()
+        self._loss_lock = threading.Lock()
         self.is_leader = False
 
     def wait_for_leadership(self) -> None:
@@ -281,18 +288,57 @@ class LeaderSelector:
                 return
             self._stop.wait(self.poll_s)
 
+    def _heartbeat(self) -> bool:
+        """One lease renewal, with the `leader.heartbeat` fault point in
+        front: an injected error IS a lease loss (the chaos suite drives
+        failover through the same fail-fast path a real expiry takes);
+        a delay rule is a slow lease service."""
+        try:
+            fault_schedule = faults.ACTIVE
+            if fault_schedule is not None:
+                fault_schedule.hit(
+                    faults.LEADER_HEARTBEAT,
+                    member=getattr(self.elector, "member_id", ""))
+        except faults.FaultInjected:
+            return False
+        return self.elector.heartbeat()
+
     def start_heartbeat_thread(self) -> threading.Thread:
         def loop():
             while not self._stop.is_set():
-                if not self.elector.heartbeat():
+                if not self._heartbeat():
                     self.is_leader = False
-                    self.on_loss()
+                    self._fire_loss()
                     return
                 self._stop.wait(self.poll_s)
 
         t = threading.Thread(target=loop, daemon=True, name="leader-heartbeat")
         t.start()
         return t
+
+    def _fire_loss(self) -> None:
+        # a voluntary demotion racing a heartbeat failure must not run
+        # on_loss twice: the test-and-set must be atomic (Event alone
+        # lets both threads pass the is_set check)
+        with self._loss_lock:
+            if self._lost.is_set():
+                return
+            self._lost.set()
+        self.on_loss()
+
+    def demote(self) -> None:
+        """Voluntarily surrender a HELD lease (fail-stop on a journal
+        fsync error): stop renewing, release the lease so a standby with
+        a working disk can acquire it before the TTL runs out, then fire
+        on_loss once.  The heartbeat-failure path never releases — there
+        the lease is already lost."""
+        self.is_leader = False
+        self._stop.set()  # heartbeat loop exits without firing on_loss
+        try:
+            self.elector.release()
+        except Exception:  # noqa: BLE001 — the lease still expires by TTL
+            log.exception("lease release during demotion failed")
+        self._fire_loss()
 
     def stop(self) -> None:
         self._stop.set()

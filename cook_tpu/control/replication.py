@@ -33,9 +33,11 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Optional
 
+from cook_tpu import faults
 from cook_tpu.models import persistence
 from cook_tpu.models.store import JobStore
 from cook_tpu.utils.metrics import global_registry
+from cook_tpu.utils.retry import RetryPolicy, backoff_s
 
 log = logging.getLogger(__name__)
 
@@ -60,6 +62,7 @@ class JournalFollower:
         long_poll_s: Optional[float] = None,
         member_id: str = "",
         on_leader_url: Optional[Callable[[str], None]] = None,
+        reconnect_policy: Optional[RetryPolicy] = None,
     ):
         self.store = store
         self.leader_url_fn = leader_url_fn
@@ -94,6 +97,21 @@ class JournalFollower:
         # rides in every ack so the leader can tie a replication ack back
         # to the mutation it makes durable (docs/observability.md)
         self.last_txn_id: str = ""
+        # reconnect backoff: on leader transport errors the poll loop
+        # backs off with jittered exponential delays (capped) instead of
+        # retrying tight at poll_s — a dead leader with N standbys must
+        # not eat N tight retry loops of connection attempts.  The
+        # max_attempts bound is irrelevant here (the loop retries until
+        # stopped); only the delay curve is used.
+        self.reconnect_policy = reconnect_policy or RetryPolicy(
+            base_s=max(poll_s, 0.2), multiplier=2.0, cap_s=30.0,
+            jitter=0.5)
+        self._consecutive_failures = 0
+        self._transport_error = False
+        self.reconnect_attempts = 0  # lifetime total, tests/chaos read it
+        self._reconnects = global_registry.counter(
+            "replication.reconnects",
+            "follower reconnect attempts after leader transport errors")
 
     # ------------------------------------------------------------- transport
 
@@ -102,11 +120,19 @@ class JournalFollower:
         req = urllib.request.Request(
             url, headers={"X-Cook-Requesting-User": self.as_user})
         try:
+            # fault point: a dropped fetch (error mode) takes the exact
+            # transport-failure path below; a delay rule is a slow link
+            # or wedged follower
+            fault_schedule = faults.ACTIVE
+            if fault_schedule is not None:
+                fault_schedule.hit(faults.REPLICATION_FETCH,
+                                   follower=self.member_id)
             with urllib.request.urlopen(
                     req, timeout=timeout_s or self.timeout_s) as r:
                 return json.loads(r.read())
         except (urllib.error.URLError, OSError, ValueError) as e:
             self.last_error = str(e)
+            self._transport_error = True
             return None
 
     def _post(self, url: str, payload: dict) -> Optional[dict]:
@@ -115,10 +141,15 @@ class JournalFollower:
             headers={"X-Cook-Requesting-User": self.as_user,
                      "Content-Type": "application/json"}, method="POST")
         try:
+            fault_schedule = faults.ACTIVE
+            if fault_schedule is not None:
+                fault_schedule.hit(faults.REPLICATION_ACK,
+                                   follower=self.member_id)
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return json.loads(r.read())
         except (urllib.error.URLError, OSError, ValueError) as e:
             self.last_error = str(e)
+            self._transport_error = True
             return None
 
     # ------------------------------------------------------------------ sync
@@ -262,14 +293,54 @@ class JournalFollower:
 
     # --------------------------------------------------------------- running
 
+    def _next_wait_s(self, cycle_elapsed_s: float = 0.0) -> float:
+        """Poll interval for the next cycle: poll_s while healthy,
+        jittered exponential backoff (capped) after leader transport
+        errors — the follower must not hammer a dead or flapping leader
+        at full poll rate.  The delay is measured from cycle START: a
+        fetch that burned `timeout_s` before failing already served as
+        its own backoff (the tight-retry risk only exists for cycles
+        that fail fast, e.g. connection-refused from a dead leader)."""
+        if self._consecutive_failures == 0:
+            return self.poll_s
+        delay = backoff_s(self.reconnect_policy,
+                          self._consecutive_failures)
+        return max(self.poll_s, delay - cycle_elapsed_s)
+
+    def _note_cycle_outcome(self) -> None:
+        if self._transport_error:
+            self._transport_error = False
+            self._consecutive_failures += 1
+            self.reconnect_attempts += 1
+            self._reconnects.inc()
+        else:
+            self._consecutive_failures = 0
+
     def start(self) -> "JournalFollower":
+        import time as _time
+
         def loop():
-            while not self._stop.wait(self.poll_s):
+            wait_s = self.poll_s
+            while not self._stop.wait(wait_s):
+                self._transport_error = False
+                t0 = _time.monotonic()
                 try:
                     self.sync_once()
+                except OSError:
+                    # a transport failure that escaped _get/_post's own
+                    # handling: back off like any other reconnect
+                    log.exception("journal follower sync failed "
+                                  "(transport)")
+                    self._transport_error = True
                 except Exception:  # noqa: BLE001 — a standby's sync loop
-                    # must survive any leader hiccup
-                    log.exception("journal follower sync failed")
+                    # must survive any leader hiccup; an APPLY failure is
+                    # not a transport error, so it retries at the normal
+                    # poll cadence and stays out of the reconnect
+                    # counter (the backoff would stretch replication lag
+                    # to cap_s while pointing operators at the network)
+                    log.exception("journal follower sync failed (apply)")
+                self._note_cycle_outcome()
+                wait_s = self._next_wait_s(_time.monotonic() - t0)
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="journal-follower")

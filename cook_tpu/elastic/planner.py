@@ -271,9 +271,14 @@ class CapacityPlanner:
         """The cluster whose node-pool backs this pool (single-scalable-
         cluster deployments; with several, the one already offering in
         the pool wins)."""
+        from cook_tpu.cluster.base import safe_pool_offers
+
         scalable = [c for c in self.clusters if c.supports_scale()]
         for cluster in scalable:
-            if cluster.pending_offers(pool):
+            # guarded scan: reconcile_clusters runs after every commit,
+            # so a flapping offers RPC must skip the cluster, not crash
+            # the commit path (safe_pool_offers returns None on error)
+            if safe_pool_offers(cluster, pool):
                 return cluster
         return scalable[0] if scalable else None
 

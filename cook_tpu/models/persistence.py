@@ -18,12 +18,15 @@ Entity (de)serialization lives in `cook_tpu.models.codec`.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Optional
 
 from cook_tpu.models import codec
 from cook_tpu.models.store import Event, JobStore
 from cook_tpu.obs.contention import JournalTelemetry
+
+log = logging.getLogger(__name__)
 
 _encode = codec.encode  # back-compat aliases
 _dec_resources = codec.dec_resources
@@ -184,11 +187,32 @@ class JournalWriter:
 
     DEFAULT_FSYNC_EVERY = 64
 
-    def __init__(self, path: str, *, fsync_every: int = DEFAULT_FSYNC_EVERY):
+    # what an fsync failure means (docs/resilience.md): "fail-stop"
+    # re-raises — the commit pipeline reports the write undurable (REST
+    # 500) and, when wired (components.start_leader_duties), the leader
+    # demotes so a standby with a working disk takes over; "degrade-async"
+    # keeps committing WITHOUT the disk barrier (writes ride the page
+    # cache), surfaces the `journal-fsync-degraded` health reason, and
+    # probes the disk again every `degraded_retry_s`.
+    FSYNC_POLICIES = ("fail-stop", "degrade-async")
+
+    def __init__(self, path: str, *, fsync_every: int = DEFAULT_FSYNC_EVERY,
+                 fsync_policy: str = "fail-stop",
+                 degraded_retry_s: float = 5.0,
+                 on_fsync_error=None):
+        if fsync_policy not in self.FSYNC_POLICIES:
+            raise ValueError(f"unknown fsync policy {fsync_policy!r}")
         self.path = path
         self.fsync_every = fsync_every
+        self.fsync_policy = fsync_policy
+        self.degraded_retry_s = degraded_retry_s
+        # observer hook, called (under the writer lock) with the OSError;
+        # the fail-stop leader-demotion wiring lives here
+        self.on_fsync_error = on_fsync_error
         self._count = 0
         self._dirty = False
+        self._degraded = False
+        self._last_fsync_attempt = 0.0
         # events flushed to the OS but not yet covered by an fsync: the
         # append "queue" the contention observatory reports, and the
         # group-commit batch size the next fsync covers
@@ -205,12 +229,57 @@ class JournalWriter:
     def _fsync_locked(self) -> None:
         import time as _time
 
+        from cook_tpu import faults
+
         batch = self._pending
+        self._last_fsync_attempt = _time.monotonic()
         t0 = _time.perf_counter()
-        os.fsync(self._f.fileno())
+        try:
+            fault_schedule = faults.ACTIVE
+            if fault_schedule is not None:
+                fault_schedule.hit(faults.JOURNAL_FSYNC, path=self.path)
+            os.fsync(self._f.fileno())
+        except OSError as e:
+            self._handle_fsync_error(e)
+            return
         self.telemetry.note_fsync(batch, _time.perf_counter() - t0)
+        if self._degraded:
+            log.warning("journal %s fsync recovered; leaving degraded "
+                        "async mode", self.path)
+            self._degraded = False
+            self.telemetry.set_degraded(False)
         self._pending = 0
         self._dirty = False
+
+    def _handle_fsync_error(self, exc: OSError) -> None:
+        """Caller holds self._lock.  The pending/dirty counters are NOT
+        reset: the exposure the gauge reports is real until an fsync
+        succeeds."""
+        self.telemetry.note_fsync_error()
+        if self.on_fsync_error is not None:
+            try:
+                self.on_fsync_error(exc)
+            except Exception:  # noqa: BLE001 — observer only
+                log.exception("on_fsync_error callback failed")
+        if self.fsync_policy == "degrade-async":
+            if not self._degraded:
+                log.error("journal %s fsync failed (%s); degrading to "
+                          "async (no disk barrier) — commits remain "
+                          "applied+replicated but an OS crash may lose "
+                          "the unfsynced tail; retrying the disk every "
+                          "%.0fs", self.path, exc, self.degraded_retry_s)
+                self._degraded = True
+                self.telemetry.set_degraded(True)
+            return
+        log.error("journal %s fsync failed (%s); fail-stop policy "
+                  "re-raises — the commit is reported undurable",
+                  self.path, exc)
+        raise exc
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
 
     def __call__(self, event: Event) -> None:
         self.write_line(event.to_json())
@@ -229,15 +298,31 @@ class JournalWriter:
             self._dirty = True
             self.telemetry.note_append(len(payload), self._pending)
             if self.fsync_every and self._count % self.fsync_every == 0:
-                self._fsync_locked()
+                import time as _time
+
+                # degraded-async cool-off applies to the backstop too, or
+                # a broken disk gets probed every 64 events
+                if not (self._degraded and _time.monotonic()
+                        - self._last_fsync_attempt < self.degraded_retry_s):
+                    self._fsync_locked()
 
     def sync(self) -> None:
         """Group-commit barrier: fsync anything flushed since the last
         sync.  A no-op when nothing is dirty — so of N concurrent
-        commits, whichever syncs first pays the fsync for all of them."""
+        commits, whichever syncs first pays the fsync for all of them.
+        In degraded-async mode (an earlier fsync failed under the
+        degrade policy) the disk is only re-probed every
+        `degraded_retry_s`; between probes commits proceed without the
+        barrier — that IS the degradation the health reason names."""
+        import time as _time
+
         with self._lock:
-            if self._dirty and not self._f.closed:
-                self._fsync_locked()
+            if not self._dirty or self._f.closed:
+                return
+            if self._degraded and _time.monotonic() \
+                    - self._last_fsync_attempt < self.degraded_retry_s:
+                return
+            self._fsync_locked()
 
     def rotate(self) -> None:
         """After a snapshot, the journal prefix is redundant: move it aside

@@ -51,9 +51,14 @@ FSYNC_STALL = "fsync-stall"
 REPLICATION_LAG = "replication-lag"
 COMMIT_ACK_SLO_BURN = "commit-ack-slo-burn"
 JOB_STARVATION = "job-starvation"
+# the journal's degrade-to-async fsync policy is in effect: an fsync
+# FAILED (not merely stalled) and commits are proceeding without the
+# disk barrier (models/persistence.JournalWriter, docs/resilience.md)
+JOURNAL_FSYNC_DEGRADED = "journal-fsync-degraded"
 
 CONTENTION_REASONS = (STORE_LOCK_SATURATION, FSYNC_STALL, REPLICATION_LAG,
-                      COMMIT_ACK_SLO_BURN, JOB_STARVATION)
+                      COMMIT_ACK_SLO_BURN, JOB_STARVATION,
+                      JOURNAL_FSYNC_DEGRADED)
 
 # lock waits/holds live in the microsecond-to-millisecond range; the
 # default request-scale buckets would collapse everything into the
@@ -334,6 +339,8 @@ class JournalTelemetry:
         self.appends = 0
         self.bytes_written = 0
         self.fsyncs = 0
+        self.fsync_errors = 0
+        self.degraded = False
         self.fsync_seconds_total = 0.0
         self.max_fsync_s = 0.0
         self.last_batch = 0
@@ -350,6 +357,12 @@ class JournalTelemetry:
         self._batch_hist = global_registry.histogram(
             "journal.fsync_batch_events",
             "events covered by one group fsync", buckets=BATCH_BUCKETS)
+        self._error_counter = global_registry.counter(
+            "journal.fsync_errors", "journal fsyncs that FAILED (raised)")
+        self._degraded_gauge = global_registry.gauge(
+            "journal.degraded",
+            "1 while the journal runs in degraded async mode (fsync "
+            "failed under the degrade-to-async policy)")
 
     def note_append(self, n_bytes: int, pending: int) -> None:
         with self._lock:
@@ -370,6 +383,20 @@ class JournalTelemetry:
         self._batch_hist.observe(float(batch_events))
         self._pending_gauge.set(0)
 
+    def note_fsync_error(self) -> None:
+        with self._lock:
+            self.fsync_errors += 1
+        self._error_counter.inc()
+
+    def set_degraded(self, degraded: bool) -> None:
+        with self._lock:
+            self.degraded = degraded
+        self._degraded_gauge.set(1.0 if degraded else 0.0)
+
+    def is_degraded(self) -> bool:
+        with self._lock:
+            return self.degraded
+
     def note_rotate(self) -> None:
         """Journal rotation dropped the unfsynced tail with the old
         file — nothing is pending against the fresh one."""
@@ -386,6 +413,8 @@ class JournalTelemetry:
                 "appends": self.appends,
                 "bytes_written": self.bytes_written,
                 "fsyncs": self.fsyncs,
+                "fsync_errors": self.fsync_errors,
+                "degraded": self.degraded,
                 "fsync_seconds_total": self.fsync_seconds_total,
                 "fsync_max_s": self.max_fsync_s,
                 "recent_fsync_max_s": max(recent, default=0.0),
@@ -740,9 +769,23 @@ class ContentionObservatory:
                     "recent_window": samples,
                 })
 
-        stall = self._journal().recent_fsync_max()
+        journal = self._journal()
+        stall = journal.recent_fsync_max()
         checks["journal"] = {"recent_fsync_max_s": stall,
-                             "threshold_s": p.fsync_stall_s}
+                             "threshold_s": p.fsync_stall_s,
+                             "degraded": journal.is_degraded(),
+                             "fsync_errors": journal.fsync_errors}
+        if journal.is_degraded():
+            degradations.append({
+                "reason": JOURNAL_FSYNC_DEGRADED,
+                "detail": (
+                    "journal fsync FAILED and the degrade-to-async "
+                    "policy is in effect: commits proceed without the "
+                    "disk barrier (an OS crash may lose the unfsynced "
+                    "tail) until a disk probe succeeds — check the "
+                    "volume; see docs/resilience.md"),
+                "fsync_errors": journal.fsync_errors,
+            })
         if stall >= p.fsync_stall_s:
             degradations.append({
                 "reason": FSYNC_STALL,

@@ -32,9 +32,15 @@ RECOMPILE_STORM = "recompile-storm"
 QUALITY_DRIFT = "quality-drift"
 SOLVE_LATENCY_REGRESSION = "solve-latency-regression"
 DEVICE_OOM_RISK = "device-oom-risk"
+# the matcher degraded the pool to the CPU reference solver after a
+# device solve error or a latency-guard breach (scheduler/matcher device
+# fallback — docs/resilience.md reaction (c)); clears when the periodic
+# device probe succeeds
+DEVICE_DEGRADED = "device-degraded"
 
 DEGRADATION_REASONS = (RECOMPILE_STORM, QUALITY_DRIFT,
-                       SOLVE_LATENCY_REGRESSION, DEVICE_OOM_RISK)
+                       SOLVE_LATENCY_REGRESSION, DEVICE_OOM_RISK,
+                       DEVICE_DEGRADED)
 
 
 class HealthMonitor:
@@ -91,6 +97,21 @@ class HealthMonitor:
                 **evidence,
             })
 
+        fallbacks = getattr(self.telemetry, "device_fallbacks",
+                            lambda: {})()
+        for pool, evidence in sorted(fallbacks.items()):
+            degradations.append({
+                "reason": DEVICE_DEGRADED, "pool": pool,
+                "detail": (
+                    f"pool {pool} match solves degraded to the CPU "
+                    f"reference ({evidence.get('cause', '?')}, "
+                    f"{evidence.get('cycles', 0)} cycles so far, "
+                    f"{evidence.get('cycles_left', 0)} before the next "
+                    f"device probe) — placements continue; investigate "
+                    f"the device"),
+                **evidence,
+            })
+
         memory = self.memory_stats_fn()
         if memory is not None and memory["utilization"] >= self.oom_threshold:
             degradations.append({
@@ -117,6 +138,7 @@ class HealthMonitor:
                 "compile": self.telemetry.observatory.stats(),
                 "quality": self.telemetry.quality.stats(),
                 "solve_latency": self.telemetry.latency_stats(),
+                "device_fallback": fallbacks,
                 "device_memory": (memory if memory is not None
                                   else {"observable": False}),
             },

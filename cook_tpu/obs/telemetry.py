@@ -46,7 +46,15 @@ class DeviceTelemetry:
                                   min_samples=latency_min_samples)
         self._latency: dict[str, RollingBaseline] = {}
         self._last_solve: dict[str, dict] = {}
+        # pools currently degraded to the CPU reference solver
+        # (scheduler/matcher device fallback): pool -> evidence for the
+        # `device-degraded` health reason
+        self._fallbacks: dict[str, dict] = {}
         self._lock = threading.Lock()
+        self._fallback_gauge = global_registry.gauge(
+            "obs.device_fallback_active",
+            "1 while the pool's match solve is degraded to the CPU "
+            "reference solver")
         self._update_memory_gauges = update_memory_gauges
         self._memory_stats_fn = memory_stats_fn
         self._solve_hist = global_registry.histogram(
@@ -139,6 +147,37 @@ class DeviceTelemetry:
             update_device_memory_gauges(self._memory_stats_fn)
         else:
             update_device_memory_gauges()
+
+    # ------------------------------------------------------ device fallback
+
+    def note_device_fallback(self, pool: str, reason: str, *,
+                             cycles_left: int = 0) -> None:
+        """The matcher solved this pool on the CPU reference this cycle
+        (scheduler/matcher.record_fallback_outcome)."""
+        import time as _time
+
+        with self._lock:
+            entry = self._fallbacks.get(pool)
+            if entry is None:
+                # key is "cause", NOT "reason": the dict is spread into
+                # the health degradation entry, whose "reason" key is the
+                # verdict constant (device-degraded)
+                entry = self._fallbacks[pool] = {
+                    "cause": reason, "since": _time.time(), "cycles": 0}
+            entry["cause"] = reason
+            entry["cycles"] += 1
+            entry["cycles_left"] = cycles_left
+        self._fallback_gauge.set(1.0, {"pool": pool})
+
+    def clear_device_fallback(self, pool: str) -> None:
+        """The device probe succeeded; the pool is healthy again."""
+        with self._lock:
+            self._fallbacks.pop(pool, None)
+        self._fallback_gauge.set(0.0, {"pool": pool})
+
+    def device_fallbacks(self) -> dict[str, dict]:
+        with self._lock:
+            return {pool: dict(e) for pool, e in self._fallbacks.items()}
 
     # ---------------------------------------------------------------- reads
 

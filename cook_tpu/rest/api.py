@@ -106,6 +106,16 @@ class ApiConfig:
     # (store-lock-saturation, fsync-stall, replication-lag,
     # commit-ack-slo-burn, job-starvation); None = defaults
     contention: Optional[ContentionParams] = None
+    # overload load shedding (cook_tpu/faults/reactions.py): while
+    # commit-ack SLO burn or store-lock saturation is active, heavy read
+    # endpoints answer 429 + Retry-After instead of piling onto the
+    # saturated store lock; mutations are never shed
+    load_shedding: bool = True
+    shed_retry_after_s: float = 5.0
+    # POST /debug/faults (arm/disarm the process fault schedule) — OFF
+    # by default and admin-only when on; never enable in production
+    # outside a chaos drill (docs/resilience.md)
+    fault_injection: bool = False
 
 
 class CookApi:
@@ -178,6 +188,14 @@ class CookApi:
             replication_meta_fn=lambda: self.replication_ack_meta,
             starvation_fn=self._starvation_view,
         )
+        # overload reaction: heavy reads shed while the SLO burns
+        # (cook_tpu/faults/reactions.py; also the scheduler's admission-
+        # scaleback signal — components.py wires overload_fn to this)
+        from cook_tpu.faults.reactions import LoadShedder
+
+        self.shedder = LoadShedder(
+            self.contention,
+            retry_after_s=self.config.shed_retry_after_s)
 
     def _starvation_view(self) -> dict:
         from cook_tpu.scheduler.monitor import starvation_stats
@@ -240,6 +258,8 @@ class CookApi:
         r.add_get("/debug", self.get_debug)
         r.add_get("/debug/health", self.get_debug_health)
         r.add_get("/debug/contention", self.get_debug_contention)
+        r.add_get("/debug/faults", self.get_debug_faults)
+        r.add_post("/debug/faults", self.post_debug_faults)
         r.add_get("/debug/elastic", self.get_debug_elastic)
         r.add_get("/debug/cycles", self.get_debug_cycles)
         r.add_get("/debug/cycles/{cycle_id}", self.get_debug_cycle)
@@ -328,6 +348,60 @@ class CookApi:
             "1 while /debug/health reports any degradation reason").set(
             0.0 if verdict["healthy"] else 1.0)
         return web.json_response(verdict)
+
+    def _shed(self, route: str) -> Optional[web.Response]:
+        """Load-shedding gate for heavy read endpoints: 429 + Retry-After
+        while a shed-relevant degradation (commit-ack-slo-burn,
+        store-lock-saturation) is active.  Mutations and cheap probes
+        are never routed through here."""
+        if not self.config.load_shedding:
+            return None
+        verdict = self.shedder.should_shed(route)
+        if verdict is None:
+            return None
+        response = _err(429, verdict["detail"])
+        response.headers["Retry-After"] = str(
+            max(1, int(verdict["retry_after_s"])))
+        return response
+
+    async def get_debug_faults(self, request: web.Request) -> web.Response:
+        """The armed fault schedule (rule state + firing counts).
+        Readable whenever fault injection is enabled."""
+        from cook_tpu import faults
+
+        if not self.config.fault_injection:
+            return _err(403, "fault injection is disabled "
+                             "(ApiConfig.fault_injection)")
+        active = faults.ACTIVE
+        return web.json_response({
+            "enabled": True,
+            "armed": active is not None,
+            "schedule": active.to_dict() if active is not None else None,
+        })
+
+    async def post_debug_faults(self, request: web.Request) -> web.Response:
+        """Arm ({"seed": .., "rules": [...]}) or disarm ({"disarm":
+        true}) the process-global fault schedule.  Admin-only, and gated
+        behind ApiConfig.fault_injection — this endpoint exists for
+        chaos drills (docs/resilience.md), not production traffic."""
+        from cook_tpu import faults
+
+        if not self.config.fault_injection:
+            return _err(403, "fault injection is disabled "
+                             "(ApiConfig.fault_injection)")
+        if request["user"] not in self.config.admins:
+            return _err(403, f"user {request['user']} is not an admin")
+        body = await request.json()
+        if body.get("disarm"):
+            faults.disarm()
+            return web.json_response({"armed": False})
+        try:
+            schedule = faults.FaultSchedule.from_dict(body)
+        except (KeyError, TypeError, ValueError) as e:
+            return _err(400, f"bad fault schedule: {e}")
+        faults.arm(schedule)
+        return web.json_response({"armed": True,
+                                  "schedule": schedule.to_dict()})
 
     async def get_debug_contention(self, request: web.Request
                                    ) -> web.Response:
@@ -794,6 +868,9 @@ class CookApi:
         )
 
     async def get_jobs(self, request: web.Request) -> web.Response:
+        shed = self._shed("/jobs")
+        if shed is not None:
+            return shed
         uuids = request.query.getall("job", []) + request.query.getall("uuid", [])
         # resolve instance uuids to their jobs (reference: rawscheduler
         # accepts instance ids too)
@@ -1191,6 +1268,9 @@ class CookApi:
     # ------------------------------------------------------------- queue etc
 
     async def get_queue(self, request: web.Request) -> web.Response:
+        shed = self._shed("/queue")
+        if shed is not None:
+            return shed
         if not self.leader and self.leader_url:
             # non-leader nodes send queue queries to the leader
             # (reference: leader proxying, rest/api.clj:2408)
@@ -1208,6 +1288,9 @@ class CookApi:
         return web.json_response(out)
 
     async def get_running(self, request: web.Request) -> web.Response:
+        shed = self._shed("/running")
+        if shed is not None:
+            return shed
         out = []
         for pool_name in self.store.pools:
             for job in self.store.running_jobs(pool_name):
@@ -1215,6 +1298,9 @@ class CookApi:
         return web.json_response(out)
 
     async def get_list(self, request: web.Request) -> web.Response:
+        shed = self._shed("/list")
+        if shed is not None:
+            return shed
         user = request.query.get("user")
         if not user:
             return _err(400, "user required")
@@ -1238,6 +1324,9 @@ class CookApi:
         return web.json_response(out)
 
     async def get_unscheduled(self, request: web.Request) -> web.Response:
+        shed = self._shed("/unscheduled_jobs")
+        if shed is not None:
+            return shed
         from cook_tpu.scheduler.monitor import starvation_stats
 
         uuids = request.query.getall("job", [])
@@ -1342,6 +1431,9 @@ class CookApi:
 
     async def get_instance_stats(self, request: web.Request) -> web.Response:
         """Aggregate instance stats (reference task_stats.clj)."""
+        shed = self._shed("/stats/instances")
+        if shed is not None:
+            return shed
         start = int(request.query.get("start-ms", 0))
         end = int(request.query.get("end-ms", 2**62))
         durations = []

@@ -73,7 +73,8 @@ class InprocessControlPlane:
     absent, which submission/query/kill traffic never touches."""
 
     def __init__(self, *, data_dir: Optional[str] = None,
-                 pools: tuple = ("default",), config=None, clock=None):
+                 pools: tuple = ("default",), config=None, clock=None,
+                 journal_kw: Optional[dict] = None):
         import tempfile
         import time as _time
 
@@ -89,8 +90,11 @@ class InprocessControlPlane:
             clock=clock or (lambda: int(_time.time() * 1000)))
         for pool in pools:
             self.store.set_pool(Pool(name=pool))
+        # journal_kw: JournalWriter knobs (fsync_policy, degraded_retry_s,
+        # ...) — the chaos fsync scenarios exercise both failure policies
         self.journal = persistence.attach_journal(
-            self.store, f"{self.data_dir}/journal.jsonl")
+            self.store, f"{self.data_dir}/journal.jsonl",
+            **(journal_kw or {}))
         self.txn = TransactionLog(self.store, journal=self.journal)
         self.api = CookApi(self.store, None, config or ApiConfig(),
                            txn=self.txn)

@@ -189,6 +189,15 @@ class Scheduler:
             self.elastic = CapacityPlanner(
                 store, self.clusters, self.txn, self.config.elastic,
                 telemetry=self.telemetry)
+        # overload admission control (cook_tpu/faults/reactions.py):
+        # while the control plane burns its commit-ack SLO budget (or the
+        # store lock saturates), every pool's considerable window shrinks
+        # x0.95 per cycle toward a floor, restoring as the burn clears —
+        # Cook's head-of-queue scaleback, driven by overload.  Inert
+        # until components.py (or a test/chaos harness) sets overload_fn.
+        from cook_tpu.faults.reactions import AdmissionController
+
+        self.admission = AdmissionController()
         from cook_tpu.scheduler.monitor import JobLifecycleTracker
 
         # effect-gated like _on_event: a standby applying replicated
@@ -265,6 +274,8 @@ class Scheduler:
 
         t_rank = _time.perf_counter()
 
+        from cook_tpu.cluster.base import safe_pool_offers
+
         max_mem = max_cpus = max_gpus = 0.0
         autoscales = False
         for cluster in self.clusters:
@@ -273,7 +284,7 @@ class Scheduler:
             # an autoscaling cluster can grow capacity, so nothing is
             # offensive relative to its current nodes
             autoscales = autoscales or cluster.autoscaling(pool.name)
-            for offer in cluster.pending_offers(pool.name):
+            for offer in safe_pool_offers(cluster, pool.name) or ():
                 max_mem = max(max_mem, offer.total_mem or offer.mem)
                 max_cpus = max(max_cpus, offer.total_cpus or offer.cpus)
                 max_gpus = max(max_gpus, offer.gpus)
@@ -377,6 +388,8 @@ class Scheduler:
             pool.name,
             PoolMatchState(num_considerable=self.config.match.max_jobs_considered),
         )
+        self.admission.clamp(pool.name, state,
+                             self.config.match.max_jobs_considered)
         outcome = match_pool(
             self.store,
             pool,
@@ -504,11 +517,13 @@ class Scheduler:
                 self.rank_cycle(pool)
             self._credit_rank_and_quarantine(
                 flights[pool.name], pool.name, self.pool_queues[pool.name])
-            self.pool_match_state.setdefault(
+            state = self.pool_match_state.setdefault(
                 pool.name,
                 PoolMatchState(
                     num_considerable=self.config.match.max_jobs_considered),
             )
+            self.admission.clamp(pool.name, state,
+                                 self.config.match.max_jobs_considered)
         return pools, flights
 
     def _finish_multi_pool_cycle(self, pools, outcomes, flights) -> None:

@@ -48,6 +48,7 @@ LAUNCH_FAILED = "launch-failed"
 SOLVE_FAILED = "solve-failed"
 NOT_CONSIDERED = "not-considered"
 EXCEEDS_POOL_CAPACITY = "exceeds-pool-capacity"
+CLUSTER_CIRCUIT_OPEN = "cluster-circuit-open"
 
 REASON_TEXT = {
     NO_OFFERS: "no offers",
@@ -61,6 +62,10 @@ REASON_TEXT = {
     NOT_CONSIDERED: "not in this cycle's considerable window",
     EXCEEDS_POOL_CAPACITY:
         "the job's resource demands exceed every host in the pool",
+    CLUSTER_CIRCUIT_OPEN:
+        "the pool's clusters are circuit-open (launch/kill RPCs failing);"
+        " jobs wait for the breaker's half-open probe instead of burning"
+        " mea-culpa retries",
 }
 
 

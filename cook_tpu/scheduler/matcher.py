@@ -26,6 +26,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from cook_tpu import faults
 from cook_tpu.cluster.base import ComputeCluster, Offer, TaskSpec
 from cook_tpu.models.entities import (
     GroupPlacementType,
@@ -99,6 +100,18 @@ class MatchConfig:
     # pod agree — padding only in the backend would direct-bind pods the
     # kubelet must reject (calculate-effective-resources, api.clj:1152)
     checkpoint_memory_overhead_mb: float = 0.0
+    # device-solve fallback (docs/resilience.md): when a pool's solve
+    # raises — or its latency regresses past device_latency_guard x the
+    # rolling baseline — the pool degrades to the host-side
+    # ops/cpu_reference.np_greedy_match for this many cycles (health
+    # reason `device-degraded`), then probes the device again.  The
+    # failing cycle itself is re-solved on CPU, so no cycle is lost to a
+    # sick device.  0 disables the reaction (a solve error propagates
+    # as before).
+    device_fallback_cycles: int = 8
+    # latency guard ratio over the rolling median baseline (0 = latency
+    # never triggers fallback; solve errors still do)
+    device_latency_guard: float = 0.0
 
     def __post_init__(self):
         backend_flags(self.backend)  # raises on unknown names
@@ -113,11 +126,16 @@ class MatchConfig:
 
 @dataclass
 class PoolMatchState:
-    """Mutable per-pool matcher state (head-of-queue backoff)."""
+    """Mutable per-pool matcher state (head-of-queue backoff + device
+    fallback)."""
 
     num_considerable: int
     iterations_at_floor: int = 0
     chunked_solves: int = 0  # drives the periodic quality audit
+    # device-solve fallback: cycles left on the CPU reference solver
+    # before the next device probe; reason kept until a probe succeeds
+    fallback_cycles_left: int = 0
+    fallback_reason: str = ""
 
 
 @dataclass
@@ -176,6 +194,28 @@ def job_mem_with_overhead(job: Job, config: "MatchConfig") -> float:
     return mem
 
 
+def encode_problem_arrays(
+    jobs: Sequence[Job],
+    offers: Sequence,
+    config: Optional["MatchConfig"] = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(demands[j,4], avail[n,4], totals[n,2]) float32 rows — the one
+    resource encoding shared by the device problem build and the
+    host-side fallback solve (their parity claim starts here)."""
+    demands = np.zeros((len(jobs), 4), dtype=np.float32)
+    for i, job in enumerate(jobs):
+        r = job.resources
+        mem = (job_mem_with_overhead(job, config)
+               if config is not None else r.mem)
+        demands[i] = (mem, r.cpus, r.gpus, r.disk)
+    avail = np.zeros((len(offers), 4), dtype=np.float32)
+    totals = np.zeros((len(offers), 2), dtype=np.float32)
+    for i, o in enumerate(offers):
+        avail[i] = (o.mem, o.cpus, o.gpus, o.disk)
+        totals[i] = (o.total_mem or o.mem, o.total_cpus or o.cpus)
+    return demands, avail, totals
+
+
 def build_match_problem(
     jobs: Sequence[Job],
     nodes: EncodedNodes,
@@ -190,17 +230,8 @@ def build_match_problem(
         pad_j = max(pad_j, chunk)
         pad_j += (-pad_j) % chunk
     pad_n = bucket_size(max(n, 1))
-    demands = np.zeros((j, 4), dtype=np.float32)
-    for i, job in enumerate(jobs):
-        r = job.resources
-        mem = (job_mem_with_overhead(job, config)
-               if config is not None else r.mem)
-        demands[i] = (mem, r.cpus, r.gpus, r.disk)
-    avail = np.zeros((n, 4), dtype=np.float32)
-    totals = np.zeros((n, 2), dtype=np.float32)
-    for i, o in enumerate(nodes.offers):
-        avail[i] = (o.mem, o.cpus, o.gpus, o.disk)
-        totals[i] = (o.total_mem or o.mem, o.total_cpus or o.cpus)
+    demands, avail, totals = encode_problem_arrays(jobs, nodes.offers,
+                                                   config)
     feas = np.zeros((pad_j, pad_n), dtype=bool)
     feas[:j, :n] = feasible
     return MatchProblem(
@@ -235,6 +266,12 @@ def dispatch_pool_solve(prepared: "PreparedPool",
     The serial path fetches inline; the pipelined engine
     (scheduler/pipeline.py) interleaves other pools' host phases between
     dispatch and fetch."""
+    fault_schedule = faults.ACTIVE  # snapshot: a concurrent disarm must
+    if fault_schedule is not None:  # not None out the global mid-site
+        # `device.solve` fault point: error = kernel raising at dispatch
+        # (surfaces at fetch in the pipelined engine, inline here);
+        # delay = a latency spike feeding the regression guard
+        fault_schedule.hit(faults.DEVICE_SOLVE, pool=prepared.pool.name)
     if config.chunk:
         result = chunked_match(prepared.problem, chunk=config.chunk,
                                rounds=config.chunk_rounds,
@@ -269,6 +306,143 @@ def record_solve_outcome(prepared: "PreparedPool", assignment: np.ndarray,
         if (config.quality_audit_every
                 and state.chunked_solves % config.quality_audit_every == 0):
             start_quality_audit(prepared, assignment, pool_name)
+
+
+# ------------------------------------------------------ device fallback
+#
+# Reaction (c) of docs/resilience.md: a sick device must not cost match
+# cycles.  When a pool's solve raises (or its latency regresses past the
+# guard) the pool degrades to the host-side reference solver — identical
+# decision semantics to the chunk=0 exact kernel (the quality monitor's
+# parity claim) — for `device_fallback_cycles` cycles, then probes the
+# device again.  Health surfaces the episode as `device-degraded`.
+
+FALLBACK_BACKEND = "cpu-fallback"
+
+_fallback_counter = None
+
+
+def _note_fallback_metrics(pool_name: str, reason: str) -> None:
+    global _fallback_counter
+    if _fallback_counter is None:
+        _fallback_counter = global_registry.counter(
+            "matcher.device_fallback_cycles",
+            "match cycles solved on the CPU reference because the pool's "
+            "device solve is degraded, per pool/reason")
+    _fallback_counter.inc(1, {"pool": pool_name, "reason": reason})
+
+
+def enter_device_fallback(state: PoolMatchState, config: MatchConfig,
+                          pool_name: str, reason: str) -> None:
+    state.fallback_cycles_left = config.device_fallback_cycles
+    state.fallback_reason = reason
+    log.warning("pool %s: degrading to %s for %d cycles (%s)", pool_name,
+                FALLBACK_BACKEND, state.fallback_cycles_left, reason)
+
+
+def check_device_fallback(config: MatchConfig, state: PoolMatchState,
+                          telemetry, pool_name: str) -> tuple[bool, str]:
+    """(use_cpu, reason) for this cycle; consumes one cycle of the
+    fallback budget.  A pool whose budget just ran out returns False —
+    that cycle IS the device probe; `exit_device_fallback` (on probe
+    success) or `enter_device_fallback` (on probe failure) closes the
+    episode."""
+    if config.device_fallback_cycles <= 0:
+        return False, ""
+    if state.fallback_cycles_left > 0:
+        state.fallback_cycles_left -= 1
+        return True, state.fallback_reason
+    if config.device_latency_guard > 0 and telemetry is not None \
+            and not state.fallback_reason:
+        anomaly = telemetry.latency_regressions().get(pool_name)
+        if anomaly and anomaly.get("baseline", 0) > 0 and \
+                anomaly["recent"] >= config.device_latency_guard \
+                * anomaly["baseline"]:
+            enter_device_fallback(state, config, pool_name,
+                                  "latency-regression")
+            state.fallback_cycles_left -= 1
+            return True, state.fallback_reason
+    return False, ""
+
+
+def exit_device_fallback(state: PoolMatchState, telemetry,
+                         pool_name: str) -> None:
+    """A device solve succeeded with no fallback budget pending: the
+    probe passed, clear the episode (and the health reason)."""
+    if state.fallback_reason:
+        log.info("pool %s: device probe succeeded; leaving %s mode",
+                 pool_name, FALLBACK_BACKEND)
+        state.fallback_reason = ""
+        if telemetry is not None:
+            telemetry.clear_device_fallback(pool_name)
+
+
+def cpu_fallback_solve(prepared: "PreparedPool",
+                       config: MatchConfig) -> np.ndarray:
+    """Solve the prepared pool problem entirely host-side with the
+    reference numpy greedy — no device buffer is touched, so this works
+    even when the accelerator is wedged outright."""
+    from cook_tpu.ops import cpu_reference as ref
+
+    jobs = prepared.considerable
+    demands, avail, totals = encode_problem_arrays(
+        jobs, prepared.nodes.offers, config)
+    assignment = ref.np_greedy_match(
+        demands, avail, totals,
+        feasible_mask=np.asarray(prepared.feasible)[:len(jobs)])
+    return assignment.astype(np.int32)
+
+
+def record_fallback_outcome(prepared: "PreparedPool", pool_name: str,
+                            state: PoolMatchState, flight,
+                            telemetry, reason: str) -> None:
+    """The fallback cycle's counterpart of record_solve_outcome: cycle
+    record + health surface, but NO latency-baseline feeding — a CPU
+    solve's wall must not pollute the device baseline the probe will be
+    judged against (and the quality monitor's CPU-vs-CPU ratio carries
+    no signal)."""
+    flight.note_solve(shape_signature(problem_shape(prepared.problem)),
+                      FALLBACK_BACKEND, False)
+    _note_fallback_metrics(pool_name, reason or "unknown")
+    if telemetry is not None:
+        telemetry.note_device_fallback(
+            pool_name, reason or "unknown",
+            cycles_left=state.fallback_cycles_left)
+
+
+def degrade_to_solve_failed(prepared: "PreparedPool", config: "MatchConfig",
+                            state: "PoolMatchState", flight,
+                            record_placement_failure) -> "MatchOutcome":
+    """There is no further tier to degrade to (the CPU reference itself
+    raised): the pool's considerable jobs wait a cycle with solve-failed
+    recorded — shared by the serial and batched paths (the pipelined
+    engine reaches the same semantics through its fetch)."""
+    outcome = prepared.outcome
+    outcome.unmatched = list(prepared.considerable)
+    outcome.head_matched = False
+    for job in prepared.considerable:
+        flight.note_skip(job.uuid, flight_codes.SOLVE_FAILED)
+        if record_placement_failure is not None:
+            record_placement_failure(
+                job, flight_codes.REASON_TEXT[flight_codes.SOLVE_FAILED])
+    _apply_backoff(config, state, False)
+    return outcome
+
+
+class CpuFallbackPending:
+    """PendingResult stand-in for a pool in fallback mode: `fetch()` runs
+    the host-side reference solve (the pipelined engine treats it like
+    any other pending solve; there is simply no device work behind
+    it)."""
+
+    __slots__ = ("prepared", "config")
+
+    def __init__(self, prepared: "PreparedPool", config: MatchConfig):
+        self.prepared = prepared
+        self.config = config
+
+    def fetch(self) -> np.ndarray:
+        return cpu_fallback_solve(self.prepared, self.config)
 
 
 def fail_launched_specs(store: JobStore, specs: Sequence[TaskSpec],
@@ -438,6 +612,10 @@ class PreparedPool:
     balanced_pre_rows: dict = field(default_factory=dict)
     feasible: Optional[np.ndarray] = None
     problem: Optional[MatchProblem] = None
+    # clusters withheld from this cycle because their circuit breaker is
+    # open (cook_tpu/faults/breaker.py): offer-less pools report
+    # `cluster-circuit-open` instead of a misleading `no-offers`
+    circuit_open: list = field(default_factory=list)
 
     @property
     def solvable(self) -> bool:
@@ -467,11 +645,27 @@ def prepare_pool_problem(
     dependent)."""
     prepared = PreparedPool(pool=pool, outcome=MatchOutcome())
 
-    # offers from every running cluster (scheduler.clj:1574-1585)
+    # offers from every running cluster (scheduler.clj:1574-1585); an
+    # offer RPC raising skips that cluster for this scan — with NO
+    # breaker accounting (its window watches launch/kill RPCs only) —
+    # instead of killing the cycle; cluster/base.safe_pool_offers
+    from cook_tpu.cluster.base import safe_pool_offers
+    from cook_tpu.cluster.base import ClusterState as _CS
+    from cook_tpu.faults.breaker import BreakerState as _BS
+
     for cluster in clusters:
         if not cluster.accepts_work:
+            # classify via the non-mutating state read: a second
+            # allows_work() here could consume the open->half-open
+            # transition (and the probe slot) outside any launch flow
+            if cluster.state == _CS.RUNNING \
+                    and cluster.breaker.state is not _BS.CLOSED:
+                prepared.circuit_open.append(cluster.name)
             continue
-        for offer in cluster.pending_offers(pool.name):
+        offers = safe_pool_offers(cluster, pool.name)
+        if offers is None:
+            continue
+        for offer in offers:
             prepared.cluster_offers.append((cluster, offer))
     prepared.outcome.offers_total = len(prepared.cluster_offers)
 
@@ -601,8 +795,14 @@ def finalize_pool_match(
     if not prepared.solvable:
         outcome.unmatched = considerable
         outcome.head_matched = not considerable
-        code = (flight_codes.NO_OFFERS if not prepared.cluster_offers
-                else flight_codes.CONSTRAINTS_FILTERED)
+        if not prepared.cluster_offers:
+            # distinguish "no capacity" from "capacity exists but its
+            # clusters are circuit-open": the latter is a transient the
+            # breaker will probe out of, and operators must see it
+            code = (flight_codes.CLUSTER_CIRCUIT_OPEN
+                    if prepared.circuit_open else flight_codes.NO_OFFERS)
+        else:
+            code = flight_codes.CONSTRAINTS_FILTERED
         for job in considerable:
             flight.note_skip(job.uuid, code)
         _apply_backoff(config, state, outcome.head_matched)
@@ -776,9 +976,11 @@ def finalize_pool_match(
                     _cb(sp, exc) if exc is not None else None)
             continue
         try:
-            # read side of the kill-lock: kills can't interleave mid-launch
+            # read side of the kill-lock: kills can't interleave
+            # mid-launch; run_launch adds the cluster.launch fault point
+            # and circuit-breaker accounting around the backend RPC
             with cluster.kill_lock.read():
-                cluster.launch_tasks(pool.name, specs)
+                cluster.run_launch(pool.name, specs)
         except Exception as exc:  # noqa: BLE001 — one cluster's RPC
             # failure must not abort the remaining clusters' launches
             log.exception("launch_tasks failed (cluster %s, pool %s, "
@@ -936,16 +1138,49 @@ def match_pool(
         )
     assignment = np.empty(0, dtype=np.int32)
     if prepared.solvable:
-        # the solve is the cycle's device section: the inline fetch blocks
-        # until the kernel's result is materialized, so this phase's wall
-        # time covers dispatch + device execution + transfer (the
-        # pipelined engine splits these two calls across pools instead)
-        t_solve = _time.perf_counter()
-        with flight.phase("solve", device=True):
-            assignment = dispatch_pool_solve(prepared, config).fetch()
-        record_solve_outcome(prepared, assignment, config, state, pool.name,
-                             _time.perf_counter() - t_solve, flight,
-                             telemetry)
+        use_cpu, fb_reason = check_device_fallback(config, state, telemetry,
+                                                   pool.name)
+        if not use_cpu:
+            # the solve is the cycle's device section: the inline fetch
+            # blocks until the kernel's result is materialized, so this
+            # phase's wall time covers dispatch + device execution +
+            # transfer (the pipelined engine splits these two calls
+            # across pools instead)
+            t_solve = _time.perf_counter()
+            try:
+                with flight.phase("solve", device=True):
+                    assignment = dispatch_pool_solve(prepared,
+                                                     config).fetch()
+            except Exception:  # noqa: BLE001 — classified below
+                if config.device_fallback_cycles <= 0:
+                    raise
+                # reaction (c): the failing cycle is re-solved host-side
+                # NOW — no cycle is lost to a sick device — and the pool
+                # stays on the CPU reference until the next probe
+                log.exception("pool %s device solve failed; falling back "
+                              "to %s", pool.name, FALLBACK_BACKEND)
+                enter_device_fallback(state, config, pool.name,
+                                      "solve-error")
+                use_cpu, fb_reason = True, "solve-error"
+            else:
+                record_solve_outcome(prepared, assignment, config, state,
+                                     pool.name,
+                                     _time.perf_counter() - t_solve,
+                                     flight, telemetry)
+                exit_device_fallback(state, telemetry, pool.name)
+        if use_cpu:
+            try:
+                with flight.phase("solve", device=False):
+                    assignment = cpu_fallback_solve(prepared, config)
+            except Exception:  # noqa: BLE001 — the fallback solver
+                # failing too must not escape the cycle
+                log.exception("cpu fallback solve failed (pool %s)",
+                              pool.name)
+                return degrade_to_solve_failed(prepared, config, state,
+                                               flight,
+                                               record_placement_failure)
+            record_fallback_outcome(prepared, pool.name, state, flight,
+                                    telemetry, fb_reason)
     with flight.phase("launch"):
         return finalize_pool_match(
             store, prepared, assignment, config, state, clusters,
@@ -1005,115 +1240,174 @@ def match_pools_batched(
                 host_reservations=host_reservations, host_attrs=host_attrs,
                 flight=flight, encode_cache=encode_cache,
             ))
-    solvable = [p for p in prepared_list if p.solvable]
+    # reaction (c) parity with the per-pool paths: pools already in
+    # fallback mode solve host-side this cycle; the rest join the batch
+    # (a pool whose budget just ran out rejoins — the batch solve IS its
+    # device probe)
+    cpu_solving: dict[str, str] = {}  # pool -> fallback reason
+    solvable = []
+    for p in prepared_list:
+        if not p.solvable:
+            continue
+        use_cpu, fb_reason = check_device_fallback(
+            config, states[p.pool.name], telemetry, p.pool.name)
+        if use_cpu:
+            cpu_solving[p.pool.name] = fb_reason
+        else:
+            solvable.append(p)
+    batch_assignments: dict[str, np.ndarray] = {}
     if solvable:
         import time as _time
 
-        t_stack = _time.perf_counter()
-        # pad every pool's problem to shared buckets and stack
-        max_j = max(p.problem.demands.shape[0] for p in solvable)
-        max_n = max(p.problem.avail.shape[0] for p in solvable)
+        try:
+            fault_schedule = faults.ACTIVE  # snapshot: a concurrent
+            if fault_schedule is not None:  # disarm must not None out
+                # the global mid-site.  `device.solve` fault point,
+                # batched flavor: rules match per participating pool; one
+                # injected error fails the SHARED solve (a sick device
+                # takes the whole batch down, so the whole batch degrades)
+                for p in solvable:
+                    fault_schedule.hit(faults.DEVICE_SOLVE,
+                                       pool=p.pool.name)
+            t_stack = _time.perf_counter()
+            # pad every pool's problem to shared buckets and stack
+            max_j = max(p.problem.demands.shape[0] for p in solvable)
+            max_n = max(p.problem.avail.shape[0] for p in solvable)
 
-        def pad_problem(problem: MatchProblem) -> MatchProblem:
-            j, n = problem.demands.shape[0], problem.avail.shape[0]
-            return MatchProblem(
-                demands=jnp.pad(problem.demands, ((0, max_j - j), (0, 0))),
-                job_valid=jnp.pad(problem.job_valid, (0, max_j - j)),
-                avail=jnp.pad(problem.avail, ((0, max_n - n), (0, 0))),
-                totals=jnp.pad(problem.totals, ((0, max_n - n), (0, 0))),
-                node_valid=jnp.pad(problem.node_valid, (0, max_n - n)),
-                feasible=jnp.pad(problem.feasible,
-                                 ((0, max_j - j), (0, max_n - n))),
+            def pad_problem(problem: MatchProblem) -> MatchProblem:
+                j, n = problem.demands.shape[0], problem.avail.shape[0]
+                return MatchProblem(
+                    demands=jnp.pad(problem.demands,
+                                    ((0, max_j - j), (0, 0))),
+                    job_valid=jnp.pad(problem.job_valid, (0, max_j - j)),
+                    avail=jnp.pad(problem.avail, ((0, max_n - n), (0, 0))),
+                    totals=jnp.pad(problem.totals, ((0, max_n - n), (0, 0))),
+                    node_valid=jnp.pad(problem.node_valid, (0, max_n - n)),
+                    feasible=jnp.pad(problem.feasible,
+                                     ((0, max_j - j), (0, max_n - n))),
+                )
+
+            padded_problems = [pad_problem(p.problem) for p in solvable]
+            if mesh is not None:
+                # pool-axis padding: the sharded path previously only
+                # engaged when the pool count happened to divide the mesh
+                # size; pad with all-invalid problems (job_valid/
+                # node_valid False — the kernels place nothing there) so
+                # it engages for ANY count, and the padded batch shape
+                # stays one XLA program per (ceil-multiple, J, N) bucket
+                # instead of one per pool count
+                from cook_tpu.parallel.mesh import invalid_match_problem
+
+                n_pad = (-len(solvable)) % mesh.devices.size
+                if n_pad:
+                    pad_p = invalid_match_problem(
+                        max_j, max_n,
+                        n_res=int(solvable[0].problem.demands.shape[-1]))
+                    padded_problems.extend([pad_p] * n_pad)
+            stacked = jax.tree.map(
+                lambda *leaves: jnp.stack(leaves), *padded_problems,
             )
-
-        padded_problems = [pad_problem(p.problem) for p in solvable]
-        if mesh is not None:
-            # pool-axis padding: the sharded path previously only engaged
-            # when the pool count happened to divide the mesh size; pad
-            # with all-invalid problems (job_valid/node_valid False — the
-            # kernels place nothing there) so it engages for ANY count,
-            # and the padded batch shape stays one XLA program per
-            # (ceil-multiple, J, N) bucket instead of one per pool count
-            from cook_tpu.parallel.mesh import invalid_match_problem
-
-            n_pad = (-len(solvable)) % mesh.devices.size
-            if n_pad:
-                pad_p = invalid_match_problem(
-                    max_j, max_n, n_res=int(solvable[0].problem.demands.shape[-1]))
-                padded_problems.extend([pad_p] * n_pad)
-        stacked = jax.tree.map(
-            lambda *leaves: jnp.stack(leaves), *padded_problems,
-        )
-        # the shared pad/stack is host work, not solve time — credit it
-        # as tensor_build so device_s stays an honest accelerator figure
-        stack_s = _time.perf_counter() - t_stack
-        for p in solvable:
-            pool_flight(p.pool.name).add_phase("tensor_build", stack_s)
-        t_solve = _time.perf_counter()
-        if mesh is not None:
-            stacked = shard_pools(mesh, stacked)
-            result = pool_sharded_match(mesh, stacked,
-                                        chunk=config.chunk or 0,
-                                        rounds=config.chunk_rounds,
-                                        passes=config.chunk_passes,
-                                        kc=config.chunk_kc,
-                                        backend=config.backend)
-        elif config.chunk:
-            result = jax.vmap(
-                lambda p: chunked_match(
-                    p, chunk=config.chunk,
-                    rounds=config.chunk_rounds,
-                    passes=config.chunk_passes,
-                    kc=config.chunk_kc,
-                    **backend_flags(vmap_safe_backend(config.backend)))
-            )(stacked)
+            # the shared pad/stack is host work, not solve time — credit
+            # it as tensor_build so device_s stays an honest accelerator
+            # figure
+            stack_s = _time.perf_counter() - t_stack
+            for p in solvable:
+                pool_flight(p.pool.name).add_phase("tensor_build", stack_s)
+            t_solve = _time.perf_counter()
+            if mesh is not None:
+                stacked = shard_pools(mesh, stacked)
+                result = pool_sharded_match(mesh, stacked,
+                                            chunk=config.chunk or 0,
+                                            rounds=config.chunk_rounds,
+                                            passes=config.chunk_passes,
+                                            kc=config.chunk_kc,
+                                            backend=config.backend)
+            elif config.chunk:
+                result = jax.vmap(
+                    lambda p: chunked_match(
+                        p, chunk=config.chunk,
+                        rounds=config.chunk_rounds,
+                        passes=config.chunk_passes,
+                        kc=config.chunk_kc,
+                        **backend_flags(vmap_safe_backend(config.backend)))
+                )(stacked)
+            else:
+                result = jax.vmap(greedy_match)(stacked)
+            assignments = fetch_result(result.assignment)
+        except Exception:  # noqa: BLE001 — classified below
+            if config.device_fallback_cycles <= 0:
+                raise
+            # reaction (c), batched: the failing batch is re-solved
+            # host-side pool by pool NOW — no cycle is lost to a sick
+            # device — and every participating pool stays on the CPU
+            # reference until its next probe
+            log.exception("batched device solve failed (%d pools); "
+                          "falling back to %s", len(solvable),
+                          FALLBACK_BACKEND)
+            for p in solvable:
+                enter_device_fallback(states[p.pool.name], config,
+                                      p.pool.name, "solve-error")
+                cpu_solving[p.pool.name] = "solve-error"
         else:
-            result = jax.vmap(greedy_match)(stacked)
-        assignments = fetch_result(result.assignment)
-        # one shared device call solved every pool: each participating
-        # pool's record carries the full solve wall time (no pool's cycle
-        # can finish sooner than the batch).  The recorded shape is the
-        # PADDED pool axis — the device truth the compile observatory
-        # keys programs by
-        solve_s = _time.perf_counter() - t_solve
-        batch_shape = (len(padded_problems), max_j, max_n)
-        backend = (vmap_safe_backend(config.backend) if config.chunk
-                   else "exact")
-        compiled = False
-        if telemetry is not None:
-            compiled = telemetry.record_batched_match_solve(
-                [p.pool.name for p in solvable], batch_shape, backend,
-                solve_s)
-        for p in solvable:
-            flight = pool_flight(p.pool.name)
-            flight.add_phase("solve", solve_s, device=True)
-            flight.note_solve(shape_signature(batch_shape), backend,
-                              compiled)
+            # one shared device call solved every pool: each
+            # participating pool's record carries the full solve wall
+            # time (no pool's cycle can finish sooner than the batch).
+            # The recorded shape is the PADDED pool axis — the device
+            # truth the compile observatory keys programs by
+            solve_s = _time.perf_counter() - t_solve
+            batch_shape = (len(padded_problems), max_j, max_n)
+            backend = (vmap_safe_backend(config.backend) if config.chunk
+                       else "exact")
+            compiled = False
+            if telemetry is not None:
+                compiled = telemetry.record_batched_match_solve(
+                    [p.pool.name for p in solvable], batch_shape, backend,
+                    solve_s)
+            for i, p in enumerate(solvable):
+                flight = pool_flight(p.pool.name)
+                flight.add_phase("solve", solve_s, device=True)
+                flight.note_solve(shape_signature(batch_shape), backend,
+                                  compiled)
+                batch_assignments[p.pool.name] = \
+                    assignments[i][: len(p.considerable)]
+                # the batch solve doubles as the device probe for any
+                # pool whose fallback budget just ran out
+                exit_device_fallback(states[p.pool.name], telemetry,
+                                     p.pool.name)
 
     outcomes: dict[str, MatchOutcome] = {}
-    solve_idx = 0
     for prepared in prepared_list:
+        name = prepared.pool.name
+        flight = pool_flight(name)
         assignment = np.empty(0, dtype=np.int32)
-        if prepared.solvable:
-            assignment = assignments[solve_idx][: len(prepared.considerable)]
-            solve_idx += 1
+        if name in batch_assignments:
+            assignment = batch_assignments[name]
             if telemetry is not None:
-                telemetry.quality.observe_cycle(prepared, assignment,
-                                                prepared.pool.name)
+                telemetry.quality.observe_cycle(prepared, assignment, name)
             if config.chunk:
-                st = states[prepared.pool.name]
+                st = states[name]
                 st.chunked_solves += 1
                 if (config.quality_audit_every
                         and st.chunked_solves
                         % config.quality_audit_every == 0):
-                    start_quality_audit(prepared, assignment,
-                                        prepared.pool.name)
-        flight = pool_flight(prepared.pool.name)
+                    start_quality_audit(prepared, assignment, name)
+        elif name in cpu_solving:
+            try:
+                with flight.phase("solve", device=False):
+                    assignment = cpu_fallback_solve(prepared, config)
+            except Exception:  # noqa: BLE001 — the fallback solver
+                # failing too must not escape the cycle
+                log.exception("cpu fallback solve failed (pool %s)", name)
+                outcomes[name] = degrade_to_solve_failed(
+                    prepared, config, states[name], flight,
+                    record_placement_failure)
+                continue
+            record_fallback_outcome(prepared, name, states[name], flight,
+                                    telemetry, cpu_solving[name])
         with flight.phase("launch"):
-            outcomes[prepared.pool.name] = finalize_pool_match(
-                store, prepared, assignment, config,
-                states[prepared.pool.name], clusters,
-                make_task_id=make_task_id,
+            outcomes[name] = finalize_pool_match(
+                store, prepared, assignment, config, states[name],
+                clusters, make_task_id=make_task_id,
                 record_placement_failure=record_placement_failure,
                 flight=flight,
             )

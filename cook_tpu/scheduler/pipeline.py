@@ -55,13 +55,19 @@ from cook_tpu.models.store import JobStore
 from cook_tpu.scheduler import flight_recorder as flight_codes
 from cook_tpu.scheduler.flight_recorder import NULL_CYCLE
 from cook_tpu.scheduler.matcher import (
+    CpuFallbackPending,
     MatchConfig,
     MatchOutcome,
     PoolMatchState,
+    check_device_fallback,
+    cpu_fallback_solve,
     dispatch_pool_solve,
+    enter_device_fallback,
+    exit_device_fallback,
     fail_launched_specs,
     finalize_pool_match,
     prepare_pool_problem,
+    record_fallback_outcome,
     record_solve_outcome,
 )
 from cook_tpu.scheduler.ranking import RankedQueue
@@ -103,6 +109,7 @@ class _Stage:
     flight: object
     pending: object = None          # PendingResult or None
     t_dispatch: float = 0.0
+    fallback_reason: str = ""       # non-empty = CPU-fallback cycle
 
 
 def match_pools_pipelined(
@@ -166,10 +173,31 @@ def match_pools_pipelined(
                 assignment = stage.pending.fetch()
             except Exception:  # noqa: BLE001 — pool k's kernel raising
                 # (deferred device error surfaces at fetch) must not
-                # wedge pools k±1; its jobs simply wait a cycle
+                # wedge pools k±1
                 log.exception("pipelined solve failed (pool %s)",
                               stage.pool.name)
-                solve_failed = True
+                if stage.fallback_reason \
+                        or config.device_fallback_cycles <= 0:
+                    # the raise came from the CPU fallback itself (or
+                    # fallback is disabled): there is no further tier to
+                    # degrade to — jobs wait a cycle (historic
+                    # solve-failed semantics), pools k±1 untouched
+                    solve_failed = True
+                else:
+                    # reaction (c): re-solve THIS cycle host-side and
+                    # degrade the pool (same semantics as the serial
+                    # path) — no cycle lost, pools k±1 untouched
+                    enter_device_fallback(stage.state, config,
+                                          stage.pool.name, "solve-error")
+                    stage.fallback_reason = "solve-error"
+                    try:
+                        assignment = cpu_fallback_solve(stage.prepared,
+                                                        config)
+                    except Exception:  # noqa: BLE001 — fallback solver
+                        # failing too must still not escape finish()
+                        log.exception("cpu fallback solve failed "
+                                      "(pool %s)", stage.pool.name)
+                        solve_failed = True
             t_end = time.perf_counter()
             # solve phase wall = dispatch-end -> fetch-complete; under
             # overlap it also spans the host work interleaved between
@@ -182,7 +210,10 @@ def match_pools_pipelined(
             # is the pipeline working as designed)
             wait_s = t_end - t_fetch
             solve_s = t_end - stage.t_dispatch
-            flight.add_phase("solve", wait_s, device=True)
+            # a CPU-fallback solve is pure host work: nothing about its
+            # wall is device-attributable
+            flight.add_phase("solve", wait_s,
+                             device=not stage.fallback_reason)
             if solve_s > wait_s:
                 flight.add_phase("solve", solve_s - wait_s, device=False)
             if solve_failed:
@@ -200,9 +231,16 @@ def match_pools_pipelined(
                 _apply_backoff(config, stage.state, False)
                 outcomes[stage.pool.name] = outcome
                 return
-            record_solve_outcome(stage.prepared, assignment, config,
-                                 stage.state, stage.pool.name, solve_s,
-                                 flight, telemetry, overlapped=True)
+            if stage.fallback_reason:
+                record_fallback_outcome(stage.prepared, stage.pool.name,
+                                        stage.state, flight, telemetry,
+                                        stage.fallback_reason)
+            else:
+                record_solve_outcome(stage.prepared, assignment, config,
+                                     stage.state, stage.pool.name, solve_s,
+                                     flight, telemetry, overlapped=True)
+                exit_device_fallback(stage.state, telemetry,
+                                     stage.pool.name)
         with flight.phase("launch"):
             outcomes[stage.pool.name] = finalize_pool_match(
                 store, stage.prepared, assignment, config, stage.state,
@@ -232,16 +270,26 @@ def match_pools_pipelined(
         stage = _Stage(pool=pool, prepared=prepared, state=state,
                        flight=flight)
         if prepared.solvable:
-            with flight.phase("dispatch"):
-                try:
-                    stage.pending = dispatch_pool_solve(prepared, config)
-                except Exception:  # noqa: BLE001 — a dispatch-time raise
-                    # (tracing/compile error) is this pool's solve failing
-                    # eagerly; mark it failed at finish() like a deferred
-                    # device error
-                    log.exception("pipelined dispatch failed (pool %s)",
-                                  pool.name)
-                    stage.pending = _FailedDispatch()
+            use_cpu, fb_reason = check_device_fallback(
+                config, state, telemetry, pool.name)
+            if use_cpu:
+                # pool in device-fallback mode: the "pending solve" is a
+                # host-side reference solve run at fetch time (no device
+                # buffer behind it)
+                stage.pending = CpuFallbackPending(prepared, config)
+                stage.fallback_reason = fb_reason
+            else:
+                with flight.phase("dispatch"):
+                    try:
+                        stage.pending = dispatch_pool_solve(prepared,
+                                                            config)
+                    except Exception:  # noqa: BLE001 — a dispatch-time
+                        # raise (tracing/compile error) is this pool's
+                        # solve failing eagerly; mark it failed at
+                        # finish() like a deferred device error
+                        log.exception("pipelined dispatch failed "
+                                      "(pool %s)", pool.name)
+                        stage.pending = _FailedDispatch()
             # the solve interval starts where the dispatch phase ends —
             # disjoint walls, so phase sums never double-count
             stage.t_dispatch = time.perf_counter()

@@ -27,12 +27,19 @@ from cook_tpu.sim.simulator import (
 
 def cmd_run(args) -> int:
     jobs, hosts = load_trace(args.trace)
+    fault_schedule = None
+    if args.faults:
+        # chaos-drill mode (docs/resilience.md): a FaultSchedule JSON
+        # armed for the run, so recovery behavior replays from a file
+        with open(args.faults) as f:
+            fault_schedule = json.load(f)
     config = SimConfig(
         cycle_ms=args.cycle_ms,
         rebalance_every=args.rebalance_every,
         elastic_every=(args.elastic_every if args.elastic else 0),
         max_cycles=args.max_cycles,
         batched_match=args.batched,
+        fault_schedule=fault_schedule,
         scheduler=SchedulerConfig(
             # chunk/backend default to the hardware-tuned config
             # (tuned_match.json) like the service; flags override
@@ -198,6 +205,9 @@ def main(argv=None) -> int:
     r.add_argument("--elastic", action="store_true",
                    help="enable the elastic capacity plane (pool "
                         "loaning + reclaim, cook_tpu/elastic/)")
+    r.add_argument("--faults", default="",
+                   help="FaultSchedule JSON file armed for the run "
+                        "(cook_tpu.faults; see docs/resilience.md)")
     r.add_argument("--elastic-every", type=int, default=1,
                    help="cycles between capacity plans (with --elastic)")
     r.set_defaults(fn=cmd_run)

@@ -93,6 +93,12 @@ class SimConfig:
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     pools: tuple = (("default", "default"),)  # (name, dru_mode)
     batched_match: bool = False      # one device call for all pools
+    # fault-injection schedule (cook_tpu/faults.FaultSchedule.from_dict
+    # shape: {"seed": .., "rules": [{"point": .., "mode": .., ...}]}),
+    # armed for the duration of run() — the chaos scenarios
+    # (tools/chaos.py) script launch failures, device solve errors, etc.
+    # against the REAL scheduler through this knob
+    fault_schedule: Optional[dict] = None
 
 
 @dataclass
@@ -210,6 +216,22 @@ class Simulator:
         }
 
     def run(self) -> SimResult:
+        from cook_tpu import faults
+
+        cfg = self.config
+        prev = faults.ACTIVE  # restore, don't disarm: a test may run the
+        if cfg.fault_schedule:  # simulator INSIDE faults.injected(...)
+            faults.arm(faults.FaultSchedule.from_dict(cfg.fault_schedule))
+        try:
+            return self._run()
+        finally:
+            if cfg.fault_schedule:
+                if prev is not None:
+                    faults.arm(prev)
+                else:
+                    faults.disarm()
+
+    def _run(self) -> SimResult:
         cfg = self.config
         submitted = 0
         phase_wall: dict[str, float] = {"rank": 0.0, "match": 0.0,

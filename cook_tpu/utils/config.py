@@ -155,6 +155,15 @@ class Settings:
     # "rank_half_life": ..., "reclaim_window": ...})
     elastic_interval_s: float = 0.0
     elastic: dict = field(default_factory=dict)
+    # resilience plane (docs/resilience.md):
+    # POST /debug/faults arm/disarm — NEVER enable outside a chaos drill
+    fault_injection: bool = False
+    # what a journal fsync FAILURE means: "fail-stop" (commit reports
+    # undurable + leader demotes) or "degrade-async" (keep committing
+    # without the disk barrier, health reason journal-fsync-degraded)
+    journal_fsync_policy: str = "fail-stop"
+    # 429 + Retry-After on heavy reads while the commit-ack SLO burns
+    load_shedding: bool = True
 
     def match_config_for_pool(self, pool_name: str) -> MatchConfig:
         for ps in self.pool_schedulers:
@@ -180,6 +189,8 @@ def _match_config(d: dict) -> MatchConfig:
         agent_start_grace_mins=float(d.get("agent_start_grace_mins", 10.0)),
         checkpoint_memory_overhead_mb=float(
             d.get("checkpoint_memory_overhead_mb", 0.0)),
+        device_fallback_cycles=int(d.get("device_fallback_cycles", 8)),
+        device_latency_guard=float(d.get("device_latency_guard", 0.0)),
     )
 
 
@@ -208,6 +219,7 @@ def read_config(path: Optional[str] = None,
                 "replication_ack_timeout_s", "replication_ack_liveness_s",
                 "data_dir", "snapshot_interval_s", "platform",
                 "batched_match", "pipelined_match", "elastic_interval_s",
+                "fault_injection", "journal_fsync_policy", "load_shedding",
                 "queue_limit_per_pool",
                 "queue_limit_per_user", "submission_rate_per_minute"):
         if key in data:
@@ -254,6 +266,10 @@ def read_config(path: Optional[str] = None,
 def _validate(s: Settings) -> None:
     if not (0 < s.port < 65536):
         raise ValueError(f"bad port {s.port}")
+    if s.journal_fsync_policy not in ("fail-stop", "degrade-async"):
+        raise ValueError(
+            f"bad journal_fsync_policy {s.journal_fsync_policy!r} "
+            f"(fail-stop | degrade-async)")
     if s.match.scaleback <= 0 or s.match.scaleback > 1:
         raise ValueError(f"bad scaleback {s.match.scaleback}")
     if not s.pools:

@@ -21,14 +21,16 @@ def run(argv, calls=None, codes=None):
         return fn
 
     steps = {name: step(name)
-             for name in ("lint_metrics", "smoke_bench", "bench_gate")}
+             for name in ("lint_metrics", "smoke_bench", "bench_gate",
+                          "chaos_smoke")}
     return ci_checks.main(argv, steps=steps), calls
 
 
 def test_runs_all_steps_in_order_and_passes():
     code, calls = run(["--root", REPO_ROOT])
     assert code == 0
-    assert calls == ["lint_metrics", "smoke_bench", "bench_gate"]
+    assert calls == ["lint_metrics", "smoke_bench", "bench_gate",
+                     "chaos_smoke"]
 
 
 def test_skip_bench_runs_lint_only():
@@ -42,7 +44,8 @@ def test_failure_does_not_mask_later_steps():
                       codes={"lint_metrics": 1})
     assert code == 1
     # later steps still ran (one verdict, every step's result reported)
-    assert calls == ["lint_metrics", "smoke_bench", "bench_gate"]
+    assert calls == ["lint_metrics", "smoke_bench", "bench_gate",
+                     "chaos_smoke"]
 
 
 def test_gate_failure_fails_the_pipeline():
@@ -56,7 +59,8 @@ def test_step_exception_counts_as_failure():
 
     steps = {"lint_metrics": boom,
              "smoke_bench": lambda: 0,
-             "bench_gate": lambda: 0}
+             "bench_gate": lambda: 0,
+             "chaos_smoke": lambda: 0}
     assert ci_checks.main(["--root", REPO_ROOT], steps=steps) == 1
 
 

@@ -116,18 +116,31 @@ def test_columnar_rank_parity_with_offensive_filter(clock):
 
 def test_columnar_rank_speed(clock):
     """20k pending jobs: the columnar path must encode in well under the
-    loop path's time (sanity bound, not a strict benchmark)."""
+    loop path's time (sanity bound, not a strict benchmark).
+
+    Deflaked for concurrent CPU load (the full tier-1 run executes
+    alongside other CPU-heavy tests): both paths are timed best-of-3 in
+    the SAME process — min-of-N is robust to scheduler preemption
+    because external load only ever ADDS wall time to a sample — and
+    the comparison is a work ratio against that same-process baseline,
+    not a wall-clock constant."""
     store, jobs = build_store(clock, n_jobs=20000, n_users=40,
                               with_running=False)
     index = ColumnarJobIndex(store)
     pool = store.pools["default"]
     rank_pool_columnar(store, index, pool)  # warm the kernel
-    t0 = time.perf_counter()
-    col_q = rank_pool_columnar(store, index, pool)
-    col_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    loop_q = rank_pool(store, pool)
-    loop_s = time.perf_counter() - t0
+    rank_pool(store, pool)                  # warm the loop path too
+
+    def best_of(fn, n=3):
+        best, result = float("inf"), None
+        for _ in range(n):
+            t0 = time.perf_counter()
+            result = fn()
+            best = min(best, time.perf_counter() - t0)
+        return best, result
+
+    col_s, col_q = best_of(lambda: rank_pool_columnar(store, index, pool))
+    loop_s, loop_q = best_of(lambda: rank_pool(store, pool))
     assert len(col_q.jobs) == len(loop_q.jobs) == 20000
     assert col_s < loop_s, (col_s, loop_s)
 

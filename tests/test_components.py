@@ -58,6 +58,82 @@ class TestElectors:
         sel.stop()
         InMemoryElector._leaders.pop("g2", None)
 
+    def test_selector_demote_releases_lease_and_fires_loss_once(self):
+        """Fail-stop demotion (journal fsync death): the lease must be
+        RELEASED — not silently kept warm by the heartbeat thread — so a
+        standby acquires before any TTL runs out, and on_loss fires
+        exactly once even when demote() is called again."""
+        elector = InMemoryElector("g3", "x")
+        losses = []
+        sel = LeaderSelector(elector, poll_s=0.01,
+                             on_loss=lambda: losses.append(1))
+        sel.wait_for_leadership()
+        t = sel.start_heartbeat_thread()
+        sel.demote()
+        assert not sel.is_leader
+        standby = InMemoryElector("g3", "y")
+        assert standby.try_acquire()
+        t.join(timeout=2)
+        assert not t.is_alive()  # no renewals after demotion
+        sel.demote()
+        assert losses == [1]
+        InMemoryElector._leaders.pop("g3", None)
+
+    def test_selector_concurrent_loss_fires_once(self):
+        """demote() racing a heartbeat failure observes the loss from
+        two threads at once: _fire_loss's test-and-set is atomic, so
+        on_loss still runs exactly once."""
+        elector = InMemoryElector("g4", "x")
+        losses = []
+        barrier = threading.Barrier(8)
+        sel = LeaderSelector(elector, poll_s=0.01,
+                             on_loss=lambda: losses.append(1))
+        sel.wait_for_leadership()
+
+        def fire():
+            barrier.wait()
+            sel._fire_loss()
+
+        threads = [threading.Thread(target=fire) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=2)
+        assert losses == [1]
+        InMemoryElector._leaders.pop("g4", None)
+
+
+class TestReactionWiring:
+    """One flag governs BOTH halves of reaction (d): the REST 429 shed
+    AND the scheduler's considerable-window scaleback."""
+
+    @staticmethod
+    def _build(load_shedding):
+        from cook_tpu.utils.config import Settings
+        s = Settings(clusters=[{
+            "kind": "mock", "name": "m1",
+            "hosts": [{"node_id": "h0", "mem": 4000, "cpus": 8}],
+        }], pools=[{"name": "default"}], load_shedding=load_shedding,
+            rank_interval_s=3600, match_interval_s=3600)
+        return build_process(s, start_rest=False)
+
+    def test_load_shedding_on_wires_admission_to_shedder(self):
+        p = self._build(True)
+        try:
+            assert (p.scheduler.admission.overload_fn
+                    == p.api.shedder.overloaded)
+        finally:
+            shutdown(p)
+
+    def test_load_shedding_off_leaves_admission_inert(self):
+        p = self._build(False)
+        try:
+            # no silent considerable-window shrink with the knob off
+            assert p.scheduler.admission.overload_fn is None
+            assert p.scheduler.admission.overloaded() is False
+        finally:
+            shutdown(p)
+
 
 class TestConfig:
     def test_defaults(self):

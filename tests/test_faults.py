@@ -1,0 +1,937 @@
+"""Fault-injection plane + graceful-degradation reactions
+(cook_tpu/faults/, utils/retry.py — docs/resilience.md).
+
+Unit level: FaultSchedule rule semantics, the shared retry policy,
+circuit-breaker transitions, load shedding / admission scaleback, the
+journal fsync policies, follower reconnect backoff, the k8s
+idempotent-GET-only retry split.  Integration level (the satellite
+coverage the chaos suite complements): a kill racing an open circuit
+breaker, an fsync fault during leader failover (acked txns survive on
+the promoted standby), and device-fallback cycle parity against the
+healthy solve on the same problem.
+"""
+import os
+import tempfile
+import time
+
+import pytest
+import requests
+
+from cook_tpu import faults
+from cook_tpu.faults.breaker import (
+    BreakerParams,
+    BreakerState,
+    CircuitBreaker,
+)
+from cook_tpu.faults.reactions import AdmissionController, LoadShedder
+from cook_tpu.utils.retry import RetryPolicy, backoff_s, call_with_retry
+
+
+@pytest.fixture(autouse=True)
+def _no_schedule_leaks():
+    """Every test starts and ends disarmed."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------------- schedule
+
+
+class TestFaultSchedule:
+    def test_unknown_point_and_mode_rejected(self):
+        with pytest.raises(ValueError):
+            faults.FaultRule(point="journal.fsyncc")
+        with pytest.raises(ValueError):
+            faults.FaultRule(point=faults.JOURNAL_FSYNC, mode="explode")
+
+    def test_unarmed_site_is_a_noop(self):
+        assert faults.ACTIVE is None  # the only check a site pays
+
+    def test_error_rule_rides_oserror_paths(self):
+        with faults.injected({"point": "cluster.launch"}):
+            with pytest.raises(OSError):
+                faults.ACTIVE.hit(faults.CLUSTER_LAUNCH, cluster="c")
+
+    def test_times_bounds_firings(self):
+        with faults.injected({"point": "cluster.launch", "times": 2}) as s:
+            for _ in range(2):
+                with pytest.raises(faults.FaultInjected):
+                    s.hit(faults.CLUSTER_LAUNCH)
+            s.hit(faults.CLUSTER_LAUNCH)  # exhausted: no raise
+            assert s.fired_total() == 2
+
+    def test_after_skips_the_first_hits(self):
+        with faults.injected({"point": "device.solve", "after": 2}) as s:
+            s.hit(faults.DEVICE_SOLVE)
+            s.hit(faults.DEVICE_SOLVE)
+            with pytest.raises(faults.FaultInjected):
+                s.hit(faults.DEVICE_SOLVE)
+
+    def test_match_filters_on_context(self):
+        rule = {"point": "cluster.launch", "match": {"cluster": "sick"}}
+        with faults.injected(rule) as s:
+            s.hit(faults.CLUSTER_LAUNCH, cluster="healthy")
+            with pytest.raises(faults.FaultInjected):
+                s.hit(faults.CLUSTER_LAUNCH, cluster="sick")
+            # a context that lacks the matched key entirely does not fire
+            s.hit(faults.CLUSTER_LAUNCH)
+            assert s.fired_total() == 1
+
+    def test_probability_is_seeded_and_deterministic(self):
+        def firings(seed):
+            schedule = faults.FaultSchedule(
+                [faults.FaultRule(point=faults.DEVICE_SOLVE,
+                                  probability=0.5)], seed=seed)
+            out = []
+            for _ in range(20):
+                try:
+                    schedule.hit(faults.DEVICE_SOLVE)
+                    out.append(0)
+                except faults.FaultInjected:
+                    out.append(1)
+            return out
+
+        assert firings(7) == firings(7)  # same seed replays exactly
+        assert 0 < sum(firings(7)) < 20  # and actually draws both ways
+
+    def test_delay_mode_sleeps_without_raising(self):
+        slept = []
+        schedule = faults.FaultSchedule(
+            [faults.FaultRule(point=faults.JOURNAL_FSYNC, mode="delay",
+                              delay_s=0.25)], sleep=slept.append)
+        schedule.hit(faults.JOURNAL_FSYNC)
+        assert slept == [0.25]
+
+    def test_injected_nesting_restores_previous_schedule(self):
+        with faults.injected({"point": "device.solve"}) as outer:
+            with faults.injected({"point": "journal.fsync"}):
+                assert faults.ACTIVE is not outer
+            assert faults.ACTIVE is outer
+        assert faults.ACTIVE is None
+
+    def test_schedule_roundtrips_through_dict(self):
+        src = {"seed": 3, "rules": [
+            {"point": "k8s.request", "mode": "delay", "delay_s": 0.1,
+             "times": 4, "match": {"method": "GET"}}]}
+        schedule = faults.FaultSchedule.from_dict(src)
+        d = schedule.to_dict()
+        assert d["seed"] == 3
+        assert d["rules"][0]["point"] == "k8s.request"
+        assert d["rules"][0]["match"] == {"method": "GET"}
+        assert d["rules"][0]["fired"] == 0
+
+
+# ---------------------------------------------------------------- retry
+
+
+class TestRetryPolicy:
+    def test_backoff_curve_is_exponential_and_capped(self):
+        policy = RetryPolicy(base_s=0.1, multiplier=2.0, cap_s=0.5,
+                             jitter=0.0)
+        assert backoff_s(policy, 1) == pytest.approx(0.1)
+        assert backoff_s(policy, 2) == pytest.approx(0.2)
+        assert backoff_s(policy, 3) == pytest.approx(0.4)
+        assert backoff_s(policy, 4) == pytest.approx(0.5)  # capped
+        assert backoff_s(policy, 10) == pytest.approx(0.5)
+
+    def test_jitter_stays_inside_the_band(self):
+        policy = RetryPolicy(base_s=1.0, multiplier=1.0, cap_s=1.0,
+                             jitter=0.5)
+        import random
+
+        rng = random.Random(5)
+        for _ in range(50):
+            d = backoff_s(policy, 1, rng)
+            assert 0.5 <= d <= 1.0
+
+    def test_retries_then_succeeds(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        slept = []
+        out = call_with_retry(flaky,
+                              RetryPolicy(max_attempts=3, jitter=0.0,
+                                          base_s=0.01),
+                              sleep=slept.append)
+        assert out == "ok" and calls["n"] == 3
+        assert len(slept) == 2
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def wrong():
+            calls["n"] += 1
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            call_with_retry(wrong, RetryPolicy(max_attempts=5),
+                            sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_exhausted_attempts_reraise_the_last_failure(self):
+        def dead():
+            raise OSError("still down")
+
+        with pytest.raises(OSError, match="still down"):
+            call_with_retry(dead,
+                            RetryPolicy(max_attempts=3, jitter=0.0,
+                                        base_s=0.001),
+                            sleep=lambda s: None)
+
+    def test_deadline_bounds_attempts_plus_sleeps(self):
+        now = {"t": 0.0}
+
+        def clock():
+            return now["t"]
+
+        def sleep(s):
+            now["t"] += s
+
+        calls = {"n": 0}
+
+        def dead():
+            calls["n"] += 1
+            now["t"] += 0.4  # each attempt costs 0.4s
+            raise OSError("down")
+
+        # base 0.4s delay + 0.4s attempts against a 1.0s deadline: the
+        # second retry would land past the deadline -> stop at 2 calls
+        with pytest.raises(OSError):
+            call_with_retry(dead,
+                            RetryPolicy(max_attempts=10, base_s=0.4,
+                                        multiplier=1.0, jitter=0.0,
+                                        deadline_s=1.0),
+                            sleep=sleep, clock=clock)
+        assert calls["n"] == 2
+
+
+# -------------------------------------------------------------- breaker
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        clock = _Clock()
+        params = BreakerParams(**{"window": 4, "min_samples": 2,
+                                  "error_threshold": 0.5,
+                                  "cooldown_s": 10.0, **kw})
+        return CircuitBreaker("c", params, clock=clock), clock
+
+    def test_no_verdict_below_min_samples(self):
+        breaker, _ = self.make(min_samples=3)
+        breaker.note_failure()
+        breaker.note_failure()
+        assert breaker.state is BreakerState.CLOSED
+
+    def test_trips_at_error_threshold(self):
+        breaker, _ = self.make(error_threshold=0.6)
+        breaker.note_success()
+        breaker.note_failure()
+        assert breaker.state is BreakerState.CLOSED  # 1/2 < 0.6
+        breaker.note_failure()
+        assert breaker.state is BreakerState.OPEN    # 2/3 >= 0.6
+        assert breaker.opens == 1
+
+    def test_open_blocks_until_cooldown_then_half_open(self):
+        breaker, clock = self.make()
+        breaker.note_failure()
+        breaker.note_failure()
+        assert not breaker.allows_work()
+        clock.t += 10.0
+        assert breaker.allows_work()  # the transition happens here
+        assert breaker.state is BreakerState.HALF_OPEN
+
+    def test_half_open_probe_success_closes_and_forgets(self):
+        breaker, clock = self.make()
+        breaker.note_failure()
+        breaker.note_failure()
+        clock.t += 10.0
+        breaker.allows_work()
+        # a successful KILL while half-open is not the probe — only a
+        # launch outcome may close the breaker
+        breaker.note_success()
+        assert breaker.state is BreakerState.HALF_OPEN
+        breaker.note_success(probe=True)
+        assert breaker.state is BreakerState.CLOSED
+        # the pre-open error history described the outage: one new
+        # failure must not re-trip on stale errors
+        breaker.note_failure()
+        assert breaker.state is BreakerState.CLOSED
+
+    def test_half_open_probe_failure_reopens(self):
+        breaker, clock = self.make()
+        breaker.note_failure()
+        breaker.note_failure()
+        clock.t += 10.0
+        breaker.allows_work()
+        breaker.note_failure(probe=True)  # the launch probe failed
+        assert breaker.state is BreakerState.OPEN
+        assert breaker.opens == 2
+
+    def test_half_open_kill_failure_does_not_retrip(self):
+        """Mirror of the kill-success rule: while half-open, only the
+        LAUNCH probe's outcome decides the transition.  A cluster with a
+        broken kill RPC but healthy launches must not re-trip on every
+        ungated kill (it would starve forever — the probe launch could
+        never run before a kill failure flipped the breaker back open)."""
+        breaker, clock = self.make()
+        breaker.note_failure()
+        breaker.note_failure()
+        clock.t += 10.0
+        breaker.allows_work()
+        breaker.note_failure()  # a kill failing while half-open
+        assert breaker.state is BreakerState.HALF_OPEN
+        assert breaker.opens == 1
+        breaker.note_success(probe=True)  # the probe launch succeeds
+        assert breaker.state is BreakerState.CLOSED
+
+    def test_snapshot_reports_rates(self):
+        breaker, _ = self.make()
+        breaker.note_success()
+        breaker.note_failure()
+        snap = breaker.snapshot()
+        assert snap["recent_samples"] == 2 and snap["recent_errors"] == 1
+        assert snap["error_rate"] == pytest.approx(0.5)
+
+
+# ----------------------------------------------------- reactions (unit)
+
+
+class _FakeContention:
+    def __init__(self):
+        self.reasons = []
+        self.evaluations = 0
+
+    def evaluate(self):
+        self.evaluations += 1
+        return [{"reason": r} for r in self.reasons], {}
+
+
+class TestLoadShedder:
+    def test_sheds_only_on_shed_relevant_reasons(self):
+        contention = _FakeContention()
+        clock = _Clock()
+        shedder = LoadShedder(contention, ttl_s=0.0, clock=clock)
+        assert shedder.should_shed("/queue") is None
+        contention.reasons = ["fsync-stall"]  # detected but not shed-able
+        clock.t += 1
+        assert shedder.should_shed("/queue") is None
+        contention.reasons = ["commit-ack-slo-burn"]
+        clock.t += 1
+        verdict = shedder.should_shed("/queue")
+        assert verdict is not None
+        assert verdict["reasons"] == ["commit-ack-slo-burn"]
+        assert verdict["retry_after_s"] > 0
+
+    def test_ttl_caches_the_evaluation(self):
+        contention = _FakeContention()
+        clock = _Clock()
+        shedder = LoadShedder(contention, ttl_s=5.0, clock=clock)
+        for _ in range(10):
+            shedder.should_shed("/jobs")
+        assert contention.evaluations == 1
+        clock.t += 6.0
+        shedder.should_shed("/jobs")
+        assert contention.evaluations == 2
+
+
+class TestAdmissionController:
+    def test_scaleback_floor_and_reset(self):
+        overloaded = {"v": True}
+        admission = AdmissionController(overload_fn=lambda: overloaded["v"],
+                                        scaleback=0.5, floor_fraction=0.1)
+        from cook_tpu.scheduler.matcher import PoolMatchState
+
+        state = PoolMatchState(num_considerable=100)
+        steps0 = admission._scalebacks.value({"pool": "p"})
+        admission.clamp("p", state, 100)
+        assert state.num_considerable == 50
+        for _ in range(10):  # keep shrinking to the floor, never below
+            admission.clamp("p", state, 100)
+        assert admission.cap("p") == 10
+        # only actual shrink steps count (100->50->25->12->10): a cap
+        # held at the floor is not another scaleback
+        assert admission._scalebacks.value({"pool": "p"}) - steps0 == 4
+        overloaded["v"] = False  # burn clears: cap resets to max
+        state.num_considerable = 5  # matcher's own backoff stays OWNED
+        admission.clamp("p", state, 100)
+        assert admission.cap("p") == 100
+        assert state.num_considerable == 5
+
+    def test_broken_overload_signal_fails_open(self):
+        def boom():
+            raise RuntimeError("signal down")
+
+        admission = AdmissionController(overload_fn=boom)
+        from cook_tpu.scheduler.matcher import PoolMatchState
+
+        state = PoolMatchState(num_considerable=100)
+        admission.clamp("p", state, 100)  # must not raise
+        assert state.num_considerable == 100
+
+
+# -------------------------------------------------- journal fsync policy
+
+
+def _journal(tmp_path, **kw):
+    from cook_tpu.models.persistence import JournalWriter
+
+    return JournalWriter(os.path.join(str(tmp_path), "journal.jsonl"),
+                         fsync_every=0, **kw)
+
+
+class TestFsyncPolicies:
+    def test_fail_stop_reraises_and_notifies(self, tmp_path):
+        seen = []
+        journal = _journal(tmp_path, on_fsync_error=seen.append)
+        journal.write_line('{"kind": "x"}')
+        with faults.injected({"point": "journal.fsync"}):
+            with pytest.raises(OSError):
+                journal.sync()
+        assert len(seen) == 1 and isinstance(seen[0], OSError)
+        assert not journal.degraded
+
+    def test_degrade_async_keeps_committing_then_recovers(self, tmp_path):
+        journal = _journal(tmp_path, fsync_policy="degrade-async",
+                           degraded_retry_s=0.05)
+        journal.write_line('{"kind": "a"}')
+        with faults.injected({"point": "journal.fsync"}):
+            journal.sync()  # swallows the failure, degrades
+            assert journal.degraded
+            assert journal.telemetry.fsync_errors == 1
+            # within the cool-off, syncs don't re-probe the broken disk
+            journal.write_line('{"kind": "b"}')
+            journal.sync()
+            assert journal.telemetry.fsync_errors == 1
+        time.sleep(0.06)
+        journal.sync()  # past the cool-off, the probe succeeds
+        assert not journal.degraded
+        # everything written while degraded is on disk
+        with open(journal.path) as f:
+            assert len(f.read().splitlines()) == 2
+
+    def test_unknown_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            _journal(tmp_path, fsync_policy="shrug")
+
+
+# ------------------------------------------------- replication backoff
+
+
+class TestFollowerBackoff:
+    def make_follower(self):
+        from cook_tpu.control.replication import JournalFollower
+        from cook_tpu.models.store import JobStore
+
+        return JournalFollower(
+            JobStore(), leader_url_fn=lambda: "", poll_s=0.05,
+            reconnect_policy=RetryPolicy(base_s=0.1, multiplier=2.0,
+                                         cap_s=0.4, jitter=0.0))
+
+    def test_wait_grows_with_failures_and_caps(self):
+        follower = self.make_follower()
+        assert follower._next_wait_s() == pytest.approx(0.05)
+        for _ in range(2):
+            follower._transport_error = True
+            follower._note_cycle_outcome()
+        assert follower._next_wait_s() == pytest.approx(0.2)
+        for _ in range(5):
+            follower._transport_error = True
+            follower._note_cycle_outcome()
+        assert follower._next_wait_s() == pytest.approx(0.4)  # capped
+        assert follower.reconnect_attempts == 7
+
+    def test_success_resets_to_poll_interval(self):
+        follower = self.make_follower()
+        follower._transport_error = True
+        follower._note_cycle_outcome()
+        assert follower._next_wait_s() > 0.05
+        follower._note_cycle_outcome()  # clean cycle
+        assert follower._next_wait_s() == pytest.approx(0.05)
+
+    def test_dropped_fetch_counts_reconnects(self):
+        """The replication.fetch fault point drives the REAL loop: a
+        dead leader produces counted, backed-off reconnect attempts."""
+        follower = self.make_follower()
+        follower.leader_url_fn = lambda: "http://127.0.0.1:1"
+        with faults.injected({"point": "replication.fetch"}):
+            follower.start()
+            deadline = time.monotonic() + 5.0
+            while follower.reconnect_attempts < 2 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            follower.stop()
+        assert follower.reconnect_attempts >= 2
+
+    def test_apply_failure_is_not_a_reconnect(self):
+        """A sync_once raise that is NOT a transport error (e.g. a store
+        apply bug) must retry at the normal poll cadence — no reconnect
+        count, no backoff stretching replication lag to the cap."""
+        follower = self.make_follower()
+        follower.leader_url_fn = lambda: "http://leader.example"
+        follower.sync_once = lambda: (_ for _ in ()).throw(
+            RuntimeError("apply failed"))
+        follower.start()
+        time.sleep(0.4)  # several poll cycles' worth of failures
+        follower.stop()
+        assert follower.reconnect_attempts == 0
+        assert follower._next_wait_s() == pytest.approx(follower.poll_s)
+
+
+# ------------------------------------------------------- k8s retry split
+
+
+class TestK8sRetrySplit:
+    @pytest.fixture()
+    def api(self):
+        from cook_tpu.cluster.k8s_http import HttpKubeApi
+        from tests.fake_apiserver import make_server
+
+        server, state, url = make_server()
+        api = HttpKubeApi(url, namespace="default")
+        state.add_node("n1", 8192, 16)
+        yield api
+        api.stop()
+        server.shutdown()
+
+    def test_idempotent_get_retried_once(self, api):
+        with faults.injected({"point": "k8s.request", "times": 1,
+                              "match": {"method": "GET"}}) as s:
+            [node] = api.list_nodes()  # first attempt faulted, retry won
+        assert node.name == "n1"
+        assert s.fired_total() == 1
+
+    def test_mutating_request_stays_single_shot(self, api):
+        with faults.injected({"point": "k8s.request", "times": 1,
+                              "match": {"method": "DELETE"}}) as s:
+            # if DELETE were retried, the second attempt would succeed
+            # and no error would surface — the raise IS the proof
+            with pytest.raises(OSError):
+                api.delete_pod("anything")
+        assert s.fired_total() == 1
+
+    def test_get_retry_classification(self):
+        from cook_tpu.cluster.k8s_http import (
+            ApiError,
+            WatchGap,
+            _retryable_get_error,
+        )
+
+        assert _retryable_get_error(OSError("conn refused"))
+        assert _retryable_get_error(ApiError("boom", 503))
+        assert not _retryable_get_error(ApiError("bad request", 400))
+        assert not _retryable_get_error(ApiError("not found", 404))
+        assert not _retryable_get_error(WatchGap("/pods"))
+        assert not _retryable_get_error(ValueError("bad json"))
+
+
+# --------------------------------------- scheduler-level breaker + kill
+
+
+def _scheduler_rig(n_hosts=4, n_jobs=6, fallback_cycles=8):
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.entities import Job, Pool, Resources
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from cook_tpu.scheduler.matcher import MatchConfig
+    from tests.conftest import FakeClock
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    hosts = [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=8)
+             for i in range(n_hosts)]
+    cluster = MockCluster("mock", hosts, clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=0,
+                          device_fallback_cycles=fallback_cycles)))
+    # deterministic uuids: the parity test compares placements across
+    # two independent rigs built from this same trace
+    jobs = [Job(uuid=f"flt-{i:03d}", user=f"u{i % 3}", pool="default",
+                command="true", resources=Resources(mem=200, cpus=1),
+                max_retries=5)
+            for i in range(n_jobs)]
+    store.submit_jobs(jobs)
+    return clock, store, cluster, scheduler, jobs
+
+
+def _match_once(scheduler, store, clock):
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    clock.advance(1000)
+    return outcome
+
+
+class TestBreakerIntegration:
+    def test_kill_races_an_open_breaker(self):
+        """Kills are NEVER gated: a job killed while its cluster's
+        breaker is open still reaches the backend, and recovery does not
+        resurrect it or double-launch anything."""
+        from cook_tpu.models.entities import JobState
+
+        clock, store, cluster, scheduler, jobs = _scheduler_rig()
+        _match_once(scheduler, store, clock)
+        assert all(store.jobs[j.uuid].state is JobState.RUNNING
+                   for j in jobs)
+
+        breaker = cluster.configure_breaker(BreakerParams(
+            window=4, min_samples=2, error_threshold=0.5, cooldown_s=0.2))
+        from tests.conftest import make_job
+
+        late = [make_job(user="late", mem=200, cpus=1, max_retries=5)
+                for _ in range(2)]
+        store.submit_jobs(late)
+        with faults.injected({"point": "cluster.launch", "times": 2,
+                              "match": {"cluster": "mock"}}):
+            _match_once(scheduler, store, clock)
+            _match_once(scheduler, store, clock)
+        assert breaker.state is BreakerState.OPEN
+
+        # the race: a user kill lands while the breaker is OPEN
+        victim = jobs[0]
+        task_ids = {i.task_id for i in
+                    store.live_instances_of_job(victim.uuid)}
+        store.kill_jobs([victim.uuid])
+        assert store.jobs[victim.uuid].state is JobState.COMPLETED
+        assert not any(t in cluster.running for t in task_ids), \
+            "open breaker blocked the kill RPC"
+        assert breaker.state is BreakerState.OPEN  # kills don't close it
+
+        time.sleep(0.25)  # cooldown -> the next launch is the probe
+        for _ in range(4):
+            _match_once(scheduler, store, clock)
+            if all(store.jobs[j.uuid].state is JobState.RUNNING
+                   for j in late):
+                break
+        assert breaker.state is BreakerState.CLOSED
+        assert store.jobs[victim.uuid].state is JobState.COMPLETED
+        live = [i for i in store.instances.values()
+                if not i.status.terminal]
+        assert len({i.task_id for i in live}) == len(live)
+        assert set(cluster.running) == {i.task_id for i in live}
+
+    def test_open_breaker_skips_with_circuit_reason(self):
+        from cook_tpu.scheduler import flight_recorder as flight_codes
+
+        clock, store, cluster, scheduler, jobs = _scheduler_rig(n_jobs=3)
+        cluster.configure_breaker(BreakerParams(
+            window=4, min_samples=2, error_threshold=0.5,
+            cooldown_s=60.0))
+        with faults.injected({"point": "cluster.launch", "times": 2}):
+            _match_once(scheduler, store, clock)
+            _match_once(scheduler, store, clock)
+        launched = len(store.instances)
+        _match_once(scheduler, store, clock)  # open: no offers, no txns
+        assert len(store.instances) == launched
+        reason = scheduler.recorder.job_reason(jobs[0].uuid)
+        assert reason is not None
+        assert reason[1] == flight_codes.CLUSTER_CIRCUIT_OPEN
+
+    def test_offer_scan_failure_skips_cluster_not_cycle(self):
+        from cook_tpu.models.entities import JobState
+
+        clock, store, cluster, scheduler, jobs = _scheduler_rig(n_jobs=2)
+        with faults.injected({"point": "cluster.offers", "times": 1}):
+            _match_once(scheduler, store, clock)  # scan raised: skipped
+        _match_once(scheduler, store, clock)
+        assert all(store.jobs[j.uuid].state is JobState.RUNNING
+                   for j in jobs)
+
+
+# --------------------------------------------------- device fallback
+
+
+class TestDeviceFallback:
+    def test_fallback_cycle_parity_with_healthy_solve(self):
+        """The CPU-fallback cycle places exactly what the healthy device
+        solve places on the same problem — no cycle is lost, no
+        placement diverges."""
+        _, store_a, _, sched_a, _ = _scheduler_rig(n_hosts=3, n_jobs=6,
+                                                   fallback_cycles=2)
+        clock_b, store_b, _, sched_b, jobs = _scheduler_rig(
+            n_hosts=3, n_jobs=6, fallback_cycles=2)
+        pool_a = store_a.pools["default"]
+        sched_a.rank_cycle(pool_a)
+        healthy = sched_a.match_cycle(pool_a)
+        with faults.injected({"point": "device.solve", "times": 1}):
+            degraded = _match_once(sched_b, store_b, clock_b)
+        assert len(degraded.matched) == len(jobs)
+        a = {(j.uuid, o.hostname) for j, o in healthy.matched}
+        b = {(j.uuid, o.hostname) for j, o in degraded.matched}
+        assert a == b
+
+    def test_health_reason_raised_then_cleared_by_probe(self):
+        clock, store, _, scheduler, jobs = _scheduler_rig(
+            n_hosts=3, n_jobs=4, fallback_cycles=2)
+        from tests.conftest import make_job
+
+        with faults.injected({"point": "device.solve", "times": 1}):
+            _match_once(scheduler, store, clock)
+        assert "device-degraded" in scheduler.telemetry.health()["reasons"]
+        for cycle in range(3):  # keep the pool solvable through the window
+            store.submit_jobs([make_job(user="x", mem=100, cpus=0.5,
+                                        max_retries=5)])
+            _match_once(scheduler, store, clock)
+        assert "device-degraded" not in \
+            scheduler.telemetry.health()["reasons"]
+
+    def test_fallback_disabled_propagates_the_error(self):
+        clock, store, _, scheduler, jobs = _scheduler_rig(
+            n_jobs=2, fallback_cycles=0)
+        with faults.injected({"point": "device.solve", "times": 1}):
+            with pytest.raises(OSError):
+                _match_once(scheduler, store, clock)
+
+
+def _multi_pool_rig(n_pools=3, fallback_cycles=2):
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.entities import Job, Pool, Resources
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from cook_tpu.scheduler.matcher import MatchConfig
+    from tests.conftest import FakeClock
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    hosts = []
+    for p in range(n_pools):
+        store.set_pool(Pool(name=f"pool{p}"))
+        hosts.append(MockHost(node_id=f"p{p}h0", hostname=f"p{p}h0",
+                              mem=4000, cpus=8, pool=f"pool{p}"))
+    cluster = MockCluster("mock", hosts, clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=0,
+                          device_fallback_cycles=fallback_cycles)))
+    jobs = [Job(uuid=f"bat-{p}-{i}", user=f"u{i % 2}", pool=f"pool{p}",
+                command="true", resources=Resources(mem=200, cpus=1),
+                max_retries=5)
+            for p in range(n_pools) for i in range(3)]
+    store.submit_jobs(jobs)
+    return clock, store, cluster, scheduler, jobs
+
+
+class TestBatchedDeviceFallback:
+    """The batched multi-pool path carries the same device.solve fault
+    point and reaction (c) as the per-pool and pipelined paths: a sick
+    device fails the SHARED solve, so every participating pool re-solves
+    host-side the same cycle and degrades until its probe."""
+
+    def test_batched_fault_degrades_all_pools_cycle_survives(self):
+        _, store_a, _, sched_a, _ = _multi_pool_rig()
+        clock_b, store_b, _, sched_b, jobs = _multi_pool_rig()
+        healthy = sched_a.match_cycle_all_pools()
+        with faults.injected({"point": "device.solve", "times": 1}):
+            degraded = sched_b.match_cycle_all_pools()
+        total = sum(len(o.matched) for o in degraded.values())
+        assert total == len(jobs)  # no cycle lost to the sick device
+        for name in healthy:  # placement parity with the healthy batch
+            a = {(j.uuid, o.hostname) for j, o in healthy[name].matched}
+            b = {(j.uuid, o.hostname) for j, o in degraded[name].matched}
+            assert a == b
+        reasons = sched_b.telemetry.health()["reasons"]
+        assert "device-degraded" in reasons
+
+    def test_batched_probe_clears_the_degradation(self):
+        from tests.conftest import make_job
+
+        clock, store, _, scheduler, jobs = _multi_pool_rig(
+            n_pools=2, fallback_cycles=1)
+        with faults.injected({"point": "device.solve", "times": 1}):
+            scheduler.match_cycle_all_pools()
+        assert "device-degraded" in scheduler.telemetry.health()["reasons"]
+        for cycle in range(2):  # burn the budget, then the probe batch
+            # keep BOTH pools solvable: only a solvable pool consumes
+            # fallback budget and joins the probing batch
+            store.submit_jobs([make_job(user="x", pool=f"pool{p}", mem=100,
+                                        cpus=0.5, max_retries=5)
+                               for p in range(2)])
+            clock.advance(1000)
+            for pool in store.pools.values():  # re-rank the new jobs in
+                scheduler.rank_cycle(pool)
+            scheduler.match_cycle_all_pools()
+        assert "device-degraded" not in \
+            scheduler.telemetry.health()["reasons"]
+
+
+class TestSimulatorFaultNesting:
+    def test_sim_run_restores_the_outer_schedule(self):
+        """Simulator.run arms SimConfig.fault_schedule; finishing must
+        RESTORE a schedule armed by an enclosing faults.injected block
+        (the nesting contract injected.__exit__ documents), not disarm
+        the whole plane out from under the outer block."""
+        from cook_tpu.sim.simulator import (
+            SimConfig,
+            Simulator,
+            TraceHost,
+            TraceJob,
+        )
+
+        jobs = [TraceJob(uuid="j0", user="u", submit_time_ms=0,
+                         runtime_ms=1000, mem=100, cpus=1)]
+        hosts = [TraceHost(node_id="n0", hostname="n0", mem=2000, cpus=4)]
+        sim = Simulator(jobs, hosts, SimConfig(
+            cycle_ms=1000, max_cycles=4,
+            fault_schedule={"rules": [{"point": "cluster.offers",
+                                       "mode": "error", "times": 1}]}))
+        with faults.injected({"point": "cluster.kill", "mode": "error"}):
+            outer = faults.ACTIVE
+            sim.run()
+            assert faults.ACTIVE is outer  # restored, not disarmed
+
+
+class TestElasticOffersGuard:
+    def test_flapping_offers_rpc_skips_cluster_not_commit_path(self):
+        """CapacityPlanner.reconcile runs after EVERY capacity commit: a
+        raising offers RPC in its scale-target scan must skip the
+        cluster (the safe_pool_offers guard), not crash the commit path
+        — and the cluster.offers fault point reaches the elastic plane."""
+        from cook_tpu.cluster.mock import MockCluster, MockHost
+        from cook_tpu.elastic import CapacityPlanner, ElasticParams
+        from cook_tpu.models.entities import Pool
+        from cook_tpu.models.store import JobStore
+        from cook_tpu.txn import TransactionLog
+        from tests.conftest import FakeClock
+
+        clock = FakeClock()
+        store = JobStore(clock=clock)
+        store.set_pool(Pool(name="default"))
+        cluster = MockCluster("m", [
+            MockHost(node_id="h0", hostname="h0", mem=4000, cpus=8)],
+            clock=clock)
+        planner = CapacityPlanner(store, [cluster], TransactionLog(store),
+                                  ElasticParams(enabled=True))
+        with faults.injected({"point": "cluster.offers", "mode": "error"}):
+            planner.reconcile()  # must not raise
+
+
+# ------------------------------------------- fsync during leader failover
+
+
+class TestFailoverFsync:
+    def test_acked_txns_survive_on_the_promoted_standby(self):
+        """The leader's disk dies mid-fsync (fail-stop): the failing
+        commit errors to its client, and every PREVIOUSLY acked txn is
+        recoverable from the durable standby's local journal."""
+        from cook_tpu.control.replication import JournalFollower
+        from cook_tpu.models import persistence
+        from cook_tpu.models.store import JobStore
+        from cook_tpu.rest.server import InprocessControlPlane
+
+        follower_dir = tempfile.mkdtemp(prefix="cook-faults-standby-")
+        cp = InprocessControlPlane().start()
+        store2 = JobStore()
+        journal2 = persistence.attach_journal(
+            store2, os.path.join(follower_dir, "journal.jsonl"))
+        follower = JournalFollower(
+            store2, leader_url_fn=lambda: cp.url,
+            self_url="http://standby", member_id="standby",
+            data_dir=follower_dir, journal=journal2,
+            poll_s=0.05, timeout_s=2.0, long_poll_s=0.1).start()
+        try:
+            headers = {"X-Cook-Requesting-User": "admin"}
+            acked = []
+            for i in range(5):
+                r = requests.post(f"{cp.url}/jobs", json={"jobs": [{
+                    "uuid": f"fo-{i}", "command": "true", "mem": 64,
+                    "cpus": 0.1}]}, headers=headers)
+                assert r.status_code == 201
+                acked.append(f"fo-{i}")
+            deadline = time.monotonic() + 5.0
+            while store2.last_seq() != cp.store.last_seq() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert store2.last_seq() == cp.store.last_seq()
+
+            # only the LEADER's journal is matched — the standby's disk
+            # (same process) stays healthy
+            with faults.injected({"point": "journal.fsync",
+                                  "match": {"path": cp.journal.path}}):
+                r = requests.post(f"{cp.url}/jobs", json={"jobs": [{
+                    "uuid": "fo-doomed", "command": "true", "mem": 64,
+                    "cpus": 0.1}]}, headers=headers)
+                assert r.status_code >= 500  # undurable = not acked
+
+            cp.server.stop()  # the leader dies
+            follower.stop()
+            journal2.sync()
+            journal2.close()
+            promoted = persistence.recover(follower_dir)
+            assert promoted is not None
+            assert all(uuid in promoted.jobs for uuid in acked)
+        finally:
+            follower.stop()
+            cp.stop()
+            import shutil
+
+            shutil.rmtree(follower_dir, ignore_errors=True)
+
+
+# ------------------------------------------------------- REST endpoint
+
+
+class TestFaultEndpoint:
+    def test_disabled_by_default(self):
+        from cook_tpu.rest.server import InprocessControlPlane
+
+        cp = InprocessControlPlane().start()
+        try:
+            headers = {"X-Cook-Requesting-User": "admin"}
+            assert requests.get(f"{cp.url}/debug/faults",
+                                headers=headers).status_code == 403
+            assert requests.post(
+                f"{cp.url}/debug/faults", json={"rules": []},
+                headers=headers).status_code == 403
+        finally:
+            cp.stop()
+
+    def test_arm_observe_disarm(self):
+        from cook_tpu.rest.api import ApiConfig
+        from cook_tpu.rest.server import InprocessControlPlane
+
+        cp = InprocessControlPlane(
+            config=ApiConfig(fault_injection=True)).start()
+        try:
+            admin = {"X-Cook-Requesting-User": "admin"}
+            schedule = {"seed": 1, "rules": [
+                {"point": "journal.fsync", "mode": "delay",
+                 "delay_s": 0.0}]}
+            # non-admin cannot arm
+            r = requests.post(f"{cp.url}/debug/faults", json=schedule,
+                              headers={"X-Cook-Requesting-User": "mal"})
+            assert r.status_code == 403 and faults.ACTIVE is None
+            r = requests.post(f"{cp.url}/debug/faults", json=schedule,
+                              headers=admin)
+            assert r.status_code == 200 and r.json()["armed"]
+            assert faults.ACTIVE is not None
+            # a commit crosses the armed (zero-delay) fsync point
+            r = requests.post(f"{cp.url}/jobs", json={"jobs": [{
+                "uuid": "armed-1", "command": "true", "mem": 64,
+                "cpus": 0.1}]}, headers=admin)
+            assert r.status_code == 201
+            status = requests.get(f"{cp.url}/debug/faults",
+                                  headers=admin).json()
+            assert status["armed"]
+            assert status["schedule"]["rules"][0]["fired"] >= 1
+            r = requests.post(f"{cp.url}/debug/faults",
+                              json={"disarm": True}, headers=admin)
+            assert r.status_code == 200 and not r.json()["armed"]
+            assert faults.ACTIVE is None
+            bad = {"rules": [{"point": "not.a.point"}]}
+            assert requests.post(f"{cp.url}/debug/faults", json=bad,
+                                 headers=admin).status_code == 400
+        finally:
+            cp.stop()

@@ -69,7 +69,8 @@ def test_healthy_by_default(server, fresh_telemetry):
     # the check evidence is present even when green — device-telemetry
     # checks plus the merged control-plane contention checks
     assert set(health["checks"]) == {"compile", "quality", "solve_latency",
-                                     "device_memory", "contention"}
+                                     "device_fallback", "device_memory",
+                                     "contention"}
     assert set(health["checks"]["contention"]) == {
         "store_lock", "journal", "replication", "commit_ack", "starvation"}
 

@@ -109,6 +109,10 @@ def test_overlap_accounting_fields():
 
 def test_solve_failure_does_not_wedge_neighbor_pools(monkeypatch):
     _, store, _, scheduler, jobs = setup_multi(n_pools=3)
+    # pin the fallback-DISABLED semantics: a solve failure skips the
+    # pool's jobs for the cycle (the CPU-fallback reaction is covered in
+    # tests/test_faults.py)
+    scheduler.config.match.device_fallback_cycles = 0
     from cook_tpu.scheduler import pipeline as pipeline_mod
 
     real_dispatch = pipeline_mod.dispatch_pool_solve
@@ -135,6 +139,87 @@ def test_solve_failure_does_not_wedge_neighbor_pools(monkeypatch):
             assert store.jobs[job.uuid].state == JobState.WAITING
             cycle_id, code, _ = scheduler.recorder.job_reason(job.uuid)
             assert code == flight_codes.SOLVE_FAILED
+
+
+def test_cpu_fallback_solve_raising_does_not_reenter_fallback(monkeypatch):
+    """A pool ALREADY degraded to the CPU fallback whose reference solve
+    raises at fetch has no further tier to degrade to: its jobs wait a
+    cycle (solve-failed), the fallback budget is NOT reset, and the
+    neighbor pools still match."""
+    _, store, _, scheduler, jobs = setup_multi(n_pools=3)
+    scheduler.config.match.device_fallback_cycles = 4
+    from cook_tpu.scheduler import matcher as matcher_mod
+    from cook_tpu.scheduler import pipeline as pipeline_mod
+    from cook_tpu.scheduler.matcher import PoolMatchState
+
+    scheduler.pool_match_state["pool1"] = PoolMatchState(
+        num_considerable=scheduler.config.match.max_jobs_considered,
+        fallback_cycles_left=2, fallback_reason="solve-error")
+    calls = []
+    real = matcher_mod.cpu_fallback_solve
+
+    def cpu_solve(prepared, config):
+        calls.append(prepared.pool.name)
+        if prepared.pool.name == "pool1":
+            raise RuntimeError("reference solver crashed")
+        return real(prepared, config)
+
+    monkeypatch.setattr(matcher_mod, "cpu_fallback_solve", cpu_solve)
+    monkeypatch.setattr(pipeline_mod, "cpu_fallback_solve", cpu_solve)
+    outcomes = scheduler.match_cycle_pipelined()
+    for p in (0, 2):
+        assert len(outcomes[f"pool{p}"].matched) == 5
+    assert outcomes["pool1"].matched == []
+    assert len(outcomes["pool1"].unmatched) == 5
+    for job in jobs:
+        if job.pool == "pool1":
+            assert store.jobs[job.uuid].state == JobState.WAITING
+            _, code, _ = scheduler.recorder.job_reason(job.uuid)
+            assert code == flight_codes.SOLVE_FAILED
+    # the failing CPU solve ran ONCE (no unprotected re-run) and did not
+    # re-enter the fallback episode (enter_device_fallback would reset
+    # the budget to 4)
+    assert calls.count("pool1") == 1
+    state = scheduler.pool_match_state["pool1"]
+    assert state.fallback_cycles_left == 1
+    assert state.fallback_reason == "solve-error"
+
+
+def test_serial_cpu_fallback_solve_raising_degrades_to_solve_failed(
+        monkeypatch):
+    """The SERIAL path's analog of the guard above: a degraded pool whose
+    reference solve raises must not let the exception escape match_cycle
+    — its jobs wait with solve-failed, the fallback budget is not reset,
+    and the other pools still match."""
+    _, store, _, scheduler, jobs = setup_multi(n_pools=2)
+    scheduler.config.match.device_fallback_cycles = 4
+    from cook_tpu.scheduler import matcher as matcher_mod
+    from cook_tpu.scheduler.matcher import PoolMatchState
+
+    scheduler.pool_match_state["pool1"] = PoolMatchState(
+        num_considerable=scheduler.config.match.max_jobs_considered,
+        fallback_cycles_left=2, fallback_reason="solve-error")
+    real = matcher_mod.cpu_fallback_solve
+
+    def cpu_solve(prepared, config):
+        if prepared.pool.name == "pool1":
+            raise RuntimeError("reference solver crashed")
+        return real(prepared, config)
+
+    monkeypatch.setattr(matcher_mod, "cpu_fallback_solve", cpu_solve)
+    outcomes = {p.name: scheduler.match_cycle(p)
+                for p in store.pools.values()}
+    assert len(outcomes["pool0"].matched) == 5
+    assert outcomes["pool1"].matched == []
+    assert len(outcomes["pool1"].unmatched) == 5
+    for job in jobs:
+        if job.pool == "pool1":
+            assert store.jobs[job.uuid].state == JobState.WAITING
+            _, code, _ = scheduler.recorder.job_reason(job.uuid)
+            assert code == flight_codes.SOLVE_FAILED
+    state = scheduler.pool_match_state["pool1"]
+    assert state.fallback_cycles_left == 1
+    assert state.fallback_reason == "solve-error"
 
 
 # --------------------------------------------------------- launch fan-out

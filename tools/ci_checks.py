@@ -18,7 +18,12 @@ wrapper runs them as one pipeline with one verdict:
      record to BENCH_rsmoke_prev.json so step 3 has a pair to diff);
   3. `tools/bench_gate.py`     — phase-by-phase regression gate over
      the latest comparable record pair (commit-ack p50 included, via
-     the control_plane phase).
+     the control_plane phase);
+  4. `tools/chaos.py --smoke`  — the fast chaos trio (fsync stall ->
+     shed, launch failures -> breaker, device error -> CPU fallback):
+     each scenario injects its fault, observes the /debug/health reason
+     AND the automatic reaction, then asserts full recovery invariants
+     (docs/resilience.md).
 
     python tools/ci_checks.py [--root DIR] [--threshold 0.2]
                               [--skip-bench]
@@ -66,6 +71,19 @@ def run_bench_gate(root: str, threshold: float) -> int:
     return bench_gate.main(["--dir", root, "--threshold", str(threshold)])
 
 
+def run_chaos_smoke(root: str) -> int:
+    """Chaos smoke in a SUBPROCESS (same isolation rationale as the
+    bench: scenarios initialize jax and arm the process-global fault
+    plane — neither belongs in this process)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "chaos.py"),
+         "--smoke"],
+        cwd=root,
+        timeout=float(os.environ.get("CI_CHAOS_TIMEOUT_S", "300")),
+    )
+    return proc.returncode
+
+
 def main(argv: list[str] | None = None, *,
          steps: dict | None = None) -> int:
     parser = argparse.ArgumentParser(
@@ -83,9 +101,11 @@ def main(argv: list[str] | None = None, *,
         "lint_metrics": lambda: run_lint(args.root),
         "smoke_bench": lambda: run_smoke_bench(args.root),
         "bench_gate": lambda: run_bench_gate(args.root, args.threshold),
+        "chaos_smoke": lambda: run_chaos_smoke(args.root),
     }
     selected = (["lint_metrics"] if args.skip_bench
-                else ["lint_metrics", "smoke_bench", "bench_gate"])
+                else ["lint_metrics", "smoke_bench", "bench_gate",
+                      "chaos_smoke"])
 
     failures = []
     for name in selected:
