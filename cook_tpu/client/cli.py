@@ -268,6 +268,92 @@ def cmd_why(args) -> int:
     return 0
 
 
+def _fmt_ms(ms: Optional[float]) -> str:
+    """Human-scale duration: 4100 -> "4.1s", 3_720_000 -> "1h02m"."""
+    if ms is None:
+        return "?"
+    s = ms / 1000.0
+    if s < 60:
+        return f"{s:.1f}s"
+    if s < 3600:
+        return f"{int(s // 60)}m{int(s % 60):02d}s"
+    return f"{int(s // 3600)}h{int(s % 3600 // 60):02d}m"
+
+
+def cmd_timeline(args) -> int:
+    """Render a job's lifecycle history (GET /jobs/{uuid}/timeline)."""
+    found = _fan_out_query(args, [args.uuid])
+    if args.uuid not in found:
+        print(f"{args.uuid}: not found", file=sys.stderr)
+        return 1
+    cluster_name, _ = found[args.uuid]
+    clients = {c.name: cl for c, cl in _clients(args)}
+    tl = clients[cluster_name].timeline(args.uuid)
+    if args.json:
+        print(json.dumps(tl, indent=2))
+        return 0
+    print(f"{tl['uuid']}  {tl['state']}  (cluster {cluster_name}, "
+          f"user {tl['user']}, pool {tl['pool']}, "
+          f"priority {tl['priority']})")
+    t0 = tl["submit_time_ms"]
+    for event in tl["events"]:
+        offset = _fmt_ms(event["t_ms"] - t0)
+        kind = event["kind"]
+        if kind == "submitted":
+            line = f"submitted to pool {event['pool']}"
+        elif kind == "waiting":
+            line = event.get("summary") or (
+                f"{event['cycles']} cycles skipped: {event['code']}")
+            extras = [f"rank {event['last_rank']}"
+                      if "last_rank" in event else "",
+                      f"dru {event['last_dru']:.3f}"
+                      if "last_dru" in event else ""]
+            extras = ", ".join(e for e in extras if e)
+            if extras:
+                line += f"  ({extras})"
+        elif kind == "matched":
+            line = f"matched to {event.get('host', '?')} " \
+                   f"(cycle {event['cycle']}"
+            if "rank" in event:
+                line += f", rank {event['rank']}"
+            if "dru" in event:
+                line += f", dru {event['dru']:.3f}"
+            line += ")"
+        elif kind == "launched":
+            line = (f"launched task {event['task_id']} on "
+                    f"{event['host']} (cluster {event['cluster']})")
+        elif kind == "preempted":
+            line = (f"PREEMPTED on {event.get('host', '?')} "
+                    f"({event.get('reason', '?')})")
+        elif kind == "instance-failed":
+            line = (f"instance failed on {event.get('host', '?')} "
+                    f"({event.get('reason', '?')})")
+        elif kind == "completed":
+            line = f"completed on {event.get('host', '?')}"
+        elif kind == "re-queued":
+            line = "re-queued (waiting again)"
+        else:
+            line = json.dumps(event)
+        print(f"  +{offset:>8}  {line}")
+    waiting = tl.get("waiting", {})
+    if waiting.get("total_cycles"):
+        parts = ", ".join(f"{code}: {n}" for code, n in sorted(
+            waiting["cycles_by_reason"].items()))
+        print(f"waiting attribution: {waiting['total_cycles']} cycles "
+              f"({parts})")
+    phases = tl.get("phases", {})
+    summary = []
+    if "submit_to_first_match_ms" in phases:
+        summary.append("submit->first-match "
+                       f"{_fmt_ms(phases['submit_to_first_match_ms'])}")
+    summary.append(f"total run {_fmt_ms(phases.get('run_ms_total', 0))}")
+    if "waiting_ms_current" in phases:
+        summary.append(
+            f"waiting now {_fmt_ms(phases['waiting_ms_current'])}")
+    print("phases: " + ", ".join(summary))
+    return 0
+
+
 def cmd_usage(args) -> int:
     for cluster, client in _clients(args):
         usage = client.usage(args.lookup_user)
@@ -418,6 +504,14 @@ def build_parser() -> argparse.ArgumentParser:
     q = sub.add_parser("why", help="explain why a job isn't running")
     q.add_argument("uuid")
     q.set_defaults(fn=cmd_why)
+
+    q = sub.add_parser(
+        "timeline",
+        help="render a job's full lifecycle history (per-cycle waits, "
+             "launches, preemptions, re-queues)")
+    q.add_argument("uuid")
+    q.add_argument("--json", action="store_true")
+    q.set_defaults(fn=cmd_timeline)
 
     q = sub.add_parser("config", help="show or edit the federation config")
     q.add_argument("--add-cluster", nargs=2, metavar=("NAME", "URL"))

@@ -141,6 +141,11 @@ class JobClient:
         return self._request("GET", "/usage",
                              params={"user": user or self.user}).json()
 
+    def timeline(self, uuid: str) -> dict:
+        """GET /jobs/{uuid}/timeline: the job's causally-ordered
+        lifecycle with per-cycle skip/wait attribution."""
+        return self._request("GET", f"/jobs/{uuid}/timeline").json()
+
     def unscheduled_reasons(self, uuid: str) -> list[dict]:
         resp = self._request("GET", "/unscheduled_jobs",
                              params={"job": uuid})

@@ -254,11 +254,19 @@ def build_process(
         rank_half_life=int(elastic_conf.get("rank_half_life", 64)),
         reclaim_window=int(elastic_conf.get("reclaim_window", 100)),
     )
+    incident_dir = settings.incident_dir
+    if not incident_dir and settings.data_dir:
+        incident_dir = os.path.join(settings.data_dir, "incidents")
     scheduler = Scheduler(
         store,
         clusters,
         SchedulerConfig(match=settings.match, rebalancer=settings.rebalancer,
-                        elastic=elastic_params),
+                        elastic=elastic_params,
+                        incident_capacity=settings.incident_capacity,
+                        incident_cooldown_s=settings.incident_cooldown_s,
+                        incident_dir=incident_dir,
+                        auto_profile=settings.auto_profile,
+                        profile_dir=settings.profile_dir),
         plugins=plugins,
         txn=txn,
     )
@@ -479,6 +487,13 @@ def start_leader_duties(process: CookProcess,
         TriggerLoop("heartbeats", 30.0, process.heartbeats.check).start(),
         TriggerLoop("monitor", 30.0, lambda: collect_all(store)).start(),
     ]
+    if settings.health_watch_interval_s > 0:
+        # incident watch: evaluate the MERGED health verdict on a clock
+        # so ok->degraded transitions capture evidence bundles (and the
+        # auto profile) even when no external prober polls /debug/health
+        process.loops.append(
+            TriggerLoop("health-watch", settings.health_watch_interval_s,
+                        lambda: process.api.health_verdict()).start())
     if scannable:
         process.loops.append(
             TriggerLoop("k8s-scan", 30.0,

@@ -27,6 +27,15 @@ package makes the DEVICE side and the CONTROL-PLANE write path legible:
     `GET /debug/contention` and folded into `/debug/health` with five
     more reasons (store-lock-saturation, fsync-stall, replication-lag,
     commit-ack-slo-burn, job-starvation).
+  * `incident.IncidentRecorder` — the diagnosis layer: every
+    ok->degraded health transition snapshots an evidence bundle
+    (verdict, contention, cycle records, span-ring chrome trace, armed
+    faults, optional device profile) served at `GET /debug/incidents`;
+    `incident.job_timeline` reconstructs one job's lifecycle for
+    `GET /jobs/{uuid}/timeline`.
+  * `profiling.ProfileCapturer` — single-flight, duration-bounded,
+    cooldown-rate-limited `jax.profiler` capture behind
+    `POST /debug/profile` and the incident auto-capture.
 
 Exports resolve lazily (PEP 562): `models/store.py` and
 `models/persistence.py` import `cook_tpu.obs.contention` at module
@@ -66,6 +75,11 @@ _EXPORTS = {
     "COMMIT_ACK_SLO_BURN": ("cook_tpu.obs.contention",
                             "COMMIT_ACK_SLO_BURN"),
     "JOB_STARVATION": ("cook_tpu.obs.contention", "JOB_STARVATION"),
+    "IncidentRecorder": ("cook_tpu.obs.incident", "IncidentRecorder"),
+    "job_timeline": ("cook_tpu.obs.incident", "job_timeline"),
+    "ProfileCapturer": ("cook_tpu.obs.profiling", "ProfileCapturer"),
+    "AUTO_PROFILE_REASONS": ("cook_tpu.obs.profiling",
+                             "AUTO_PROFILE_REASONS"),
 }
 
 __all__ = sorted(_EXPORTS)

@@ -32,6 +32,7 @@ class DeviceTelemetry:
                  quality_sample_every: int = 25,
                  latency_window: int = 64, latency_recent: int = 8,
                  latency_min_samples: int = 12,
+                 latency_rel_floor: Optional[float] = None,
                  oom_threshold: float = 0.9,
                  memory_stats_fn=None,
                  update_memory_gauges: bool = True):
@@ -44,6 +45,11 @@ class DeviceTelemetry:
         self._latency_args = dict(window=latency_window,
                                   recent=latency_recent,
                                   min_samples=latency_min_samples)
+        if latency_rel_floor is not None:
+            # widen the anomaly band's relative floor (tests / noisy
+            # hosts: a short `recent` window on millisecond solves can
+            # trip on scheduler jitter alone)
+            self._latency_args["rel_floor"] = latency_rel_floor
         self._latency: dict[str, RollingBaseline] = {}
         self._last_solve: dict[str, dict] = {}
         # pools currently degraded to the CPU reference solver
@@ -51,6 +57,10 @@ class DeviceTelemetry:
         # `device-degraded` health reason
         self._fallbacks: dict[str, dict] = {}
         self._lock = threading.Lock()
+        # incident hook (obs/incident.IncidentRecorder.observe): every
+        # health() verdict reports through it so ok->degraded transitions
+        # capture evidence bundles even when no REST probe is watching
+        self.health_observer = None
         self._fallback_gauge = global_registry.gauge(
             "obs.device_fallback_active",
             "1 while the pool's match solve is degraded to the CPU "
@@ -205,5 +215,13 @@ class DeviceTelemetry:
             return {pool: (b.snapshot() or {"n": len(b)})
                     for pool, b in self._latency.items()}
 
-    def health(self) -> dict:
-        return self.health_monitor.verdict()
+    def health(self, observe: bool = True) -> dict:
+        """The device-side verdict.  `observe=False` is for callers that
+        MERGE this verdict with other degradation sources before
+        reporting (rest/api.get_debug_health) — observing both the
+        partial and the merged verdict would read a contention-only
+        degradation as an ok->degraded flap on every probe."""
+        verdict = self.health_monitor.verdict()
+        if observe and self.health_observer is not None:
+            self.health_observer(verdict)
+        return verdict

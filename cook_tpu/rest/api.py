@@ -196,6 +196,19 @@ class CookApi:
         self.shedder = LoadShedder(
             self.contention,
             retry_after_s=self.config.shed_retry_after_s)
+        # incident observatory (cook_tpu/obs/incident.py): adopt the
+        # scheduler's recorder (it already collects cycles/trace/faults)
+        # or stand up a control-plane-only one (proxy/standby nodes still
+        # capture contention-shaped incidents); either way this layer
+        # contributes the /debug/contention snapshot as bundle evidence
+        from cook_tpu.obs.incident import (IncidentRecorder,
+                                           add_default_collectors)
+
+        self.incidents = getattr(scheduler, "incidents", None)
+        self.profiler = getattr(scheduler, "profiler", None)
+        if self.incidents is None:
+            self.incidents = add_default_collectors(IncidentRecorder())
+        self.incidents.add_collector("contention", self.contention.snapshot)
 
     def _starvation_view(self) -> dict:
         from cook_tpu.scheduler.monitor import starvation_stats
@@ -264,6 +277,12 @@ class CookApi:
         r.add_get("/debug/cycles", self.get_debug_cycles)
         r.add_get("/debug/cycles/{cycle_id}", self.get_debug_cycle)
         r.add_get("/debug/spans", self.get_debug_spans)
+        r.add_get("/debug/trace", self.get_debug_trace)
+        r.add_get("/debug/incidents", self.get_debug_incidents)
+        r.add_get("/debug/incidents/{incident_id}", self.get_debug_incident)
+        r.add_get("/debug/profile", self.get_debug_profile)
+        r.add_post("/debug/profile", self.post_debug_profile)
+        r.add_get("/jobs/{uuid}/timeline", self.get_job_timeline)
         r.add_get("/swagger-docs", self.get_swagger_docs)
         r.add_get("/swagger-ui", self.get_swagger_ui)
         self._openapi = _build_openapi(app)
@@ -322,6 +341,14 @@ class CookApi:
         proxy-only node) the device side reports "unobserved" while the
         contention checks still run — the control plane is observable on
         every node."""
+        return web.json_response(self.health_verdict())
+
+    def health_verdict(self) -> dict:
+        """Compute the MERGED health verdict (device telemetry +
+        contention) and report it to the incident observatory — shared by
+        the REST handler and the health-watch trigger loop
+        (components.py), so incident capture doesn't depend on an
+        external prober hitting /debug/health at the right moment."""
         telemetry = self._telemetry()
         if telemetry is None:
             verdict = {
@@ -332,7 +359,11 @@ class CookApi:
                 "checks": {},
             }
         else:
-            verdict = telemetry.health()
+            # observe=False: the incident observatory must see ONE
+            # verdict per evaluation — the merged one below — or a
+            # contention-only degradation would read as an ok->degraded
+            # flap on every probe
+            verdict = telemetry.health(observe=False)
         degradations, checks = self.contention.evaluate()
         verdict["degradations"] = verdict["degradations"] + degradations
         verdict["checks"]["contention"] = checks
@@ -347,7 +378,8 @@ class CookApi:
             "obs.health.degraded",
             "1 while /debug/health reports any degradation reason").set(
             0.0 if verdict["healthy"] else 1.0)
-        return web.json_response(verdict)
+        self.incidents.observe(verdict)
+        return verdict
 
     def _shed(self, route: str) -> Optional[web.Response]:
         """Load-shedding gate for heavy read endpoints: 429 + Retry-After
@@ -441,17 +473,20 @@ class CookApi:
     async def get_debug_cycles(self, request: web.Request) -> web.Response:
         """Flight-recorder ring: per-cycle structured decision records
         (per-phase durations, per-job reason codes, preemption victims).
-        `?limit=` bounds the reply, `?pool=` filters."""
+        `?limit=` bounds the reply, `?pool=` filters, `?since=` keeps
+        only records with cycle id > since (incremental polling)."""
         recorder = self._recorder()
         if recorder is None:
             return _err(503, "no scheduler/flight recorder attached")
         try:
             limit = int(request.query.get("limit", "50"))
+            since = int(request.query.get("since", "0"))
         except ValueError:
-            return _err(400, "limit must be an integer")
+            return _err(400, "limit/since must be integers")
         pool = request.query.get("pool")
         return web.json_response({
-            "cycles": recorder.records_json(limit=max(1, limit), pool=pool),
+            "cycles": recorder.records_json(limit=max(1, limit), pool=pool,
+                                            since=since),
             "capacity": recorder.capacity,
         })
 
@@ -486,6 +521,100 @@ class CookApi:
             spans = [s for s in spans
                      if s.get("tags", {}).get("txn_id") == txn_id][-limit:]
         return web.json_response({"spans": spans})
+
+    async def get_debug_trace(self, request: web.Request) -> web.Response:
+        """Span-ring export.  `?format=chrome` (default) renders the ring
+        as a Chrome-trace/Perfetto-loadable event file — host threads and
+        pools become tracks, every ring tag (txn_id included) rides in
+        the event args; `?format=raw` returns the ring entries verbatim.
+        `?limit=` bounds how many (newest) spans export."""
+        from cook_tpu.utils import tracing
+
+        try:
+            limit = max(1, int(request.query.get(
+                "limit", str(tracing.ring_capacity()))))
+        except ValueError:
+            return _err(400, "limit must be an integer")
+        fmt = request.query.get("format", "chrome")
+        if fmt == "chrome":
+            return web.json_response(tracing.chrome_trace(limit=limit))
+        if fmt == "raw":
+            return web.json_response(
+                {"spans": tracing.recent_spans(limit=limit)})
+        return _err(400, f"unknown format {fmt!r} (chrome | raw)")
+
+    async def get_debug_incidents(self, request: web.Request
+                                  ) -> web.Response:
+        """Incident-bundle index: one summary per captured bundle
+        (id, wall time, trigger, reasons, recovery stamp), newest last.
+        Full bundles at /debug/incidents/{id}."""
+        return web.json_response({
+            "incidents": self.incidents.bundles(),
+            "capacity": self.incidents.capacity,
+            "cooldown_s": self.incidents.cooldown_s,
+            "dir": self.incidents.dir,
+        })
+
+    async def get_debug_incident(self, request: web.Request
+                                 ) -> web.Response:
+        """One full incident bundle: the degraded verdict plus every
+        evidence collector's snapshot (contention, cycle records,
+        chrome-trace export, armed faults, profile capture outcome)."""
+        incident_id = request.match_info["incident_id"]
+        bundle = self.incidents.get(incident_id)
+        if bundle is None:
+            return _err(404, f"incident {incident_id} not retained")
+        return web.json_response(bundle, dumps=lambda d: json.dumps(
+            d, default=str))
+
+    async def get_debug_profile(self, request: web.Request) -> web.Response:
+        """Profile-capture status: the in-flight capture (if any), recent
+        captures with their log dirs, and the auto-capture cooldown."""
+        if self.profiler is None:
+            return web.json_response({"enabled": False,
+                                      "reason": "no scheduler attached"})
+        return web.json_response({"enabled": True,
+                                  **self.profiler.status()})
+
+    async def post_debug_profile(self, request: web.Request) -> web.Response:
+        """Start one duration-bounded device profile capture
+        ({"duration_s": N}, clamped to the capturer's max).  Admin-only,
+        single-flight: a capture already in flight answers 409 with its
+        identity instead of corrupting it."""
+        if self.profiler is None:
+            return _err(503, "no scheduler/profiler attached")
+        if request["user"] not in self.config.admins:
+            return _err(403, f"user {request['user']} is not an admin")
+        body = await request.json() if request.can_read_body else {}
+        try:
+            duration = float(body.get("duration_s", 0) or 0) or None
+        except (TypeError, ValueError):
+            return _err(400, "duration_s must be a number")
+        result = self.profiler.capture(duration, trigger="rest")
+        if result["started"]:
+            status = 202
+        elif result["reason"] == "capture-in-flight":
+            # the documented retry-later case; clients poll GET status
+            status = 409
+        elif result["reason"].startswith("profiler-error"):
+            status = 503
+        else:  # bad input (e.g. non-positive duration)
+            status = 400
+        return web.json_response(result, status=status)
+
+    async def get_job_timeline(self, request: web.Request) -> web.Response:
+        """One job's causally-ordered lifecycle: submit, per-cycle
+        rank/skip decisions (consecutive same-reason cycles compressed,
+        e.g. "12 cycles skipped: insufficient-resources"), launches,
+        preemptions, re-queues — with waiting-time attribution and phase
+        latencies (cook_tpu/obs/incident.job_timeline)."""
+        from cook_tpu.obs.incident import job_timeline
+
+        job = self.store.jobs.get(request.match_info["uuid"])
+        if job is None:
+            return _err(404, "unknown job")
+        return web.json_response(job_timeline(self.store, self._recorder(),
+                                              job))
 
     @web.middleware
     async def _endpoint_middleware(self, request: web.Request, handler):

@@ -85,6 +85,20 @@ class SchedulerConfig:
     compile_storm_warmup: Optional[int] = None
     # device-oom-risk fires above this allocator fill fraction
     device_oom_threshold: float = 0.9
+    # incident observatory (cook_tpu/obs/incident.py): every ok->degraded
+    # health transition snapshots an evidence bundle (verdict, cycle
+    # records, span-ring chrome trace, armed faults, contention when the
+    # REST layer is attached) into a bounded ring served at
+    # GET /debug/incidents; incident_dir persists bundles to disk
+    incident_capacity: int = 32
+    incident_cooldown_s: float = 30.0
+    incident_dir: str = ""
+    # automatic device-profile capture (obs/profiling.ProfileCapturer)
+    # riding the incident capture for latency-shaped reasons; opt-in —
+    # jax's profiler is process-global and a capture costs real overhead,
+    # so only the service wiring (components.py) turns it on by default
+    auto_profile: bool = False
+    profile_dir: str = ""
     # elastic capacity plane (cook_tpu/elastic/): pool-to-pool capacity
     # loaning with durable ledger deltas and reclaim-before-preemption.
     # Disabled by default; enable via ElasticParams(enabled=True)
@@ -176,6 +190,28 @@ class Scheduler:
                 quality_sample_every=self.config.quality_sample_every,
                 oom_threshold=self.config.device_oom_threshold,
             )
+        # incident observatory + profile capture (diagnosis layer,
+        # cook_tpu/obs/incident.py): the scheduler contributes cycle
+        # records, the span-ring chrome trace, and the armed fault
+        # schedule as bundle evidence; the REST layer (rest/api.py) adds
+        # its contention snapshot when it adopts this recorder
+        from cook_tpu.obs.incident import (IncidentRecorder,
+                                           add_default_collectors)
+        from cook_tpu.obs.profiling import ProfileCapturer
+
+        self.profiler = ProfileCapturer(
+            base_dir=self.config.profile_dir or None)
+        self.incidents = add_default_collectors(IncidentRecorder(
+            capacity=self.config.incident_capacity,
+            cooldown_s=self.config.incident_cooldown_s,
+            dir=self.config.incident_dir or None,
+            profiler=self.profiler,
+            auto_profile=self.config.auto_profile))
+        if self.recorder is not None:
+            self.incidents.add_collector(
+                "cycles", lambda: self.recorder.records_json(limit=50))
+        if self.telemetry is not None:
+            self.telemetry.health_observer = self.incidents.observe
         self._last_rank_s: dict[str, float] = {}
         # elastic capacity plane: capacity deltas commit through the txn
         # pipeline (components.py wires the journal-backed log in; a bare

@@ -183,6 +183,11 @@ class CycleBuilder:
         # uuids queued but outside the considerable window; indexed at
         # commit, never stored on the record (O(queue) per cycle)
         self.not_considered: list[str] = []
+        # rank context for the per-job history (set by the matcher's
+        # prepare step): REFERENCES to the cycle's ranked queue — stable
+        # for the cycle's lifetime (rank_cycle replaces, never mutates)
+        self.rank_jobs: Optional[list] = None
+        self.rank_dru: Optional[dict] = None
         self._t0 = time.perf_counter()
 
     @contextmanager
@@ -223,6 +228,13 @@ class CycleBuilder:
         self.record.solve_shape = shape_sig
         self.record.backend = backend
         self.record.compiled = compiled
+
+    def set_rank_context(self, jobs, dru) -> None:
+        """Attach the cycle's ranked queue (jobs list + uuid->DRU map) so
+        commit can stamp each job's history entry with its rank position
+        and DRU score — the timeline's placement attribution."""
+        self.rank_jobs = jobs
+        self.rank_dru = dru
 
     def note_match(self, job_uuid: str, hostname: str, task_id: str) -> None:
         self.record.matched.append(
@@ -290,14 +302,19 @@ class NullCycle:
     def note_preemption(self, *a) -> None:
         pass
 
+    def set_rank_context(self, *a) -> None:
+        pass
+
 
 NULL_CYCLE = NullCycle()
 
 
 class FlightRecorder:
-    """Bounded ring of CycleRecords + per-job last-decision index."""
+    """Bounded ring of CycleRecords + per-job last-decision index +
+    per-job bounded cycle history (the timeline's substrate)."""
 
-    def __init__(self, capacity: int = 512, job_reason_capacity: int = 100_000):
+    def __init__(self, capacity: int = 512, job_reason_capacity: int = 100_000,
+                 history_per_job: int = 64):
         self._ring: collections.deque[CycleRecord] = collections.deque(
             maxlen=capacity)
         self._by_id: collections.OrderedDict[int, CycleRecord] = \
@@ -309,6 +326,13 @@ class FlightRecorder:
         self._job_reasons: collections.OrderedDict[str, tuple[int, str, str]] \
             = collections.OrderedDict()
         self._job_reason_capacity = job_reason_capacity
+        # job uuid -> deque of per-cycle decision entries ({cycle, t_ms,
+        # pool, code, detail, rank?, dru?, host?}), newest last.  Bounded
+        # twice: per-job deque maxlen AND LRU over jobs (same budget as
+        # the last-decision index) — `GET /jobs/{uuid}/timeline` walks it
+        self._history_per_job = history_per_job
+        self._job_history: collections.OrderedDict[str, collections.deque] \
+            = collections.OrderedDict()
 
     @property
     def capacity(self) -> int:
@@ -322,6 +346,13 @@ class FlightRecorder:
     def commit(self, builder: CycleBuilder) -> CycleRecord:
         record = builder.finish()
         record.not_considered = len(builder.not_considered)
+        # rank position + DRU score per uuid for the history entries —
+        # O(queue), same order as the not_considered indexing below
+        positions: dict[str, int] = {}
+        dru = builder.rank_dru or {}
+        if builder.rank_jobs is not None:
+            positions = {job.uuid: i
+                         for i, job in enumerate(builder.rank_jobs)}
         with self._lock:
             self._ring.append(record)
             self._by_id[record.cycle_id] = record
@@ -329,12 +360,21 @@ class FlightRecorder:
                 self._by_id.popitem(last=False)
             for m in record.matched:
                 self._note_reason(m["job"], record.cycle_id, MATCHED,
-                                  f"matched to {m['host']}")
+                                  f"matched to {m['host']}",
+                                  record=record, host=m["host"],
+                                  rank=positions.get(m["job"]),
+                                  dru=dru.get(m["job"]))
             for s in record.skipped:
                 self._note_reason(s["job"], record.cycle_id, s["code"],
-                                  s.get("detail", ""))
+                                  s.get("detail", ""),
+                                  record=record,
+                                  rank=positions.get(s["job"]),
+                                  dru=dru.get(s["job"]))
             for uuid in builder.not_considered:
-                self._note_reason(uuid, record.cycle_id, NOT_CONSIDERED, "")
+                self._note_reason(uuid, record.cycle_id, NOT_CONSIDERED, "",
+                                  record=record,
+                                  rank=positions.get(uuid),
+                                  dru=dru.get(uuid))
         global_registry.histogram(
             "cycle.duration", "total wall seconds per match cycle").observe(
             record.total_s, {"pool": record.pool})
@@ -383,14 +423,36 @@ class FlightRecorder:
                 cycle_id = record.cycle_id
                 record.skipped.append(
                     {"job": job_uuid, "code": code, "detail": detail})
-            self._note_reason(job_uuid, cycle_id, code, detail)
+            self._note_reason(job_uuid, cycle_id, code, detail,
+                              record=record)
 
     def _note_reason(self, job_uuid: str, cycle_id: int, code: str,
-                     detail: str) -> None:
+                     detail: str, *, record: Optional[CycleRecord] = None,
+                     rank: Optional[int] = None,
+                     dru: Optional[float] = None,
+                     host: Optional[str] = None) -> None:
         self._job_reasons[job_uuid] = (cycle_id, code, detail)
         self._job_reasons.move_to_end(job_uuid)
         while len(self._job_reasons) > self._job_reason_capacity:
             self._job_reasons.popitem(last=False)
+        entry: dict = {"cycle": cycle_id,
+                       "t_ms": record.t_ms if record is not None else 0,
+                       "pool": record.pool if record is not None else "",
+                       "code": code, "detail": detail}
+        if rank is not None:
+            entry["rank"] = rank
+        if dru is not None:
+            entry["dru"] = dru
+        if host is not None:
+            entry["host"] = host
+        history = self._job_history.get(job_uuid)
+        if history is None:
+            history = collections.deque(maxlen=self._history_per_job)
+            self._job_history[job_uuid] = history
+        history.append(entry)
+        self._job_history.move_to_end(job_uuid)
+        while len(self._job_history) > self._job_reason_capacity:
+            self._job_history.popitem(last=False)
 
     def annotate_preemptions(self, pool: str,
                              preemptions: list[PreemptionRecord],
@@ -433,12 +495,17 @@ class FlightRecorder:
             return self._by_id.get(cycle_id)
 
     def records_json(self, limit: int = 50,
-                     pool: Optional[str] = None) -> list[dict]:
+                     pool: Optional[str] = None,
+                     since: int = 0) -> list[dict]:
         """Snapshot for cross-thread consumers (REST, simulator dump):
         serialized under the lock so a concurrent rebalance annotation
-        can't tear a record mid-render."""
+        can't tear a record mid-render.  `since` keeps only records with
+        cycle_id > since (cheap incremental slicing for pollers,
+        timelines, and incident bundles)."""
         with self._lock:
-            out = [r for r in self._ring if pool is None or r.pool == pool]
+            out = [r for r in self._ring
+                   if (pool is None or r.pool == pool)
+                   and r.cycle_id > since]
             return [r.to_json() for r in out[-limit:]]
 
     def get_json(self, cycle_id: int) -> Optional[dict]:
@@ -450,3 +517,11 @@ class FlightRecorder:
         """(cycle_id, code, detail) of the job's last cycle decision."""
         with self._lock:
             return self._job_reasons.get(job_uuid)
+
+    def job_history(self, job_uuid: str) -> list[dict]:
+        """Chronological per-cycle decision entries for one job (bounded
+        to the newest `history_per_job`); copied under the lock so the
+        timeline render can't race a concurrent commit's append."""
+        with self._lock:
+            history = self._job_history.get(job_uuid)
+            return [dict(e) for e in history] if history is not None else []

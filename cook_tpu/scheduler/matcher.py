@@ -676,6 +676,10 @@ def prepare_pool_problem(
     flight.set_counts(offers=len(prepared.cluster_offers),
                       queue_len=len(queue.jobs),
                       considered=len(considerable))
+    # rank context for the per-job cycle history (references, not
+    # copies): commit stamps each decision with queue position + DRU so
+    # GET /jobs/{uuid}/timeline can attribute waits to placement rank
+    flight.set_rank_context(queue.jobs, queue.dru)
     if flight is not NULL_CYCLE and len(considerable) < len(queue.jobs):
         # jobs in the ranked queue but outside this cycle's considerable
         # window (cap, quota, launch filter, dead-in-queue): indexed so

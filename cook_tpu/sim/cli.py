@@ -64,6 +64,23 @@ def cmd_run(args) -> int:
         # analysis (same schema as GET /debug/cycles)
         with open(args.cycles_out, "w") as f:
             f.write(result.cycle_records_json())
+    if args.trace_out:
+        # chrome-trace dump of the run's span ring (load in Perfetto /
+        # chrome://tracing; same schema as GET /debug/trace?format=chrome)
+        from cook_tpu.utils import tracing
+
+        with open(args.trace_out, "w") as f:
+            json.dump(tracing.chrome_trace(), f)
+    if args.incidents_out:
+        # incident bundles the run captured (same schema as
+        # GET /debug/incidents/{id}), one JSON file per bundle
+        import os
+
+        os.makedirs(args.incidents_out, exist_ok=True)
+        for bundle in result.incidents:
+            with open(os.path.join(args.incidents_out,
+                                   f"{bundle['id']}.json"), "w") as f:
+                json.dump(bundle, f, indent=1, default=str)
     completed = sum(1 for r in result.rows if r["status"] == "success")
     p50 = (sorted(result.cycle_wall_s)[len(result.cycle_wall_s) // 2] * 1000
            if result.cycle_wall_s else 0.0)
@@ -80,6 +97,8 @@ def cmd_run(args) -> int:
         # drifts from the CPU reference says so in its summary line
         "health": result.health.get("status", "unknown"),
         "health_reasons": result.health.get("reasons", []),
+        # incident bundles captured mid-run (ok->degraded transitions)
+        "incidents": len(result.incidents),
         # capacity-plane summary: committed plans + queued-wait p50, the
         # number the elastic A/B moves
         "elastic_plans": sum(1 for p in result.elastic_plans if p["moves"]),
@@ -188,6 +207,12 @@ def main(argv=None) -> int:
                    help="write the end-of-run /debug/health verdict here")
     r.add_argument("--cycles-out", default="",
                    help="dump flight-recorder cycle records (JSON) here")
+    r.add_argument("--trace-out", default="",
+                   help="dump the run's span ring as a chrome-trace JSON "
+                        "(Perfetto-loadable) here")
+    r.add_argument("--incidents-out", default="",
+                   help="write captured incident bundles (one JSON per "
+                        "bundle) into this directory")
     r.add_argument("--cycle-ms", type=int, default=30_000)
     r.add_argument("--rebalance-every", type=int, default=0)
     r.add_argument("--max-cycles", type=int, default=10_000)

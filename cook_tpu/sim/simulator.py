@@ -28,6 +28,7 @@ from cook_tpu.models.entities import (
 )
 from cook_tpu.models.store import JobStore
 from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.utils.tracing import span
 
 
 @dataclass
@@ -99,6 +100,13 @@ class SimConfig:
     # (tools/chaos.py) script launch failures, device solve errors, etc.
     # against the REAL scheduler through this knob
     fault_schedule: Optional[dict] = None
+    # cycles between in-run health evaluations (0 = end-of-run only).
+    # Each evaluation reports to the scheduler's incident observatory,
+    # so a mid-run degradation (e.g. an armed device fault) captures an
+    # incident bundle the run dumps (SimResult.incidents); the interval
+    # must stay below device_fallback_cycles or a short degraded window
+    # can recover unobserved
+    health_every: int = 4
 
 
 @dataclass
@@ -120,6 +128,9 @@ class SimResult:
     # schema) + the final loan ledger
     elastic_plans: list[dict] = field(default_factory=list)
     capacity_ledger: list[dict] = field(default_factory=list)
+    # incident bundles captured during the run (GET /debug/incidents
+    # schema, full evidence) — written by sim.cli --incidents-out
+    incidents: list[dict] = field(default_factory=list)
 
     def queued_wait_ms(self) -> list[int]:
         """Per-started-task queued wait (start - submit): the metric the
@@ -200,6 +211,12 @@ class Simulator:
         self.scheduler = Scheduler(
             self.store, [self.cluster], self.config.scheduler
         )
+        # the capture cooldown is a REAL-time flood guard; a sim run
+        # compresses hours of virtual time into seconds of wall clock,
+        # so the service default would silently drop every incident
+        # after the first — a replayed drill must capture each
+        # ok->degraded transition (--incidents-out's contract)
+        self.scheduler.incidents.cooldown_s = 0.0
         if self.scheduler.recorder is not None:
             # the service default ring (512) would silently truncate the
             # offline dump: size it to hold every cycle of every pool this
@@ -263,32 +280,39 @@ class Simulator:
                     )
                 ])
                 submitted += 1
-            # 3. rank -> match (-> rebalance) per pool
+            # 3. rank -> match (-> rebalance) per pool; spans make the
+            # run exportable as a chrome trace (sim run --trace-out)
             t_cycle = time.perf_counter()
             if cfg.batched_match and len(pools) > 1:
                 t0 = time.perf_counter()
                 for pool in pools:
-                    self.scheduler.rank_cycle(pool)
+                    with span("sim.rank", pool=pool.name):
+                        self.scheduler.rank_cycle(pool)
                 t1 = time.perf_counter()
-                self.scheduler.match_cycle_all_pools()
+                with span("sim.match_batched", pools=len(pools)):
+                    self.scheduler.match_cycle_all_pools()
                 t2 = time.perf_counter()
                 phase_wall["rank"] += t1 - t0
                 phase_wall["match"] += t2 - t1
                 if cfg.rebalance_every and cycle % cfg.rebalance_every == 0:
                     for pool in pools:
-                        self.scheduler.rebalance_cycle(pool)
+                        with span("sim.rebalance", pool=pool.name):
+                            self.scheduler.rebalance_cycle(pool)
                     phase_wall["rebalance"] += time.perf_counter() - t2
             else:
                 for pool in pools:
                     t0 = time.perf_counter()
-                    self.scheduler.rank_cycle(pool)
+                    with span("sim.rank", pool=pool.name):
+                        self.scheduler.rank_cycle(pool)
                     t1 = time.perf_counter()
-                    self.scheduler.match_cycle(pool)
+                    with span("sim.match", pool=pool.name):
+                        self.scheduler.match_cycle(pool)
                     t2 = time.perf_counter()
                     phase_wall["rank"] += t1 - t0
                     phase_wall["match"] += t2 - t1
                     if cfg.rebalance_every and cycle % cfg.rebalance_every == 0:
-                        self.scheduler.rebalance_cycle(pool)
+                        with span("sim.rebalance", pool=pool.name):
+                            self.scheduler.rebalance_cycle(pool)
                         phase_wall["rebalance"] += time.perf_counter() - t2
             # 3b. elastic capacity plan (after matching, so demand is the
             # genuinely-unmatched queue; loans land in the NEXT cycle's
@@ -296,9 +320,18 @@ class Simulator:
             if (cfg.elastic_every and cycle % cfg.elastic_every == 0
                     and self.scheduler.elastic is not None):
                 t3 = time.perf_counter()
-                self.scheduler.elastic_cycle()
+                with span("sim.elastic"):
+                    self.scheduler.elastic_cycle()
                 phase_wall["elastic"] += time.perf_counter() - t3
             cycle_wall.append(time.perf_counter() - t_cycle)
+            # 3c. in-run health watch: an ok->degraded transition mid-run
+            # captures an incident bundle through the scheduler's
+            # observatory (the same path the service's health-watch loop
+            # drives) — without this a fault-drill run would recover
+            # before the end-of-run verdict ever looked
+            if (cfg.health_every and cycle % cfg.health_every == 0
+                    and self.scheduler.telemetry is not None):
+                self.scheduler.telemetry.health()
             # 4. advance virtual time
             self.now_ms += cfg.cycle_ms
             # stop when all work is done
@@ -326,6 +359,7 @@ class Simulator:
                 self.scheduler.elastic.recorder.records_json(limit=10_000)
                 if self.scheduler.elastic is not None else []),
             capacity_ledger=self.store.encoded_capacity_ledger(),
+            incidents=self.scheduler.incidents.dump(),
         )
 
     def _collect_rows(self) -> list[dict]:

@@ -164,6 +164,22 @@ class Settings:
     journal_fsync_policy: str = "fail-stop"
     # 429 + Retry-After on heavy reads while the commit-ack SLO burns
     load_shedding: bool = True
+    # incident observatory (cook_tpu/obs/incident.py): ok->degraded
+    # health transitions snapshot evidence bundles (GET /debug/incidents).
+    # incident_dir "" = data_dir/incidents when data_dir is set, else
+    # in-memory only; the health-watch loop evaluates the merged verdict
+    # every interval so capture doesn't depend on external probes
+    incident_dir: str = ""
+    incident_capacity: int = 32
+    incident_cooldown_s: float = 30.0
+    health_watch_interval_s: float = 15.0
+    # automatic device-profile capture on device-latency-shaped
+    # degradations (solve-latency-regression, device-degraded),
+    # cooldown-rate-limited; POST /debug/profile works regardless.
+    # commit-ack-slo-burn deliberately never auto-profiles: the
+    # capture's overhead deepens a control-plane burn (obs/profiling.py)
+    auto_profile: bool = True
+    profile_dir: str = ""
 
     def match_config_for_pool(self, pool_name: str) -> MatchConfig:
         for ps in self.pool_schedulers:
@@ -220,6 +236,8 @@ def read_config(path: Optional[str] = None,
                 "data_dir", "snapshot_interval_s", "platform",
                 "batched_match", "pipelined_match", "elastic_interval_s",
                 "fault_injection", "journal_fsync_policy", "load_shedding",
+                "incident_dir", "incident_capacity", "incident_cooldown_s",
+                "health_watch_interval_s", "auto_profile", "profile_dir",
                 "queue_limit_per_pool",
                 "queue_limit_per_user", "submission_rate_per_minute"):
         if key in data:

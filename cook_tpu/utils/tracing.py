@@ -77,6 +77,7 @@ def span(name: str, **tags):
         duration = time.perf_counter() - t0
         if error:
             tags["error"] = True
+        thread_name = threading.current_thread().name
         with _lock:
             stack = _active.get(tid)
             if stack:
@@ -91,6 +92,9 @@ def span(name: str, **tags):
                 "duration_s": duration,
                 "tags": tags,
                 "t": time.time(),
+                # thread identity for the chrome-trace export's tracks
+                "tid": tid,
+                "thread": thread_name,
             })
         metric_tags = {k: v for k, v in tags.items()
                        if k not in _RING_ONLY_TAGS}
@@ -114,6 +118,8 @@ def record_event(name: str, **tags) -> None:
             "duration_s": 0.0,
             "tags": tags,
             "t": time.time(),
+            "tid": threading.get_ident(),
+            "thread": threading.current_thread().name,
         })
 
 
@@ -131,6 +137,67 @@ def active_thread_count() -> int:
     leak regression test)."""
     with _lock:
         return len(_active)
+
+
+# ------------------------------------------------------- chrome-trace export
+# Perfetto/chrome://tracing-compatible rendering of the span ring
+# (GET /debug/trace?format=chrome, sim run --trace-out, incident bundles).
+# Tracks: every span lands on its host thread's track (pid 1, one tid per
+# thread); spans tagged with a pool additionally land on that pool's
+# track (pid 2) so per-pool cycle phases read as one lane regardless of
+# which scheduler/launcher thread executed them.  txn_id and every other
+# ring tag ride in `args`, so a mutation's spans stay correlatable after
+# export.
+
+_THREAD_PID = 1
+_POOL_PID = 2
+
+
+def chrome_trace(spans: Optional[list] = None,
+                 limit: Optional[int] = None) -> dict:
+    """Render ring entries (newest `limit`, default the whole ring) as a
+    Chrome Trace Event Format object: {"traceEvents": [...]}.  Complete
+    spans become "X" (duration) events, zero-duration markers
+    (record_event) become "i" (instant) events."""
+    if spans is None:
+        spans = recent_spans(limit or ring_capacity())
+    events: list[dict] = []
+    track_tids: dict[tuple, int] = {}
+
+    def track(pid: int, name: str) -> int:
+        key = (pid, name)
+        tid = track_tids.get(key)
+        if tid is None:
+            tid = len(track_tids) + 1
+            track_tids[key] = tid
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": name}})
+        return tid
+
+    for pid, pname in ((_THREAD_PID, "host threads"), (_POOL_PID, "pools")):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": pname}})
+    for entry in spans:
+        tags = entry.get("tags") or {}
+        args = dict(tags)
+        if entry.get("parent"):
+            args["parent"] = entry["parent"]
+        duration_us = entry.get("duration_s", 0.0) * 1e6
+        start_us = entry.get("t", 0.0) * 1e6 - duration_us
+        base = {"name": entry.get("name", "?"), "cat": "span",
+                "ts": start_us, "args": args}
+        if duration_us > 0:
+            base.update({"ph": "X", "dur": duration_us})
+        else:
+            base.update({"ph": "i", "s": "t"})
+        thread = entry.get("thread") or f"thread-{entry.get('tid', 0)}"
+        events.append({**base, "pid": _THREAD_PID,
+                       "tid": track(_THREAD_PID, thread)})
+        pool = tags.get("pool")
+        if pool:
+            events.append({**base, "pid": _POOL_PID,
+                           "tid": track(_POOL_PID, f"pool:{pool}")})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 @contextmanager

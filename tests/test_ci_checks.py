@@ -22,7 +22,7 @@ def run(argv, calls=None, codes=None):
 
     steps = {name: step(name)
              for name in ("lint_metrics", "smoke_bench", "bench_gate",
-                          "chaos_smoke")}
+                          "chaos_smoke", "debug_smoke")}
     return ci_checks.main(argv, steps=steps), calls
 
 
@@ -30,7 +30,7 @@ def test_runs_all_steps_in_order_and_passes():
     code, calls = run(["--root", REPO_ROOT])
     assert code == 0
     assert calls == ["lint_metrics", "smoke_bench", "bench_gate",
-                     "chaos_smoke"]
+                     "chaos_smoke", "debug_smoke"]
 
 
 def test_skip_bench_runs_lint_only():
@@ -45,7 +45,7 @@ def test_failure_does_not_mask_later_steps():
     assert code == 1
     # later steps still ran (one verdict, every step's result reported)
     assert calls == ["lint_metrics", "smoke_bench", "bench_gate",
-                     "chaos_smoke"]
+                     "chaos_smoke", "debug_smoke"]
 
 
 def test_gate_failure_fails_the_pipeline():
@@ -60,7 +60,8 @@ def test_step_exception_counts_as_failure():
     steps = {"lint_metrics": boom,
              "smoke_bench": lambda: 0,
              "bench_gate": lambda: 0,
-             "chaos_smoke": lambda: 0}
+             "chaos_smoke": lambda: 0,
+             "debug_smoke": lambda: 0}
     assert ci_checks.main(["--root", REPO_ROOT], steps=steps) == 1
 
 

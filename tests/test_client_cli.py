@@ -124,6 +124,30 @@ def test_cli_unknown_uuid(server, cfg, capsys):
     assert cli(server, "show", "no-such-uuid") == 1
 
 
+def test_cli_timeline(server, cfg, capsys):
+    assert cli(server, "submit", "--mem", "64", "tlwork") == 0
+    uuid = capsys.readouterr().out.strip()
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+    assert cli(server, "timeline", uuid) == 0
+    out = capsys.readouterr().out
+    assert uuid in out
+    assert "submitted to pool default" in out
+    assert "matched to" in out
+    assert "launched task" in out
+    assert "phases:" in out
+    # --json emits the raw endpoint body
+    assert cli(server, "timeline", uuid, "--json") == 0
+    body = json.loads(capsys.readouterr().out)
+    assert body["uuid"] == uuid
+    assert [e["kind"] for e in body["events"]][0] == "submitted"
+
+
+def test_cli_timeline_unknown_uuid(server, cfg, capsys):
+    assert cli(server, "timeline", "no-such-uuid") == 1
+
+
 def test_cli_admin_share_and_quota(server, cfg, capsys):
     assert cli_main(["--config", server.cfg_path, "--user", "admin",
                      "admin", "set-share", "--for-user", "zed",

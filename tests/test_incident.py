@@ -1,0 +1,559 @@
+"""Incident observatory: profile capture, bundle lifecycle, the
+end-to-end fault→degrade→bundle→recovery drill, and the per-job
+timeline reconstruction (cook_tpu/obs/incident.py + obs/profiling.py)."""
+import json
+import threading
+import time
+
+import pytest
+
+from cook_tpu import faults
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import (
+    InstanceStatus,
+    Job,
+    JobState,
+    Pool,
+    Resources,
+)
+from cook_tpu.models.store import JobStore
+from cook_tpu.obs.incident import IncidentRecorder, job_timeline
+from cook_tpu.obs.profiling import ProfileCapturer
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.scheduler.matcher import MatchConfig
+from tests.conftest import FakeClock
+
+
+# ------------------------------------------------------- profile capturer
+
+
+class FakeProfiler:
+    def __init__(self, fail_start=False):
+        self.started = []
+        self.stopped = 0
+        self.fail_start = fail_start
+
+    def start(self, log_dir):
+        if self.fail_start:
+            raise RuntimeError("no device")
+        self.started.append(log_dir)
+
+    def stop(self):
+        self.stopped += 1
+
+
+def _capturer(tmp_path, fake, **kw):
+    kw.setdefault("default_duration_s", 0.05)
+    return ProfileCapturer(base_dir=str(tmp_path), start_fn=fake.start,
+                           stop_fn=fake.stop, **kw)
+
+
+def test_profile_capture_is_single_flight_and_stops_itself(tmp_path):
+    fake = FakeProfiler()
+    capturer = _capturer(tmp_path, fake)
+    first = capturer.capture(trigger="manual")
+    assert first["started"] and len(fake.started) == 1
+    second = capturer.capture()
+    assert not second["started"]
+    assert second["reason"] == "capture-in-flight"
+    assert len(fake.started) == 1  # the in-flight capture was untouched
+    deadline = time.monotonic() + 5.0
+    while fake.stopped == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fake.stopped == 1  # the timer stopped it, nobody else had to
+    assert capturer.status()["active"] is None
+    assert capturer.status()["recent"][0]["completed"]
+    # single-flight released: a new capture may start
+    assert capturer.capture()["started"]
+
+
+def test_profile_duration_clamped_and_errors_degrade(tmp_path):
+    fake = FakeProfiler()
+    capturer = _capturer(tmp_path, fake, max_duration_s=0.05)
+    result = capturer.capture(3600.0)
+    assert result["duration_s"] == 0.05
+    broken = _capturer(tmp_path, FakeProfiler(fail_start=True))
+    result = broken.capture()
+    assert not result["started"]
+    assert "profiler-error" in result["reason"]
+    assert broken.status()["active"] is None  # nothing leaked open
+
+
+def test_auto_profile_reason_filter_and_cooldown(tmp_path):
+    fake = FakeProfiler()
+    capturer = _capturer(tmp_path, fake, cooldown_s=3600.0)
+    # non-latency-shaped reasons never profile
+    result = capturer.maybe_capture_auto(["recompile-storm"])
+    assert not result["started"]
+    assert result["reason"] == "no-latency-shaped-reason"
+    assert capturer.maybe_capture_auto(["device-degraded"])["started"]
+    deadline = time.monotonic() + 5.0
+    while fake.stopped == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # second auto within the cooldown is suppressed even though the
+    # capture slot is free again
+    result = capturer.maybe_capture_auto(["device-degraded"])
+    assert not result["started"]
+    assert result["reason"] == "cooldown"
+
+
+# ------------------------------------------------------ incident recorder
+
+
+def _verdict(healthy, *reasons):
+    return {"healthy": healthy, "status": "ok" if healthy else "degraded",
+            "reasons": list(reasons), "degradations": [], "checks": {}}
+
+
+def test_capture_fires_exactly_on_the_ok_to_degraded_edge():
+    recorder = IncidentRecorder(cooldown_s=0.0)
+    recorder.add_collector("evidence", lambda: {"n": 42})
+    assert recorder.observe(_verdict(True)) is None
+    bundle = recorder.observe(_verdict(False, "fsync-stall"))
+    assert bundle is not None
+    assert bundle["reasons"] == ["fsync-stall"]
+    assert bundle["evidence"] == {"n": 42}
+    # still degraded: no second capture
+    assert recorder.observe(_verdict(False, "fsync-stall")) is None
+    assert len(recorder.bundles()) == 1
+    # recovery stamps the bundle
+    assert recorder.observe(_verdict(True)) is None
+    [summary] = recorder.bundles()
+    assert summary["recovered_time"] is not None
+    # a NEW degradation is a new incident
+    assert recorder.observe(_verdict(False, "replication-lag")) is not None
+    assert len(recorder.bundles()) == 2
+
+
+def test_cooldown_suppresses_flapping_and_collector_errors_degrade():
+    recorder = IncidentRecorder(cooldown_s=3600.0)
+
+    def boom():
+        raise RuntimeError("ring on fire")
+
+    recorder.add_collector("broken", boom)
+    assert recorder.observe(_verdict(False, "x")) is not None
+    recorder.observe(_verdict(True))
+    # flap back within the cooldown: suppressed
+    assert recorder.observe(_verdict(False, "x")) is None
+    assert len(recorder.bundles()) == 1
+    bundle = recorder.get(recorder.bundles()[0]["id"])
+    assert "RuntimeError" in bundle["broken"]["error"]
+
+
+def test_cooldown_suppressed_edge_captures_after_cooldown_clears():
+    """A sustained incident whose edge landed inside the cooldown must
+    still get a bundle once the cooldown clears — deferred, not
+    dropped."""
+    recorder = IncidentRecorder(cooldown_s=0.15)
+    assert recorder.observe(_verdict(False, "a")) is not None
+    recorder.observe(_verdict(True))
+    # new incident starts inside the cooldown: deferred
+    assert recorder.observe(_verdict(False, "b")) is None
+    assert len(recorder.bundles()) == 1
+    time.sleep(0.2)
+    # still degraded after the cooldown: the deferred capture fires
+    bundle = recorder.observe(_verdict(False, "b"))
+    assert bundle is not None and bundle["reasons"] == ["b"]
+    # and only once
+    assert recorder.observe(_verdict(False, "b")) is None
+    assert len(recorder.bundles()) == 2
+    # a deferral cancelled by recovery does not fire later
+    recorder2 = IncidentRecorder(cooldown_s=0.15)
+    recorder2.observe(_verdict(False, "a"))
+    recorder2.observe(_verdict(True))
+    recorder2.observe(_verdict(False, "b"))  # deferred
+    recorder2.observe(_verdict(True))        # recovered: cancel
+    time.sleep(0.2)
+    assert recorder2.observe(_verdict(True)) is None
+    assert len(recorder2.bundles()) == 1
+
+
+def test_bundles_persist_to_dir_with_bounded_retention(tmp_path):
+    incidents_dir = tmp_path / "incidents"
+    recorder = IncidentRecorder(capacity=2, cooldown_s=0.0,
+                                dir=str(incidents_dir))
+    for i in range(4):
+        recorder.capture(_verdict(False, f"r{i}"), trigger="manual")
+    files = sorted(p.name for p in incidents_dir.glob("inc-*.json"))
+    assert len(files) == 2  # oldest pruned past capacity
+    assert files == ["inc-000003.json", "inc-000004.json"]
+    with open(incidents_dir / files[-1]) as f:
+        assert json.load(f)["reasons"] == ["r3"]
+    assert len(recorder.bundles()) == 2
+
+
+def test_incident_ids_resume_after_restart(tmp_path):
+    """A restarted process must not recycle ids and os.replace a crashed
+    run's persisted bundle — the evidence the dir exists to preserve."""
+    incidents_dir = str(tmp_path / "incidents")
+    first = IncidentRecorder(cooldown_s=0.0, dir=incidents_dir)
+    first.capture(_verdict(False, "crash-era"), trigger="manual")
+    # "restart": a fresh recorder over the same directory
+    second = IncidentRecorder(cooldown_s=0.0, dir=incidents_dir)
+    bundle = second.capture(_verdict(False, "post-boot"), trigger="manual")
+    assert bundle["id"] == "inc-000002"
+    with open(tmp_path / "incidents" / "inc-000001.json") as f:
+        assert json.load(f)["reasons"] == ["crash-era"]  # survived
+
+
+def test_concurrent_observers_capture_once():
+    """The REST handler, the health-watch loop, and the scheduler can
+    all report the same degraded verdict concurrently — one bundle."""
+    recorder = IncidentRecorder(cooldown_s=3600.0)
+    barrier = threading.Barrier(6)
+
+    def probe():
+        barrier.wait()
+        recorder.observe(_verdict(False, "device-degraded"))
+
+    threads = [threading.Thread(target=probe) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(recorder.bundles()) == 1
+
+
+# ------------------------------------------------- end-to-end drill (REST)
+
+
+def _drill_rig():
+    from cook_tpu.obs.telemetry import DeviceTelemetry
+    from cook_tpu.rest.api import ApiConfig, CookApi
+    from cook_tpu.rest.server import ServerThread
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "drill",
+        [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=8000, cpus=16)
+         for i in range(3)],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=0, device_fallback_cycles=3,
+                          device_latency_guard=3.0),
+        incident_cooldown_s=0.0))
+    # tight latency windows so the guard arms after a handful of cycles
+    # instead of the production 12-sample warmup; the wide rel_floor
+    # (5x baseline) keeps host-scheduling jitter on millisecond solves
+    # from tripping the band before the 100x injected delay does
+    scheduler.telemetry = DeviceTelemetry(
+        latency_window=16, latency_recent=2, latency_min_samples=3,
+        latency_rel_floor=5.0, update_memory_gauges=False)
+    scheduler.telemetry.health_observer = scheduler.incidents.observe
+    # injected profiler: the drill proves the auto-capture WIRING, not
+    # jax's profiler
+    fake = FakeProfiler()
+    scheduler.profiler = ProfileCapturer(
+        base_dir="/tmp/unused", start_fn=fake.start, stop_fn=fake.stop,
+        default_duration_s=0.01, cooldown_s=0.0)
+    scheduler.incidents.profiler = scheduler.profiler
+    scheduler.incidents.auto_profile = True
+    api = CookApi(store, scheduler, ApiConfig())
+    server = ServerThread(api).start()
+    return clock, store, cluster, scheduler, api, server, fake
+
+
+def _cycle(scheduler, store, clock, n_jobs=2, prefix="d"):
+    uuid_base = f"{prefix}-{clock.now_ms}"
+    store.submit_jobs([
+        Job(uuid=f"{uuid_base}-{i}", user=f"u{i % 2}", pool="default",
+            command="true", resources=Resources(mem=100, cpus=0.5),
+            max_retries=5)
+        for i in range(n_jobs)])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    clock.advance(1000)
+
+
+def test_end_to_end_drill_latency_fault_to_bundle_to_recovery():
+    """The acceptance drill: device.solve latency armed -> health
+    degrades -> ONE bundle auto-captured (verdict + contention + cycle
+    records + chrome trace + auto profile) -> health recovers ->
+    /debug/incidents lists exactly one bundle, recovery-stamped."""
+    import urllib.request
+
+    clock, store, cluster, scheduler, api, server, fake = _drill_rig()
+
+    def get(path):
+        req = urllib.request.Request(
+            server.url + path,
+            headers={"X-Cook-Requesting-User": "admin"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    try:
+        for _ in range(6):  # warm the latency baseline
+            _cycle(scheduler, store, clock)
+        assert get("/debug/health")["status"] == "ok"
+
+        faults.arm(faults.FaultSchedule([faults.FaultRule(
+            point=faults.DEVICE_SOLVE, mode="delay", delay_s=0.25)]))
+        for _ in range(4):  # slow solves push the recent median past
+            _cycle(scheduler, store, clock)  # guard x baseline
+        health = get("/debug/health")
+        assert health["status"] == "degraded"
+        reasons = set(health["reasons"])
+        assert reasons & {"device-degraded", "solve-latency-regression"}, \
+            reasons
+
+        index = get("/debug/incidents")
+        assert len(index["incidents"]) == 1
+        bundle = get(f"/debug/incidents/{index['incidents'][0]['id']}")
+        assert bundle["trigger"] == "health-transition"
+        assert bundle["verdict"]["status"] == "degraded"
+        assert "store_lock" in bundle["contention"]  # contention snapshot
+        assert bundle["cycles"], "bundle carries no cycle records"
+        assert bundle["trace"]["traceEvents"] is not None
+        assert bundle["profile"]["started"] is True
+        assert fake.started, "auto profile never reached the profiler"
+
+        faults.disarm()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            _cycle(scheduler, store, clock)
+            if get("/debug/health")["status"] == "ok":
+                break
+        assert get("/debug/health")["status"] == "ok"
+
+        index = get("/debug/incidents")
+        assert len(index["incidents"]) == 1  # still exactly one
+        assert index["incidents"][0]["recovered_time"] is not None
+    finally:
+        faults.disarm()
+        server.stop()
+
+
+def test_contention_only_degradation_is_not_a_flap():
+    """A verdict degraded ONLY by contention must not oscillate through
+    the device-side observer: repeated /debug/health probes capture one
+    bundle, not one per probe."""
+    clock, store, cluster, scheduler, api, server, fake = _drill_rig()
+    try:
+        _cycle(scheduler, store, clock)
+        degraded = [{"reason": "fsync-stall", "detail": "test"}]
+        api.contention.evaluate = lambda: (degraded, {})
+        for _ in range(4):
+            verdict = api.health_verdict()
+            assert verdict["status"] == "degraded"
+        assert len(scheduler.incidents.bundles()) == 1
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------- job timeline
+
+
+def test_timeline_reconstructs_preempted_lifecycle():
+    """Acceptance: submit -> ranked/skipped -> matched -> running ->
+    preempted -> re-queued -> matched again, with per-cycle skip/wait
+    attribution and rank/DRU stamps."""
+    from cook_tpu.models.entities import Share
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "tl",
+        [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=1000, cpus=4)
+         for i in range(2)],
+        clock=clock, default_runtime_ms=60_000)
+    scheduler = Scheduler(store, [cluster],
+                          SchedulerConfig(match=MatchConfig(chunk=0)))
+    pool = store.pools["default"]
+    # bob's share dwarfs alice's, so bob's jobs always outrank hers
+    store.set_share(Share(user="bob", pool="default",
+                          resources=Resources(mem=1_000_000, cpus=1000)))
+    store.set_share(Share(user="alice", pool="default",
+                          resources=Resources(mem=100, cpus=1)))
+
+    def cycle():
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+        clock.advance(1000)
+
+    job_a = Job(uuid="tl-a", user="alice", pool="default", command="true",
+                resources=Resources(mem=800, cpus=1), max_retries=5)
+    store.submit_jobs([job_a])
+    cycle()
+    assert store.jobs["tl-a"].state is JobState.RUNNING
+    [task_a] = store.jobs["tl-a"].instance_ids
+    host_a = store.instances[task_a].hostname
+    store.submit_jobs([Job(uuid="tl-b1", user="bob", pool="default",
+                           command="true",
+                           resources=Resources(mem=800, cpus=1),
+                           max_retries=5)])
+    cycle()  # bob's first job takes the other host
+    assert store.jobs["tl-b1"].state is JobState.RUNNING
+
+    # the rebalancer's preemption effect (_transact_preemption):
+    # instance fails with the mea-culpa preemption reason, the backend
+    # task is killed (freeing the host), the job re-queues
+    store.update_instance_state(task_a, InstanceStatus.FAILED,
+                                "preempted-by-rebalancer")
+    cluster.safe_kill_task(task_a)
+    assert store.jobs["tl-a"].state is JobState.WAITING
+
+    # bob's second job outranks tl-a and takes the freed host; tl-a
+    # cannot return to the host it failed on (novel-host constraint) and
+    # nothing else fits: insufficient-resources for a few cycles
+    store.submit_jobs([Job(uuid="tl-b2", user="bob", pool="default",
+                           command="true",
+                           resources=Resources(mem=800, cpus=1),
+                           max_retries=5)])
+    for _ in range(3):
+        cycle()
+    assert store.jobs["tl-a"].state is JobState.WAITING
+    assert store.jobs["tl-b2"].state is JobState.RUNNING
+
+    # bob's jobs complete; tl-a matches again on a novel host
+    clock.advance(61_000)
+    cluster.advance_to(clock.now_ms)
+    cycle()
+    assert store.jobs["tl-a"].state is JobState.RUNNING
+    assert store.instances[store.jobs["tl-a"].instance_ids[-1]].hostname \
+        != host_a
+
+    timeline = job_timeline(store, scheduler.recorder,
+                            store.jobs["tl-a"])
+    kinds = [e["kind"] for e in timeline["events"]]
+    for expected in ("submitted", "matched", "launched", "preempted",
+                     "re-queued", "waiting"):
+        assert expected in kinds, (expected, kinds)
+    # causal order: submit < first match < preemption < re-queue <
+    # waiting attribution < second match
+    assert kinds.index("submitted") < kinds.index("matched")
+    assert kinds.index("preempted") < kinds.index("re-queued")
+    assert kinds.index("re-queued") < kinds.index("waiting")
+    assert kinds.count("matched") == 2
+    assert kinds.count("launched") == 2
+
+    [preempted] = [e for e in timeline["events"]
+                   if e["kind"] == "preempted"]
+    assert preempted["reason"] == "preempted-by-rebalancer"
+    assert preempted["mea_culpa"] is True
+
+    waiting_events = [e for e in timeline["events"]
+                      if e["kind"] == "waiting"]
+    attribution = timeline["waiting"]["cycles_by_reason"]
+    assert attribution.get("insufficient-resources", 0) >= 3
+    [skip_run] = [e for e in waiting_events
+                  if e["code"] == "insufficient-resources"]
+    assert skip_run["cycles"] >= 3
+    assert "cycles skipped: insufficient-resources" in skip_run["summary"]
+    assert "last_rank" in skip_run and "last_dru" in skip_run
+
+    matched = [e for e in timeline["events"] if e["kind"] == "matched"]
+    assert all("rank" in e and "host" in e for e in matched)
+    assert timeline["phases"]["submit_to_first_match_ms"] == 0
+    assert timeline["state"] == "running"
+    assert timeline["instances"] == 2
+    # the re-queue is timestamped at ITS attempt's death, not the
+    # (re-stamped) latest waiting start
+    [requeued] = [e for e in timeline["events"] if e["kind"] == "re-queued"]
+    assert requeued["t_ms"] == \
+        store.instances[task_a].end_time_ms
+
+    # once the job COMPLETES, the historical re-queue must survive in
+    # the timeline (it happened), and no phantom re-queue is added for
+    # the successful final attempt
+    clock.advance(61_000)
+    cluster.advance_to(clock.now_ms)
+    assert store.jobs["tl-a"].state is JobState.COMPLETED
+    done = job_timeline(store, scheduler.recorder, store.jobs["tl-a"])
+    done_kinds = [e["kind"] for e in done["events"]]
+    assert done_kinds.count("re-queued") == 1
+    assert "completed" in done_kinds
+
+
+def test_timeline_rest_endpoint_and_cycles_since_filter():
+    """GET /jobs/{uuid}/timeline serves the reconstruction; /debug/cycles
+    ?since= slices the ring incrementally."""
+    import urllib.request
+
+    from cook_tpu.rest.api import ApiConfig, CookApi
+    from cook_tpu.rest.server import ServerThread
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "tl2", [MockHost(node_id="h0", hostname="h0", mem=4000, cpus=8)],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster],
+                          SchedulerConfig(match=MatchConfig(chunk=0)))
+    store.submit_jobs([Job(uuid="tl2-a", user="u", pool="default",
+                           command="true",
+                           resources=Resources(mem=100, cpus=1))])
+    pool = store.pools["default"]
+    for _ in range(3):
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+        clock.advance(1000)
+    api = CookApi(store, scheduler, ApiConfig())
+    server = ServerThread(api).start()
+
+    def get(path, expect=200):
+        req = urllib.request.Request(
+            server.url + path,
+            headers={"X-Cook-Requesting-User": "admin"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == expect
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            assert e.code == expect
+            return None
+
+    try:
+        timeline = get("/jobs/tl2-a/timeline")
+        assert timeline["uuid"] == "tl2-a"
+        assert timeline["events"][0]["kind"] == "submitted"
+        assert any(e["kind"] == "matched" for e in timeline["events"])
+        get("/jobs/no-such-job/timeline", expect=404)
+
+        all_cycles = get("/debug/cycles?limit=100")["cycles"]
+        assert len(all_cycles) == 3
+        newest = get(f"/debug/cycles?since={all_cycles[-2]['cycle']}")
+        assert [c["cycle"] for c in newest["cycles"]] == \
+            [all_cycles[-1]["cycle"]]
+        assert get("/debug/cycles?since=999999")["cycles"] == []
+    finally:
+        server.stop()
+
+
+# -------------------------------------------------- recorder job history
+
+
+def test_job_history_is_bounded_and_ordered():
+    from cook_tpu.scheduler.flight_recorder import FlightRecorder
+
+    recorder = FlightRecorder(history_per_job=4)
+    for i in range(10):
+        builder = recorder.begin("default", t_ms=i * 1000)
+        builder.note_skip("job-x", "insufficient-resources")
+        recorder.commit(builder)
+    history = recorder.job_history("job-x")
+    assert len(history) == 4  # bounded per job
+    cycles = [e["cycle"] for e in history]
+    assert cycles == sorted(cycles)  # chronological
+    assert cycles[-1] == 10
+    assert all(e["t_ms"] == (e["cycle"] - 1) * 1000 for e in history)
+    assert recorder.job_history("never-seen") == []
+
+
+def test_job_history_lru_bounds_job_count():
+    from cook_tpu.scheduler.flight_recorder import FlightRecorder
+
+    recorder = FlightRecorder(job_reason_capacity=5)
+    builder = recorder.begin("default", t_ms=0)
+    for i in range(20):
+        builder.note_skip(f"job-{i}", "no-offers")
+    recorder.commit(builder)
+    tracked = sum(1 for i in range(20)
+                  if recorder.job_history(f"job-{i}"))
+    assert tracked == 5  # LRU over jobs, oldest evicted
+    assert recorder.job_history("job-19")  # newest survives

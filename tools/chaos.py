@@ -341,6 +341,27 @@ def scenario_device_fallback() -> list:
                f"expected device-degraded in {sorted(reasons)}")
         steps.append("health: device-degraded (with pool evidence)")
 
+        # diagnosis: the ok->degraded transition must have captured an
+        # incident bundle with the evidence an operator needs
+        bundles = sched_b.incidents.bundles()
+        _check(len(bundles) == 1,
+               f"expected exactly 1 incident bundle, got {len(bundles)}")
+        bundle = sched_b.incidents.get(bundles[0]["id"])
+        _check("device-degraded" in bundle["reasons"],
+               f"bundle reasons missing device-degraded: "
+               f"{bundle['reasons']}")
+        _check(bundle.get("cycles"),
+               "incident bundle carries no cycle records")
+        _check("traceEvents" in (bundle.get("trace") or {}),
+               "incident bundle carries no chrome-trace export")
+        armed = bundle.get("faults") or {}
+        _check(any(r.get("point") == "device.solve"
+                   for r in armed.get("rules", [])),
+               f"bundle fault schedule missing device.solve: {armed}")
+        steps.append(f"diagnosis: incident bundle {bundle['id']} captured "
+                     f"(verdict + cycle records + chrome trace + armed "
+                     f"faults)")
+
         # keep the pool solvable through the fallback window + probe
         extra = 0
         for cycle in range(3):

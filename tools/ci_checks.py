@@ -23,7 +23,11 @@ wrapper runs them as one pipeline with one verdict:
      shed, launch failures -> breaker, device error -> CPU fallback):
      each scenario injects its fault, observes the /debug/health reason
      AND the automatic reaction, then asserts full recovery invariants
-     (docs/resilience.md).
+     (docs/resilience.md);
+  5. `tools/debug_smoke.py`    — boots a full-stack node and GETs every
+     /debug/* endpoint (plus /jobs/{uuid}/timeline), asserting 200 +
+     parseable JSON — catches schema-breaking regressions no
+     per-handler unit test sees.
 
     python tools/ci_checks.py [--root DIR] [--threshold 0.2]
                               [--skip-bench]
@@ -84,6 +88,19 @@ def run_chaos_smoke(root: str) -> int:
     return proc.returncode
 
 
+def run_debug_smoke(root: str) -> int:
+    """Debug-surface smoke in a SUBPROCESS (boots a full scheduler, which
+    initializes jax): GET every /debug/* endpoint of a live node and
+    assert 200 + parseable JSON — the schema-regression tripwire no
+    per-handler unit test provides."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "debug_smoke.py")],
+        cwd=root,
+        timeout=float(os.environ.get("CI_DEBUG_SMOKE_TIMEOUT_S", "180")),
+    )
+    return proc.returncode
+
+
 def main(argv: list[str] | None = None, *,
          steps: dict | None = None) -> int:
     parser = argparse.ArgumentParser(
@@ -102,10 +119,11 @@ def main(argv: list[str] | None = None, *,
         "smoke_bench": lambda: run_smoke_bench(args.root),
         "bench_gate": lambda: run_bench_gate(args.root, args.threshold),
         "chaos_smoke": lambda: run_chaos_smoke(args.root),
+        "debug_smoke": lambda: run_debug_smoke(args.root),
     }
     selected = (["lint_metrics"] if args.skip_bench
                 else ["lint_metrics", "smoke_bench", "bench_gate",
-                      "chaos_smoke"])
+                      "chaos_smoke", "debug_smoke"])
 
     failures = []
     for name in selected:

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Debug-surface smoke: GET every /debug/* endpoint, assert 200 + JSON.
+
+Unit tests pin individual handler behaviors; what nothing pinned before
+this tool is the whole surface at once — a schema-breaking refactor (a
+renamed field, a handler raising on an empty ring, a route dropped from
+build_app) ships silently until an operator mid-incident discovers the
+endpoint 500s.  This harness boots a REAL full-stack node — JobStore +
+MockCluster + Scheduler (one match cycle run, so rings hold data) +
+CookApi on a ServerThread — then walks the route table from the
+generated OpenAPI doc, GETs every `/debug` path (plus the per-job
+timeline), and asserts every answer is the expected status with a
+parseable JSON body.
+
+    python tools/debug_smoke.py
+
+Wired into `tools/ci_checks.py` as the `debug_smoke` step (subprocess:
+the scheduler initializes jax, which does not belong in the ci_checks
+driver process).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+ADMIN = {"X-Cook-Requesting-User": "admin"}
+
+
+def build_rig():
+    """A full-stack node with data in every debug ring."""
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.entities import Job, Pool, Resources
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.rest.api import ApiConfig, CookApi
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from cook_tpu.scheduler.matcher import MatchConfig
+
+    store = JobStore()
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "smoke",
+        [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=8)
+         for i in range(2)],
+        clock=store.clock)
+    scheduler = Scheduler(store, [cluster],
+                          SchedulerConfig(match=MatchConfig(chunk=0)))
+    store.submit_jobs([
+        Job(uuid=f"smoke-{i}", user="smoke", pool="default", command="true",
+            resources=Resources(mem=200, cpus=1)) for i in range(3)])
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    # fault_injection on so GET /debug/faults serves its (disarmed) state
+    # instead of the production 403
+    api = CookApi(store, scheduler, ApiConfig(fault_injection=True))
+    # mint one incident so /debug/incidents/{id} has a real id to serve
+    incident = api.incidents.capture(
+        {"healthy": False, "reasons": ["debug-smoke"]}, trigger="smoke")
+    return api, incident["id"]
+
+
+def smoke_paths(api, incident_id: str) -> list[str]:
+    """Every GET /debug route from the generated OpenAPI doc, templates
+    substituted with ids that exist in this rig, plus the per-job
+    timeline (the debug surface that lives under /jobs)."""
+    substitutions = {"{cycle_id}": "1", "{incident_id}": incident_id}
+    paths = []
+    for path, methods in sorted(api._openapi["paths"].items()):
+        if "get" not in methods or not path.startswith("/debug"):
+            continue
+        for template, value in substitutions.items():
+            path = path.replace(template, value)
+        if "{" in path:
+            raise AssertionError(
+                f"debug route {path} has a path parameter this smoke "
+                f"doesn't know how to substitute — teach smoke_paths()")
+        paths.append(path)
+    return paths + ["/jobs/smoke-0/timeline"]
+
+
+def main(argv=None) -> int:
+    from cook_tpu.rest.server import ServerThread
+
+    api, incident_id = build_rig()
+    server = ServerThread(api).start()
+    failures = []
+    try:
+        for path in smoke_paths(api, incident_id):
+            url = server.url + path
+            try:
+                req = urllib.request.Request(url, headers=ADMIN)
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    status, body = r.status, r.read()
+            except urllib.error.HTTPError as e:
+                status, body = e.code, e.read()
+            except OSError as e:
+                failures.append(f"{path}: {e}")
+                print(f"debug_smoke: {path}: FAIL ({e})")
+                continue
+            problem = ""
+            if status != 200:
+                problem = f"status {status}"
+            else:
+                try:
+                    json.loads(body)
+                except ValueError as e:
+                    problem = f"unparseable JSON: {e}"
+            if problem:
+                failures.append(f"{path}: {problem}")
+                print(f"debug_smoke: {path}: FAIL ({problem})")
+            else:
+                print(f"debug_smoke: {path}: 200 OK "
+                      f"({len(body)} bytes)")
+    finally:
+        server.stop()
+    if failures:
+        print(f"debug_smoke: FAILED: {len(failures)} endpoint(s)")
+        return 1
+    print("debug_smoke: all debug endpoints healthy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
