@@ -88,7 +88,10 @@ def load_tuned():
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tuned_match.json")
     tuned = {"backend": "xla", "chunk": 1024, "rounds": 3, "passes": 2,
-             "kc": 128}
+             "kc": 128,
+             # hierarchical (match_xl) knobs a sweep may promote; the
+             # QualityMonitor + parity tests guard any promoted value
+             "hier_nodes_per_block": 512, "hier_coarse_backend": "xla"}
     try:
         with open(path) as f:
             loaded = json.load(f)
@@ -227,6 +230,108 @@ def make_rebalance_state(jnp, t, h, t_real=None, h_real=None, seed=4):
         task_eligible=jnp.asarray(task_eligible),
         spare=jnp.asarray(spare), host_ok=jnp.asarray(host_ok),
     )
+
+
+def bench_match_xl(jax, jnp, platform, *, smoke=False, repeats=3) -> dict:
+    """`match_xl` tier: the hierarchical two-level matcher
+    (ops/hierarchical.py) at the SNIPPETS.md north-star scale — one pool
+    of 100k jobs x 10k nodes (padded 131072 x 16384), decomposed into
+    topology blocks whose fine problems solve as one batched kernel
+    sharded over the mesh.  The smoke variant (2k x 256) runs in seconds
+    and is diffed by bench_gate in ci_checks, so the trajectory toward
+    the <200 ms/cycle target is measured every round.  Per-phase p50s
+    (coarse/fine/refine) ride along as their own gate-visible phases."""
+    from cook_tpu.ops import cpu_reference as ref
+    from cook_tpu.ops.hierarchical import HierParams, hierarchical_match
+    from cook_tpu.ops.match import MatchProblem
+
+    if smoke:
+        J, N = 2048, 256
+        j_real, n_real = 2000, 256
+        params = HierParams(nodes_per_block=64, chunk=256, kc=32)
+    else:
+        J, N = 131072, 16384  # padded buckets over 100k x 10k
+        j_real, n_real = 100_000, 10_000
+        tuned = load_tuned()
+        # default nodes_per_block=512 -> 32 blocks: measured the best
+        # wall/quality point on the CPU fallback and plenty of mesh
+        # lanes on real hardware; the fine solve reuses the tuned
+        # chunked-matcher knobs, and a sweep can promote the block
+        # width / coarse backend via tuned_match.json
+        params = HierParams(nodes_per_block=tuned["hier_nodes_per_block"],
+                            chunk=min(tuned["chunk"], 8192),
+                            rounds=tuned["rounds"], passes=tuned["passes"],
+                            kc=tuned["kc"],
+                            coarse_backend=tuned["hier_coarse_backend"])
+    demands, avail, totals = make_problem(J, N, seed=2)
+    job_valid = np.zeros(J, dtype=bool)
+    job_valid[:j_real] = True
+    node_valid = np.zeros(N, dtype=bool)
+    node_valid[:n_real] = True
+    problem = MatchProblem(
+        demands=jnp.asarray(demands), job_valid=jnp.asarray(job_valid),
+        avail=jnp.asarray(avail), totals=jnp.asarray(totals),
+        node_valid=jnp.asarray(node_valid), feasible=None,
+    )
+    mesh = None
+    if len(jax.devices()) > 1:
+        from cook_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+
+    runs = []
+
+    def solve():
+        result, stats = hierarchical_match(problem, params=params,
+                                           mesh=mesh)
+        runs.append(stats)
+        return np.asarray(result.assignment)
+
+    t0 = time.perf_counter()
+    assignment = solve()
+    log(f"match_xl compile+first run: "
+        f"{(time.perf_counter() - t0) * 1000:.0f} ms "
+        f"(blocks {runs[-1]['blocks']}, fine {runs[-1]['fine_shape']})")
+    p50, times = time_fn(solve, repeats=repeats)
+    timed = runs[-repeats:]
+
+    def phase_p50(key):
+        return float(np.percentile([s[key] * 1000 for s in timed], 50))
+
+    # packing-efficiency parity vs the strongest honest CPU baseline —
+    # cheap at smoke size; at full size only when the C++ greedy is
+    # available (the pure-python reference would take longer than the
+    # whole tier)
+    from cook_tpu.ops import native
+
+    eff = None
+    if smoke or native.available():
+        cpu_assign, kind = cpu_greedy(demands[:j_real], avail[:n_real],
+                                      totals[:n_real])
+        q_cpu = ref.packing_quality(demands[:j_real], cpu_assign)
+        q_dev = ref.packing_quality(demands[:j_real], assignment[:j_real])
+        eff = (q_dev["cpus_placed"] / q_cpu["cpus_placed"]
+               if q_cpu["cpus_placed"] else 1.0)
+        log(f"match_xl {j_real} x {n_real} [{platform}]: p50 {p50:.1f} ms "
+            f"(all {[f'{t:.0f}' for t in times]}); "
+            f"cpu[{kind}] placed {q_cpu['num_placed']} vs device "
+            f"{q_dev['num_placed']}; packing efficiency {eff:.4f}")
+    else:
+        log(f"match_xl {j_real} x {n_real} [{platform}]: p50 {p50:.1f} ms "
+            f"(all {[f'{t:.0f}' for t in times]}); no C++ baseline — "
+            f"packing efficiency skipped")
+    stats = timed[-1]
+    out = {
+        "match_xl": {"p50_ms": p50, "jobs": j_real, "nodes": n_real,
+                     "blocks": stats["blocks"],
+                     "nodes_per_block": stats["nodes_per_block"],
+                     "spilled": stats["spilled"],
+                     **({"packing_eff": eff} if eff is not None else {})},
+        "match_xl_coarse": {"p50_ms": phase_p50("coarse_s")},
+        "match_xl_fine": {"p50_ms": phase_p50("fine_s")},
+        "match_xl_refine": {"p50_ms": phase_p50("refine_s")},
+    }
+    return out
 
 
 def bench_dru(jax, jnp):
@@ -625,17 +730,38 @@ def _result_line(match_p50, cpu_ms, eff, j_real, n_real, platform,
 # ------------------------------------------------- structured bench records
 
 
+def resolved_backend() -> str:
+    """The JAX backend this process's solves actually ran on — stamped
+    into every record AND every phase so bench_gate can refuse to diff a
+    silent CPU-fallback round against a real-accelerator round (the
+    first five BENCH rounds were exactly that, undetected)."""
+    import jax
+
+    return jax.default_backend()
+
+
 def make_record(mode: str, platform: str, phases: dict,
-                headline=None) -> dict:
+                headline=None, backend: str = None) -> dict:
     """One structured bench record (schema cook-bench/v1): per-phase p50s
     keyed by solve name, plus the headline line the driver scrapes.
-    `tools/bench_gate.py` diffs consecutive records phase by phase."""
+    `tools/bench_gate.py` diffs consecutive records phase by phase —
+    refusing pairs whose resolved JAX backend differs.  `backend` is
+    stamped on the record and (unless a phase already carries its own)
+    on every phase; default: the live `resolved_backend()`."""
+    if backend is None:
+        backend = resolved_backend()
+    phases = {
+        name: ({**info, "backend": info.get("backend", backend)}
+               if isinstance(info, dict) else info)
+        for name, info in phases.items()
+    }
     return {
         "schema": BENCH_SCHEMA,
         "mode": mode,                 # "full" | "smoke"
         "platform": platform,         # "tpu" | "cpu" | ...
+        "backend": backend,           # resolved JAX backend of the run
         "wall_time": time.time(),
-        "phases": phases,             # name -> {"p50_ms": ..., ...}
+        "phases": phases,             # name -> {"p50_ms": ..., "backend": ...}
         "headline": headline,
     }
 
@@ -696,6 +822,7 @@ def device_main():
     platform = jax.devices()[0].platform
     log(f"device: {jax.devices()[0]} ({platform})")
     match_p50, cpu_ms, eff, (j_real, n_real) = bench_match(jax, jnp, platform)
+    xl_phases = bench_match_xl(jax, jnp, platform)
     dru_p50 = bench_dru(jax, jnp)
     reb_p50 = bench_rebalance(jax, jnp)
     multi_p50 = bench_multipool(jax, jnp, load_tuned())
@@ -711,6 +838,7 @@ def device_main():
     write_bench_record(make_record("full", platform, {
         "match": {"p50_ms": match_p50, "jobs": j_real, "nodes": n_real,
                   "packing_eff": eff, "baseline_ms": cpu_ms},
+        **xl_phases,
         "dru": {"p50_ms": dru_p50},
         "rebalance": {"p50_ms": reb_p50},
         "multipool": {"p50_ms": multi_p50},
@@ -737,9 +865,14 @@ def cpu_main():
     note = " [CPU FALLBACK — accelerator unreachable; see docs/status.md]"
     headline = _result_line(match_p50, cpu_ms, eff, j_real, n_real,
                             "cpu", note=note)
+    # match_xl runs at FULL 100k x 10k even on the CPU fallback: the
+    # hierarchical decomposition is precisely what makes the XL pool
+    # tractable without an accelerator (the flat solve is not)
+    xl_phases = bench_match_xl(jax, jnp, "cpu")
     write_bench_record(make_record("full", "cpu", {
         "match": {"p50_ms": match_p50, "jobs": j_real, "nodes": n_real,
                   "packing_eff": eff, "baseline_ms": cpu_ms},
+        **xl_phases,
         # the control plane never needed the accelerator; its phase is
         # measured at full scale even on the CPU fallback
         "control_plane": bench_control_plane(),
@@ -823,6 +956,12 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     # elastic capacity plan: 8 pools x 256 queued jobs (shared construction)
     elastic_p50 = bench_elastic(jax, jnp, p=8, j=256, repeats=repeats)
     phases["elastic_plan"] = {"p50_ms": elastic_p50, "pools": 8, "jobs": 256}
+
+    # hierarchical two-level matcher, tiny tier (2k jobs x 256 nodes):
+    # same coarse/scatter/fine/refine pipeline as the 100k x 10k full
+    # tier, so the gate tracks the XL trajectory every CI run
+    phases.update(bench_match_xl(jax, jnp, jax.devices()[0].platform,
+                                 smoke=True, repeats=repeats))
 
     # control plane: the smoke loadtest against an in-process server —
     # commit-ack latency under sustained submit/query/kill traffic

@@ -1,6 +1,10 @@
 """JAX kernels for the scheduling hot loops (DRU rank, match, rebalance)
 plus reference-faithful CPU baselines for parity and benchmarking."""
 from cook_tpu.ops.dru import DruResult, DruTasks, dru_rank  # noqa: F401
+from cook_tpu.ops.hierarchical import (  # noqa: F401
+    HierParams,
+    hierarchical_match,
+)
 from cook_tpu.ops.match import (  # noqa: F401
     MatchProblem,
     MatchResult,

@@ -1,0 +1,529 @@
+"""Hierarchical two-level matcher: one giant pool via block decomposition.
+
+The flat matchers (`ops/match.py`) hold the whole [J, N] problem on one
+chip, and `parallel/mesh.py` only shards *across* pools — so a single
+100k-job x 10k-node pool cannot use more than one device.  This module
+decomposes one giant pool into B topology blocks and solves it in three
+passes:
+
+  1. **coarse** — nodes are grouped into B contiguous capacity blocks
+     (offer order reflects cluster/rack adjacency, so contiguous slices
+     are the topology grouping; block size comes from tuned buckets).
+     Jobs are assigned to blocks by the SAME chunked greedy kernel run on
+     the aggregated problem: block availability is the summed capacity,
+     feasibility is gated by the block's per-resource max single node
+     (a job no node in the block can hold never routes there).  J x B is
+     tiny next to J x N.
+
+  2. **fine** — jobs scatter to their assigned blocks and every block's
+     [jobs_per_block, nodes_per_block] problem solves as ONE batched
+     `MatchProblem` with blocks as the leading batch axis — exactly the
+     axis `parallel/mesh.py` already shards for pools.  The block axis
+     pads to a mesh multiple with `invalid_match_problem` lanes, so ANY
+     block count engages the mesh with a single XLA program per
+     (block-bucket, job-slot, node-slot) shape.
+
+  3. **refine** — jobs the coarse pass overflowed (no block, slot-cap
+     spill, or fine-solve miss) are re-offered to under-filled blocks: a
+     bounded number of extra coarse+fine rounds against the UPDATED block
+     availabilities, reusing the exact same padded shapes (no new XLA
+     programs).
+
+The coarse pass has an optional fused Pallas backend
+(`ops/pallas_match.best_block`: aggregate-fit + max-node gate + fitness +
+argmax in one VMEM-resident sweep); it skips the host-built [J, B]
+constraint mask, so it is guarded by the QualityMonitor shadow solves
+like every other approximate backend (tuned_match.json promotes it only
+with measured packing parity).
+
+Packing parity vs the flat `cpu_reference.np_greedy_match` is pinned by
+tests/test_hierarchical.py within a fixed tolerance; the scheduler's
+quality monitor guards the live trend.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cook_tpu.ops.common import BIG, bucket_size, fetch_result
+from cook_tpu.ops.match import (
+    MatchProblem,
+    MatchResult,
+    backend_flags,
+    chunked_match,
+    conflict_round,
+    vmap_safe_backend,
+)
+from cook_tpu.utils.metrics import global_registry
+
+# tuned buckets for nodes-per-block: power-of-two block widths so the
+# (block-bucket, job-slot, node-slot) shape lattice stays bounded like
+# every other padded solve (ops/common.bucket_size rationale)
+NODE_BLOCK_BUCKETS = (64, 128, 256, 512, 1024)
+# aim for at least this many blocks so the mesh has lanes to shard
+MIN_BLOCKS = 8
+
+
+@dataclass
+class HierParams:
+    """Knobs of the two-level solve (MatchConfig.hierarchical_* mirrors
+    the subset the scheduler exposes)."""
+
+    nodes_per_block: int = 0      # 0 = auto from NODE_BLOCK_BUCKETS
+    jobs_per_block: int = 0       # 0 = auto (block_slack x J/B, bucketed)
+    block_slack: float = 2.0      # per-block job-slot headroom factor
+    refine_rounds: int = 2        # bounded re-offer rounds (0 disables)
+    # fine-solve chunked-matcher knobs (MatchConfig equivalents)
+    chunk: int = 1024
+    rounds: int = 3
+    passes: int = 2
+    kc: int = 128
+    backend: str = "xla"          # fine candidate backend (vmap-safe)
+    # coarse block-scoring backend: "xla" (masked chunked_match) or
+    # "pallas" (fused best_block kernel; quality-guarded)
+    coarse_backend: str = "xla"
+    coarse_chunk: int = 4096
+    # the coarse pass runs SINGLE-candidate conflict rounds (each job
+    # picks its one best block; the prefix-accept then admits as many
+    # contenders as the block's aggregate capacity holds — multi-
+    # candidate spreading would cap admissions at kc per block per
+    # round, starving a J >> B problem); passes re-pick fresh blocks for
+    # jobs whose first choice filled — the binpack fitness jams one block
+    # per pass, so passes should be O(blocks it takes to hold the queue)
+    coarse_rounds: int = 2
+    coarse_passes: int = 8
+
+    def __post_init__(self):
+        if self.coarse_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown hierarchical coarse backend "
+                f"{self.coarse_backend!r} (expected xla | pallas)")
+        backend_flags(self.backend)  # canonical validation + error
+
+
+def choose_nodes_per_block(n_nodes: int, override: int = 0) -> int:
+    """Pick the block width from the tuned buckets: the largest bucket
+    that still yields >= MIN_BLOCKS blocks (so the mesh has lanes), else
+    the largest yielding >= 2, else the smallest bucket."""
+    if override:
+        return override
+    for npb in reversed(NODE_BLOCK_BUCKETS):
+        if n_nodes // npb >= MIN_BLOCKS:
+            return npb
+    for npb in reversed(NODE_BLOCK_BUCKETS):
+        if n_nodes // npb >= 2:
+            return npb
+    return NODE_BLOCK_BUCKETS[0]
+
+
+@functools.partial(jax.jit, static_argnames=("npb",))
+def block_aggregates(avail, totals, node_valid, npb: int):
+    """Per-block coarse tensors from node-axis slices: summed capacity
+    (the coarse availability), per-resource max single node (the coarse
+    feasibility gate), summed totals (fitness denominators), any-valid."""
+    n, r = avail.shape
+    b = n // npb
+    av = avail.reshape(b, npb, r)
+    nv = node_valid.reshape(b, npb)
+    tot = totals.reshape(b, npb, 2)
+    masked = jnp.where(nv[..., None], av, 0.0)
+    block_sum = masked.sum(axis=1)
+    block_max = jnp.where(nv[..., None], av, -1.0).max(axis=1)
+    block_tot = jnp.where(nv[..., None], tot, 0.0).sum(axis=1)
+    block_valid = nv.any(axis=1)
+    return block_sum, block_max, block_tot, block_valid
+
+
+def _coarse_xla(demands, active, block_sum, block_max, block_tot,
+                block_valid, block_any, params: HierParams):
+    """Coarse jobs x blocks assignment on the aggregated problem via the
+    shared chunked kernel; `block_any` optionally gates each (job, block)
+    on the original constraint mask having any feasible node there."""
+    feas = jnp.all(block_max[None, :, :] >= demands[:, None, :], axis=-1)
+    if block_any is not None:
+        feas = feas & block_any
+    problem = MatchProblem(
+        demands=demands, job_valid=active, avail=block_sum,
+        totals=block_tot, node_valid=block_valid, feasible=feas)
+    chunk = _chunk_for(params.coarse_chunk, demands.shape[0])
+    # kc=1: single-candidate conflict rounds (see HierParams.coarse_rounds
+    # comment); exact top-1 — approx_max_k has nothing to save over B
+    # blocks and its recall target would misroute jobs
+    result = chunked_match(problem, chunk=chunk, rounds=params.coarse_rounds,
+                           passes=params.coarse_passes,
+                           kc=1, use_approx=False, **backend_flags("xla"))
+    return result.assignment
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("chunk", "rounds", "passes", "interpret"))
+def _coarse_pallas(demands, active, block_sum, block_max, block_tot,
+                   block_valid, *, chunk: int, rounds: int, passes: int,
+                   interpret: bool):
+    """Coarse pass on the fused Pallas block-scoring kernel: per chunk,
+    `best_block` returns each job's best block (aggregate fit + max-node
+    gate + fitness + argmax in one sweep), then the shared conflict
+    rounds accept against the aggregate availability.  No [J, B] mask is
+    ever materialized — that is the fusion the XLA path can't express."""
+    from cook_tpu.ops.pallas_match import best_block
+
+    j, r = demands.shape
+    b = block_sum.shape[0]
+    demands_c = demands.reshape(j // chunk, chunk, r)
+    ok_c = active.reshape(j // chunk, chunk)
+
+    def chunk_step(avail, inputs):
+        d, ok = inputs
+
+        def candidate_pass(avail, assignment):
+            unplaced = assignment < 0
+            d_eff = jnp.where((ok & unplaced)[:, None], d, 2 * BIG)
+            val, idx = best_block(d_eff, avail, block_max, block_tot,
+                                  block_valid, interpret=interpret)
+            return val[:, None], jnp.maximum(idx, 0)[:, None]
+
+        def round_step(carry, _):
+            avail, assignment, cv, ci = carry
+            avail, assignment = conflict_round(avail, assignment, cv, ci,
+                                               d, b)
+            return (avail, assignment, cv, ci), None
+
+        assignment = (d[:, 0] * 0).astype(jnp.int32) - 1
+        for _ in range(passes):
+            cv, ci = candidate_pass(avail, assignment)
+            (avail, assignment, _, _), _ = jax.lax.scan(
+                round_step, (avail, assignment, cv, ci), None, length=rounds)
+        return avail, assignment
+
+    _, assignment = jax.lax.scan(chunk_step, block_sum, (demands_c, ok_c))
+    return assignment.reshape(j)
+
+
+def scatter_to_blocks(coarse: np.ndarray, job_valid: np.ndarray,
+                      b: int, slots: int):
+    """Host-side scatter: per-block job-slot index matrix [b, slots]
+    (-1 padding), filling each block in schedule order so the ranked
+    queue's fairness order survives the decomposition.  Jobs beyond a
+    block's slot cap spill (True in the returned mask) to the refinement
+    round instead of silently dropping."""
+    j = coarse.shape[0]
+    active = (coarse >= 0) & (coarse < b) & job_valid
+    blocks = np.where(active, coarse, b)  # inactive jobs sort last
+    order = np.argsort(blocks, kind="stable")
+    sb = blocks[order]
+    first = np.searchsorted(sb, np.arange(b + 1))
+    job_idx = np.full((b, slots), -1, dtype=np.int32)
+    spilled = np.zeros(j, dtype=bool)
+    for bi in range(b):
+        seg = order[first[bi]:first[bi + 1]]
+        take = seg[:slots]
+        job_idx[bi, :len(take)] = take
+        if len(seg) > slots:
+            spilled[seg[slots:]] = True
+    return job_idx, spilled
+
+
+@functools.partial(jax.jit, static_argnames=("npb",))
+def gather_fine(demands, job_valid, feasible, avail, totals, node_valid,
+                job_idx, npb: int) -> MatchProblem:
+    """Build the batched per-block fine problems: demands gathered by the
+    scatter's slot matrix, node tensors sliced by contiguous blocks.  The
+    constraint mask is gathered per (block, slot) against the block's OWN
+    node columns — no [B, S, N] blowup."""
+    b, s = job_idx.shape
+    r = demands.shape[-1]
+    safe = jnp.maximum(job_idx, 0)
+    demands_f = demands[safe]                                  # [B, S, R]
+    valid_f = (job_idx >= 0) & job_valid[safe]
+    avail_f = avail.reshape(b, npb, r)
+    totals_f = totals.reshape(b, npb, 2)
+    nv_f = node_valid.reshape(b, npb)
+    if feasible is not None:
+        j = demands.shape[0]
+        f3 = feasible.reshape(j, b, npb)
+        feas_f = f3[safe, jnp.arange(b)[:, None], :]           # [B, S, npb]
+    else:
+        feas_f = None
+    return MatchProblem(demands=demands_f, job_valid=valid_f, avail=avail_f,
+                        totals=totals_f, node_valid=nv_f, feasible=feas_f)
+
+
+def _pad_block_axis(problems: MatchProblem, count: int,
+                    n_res: int) -> MatchProblem:
+    """Extend the fine batch with `count` all-invalid lanes
+    (`parallel.mesh.invalid_match_problem`) so the block axis reaches the
+    mesh/bucket multiple — the same dead-lane padding the pool-batched
+    path uses, so any block count keeps ONE XLA program."""
+    if count <= 0:
+        return problems
+    from cook_tpu.parallel.mesh import invalid_match_problem
+
+    s, npb = problems.demands.shape[1], problems.avail.shape[1]
+    pad = invalid_match_problem(
+        s, npb, n_res=n_res, with_feasible=problems.feasible is not None)
+    return jax.tree.map(
+        lambda real, dead: jnp.concatenate(
+            [real, jnp.broadcast_to(dead, (count,) + dead.shape)]),
+        problems, pad)
+
+
+def _chunk_for(width: int, axis: int) -> int:
+    """Largest power-of-two chunk <= min(width, axis): the padded job
+    axes here are powers of two, so a pow2 chunk always divides them
+    (an odd configured chunk must not trip chunked_match's assert)."""
+    chunk = max(1, min(width, axis))
+    return 1 << (chunk.bit_length() - 1)
+
+
+def _fine_solve(problems: MatchProblem, params: HierParams,
+                mesh) -> MatchResult:
+    backend = vmap_safe_backend(params.backend)
+    chunk = _chunk_for(params.chunk, problems.demands.shape[1])
+    if mesh is not None:
+        from cook_tpu.parallel.mesh import pool_sharded_match, shard_pools
+
+        problems = shard_pools(mesh, problems)
+        return pool_sharded_match(mesh, problems, chunk=chunk,
+                                  rounds=params.rounds, passes=params.passes,
+                                  kc=params.kc, backend=backend)
+    fn = functools.partial(chunked_match, chunk=chunk, rounds=params.rounds,
+                           passes=params.passes, kc=params.kc,
+                           **backend_flags(backend))
+    return jax.vmap(fn)(problems)
+
+
+_metrics = None
+
+
+def _note_metrics(pool: str, backend: str, stats: dict) -> None:
+    global _metrics
+    if _metrics is None:
+        _metrics = {
+            "solves": global_registry.counter(
+                "hierarchical.solves",
+                "two-level hierarchical match solves per pool/backend"),
+            "blocks": global_registry.gauge(
+                "hierarchical.blocks",
+                "topology blocks of the pool's last hierarchical solve"),
+            "spilled": global_registry.gauge(
+                "hierarchical.spilled",
+                "jobs the last coarse pass overflowed into refinement"),
+            "refine_placed": global_registry.counter(
+                "hierarchical.refine_placed",
+                "jobs placed by hierarchical refinement rounds per pool"),
+        }
+    labels = {"pool": pool or "-"}
+    _metrics["solves"].inc(labels={**labels, "backend": backend})
+    _metrics["blocks"].set(stats["blocks"], labels)
+    _metrics["spilled"].set(stats["spilled"], labels)
+    if stats.get("refine_placed"):
+        _metrics["refine_placed"].inc(stats["refine_placed"], labels)
+
+
+def hierarchical_match(
+    problem: MatchProblem,
+    *,
+    params: Optional[HierParams] = None,
+    mesh=None,
+    observatory=None,
+    pool: str = "",
+) -> tuple[MatchResult, dict]:
+    """Solve one giant pool's match problem coarse-then-fine.
+
+    Returns (MatchResult, stats): the assignment is in the ORIGINAL node
+    index space (block * nodes_per_block + local), and `stats` carries
+    the phase walls (coarse_s/fine_s/refine_s), block geometry, per-block
+    jobs/placed counts, and spill/refine accounting — the matcher copies
+    it into the CycleRecord's hierarchical fields.
+
+    `observatory` (obs.CompileObservatory) receives one
+    `match_coarse`/`match_fine` solve report per pass, keyed by the
+    padded shapes — the pin that any block count compiles ONE fine
+    program.
+    """
+    params = params or HierParams()
+    t_start = time.perf_counter()
+    orig_j = int(problem.demands.shape[0])
+    n = int(problem.avail.shape[0])
+    n_res = int(problem.demands.shape[-1])
+    # power-of-two job axis so every chunk width divides it (the matcher
+    # and bench already bucket-pad; direct callers get the same treatment)
+    j = bucket_size(orig_j)
+    if j != orig_j:
+        problem = problem._replace(
+            demands=jnp.pad(problem.demands, ((0, j - orig_j), (0, 0))),
+            job_valid=jnp.pad(problem.job_valid, (0, j - orig_j)),
+            feasible=(None if problem.feasible is None else
+                      jnp.pad(problem.feasible,
+                              ((0, j - orig_j), (0, 0)))),
+        )
+    npb = choose_nodes_per_block(n, params.nodes_per_block)
+    npb = min(npb, bucket_size(n))
+    b_real = -(-n // npb)
+    n_pad = b_real * npb
+    mesh_size = int(mesh.devices.size) if mesh is not None else 1
+
+    avail = problem.avail
+    totals = problem.totals
+    node_valid = problem.node_valid
+    feasible = problem.feasible
+    if n_pad != n:
+        # pad the node axis to a whole number of blocks with dead nodes
+        avail = jnp.pad(avail, ((0, n_pad - n), (0, 0)))
+        totals = jnp.pad(totals, ((0, n_pad - n), (0, 0)),
+                         constant_values=1.0)
+        node_valid = jnp.pad(node_valid, (0, n_pad - n))
+        if feasible is not None:
+            feasible = jnp.pad(feasible, ((0, 0), (0, n_pad - n)))
+
+    # block axis pads to a power-of-two bucket that is also a mesh
+    # multiple: the fine batch shape — and therefore the XLA program —
+    # is keyed by (b_pad, slots, npb), never by the raw block count
+    b_pad = bucket_size(b_real, minimum=max(mesh_size, MIN_BLOCKS))
+    b_pad += (-b_pad) % mesh_size
+    if params.jobs_per_block:
+        # round an override up to a power of two: the chunked fine solve
+        # needs its chunk to divide the slot axis
+        slots = 1 << (params.jobs_per_block - 1).bit_length()
+    else:
+        slots = bucket_size(int(np.ceil(params.block_slack * j / b_real)))
+    slots = min(slots, bucket_size(j))
+
+    job_valid_np = np.asarray(problem.job_valid)
+    out = np.full(j, -1, dtype=np.int32)
+    block_pad_axis = b_pad - b_real
+    coarse_backend = params.coarse_backend
+    coarse_s = fine_s = refine_s = 0.0
+    spilled_total = 0
+    refine_placed = 0
+    block_stats: list[dict] = []
+    avail_now = avail
+
+    def coarse_pass(active_mask: np.ndarray) -> np.ndarray:
+        """One coarse jobs x blocks assignment against the CURRENT block
+        availabilities (refine rounds re-enter here with only the
+        leftover jobs active)."""
+        block_sum, block_max, block_tot, block_valid = block_aggregates(
+            avail_now, totals, node_valid, npb)
+        if block_pad_axis:
+            block_sum = jnp.pad(block_sum, ((0, block_pad_axis), (0, 0)))
+            block_max = jnp.pad(block_max, ((0, block_pad_axis), (0, 0)),
+                                constant_values=-1.0)
+            block_tot = jnp.pad(block_tot, ((0, block_pad_axis), (0, 0)),
+                                constant_values=1.0)
+            block_valid = jnp.pad(block_valid, (0, block_pad_axis))
+        active = jnp.asarray(active_mask)
+        if coarse_backend == "pallas":
+            interpret = jax.default_backend() != "tpu"
+            assignment = _coarse_pallas(
+                problem.demands, active, block_sum, block_max, block_tot,
+                block_valid,
+                chunk=_chunk_for(params.coarse_chunk, j),
+                rounds=params.coarse_rounds, passes=params.coarse_passes,
+                interpret=interpret)
+        else:
+            block_any = None
+            if feasible is not None:
+                block_any = feasible.reshape(j, b_real, npb).any(axis=-1)
+                if block_pad_axis:
+                    block_any = jnp.pad(block_any,
+                                        ((0, 0), (0, block_pad_axis)))
+            assignment = _coarse_xla(
+                problem.demands, active, block_sum, block_max, block_tot,
+                block_valid, block_any, params)
+        if observatory is not None:
+            observatory.observe_solve("match_coarse", (j, b_pad),
+                                      coarse_backend)
+        return np.asarray(fetch_result(assignment))
+
+    def fine_pass(job_idx: np.ndarray):
+        """Scattered fine batch solve; returns (assignment [b_real, s]
+        local node indices, updated flat availability)."""
+        problems = gather_fine(problem.demands, problem.job_valid, feasible,
+                               avail_now, totals, node_valid,
+                               jnp.asarray(job_idx), npb)
+        problems = _pad_block_axis(problems, block_pad_axis, n_res)
+        result = _fine_solve(problems, params, mesh)
+        if observatory is not None:
+            observatory.observe_solve(
+                "match_fine", (b_pad, slots, npb),
+                vmap_safe_backend(params.backend))
+        assignment = np.asarray(fetch_result(result.assignment))[:b_real]
+        new_avail = result.new_avail[:b_real].reshape(n_pad, n_res)
+        return assignment, new_avail
+
+    def merge(job_idx: np.ndarray, fine_assign: np.ndarray) -> int:
+        """Fold one fine pass's block-local picks into the global
+        assignment; returns the number of jobs placed this pass."""
+        sel = (job_idx >= 0) & (fine_assign >= 0)
+        local = np.where(sel, fine_assign, 0)
+        global_idx = (np.arange(b_real, dtype=np.int64)[:, None] * npb
+                      + local)
+        out[job_idx[sel]] = global_idx[sel].astype(np.int32)
+        return int(sel.sum())
+
+    # ---- round 0: coarse -> scatter -> fine
+    t0 = time.perf_counter()
+    coarse = coarse_pass(job_valid_np)
+    coarse_s += time.perf_counter() - t0
+    t0 = time.perf_counter()
+    job_idx, spilled = scatter_to_blocks(coarse, job_valid_np, b_real, slots)
+    spilled_total = int(spilled.sum())
+    fine_assign, avail_now = fine_pass(job_idx)
+    fine_s += time.perf_counter() - t0
+    merge(job_idx, fine_assign)
+    for bi in range(b_real):
+        block_stats.append({
+            "jobs": int((job_idx[bi] >= 0).sum()),
+            "placed": int(((job_idx[bi] >= 0)
+                           & (fine_assign[bi] >= 0)).sum()),
+        })
+
+    # ---- bounded refinement: re-offer every leftover (coarse-unrouted,
+    # slot-spilled, or fine-unplaced) to under-filled blocks against the
+    # UPDATED availabilities — identical shapes, so no new programs
+    rounds_run = 0
+    for _ in range(max(0, params.refine_rounds)):
+        leftover = job_valid_np & (out < 0)
+        if not leftover.any():
+            break
+        rounds_run += 1
+        t0 = time.perf_counter()
+        coarse = coarse_pass(leftover)
+        job_idx, _ = scatter_to_blocks(coarse, leftover, b_real, slots)
+        fine_assign, avail_now = fine_pass(job_idx)
+        placed = merge(job_idx, fine_assign)
+        refine_placed += placed
+        refine_s += time.perf_counter() - t0
+        if placed == 0:
+            break
+
+    stats = {
+        "blocks": b_real,
+        "block_pad": b_pad,
+        "nodes_per_block": npb,
+        "jobs_per_block": slots,
+        "coarse_s": coarse_s,
+        "fine_s": fine_s,
+        "refine_s": refine_s,
+        "refine_rounds": rounds_run,
+        "refine_placed": refine_placed,
+        "spilled": spilled_total,
+        "placed": int((out >= 0).sum()),
+        "coarse_shape": (j, b_pad),
+        "fine_shape": (b_pad, slots, npb),
+        "backend": vmap_safe_backend(params.backend),
+        "coarse_backend": coarse_backend,
+        "block_stats": block_stats,
+        "total_s": time.perf_counter() - t_start,
+    }
+    _note_metrics(pool, stats["backend"], stats)
+    return MatchResult(assignment=jnp.asarray(out[:orig_j]),
+                       new_avail=avail_now[:n]), stats

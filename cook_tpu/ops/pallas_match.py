@@ -91,6 +91,36 @@ def _best_node_masked_kernel(d_ref, avail_ref, totals_ref, valid_ref,
                           best_val_ref, best_idx_ref)
 
 
+def _grid_best_call(kernel, *, padded_k, padded_n, block_jobs, block_nodes,
+                    in_specs, args, interpret):
+    """Shared pallas_call scaffold of the best-* kernels: jobs x node
+    tiles grid (node axis innermost/sequential), per-job (val, idx)
+    accumulator outputs.  ONE copy so a padding/tie-break fix can never
+    silently miss a sibling kernel."""
+    return pl.pallas_call(
+        kernel,
+        grid=(padded_k // block_jobs, padded_n // block_nodes),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
+            pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded_k,), jnp.float32),
+            jax.ShapeDtypeStruct((padded_k,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+
+def _unpad_best(best_val, best_idx, k):
+    """Shared postlude: drop job padding, -1 where nothing was feasible."""
+    best_val = best_val[:k]
+    best_idx = best_idx[:k]
+    found = best_val > -BIG
+    return best_val, jnp.where(found, best_idx, -1)
+
+
 @functools.partial(jax.jit, static_argnames=("block_jobs", "block_nodes",
                                              "interpret"))
 def best_node(
@@ -135,38 +165,93 @@ def best_node(
         pl.BlockSpec((block_nodes, 2), lambda i, j: (j, 0)),
         pl.BlockSpec((block_nodes,), lambda i, j: (j,)),
     ]
-    out_specs = [
-        pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
-        pl.BlockSpec((block_jobs,), lambda i, j: (i,)),
-    ]
-    out_shape = [
-        jax.ShapeDtypeStruct((padded_k,), jnp.float32),
-        jax.ShapeDtypeStruct((padded_k,), jnp.int32),
-    ]
     args = (demands.astype(jnp.float32), avail.astype(jnp.float32),
             totals.astype(jnp.float32), valid_i)
     if feasible is None:
-        best_val, best_idx = pl.pallas_call(
-            _best_node_kernel,
-            grid=(padded_k // block_jobs, padded_n // block_nodes),
-            in_specs=job_specs,
-            out_specs=out_specs,
-            out_shape=out_shape,
-            interpret=interpret,
-        )(*args)
+        best_val, best_idx = _grid_best_call(
+            _best_node_kernel, padded_k=padded_k, padded_n=padded_n,
+            block_jobs=block_jobs, block_nodes=block_nodes,
+            in_specs=job_specs, args=args, interpret=interpret)
     else:
-        best_val, best_idx = pl.pallas_call(
-            _best_node_masked_kernel,
-            grid=(padded_k // block_jobs, padded_n // block_nodes),
+        best_val, best_idx = _grid_best_call(
+            _best_node_masked_kernel, padded_k=padded_k, padded_n=padded_n,
+            block_jobs=block_jobs, block_nodes=block_nodes,
             in_specs=job_specs + [
                 pl.BlockSpec((block_jobs, block_nodes),
                              lambda i, j: (i, j)),
             ],
-            out_specs=out_specs,
-            out_shape=out_shape,
-            interpret=interpret,
-        )(*args, feasible.astype(jnp.int32))
-    best_val = best_val[:k]
-    best_idx = best_idx[:k]
-    found = best_val > -BIG
-    return best_val, jnp.where(found, best_idx, -1)
+            args=args + (feasible.astype(jnp.int32),),
+            interpret=interpret)
+    return _unpad_best(best_val, best_idx, k)
+
+
+# ---------------------------------------------------- hierarchical coarse
+
+
+def _best_block_kernel(d_ref, avail_ref, maxn_ref, totals_ref, valid_ref,
+                       best_val_ref, best_idx_ref):
+    """`_best_node_kernel` for BLOCK aggregates (ops/hierarchical.py
+    coarse pass) with the extra max-single-node feasibility gate fused
+    in-kernel: a job routes to a block only if the block's aggregate
+    capacity fits it AND some single node there could hold it.  The XLA
+    path materializes that gate as a host-built [J, B] mask; here it is
+    computed on the fly from the [BN, R] max-node tile — the fusion this
+    kernel exists for."""
+    d = d_ref[:]
+    gate = jnp.all(maxn_ref[:][None, :, :] >= d[:, None, :], axis=-1)
+    _score_and_accumulate(d, avail_ref[:], totals_ref[:], valid_ref[:],
+                          gate, pl.program_id(1),
+                          best_val_ref, best_idx_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("block_jobs", "block_nodes",
+                                             "interpret"))
+def best_block(
+    demands: jnp.ndarray,      # [K, R]
+    block_avail: jnp.ndarray,  # [B, R] aggregate availability per block
+    block_max: jnp.ndarray,    # [B, R] max single-node availability
+    block_totals: jnp.ndarray, # [B, 2] aggregate capacity (fitness denoms)
+    block_valid: jnp.ndarray,  # [B] (bool or int)
+    *,
+    block_jobs: int = 256,
+    block_nodes: int = 128,
+    interpret: bool = False,
+):
+    """Per-job best feasible BLOCK for the hierarchical coarse pass:
+    returns (best_score [K], best_idx [K]); best_idx is -1 (score -BIG)
+    when no block is feasible.  Same layout/padding discipline as
+    `best_node`."""
+    k, b = demands.shape[0], block_avail.shape[0]
+    block_jobs = min(block_jobs, k)
+    block_nodes = min(block_nodes, b)
+    pad_k = (-k) % block_jobs
+    pad_b = (-b) % block_nodes
+    valid_i = block_valid.astype(jnp.int32)
+    if pad_k:
+        demands = jnp.pad(demands, ((0, pad_k), (0, 0)),
+                          constant_values=2 * BIG)
+    if pad_b:
+        block_avail = jnp.pad(block_avail, ((0, pad_b), (0, 0)))
+        block_max = jnp.pad(block_max, ((0, pad_b), (0, 0)),
+                            constant_values=-1.0)
+        block_totals = jnp.pad(block_totals, ((0, pad_b), (0, 0)))
+        valid_i = jnp.pad(valid_i, (0, pad_b))
+    padded_k = k + pad_k
+    padded_b = b + pad_b
+    r = demands.shape[-1]
+
+    best_val, best_idx = _grid_best_call(
+        _best_block_kernel, padded_k=padded_k, padded_n=padded_b,
+        block_jobs=block_jobs, block_nodes=block_nodes,
+        in_specs=[
+            pl.BlockSpec((block_jobs, r), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_nodes, r), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_nodes, r), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_nodes, 2), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_nodes,), lambda i, j: (j,)),
+        ],
+        args=(demands.astype(jnp.float32), block_avail.astype(jnp.float32),
+              block_max.astype(jnp.float32),
+              block_totals.astype(jnp.float32), valid_i),
+        interpret=interpret)
+    return _unpad_best(best_val, best_idx, k)

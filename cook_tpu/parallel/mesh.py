@@ -65,20 +65,24 @@ def shard_pools(mesh: Mesh, tree, axis: str = "pool"):
     return jax.device_put(tree, sharding)
 
 
-def invalid_match_problem(j: int, n: int, n_res: int = 4) -> MatchProblem:
+def invalid_match_problem(j: int, n: int, n_res: int = 4,
+                          with_feasible: bool = True) -> MatchProblem:
     """An all-invalid padded problem used to fill the pool axis up to a
-    mesh multiple (matcher.match_pools_batched): job_valid/node_valid are
-    all False so the kernels place nothing, and the sharded path engages
-    for ANY solvable-pool count instead of only exact mesh multiples.
-    `totals` is ones so the binpack fitness arithmetic stays finite on
-    the dead lanes."""
+    mesh multiple (matcher.match_pools_batched) and the BLOCK axis of the
+    hierarchical fine batch (ops/hierarchical.py): job_valid/node_valid
+    are all False so the kernels place nothing, and the sharded path
+    engages for ANY solvable-pool/block count instead of only exact mesh
+    multiples.  `totals` is ones so the binpack fitness arithmetic stays
+    finite on the dead lanes.  `with_feasible=False` matches batches
+    whose real problems carry no constraint mask (the pytree structures
+    must agree for stacking/vmap)."""
     return MatchProblem(
         demands=jnp.zeros((j, n_res), jnp.float32),
         job_valid=jnp.zeros((j,), bool),
         avail=jnp.zeros((n, n_res), jnp.float32),
         totals=jnp.ones((n, 2), jnp.float32),
         node_valid=jnp.zeros((n,), bool),
-        feasible=jnp.zeros((j, n), bool),
+        feasible=jnp.zeros((j, n), bool) if with_feasible else None,
     )
 
 
@@ -96,9 +100,13 @@ def pool_sharded_match(mesh: Mesh, problems: MatchProblem, *,
           if chunk else greedy_match)
     mapped = jax.vmap(fn)
     spec = P("pool")
+    # a mask-less batch (feasible=None, e.g. the hierarchical fine solve
+    # at XL sizes where a [J, N] mask would be GBs) has no leaf there —
+    # the spec pytree must match the data pytree's structure
+    feas_spec = spec if problems.feasible is not None else None
     shmapped = shard_map(
         mapped, mesh=mesh,
-        in_specs=(MatchProblem(spec, spec, spec, spec, spec, spec),),
+        in_specs=(MatchProblem(spec, spec, spec, spec, spec, feas_spec),),
         out_specs=MatchResult(spec, spec),
     )
     return shmapped(problems)

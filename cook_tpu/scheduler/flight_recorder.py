@@ -119,6 +119,19 @@ class CycleRecord:
     solve_shape: str = ""
     backend: str = ""
     compiled: bool = False
+    # hierarchical two-level solve accounting (ops/hierarchical.py):
+    # set when the cycle's solve decomposed into topology blocks.  The
+    # coarse/fine/refine walls live OUTSIDE `phases` on purpose — they
+    # are sub-spans of the cycle's one `solve` phase, and folding them
+    # into `phases` would double-count device_s/host_s and the pipelined
+    # overlap accounting.  block_stats carries per-block {jobs, placed}
+    # for the round-0 scatter (bounded: one entry per topology block).
+    hierarchical: bool = False
+    hier_blocks: int = 0
+    hier_phases: dict = field(default_factory=dict)
+    hier_spilled: int = 0
+    hier_refine_placed: int = 0
+    block_stats: list[dict] = field(default_factory=list)
     # per-pool capacity snapshot at cycle start ({hosts, mem, cpus,
     # spare_*}) + the elastic plan id in force — so a capacity delta
     # (cook_tpu/elastic/) correlates with match outcomes record-to-record
@@ -155,6 +168,12 @@ class CycleRecord:
             "solve_shape": self.solve_shape,
             "backend": self.backend,
             "compiled": self.compiled,
+            "hierarchical": self.hierarchical,
+            "hier_blocks": self.hier_blocks,
+            "hier_phases": dict(self.hier_phases),
+            "hier_spilled": self.hier_spilled,
+            "hier_refine_placed": self.hier_refine_placed,
+            "block_stats": list(self.block_stats),
             "pool_capacity": dict(self.pool_capacity),
             "elastic_plan": self.elastic_plan,
             "offers": self.offers,
@@ -236,6 +255,22 @@ class CycleBuilder:
         self.rank_jobs = jobs
         self.rank_dru = dru
 
+    def note_hierarchical(self, stats: dict) -> None:
+        """Fold a two-level solve's accounting (ops/hierarchical.py
+        stats) into the record: block geometry, coarse/fine/refine walls,
+        spill/refine counts, per-block jobs/placed."""
+        rec = self.record
+        rec.hierarchical = True
+        rec.hier_blocks = int(stats.get("blocks", 0))
+        rec.hier_phases = {
+            "coarse_solve": stats.get("coarse_s", 0.0),
+            "fine_solve": stats.get("fine_s", 0.0),
+            "refine": stats.get("refine_s", 0.0),
+        }
+        rec.hier_spilled = int(stats.get("spilled", 0))
+        rec.hier_refine_placed = int(stats.get("refine_placed", 0))
+        rec.block_stats = list(stats.get("block_stats", []))
+
     def note_match(self, job_uuid: str, hostname: str, task_id: str) -> None:
         self.record.matched.append(
             {"job": job_uuid, "host": hostname, "task_id": task_id})
@@ -303,6 +338,9 @@ class NullCycle:
         pass
 
     def set_rank_context(self, *a) -> None:
+        pass
+
+    def note_hierarchical(self, *a) -> None:
         pass
 
 

@@ -112,9 +112,33 @@ class MatchConfig:
     # latency guard ratio over the rolling median baseline (0 = latency
     # never triggers fallback; solve errors still do)
     device_latency_guard: float = 0.0
+    # hierarchical two-level matcher (ops/hierarchical.py): when a pool's
+    # padded jobs x nodes product reaches this threshold, the solve
+    # decomposes into topology blocks — coarse jobs x blocks assignment,
+    # then every block's fine problem batched over the block axis (the
+    # axis parallel/mesh.py shards), plus bounded refinement.  0 disables
+    # (the flat kernels remain the only path).  Reached via
+    # SchedulerConfig.match.hierarchical_threshold.
+    hierarchical_threshold: int = 0
+    # block geometry overrides; 0 = auto from the tuned buckets
+    # (ops/hierarchical.NODE_BLOCK_BUCKETS / block_slack)
+    hierarchical_nodes_per_block: int = 0
+    hierarchical_jobs_per_block: int = 0
+    hierarchical_refine_rounds: int = 2
+    # coarse block-scoring backend: "xla" (masked chunked kernel) or
+    # "pallas" (fused ops/pallas_match.best_block; quality-guarded)
+    hierarchical_coarse_backend: str = "xla"
+    # shard the fine batch's block axis over the device mesh when the
+    # process holds more than one device
+    hierarchical_use_mesh: bool = True
 
     def __post_init__(self):
         backend_flags(self.backend)  # raises on unknown names
+        if self.hierarchical_coarse_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown hierarchical coarse backend "
+                f"{self.hierarchical_coarse_backend!r} "
+                "(expected xla | pallas)")
         if self.backend == "bucketed" and 0 < self.chunk and \
                 self.chunk_passes < 2:
             # the solve-time guard in ops/match.py would only fire on the
@@ -256,8 +280,85 @@ def solve_backend(config: "MatchConfig") -> str:
     return config.backend if config.chunk else "exact"
 
 
-def dispatch_pool_solve(prepared: "PreparedPool",
-                        config: "MatchConfig") -> PendingResult:
+def hierarchical_enabled(config: "MatchConfig",
+                         problem: MatchProblem) -> bool:
+    """Automatic two-level path: padded jobs x nodes at/over the
+    configured threshold (0 = never)."""
+    if config.hierarchical_threshold <= 0:
+        return False
+    j, n = problem_shape(problem)
+    return j * n >= config.hierarchical_threshold
+
+
+def hier_params_from_config(config: "MatchConfig"):
+    """MatchConfig -> ops/hierarchical.HierParams (the chunked-matcher
+    knobs carry over so the fine solve uses the pool's tuned config)."""
+    from cook_tpu.ops.hierarchical import HierParams
+
+    return HierParams(
+        nodes_per_block=config.hierarchical_nodes_per_block,
+        jobs_per_block=config.hierarchical_jobs_per_block,
+        refine_rounds=config.hierarchical_refine_rounds,
+        chunk=config.chunk or 1024,
+        rounds=config.chunk_rounds,
+        passes=config.chunk_passes,
+        kc=config.chunk_kc,
+        backend=vmap_safe_backend(config.backend),
+        coarse_backend=config.hierarchical_coarse_backend,
+    )
+
+
+_HIER_MESH = None
+_HIER_MESH_READY = False
+
+
+def hier_mesh():
+    """Process-cached device mesh for the hierarchical fine batch (None
+    on single-device hosts: plain vmap is the right schedule there)."""
+    global _HIER_MESH, _HIER_MESH_READY
+    if not _HIER_MESH_READY:
+        import jax
+
+        from cook_tpu.parallel.mesh import make_mesh
+
+        _HIER_MESH = make_mesh() if len(jax.devices()) > 1 else None
+        _HIER_MESH_READY = True
+    return _HIER_MESH
+
+
+class HierarchicalPending:
+    """PendingResult stand-in for a pool solved by the two-level matcher:
+    the coarse/scatter/fine/refine pipeline needs host round-trips, so
+    the whole solve runs at `fetch()` (JAX still dispatches each device
+    pass asynchronously inside).  Stats land on `prepared.hier_stats`
+    for record_solve_outcome to fold into the CycleRecord."""
+
+    __slots__ = ("prepared", "config", "telemetry")
+
+    def __init__(self, prepared: "PreparedPool", config: "MatchConfig",
+                 telemetry=None):
+        self.prepared = prepared
+        self.config = config
+        self.telemetry = telemetry
+
+    def fetch(self) -> np.ndarray:
+        from cook_tpu.ops.hierarchical import hierarchical_match
+
+        observatory = (self.telemetry.observatory
+                       if self.telemetry is not None else None)
+        mesh = (hier_mesh() if self.config.hierarchical_use_mesh else None)
+        result, stats = hierarchical_match(
+            self.prepared.problem,
+            params=hier_params_from_config(self.config),
+            mesh=mesh, observatory=observatory,
+            pool=self.prepared.pool.name)
+        self.prepared.hier_stats = stats
+        return np.asarray(
+            result.assignment[: len(self.prepared.considerable)])
+
+
+def dispatch_pool_solve(prepared: "PreparedPool", config: "MatchConfig",
+                        telemetry=None) -> PendingResult:
     """Dispatch the pool's match kernel WITHOUT observing completion.
 
     JAX's async dispatch returns device buffers immediately; the returned
@@ -265,13 +366,18 @@ def dispatch_pool_solve(prepared: "PreparedPool",
     semantics as `fetch_result`, including deferred-error surfacing).
     The serial path fetches inline; the pipelined engine
     (scheduler/pipeline.py) interleaves other pools' host phases between
-    dispatch and fetch."""
+    dispatch and fetch.  Pools at/over `hierarchical_threshold` route to
+    the two-level matcher (ops/hierarchical.py) behind the same pending
+    interface — a raising hierarchical solve rides the identical
+    device-fallback ladder."""
     fault_schedule = faults.ACTIVE  # snapshot: a concurrent disarm must
     if fault_schedule is not None:  # not None out the global mid-site
         # `device.solve` fault point: error = kernel raising at dispatch
         # (surfaces at fetch in the pipelined engine, inline here);
         # delay = a latency spike feeding the regression guard
         fault_schedule.hit(faults.DEVICE_SOLVE, pool=prepared.pool.name)
+    if hierarchical_enabled(config, prepared.problem):
+        return HierarchicalPending(prepared, config, telemetry)
     if config.chunk:
         result = chunked_match(prepared.problem, chunk=config.chunk,
                                rounds=config.chunk_rounds,
@@ -295,12 +401,28 @@ def record_solve_outcome(prepared: "PreparedPool", assignment: np.ndarray,
     see DeviceTelemetry.record_match_solve)."""
     shape = problem_shape(prepared.problem)
     backend = solve_backend(config)
+    hier = prepared.hier_stats
+    if hier is not None:
+        # two-level solve: the record's backend names the decomposition
+        # so a slow cycle is attributable to the hierarchical path from
+        # the record alone (coarse/fine wall split rides in hier_phases)
+        backend = f"hier-{hier['backend']}"
     compiled = False
     if telemetry is not None:
         compiled = telemetry.record_match_solve(
             pool_name, shape, backend, solve_s, overlapped=overlapped)
         telemetry.quality.observe_cycle(prepared, assignment, pool_name)
     flight.note_solve(shape_signature(shape), backend, compiled)
+    if hier is not None:
+        flight.note_hierarchical(hier)
+        # NO exact-kernel audit for hierarchical cycles: the audit
+        # replays the FULL flat problem through the sequential-greedy
+        # scan — the very solve the decomposition exists to avoid (at
+        # the XL sizes that trigger this path it would peg a core for
+        # minutes under the single-flight audit lock).  Parity is
+        # guarded by the QualityMonitor shadow solves (bounded by
+        # max_shadow_jobs) and the pinned tests instead.
+        return
     if config.chunk:
         state.chunked_solves += 1
         if (config.quality_audit_every
@@ -612,6 +734,10 @@ class PreparedPool:
     balanced_pre_rows: dict = field(default_factory=dict)
     feasible: Optional[np.ndarray] = None
     problem: Optional[MatchProblem] = None
+    # two-level solve accounting (ops/hierarchical.py stats), set by
+    # HierarchicalPending.fetch and folded into the CycleRecord by
+    # record_solve_outcome
+    hier_stats: Optional[dict] = None
     # clusters withheld from this cycle because their circuit breaker is
     # open (cook_tpu/faults/breaker.py): offer-less pools report
     # `cluster-circuit-open` instead of a misleading `no-offers`
@@ -1153,8 +1279,8 @@ def match_pool(
             t_solve = _time.perf_counter()
             try:
                 with flight.phase("solve", device=True):
-                    assignment = dispatch_pool_solve(prepared,
-                                                     config).fetch()
+                    assignment = dispatch_pool_solve(
+                        prepared, config, telemetry=telemetry).fetch()
             except Exception:  # noqa: BLE001 — classified below
                 if config.device_fallback_cycles <= 0:
                     raise
@@ -1250,6 +1376,7 @@ def match_pools_batched(
     # device probe)
     cpu_solving: dict[str, str] = {}  # pool -> fallback reason
     solvable = []
+    hier_pools = []
     for p in prepared_list:
         if not p.solvable:
             continue
@@ -1257,9 +1384,48 @@ def match_pools_batched(
             config, states[p.pool.name], telemetry, p.pool.name)
         if use_cpu:
             cpu_solving[p.pool.name] = fb_reason
+        elif hierarchical_enabled(config, p.problem):
+            # a pool at/over the hierarchical threshold must not ride
+            # the flat batched kernel (the intractable [J, N] wall the
+            # decomposition exists to avoid): it solves through the
+            # two-level path individually, with the same fault point
+            # and fallback ladder as the serial/pipelined routes
+            hier_pools.append(p)
         else:
             solvable.append(p)
     batch_assignments: dict[str, np.ndarray] = {}
+    hier_solved: set = set()
+    if hier_pools:
+        import time as _time
+
+        fault_schedule = faults.ACTIVE  # snapshot (see flat branch)
+        for p in hier_pools:
+            name = p.pool.name
+            flight = pool_flight(name)
+            t_solve = _time.perf_counter()
+            try:
+                if fault_schedule is not None:
+                    fault_schedule.hit(faults.DEVICE_SOLVE, pool=name)
+                with flight.phase("solve", device=True):
+                    assignment = HierarchicalPending(p, config,
+                                                     telemetry).fetch()
+            except Exception:  # noqa: BLE001 — classified below
+                if config.device_fallback_cycles <= 0:
+                    raise
+                # reaction (c): this pool re-solves host-side below; the
+                # OTHER pools' batch proceeds untouched
+                log.exception("hierarchical solve failed (pool %s); "
+                              "falling back to %s", name, FALLBACK_BACKEND)
+                enter_device_fallback(states[name], config, name,
+                                      "solve-error")
+                cpu_solving[name] = "solve-error"
+                continue
+            record_solve_outcome(p, assignment, config, states[name],
+                                 name, _time.perf_counter() - t_solve,
+                                 flight, telemetry)
+            exit_device_fallback(states[name], telemetry, name)
+            batch_assignments[name] = assignment
+            hier_solved.add(name)
     if solvable:
         import time as _time
 
@@ -1386,9 +1552,12 @@ def match_pools_batched(
         assignment = np.empty(0, dtype=np.int32)
         if name in batch_assignments:
             assignment = batch_assignments[name]
-            if telemetry is not None:
+            # hierarchically-solved pools already went through
+            # record_solve_outcome (quality observe + hier record note);
+            # re-observing here would double-count the sample
+            if name not in hier_solved and telemetry is not None:
                 telemetry.quality.observe_cycle(prepared, assignment, name)
-            if config.chunk:
+            if config.chunk and name not in hier_solved:
                 st = states[name]
                 st.chunked_solves += 1
                 if (config.quality_audit_every

@@ -281,8 +281,8 @@ def match_pools_pipelined(
             else:
                 with flight.phase("dispatch"):
                     try:
-                        stage.pending = dispatch_pool_solve(prepared,
-                                                            config)
+                        stage.pending = dispatch_pool_solve(
+                            prepared, config, telemetry=telemetry)
                     except Exception:  # noqa: BLE001 — a dispatch-time
                         # raise (tracing/compile error) is this pool's
                         # solve failing eagerly; mark it failed at

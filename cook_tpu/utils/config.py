@@ -207,6 +207,18 @@ def _match_config(d: dict) -> MatchConfig:
             d.get("checkpoint_memory_overhead_mb", 0.0)),
         device_fallback_cycles=int(d.get("device_fallback_cycles", 8)),
         device_latency_guard=float(d.get("device_latency_guard", 0.0)),
+        # hierarchical two-level matcher (ops/hierarchical.py): engages
+        # when padded jobs x nodes reaches the threshold (0 = off)
+        hierarchical_threshold=int(d.get("hierarchical_threshold", 0)),
+        hierarchical_nodes_per_block=int(
+            d.get("hierarchical_nodes_per_block", 0)),
+        hierarchical_jobs_per_block=int(
+            d.get("hierarchical_jobs_per_block", 0)),
+        hierarchical_refine_rounds=int(
+            d.get("hierarchical_refine_rounds", 2)),
+        hierarchical_coarse_backend=str(
+            d.get("hierarchical_coarse_backend", "xla")),
+        hierarchical_use_mesh=bool(d.get("hierarchical_use_mesh", True)),
     )
 
 

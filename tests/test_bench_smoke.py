@@ -40,9 +40,15 @@ def test_smoke_emits_structured_record(smoke_record):
     assert on_disk["schema"] == "cook-bench/v1"
     assert on_disk["mode"] == "smoke"
     assert set(on_disk["phases"]) == {"match", "dru", "rebalance",
-                                      "elastic_plan", "control_plane"}
+                                      "elastic_plan", "control_plane",
+                                      "match_xl", "match_xl_coarse",
+                                      "match_xl_fine", "match_xl_refine"}
+    # every record and every phase carries the resolved JAX backend —
+    # the label bench_gate uses to refuse cross-backend comparisons
+    assert on_disk["backend"] == "cpu"
     for phase in on_disk["phases"].values():
         assert phase["p50_ms"] > 0
+        assert phase["backend"] == "cpu"
     assert on_disk["headline"]["unit"] == "ms"
     assert record["phases"]["match"]["jobs"] == 1000
     # the control-plane phase gates commit-ack p50 and records the p99
@@ -58,6 +64,19 @@ def test_smoke_match_holds_packing_parity(smoke_record):
     # see bench.bench_smoke) — a drop here is a real matcher regression
     record, _, _ = smoke_record
     assert record["phases"]["match"]["packing_eff"] >= 0.99
+
+
+def test_smoke_match_xl_tier(smoke_record):
+    """The hierarchical match_xl smoke tier: blocks engaged, per-phase
+    (coarse/fine/refine) p50s recorded for the gate, packing parity
+    within the pinned hierarchical tolerance."""
+    record, _, _ = smoke_record
+    xl = record["phases"]["match_xl"]
+    assert xl["jobs"] == 2000 and xl["nodes"] == 256
+    assert xl["blocks"] >= 2
+    assert xl["packing_eff"] >= 0.95
+    for phase in ("match_xl_coarse", "match_xl_fine"):
+        assert record["phases"][phase]["p50_ms"] > 0
 
 
 def test_next_phase_record_path_skips_driver_rounds(tmp_path):
@@ -176,3 +195,38 @@ class TestBenchGate:
 
     def test_bad_threshold_is_usage_error(self, tmp_path):
         assert bench_gate.main(["--threshold", "0"]) == 2
+
+    def test_cross_backend_records_refused(self, tmp_path, capsys):
+        """Two records of the same (mode, platform) family taken on
+        different resolved JAX backends must NOT be diffed — the gate
+        fails loudly instead of comparing apples to oranges (the silent
+        CPU-fallback trap of rounds 1-5)."""
+        old = make_record(tmp_path / "a.json", match=10.0)
+        new = make_record(tmp_path / "b.json", match=10.0)
+        for path, backend in ((old, "tpu"), (new, "cpu")):
+            data = json.loads(pathlib.Path(path).read_text())
+            data["backend"] = backend
+            pathlib.Path(path).write_text(json.dumps(data))
+        assert bench_gate.main([old, new]) == 1
+        out = capsys.readouterr().out
+        assert "REFUSED" in out and "different resolved JAX backends" in out
+
+    def test_cross_backend_phase_refused(self, tmp_path, capsys):
+        """One phase measured on a different backend (e.g. a device
+        upgrade relay mixing records) refuses on its own even when the
+        record-level backends agree or are absent."""
+        old = make_record(tmp_path / "a.json", match=10.0, dru=2.0)
+        new = make_record(tmp_path / "b.json", match=10.0, dru=2.0)
+        for path, backend in ((old, "tpu"), (new, "cpu")):
+            data = json.loads(pathlib.Path(path).read_text())
+            data["phases"]["match"]["backend"] = backend
+            pathlib.Path(path).write_text(json.dumps(data))
+        assert bench_gate.main([old, new]) == 1
+        assert "cross-backend" in capsys.readouterr().out
+
+    def test_legacy_records_without_backend_still_compare(self, tmp_path):
+        # records predating the backend stamp carry no label; the gate
+        # compares them as before instead of refusing history
+        old = make_record(tmp_path / "a.json", match=10.0)
+        new = make_record(tmp_path / "b.json", match=50.0)
+        assert bench_gate.main([old, new]) == 1  # real regression still fails

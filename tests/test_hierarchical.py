@@ -1,0 +1,353 @@
+"""Hierarchical two-level matcher (ops/hierarchical.py): packing parity
+vs the flat CPU reference across block counts, one fine-solve XLA program
+across block counts (CompileObservatory-pinned), phantom-free mesh
+padding, the QualityMonitor guard on degraded decompositions, and the
+scheduler wiring (threshold trigger, CycleRecord fields, fallback
+ladder)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cook_tpu.obs.compile_observatory import CompileObservatory
+from cook_tpu.obs.quality_monitor import QualityMonitor
+from cook_tpu.ops import cpu_reference as ref
+from cook_tpu.ops.hierarchical import (
+    HierParams,
+    choose_nodes_per_block,
+    hierarchical_match,
+)
+from cook_tpu.ops.match import MatchProblem
+from cook_tpu.parallel.mesh import make_mesh
+from tests.conftest import FakeClock
+from tests.test_ops_parity import random_match_problem
+
+# pinned packing-parity tolerance vs the flat np_greedy_match reference:
+# the decomposition trades a bounded amount of packing quality for the
+# block-batched schedule; a drop below this bar is a regression, not
+# noise (tests below measure ~0.96-1.0 on these seeds)
+HIER_EFF_TOLERANCE = 0.95
+
+
+def dense_problem(j, n, seed=0):
+    """Unconstrained seeded problem (bench.make_problem shape family)."""
+    rng = np.random.default_rng(seed)
+    demands = np.stack([
+        rng.choice([512.0, 1024.0, 2048.0, 4096.0], j),
+        rng.choice([0.5, 1.0, 2.0, 4.0], j),
+        np.zeros(j),
+    ], axis=-1).astype(np.float32)
+    totals = np.stack([np.full(n, 65536.0), np.full(n, 32.0)],
+                      axis=-1).astype(np.float32)
+    frac = rng.uniform(0.2, 1.0, (n, 1)).astype(np.float32)
+    avail = np.concatenate([totals * frac, np.zeros((n, 1), np.float32)],
+                           axis=-1)
+    return demands, avail, totals
+
+
+def as_problem(demands, avail, totals, feasible=None):
+    j, n = demands.shape[0], avail.shape[0]
+    return MatchProblem(
+        demands=jnp.asarray(demands), job_valid=jnp.ones(j, dtype=bool),
+        avail=jnp.asarray(avail), totals=jnp.asarray(totals),
+        node_valid=jnp.ones(n, dtype=bool),
+        feasible=None if feasible is None else jnp.asarray(feasible),
+    )
+
+
+def assert_valid(demands, avail, assignment, feasible=None):
+    """No oversubscribed node, no constraint-mask violation."""
+    placed = assignment >= 0
+    n = avail.shape[0]
+    assert (assignment[placed] < n).all()
+    use = np.zeros_like(avail, dtype=np.float64)
+    np.add.at(use, assignment[placed],
+              demands[placed].astype(np.float64)[:, :avail.shape[1]])
+    assert (use <= avail.astype(np.float64) + 1e-3).all(), \
+        "a node was oversubscribed"
+    if feasible is not None:
+        assert feasible[np.where(placed)[0], assignment[placed]].all()
+
+
+def efficiency(demands, assignment, ref_assignment):
+    q_dev = ref.packing_quality(demands, assignment)
+    q_ref = ref.packing_quality(demands, ref_assignment)
+    if not q_ref["cpus_placed"]:
+        return 1.0
+    return q_dev["cpus_placed"] / q_ref["cpus_placed"]
+
+
+def test_choose_nodes_per_block_buckets():
+    # tuned buckets: largest width keeping >= 8 blocks, fallback to >= 2
+    assert choose_nodes_per_block(16384) == 1024
+    assert choose_nodes_per_block(1024) == 128
+    assert choose_nodes_per_block(256) == 128  # >= 2-block fallback
+    assert choose_nodes_per_block(96) == 64    # smallest bucket floor
+    assert choose_nodes_per_block(16384, override=512) == 512
+
+
+@pytest.mark.parametrize("npb", [32, 64, 128])
+def test_parity_across_block_counts(npb):
+    """Property-style parity pin: hierarchical packing efficiency stays
+    within HIER_EFF_TOLERANCE of the flat reference greedy, at several
+    block decompositions of the same seeded problem."""
+    demands, avail, totals = dense_problem(512, 256, seed=npb)
+    problem = as_problem(demands, avail, totals)
+    result, stats = hierarchical_match(
+        problem, params=HierParams(nodes_per_block=npb, chunk=256, kc=32))
+    a = np.asarray(result.assignment)
+    assert_valid(demands, avail[:, :3], a)
+    flat = ref.np_greedy_match(demands, avail[:, :3], totals)
+    eff = efficiency(demands, a, flat)
+    assert eff >= HIER_EFF_TOLERANCE, (npb, eff)
+    assert stats["blocks"] == 256 // npb
+
+
+def test_parity_with_constraint_mask():
+    rng = np.random.default_rng(3)
+    demands, avail, totals, feasible = random_match_problem(rng, j=256,
+                                                           n=128)
+    problem = as_problem(demands, avail, totals, feasible)
+    result, _ = hierarchical_match(
+        problem, params=HierParams(nodes_per_block=32, chunk=128, kc=32))
+    a = np.asarray(result.assignment)
+    assert_valid(demands, avail, a, feasible=feasible)
+    flat = ref.np_greedy_match(demands, avail, totals,
+                               feasible_mask=feasible)
+    assert efficiency(demands, a, flat) >= HIER_EFF_TOLERANCE
+
+
+def test_pallas_coarse_matches_xla_coarse():
+    """The fused best_block coarse backend (interpret mode on CPU) is a
+    drop-in for the masked XLA coarse pass on an unconstrained
+    problem."""
+    demands, avail, totals = dense_problem(256, 128, seed=9)
+    problem = as_problem(demands, avail, totals)
+    outs = {}
+    for cb in ("xla", "pallas"):
+        result, stats = hierarchical_match(
+            problem, params=HierParams(nodes_per_block=32, chunk=128,
+                                       kc=32, coarse_backend=cb))
+        outs[cb] = np.asarray(result.assignment)
+        assert stats["coarse_backend"] == cb
+    flat = ref.np_greedy_match(demands, avail[:, :3], totals)
+    for cb, a in outs.items():
+        assert_valid(demands, avail[:, :3], a)
+        assert efficiency(demands, a, flat) >= HIER_EFF_TOLERANCE, cb
+
+
+def test_best_block_kernel_semantics():
+    """best_block == argmax over blocks of (aggregate fit AND max-node
+    gate AND valid) scored by the binpack fitness on aggregates."""
+    from cook_tpu.ops.pallas_match import best_block
+
+    rng = np.random.default_rng(4)
+    k, b = 16, 8
+    demands = rng.uniform(10, 500, (k, 3)).astype(np.float32)
+    bsum = rng.uniform(100, 2000, (b, 3)).astype(np.float32)
+    bmax = (bsum * rng.uniform(0.1, 1.0, (b, 3))).astype(np.float32)
+    btot = (bsum[:, :2] * 1.5).astype(np.float32)
+    valid = rng.uniform(size=b) > 0.2
+    val, idx = best_block(jnp.asarray(demands), jnp.asarray(bsum),
+                          jnp.asarray(bmax), jnp.asarray(btot),
+                          jnp.asarray(valid), interpret=True)
+    val, idx = np.asarray(val), np.asarray(idx)
+    used0 = btot[:, 0] - bsum[:, 0]
+    used1 = btot[:, 1] - bsum[:, 1]
+    denom = np.maximum(btot, 1e-30)
+    for ji in range(k):
+        feas = ((bsum >= demands[ji]).all(axis=1)
+                & (bmax >= demands[ji]).all(axis=1) & valid)
+        fit = ((used0 + demands[ji, 0]) / denom[:, 0]
+               + (used1 + demands[ji, 1]) / denom[:, 1]) * 0.5
+        if not feas.any():
+            assert idx[ji] == -1
+            continue
+        fit[~feas] = -np.inf
+        assert idx[ji] == int(np.argmax(fit))
+        np.testing.assert_allclose(val[ji], fit[idx[ji]], rtol=1e-5)
+
+
+def test_refine_places_spilled_jobs():
+    """Slot-cap overflow spills to the refinement round instead of
+    silently dropping: with refinement on, the spilled jobs place."""
+    demands, avail, totals = dense_problem(256, 128, seed=7)
+    problem = as_problem(demands, avail, totals)
+    # 16-slot blocks on a 256-job problem force heavy spill
+    base = dict(nodes_per_block=32, jobs_per_block=16, chunk=16, kc=16)
+    _, stats0 = hierarchical_match(
+        problem, params=HierParams(refine_rounds=0, **base))
+    assert stats0["spilled"] > 0
+    result2, stats2 = hierarchical_match(
+        problem, params=HierParams(refine_rounds=4, **base))
+    assert stats2["placed"] > stats0["placed"]
+    assert stats2["refine_placed"] > 0
+    assert_valid(demands, avail[:, :3], np.asarray(result2.assignment))
+
+
+def test_one_fine_program_across_block_counts():
+    """The acceptance pin: >= 3 different real block counts (3, 5, 8 —
+    none dividing into the next) pad onto the SAME fine batch shape via
+    invalid_match_problem lanes, so the CompileObservatory sees exactly
+    ONE match_fine XLA program, with the mesh engaged."""
+    mesh = make_mesh()  # 8 virtual cpu devices (conftest)
+    observatory = CompileObservatory()
+    npb, slots = 32, 128
+    for blocks in (3, 5, 8):
+        n = blocks * npb
+        demands, avail, totals = dense_problem(256, n, seed=blocks)
+        problem = as_problem(demands, avail, totals)
+        result, stats = hierarchical_match(
+            problem,
+            params=HierParams(nodes_per_block=npb, jobs_per_block=slots,
+                              chunk=64, kc=32),
+            mesh=mesh, observatory=observatory)
+        assert stats["blocks"] == blocks
+        assert stats["block_pad"] == 8
+        a = np.asarray(result.assignment)
+        assert_valid(demands, avail[:, :3], a)
+        # zero phantom matches: every placement indexes a REAL node of a
+        # REAL block — the invalid padding lanes contribute nothing
+        placed = a[a >= 0]
+        assert (placed < n).all()
+        assert (a >= 0).sum() > 0
+    stats = observatory.stats()
+    assert stats["match_fine"]["programs"] == 1
+    # the coarse pass shares one program across block counts too
+    assert stats["match_coarse"]["programs"] == 1
+
+
+def test_degraded_hierarchical_raises_quality_drift():
+    """QualityMonitor guard: a degraded hierarchical solve (starved slot
+    caps, no refinement — the failure mode of a bad tuned config) drops
+    packing efficiency through the parity floor and surfaces
+    quality-drift."""
+    from types import SimpleNamespace
+
+    demands, avail, totals = dense_problem(256, 128, seed=13)
+    problem = as_problem(demands, avail, totals)
+    result, stats = hierarchical_match(
+        problem, params=HierParams(nodes_per_block=32, jobs_per_block=16,
+                                   refine_rounds=0, chunk=16, kc=16))
+    assert stats["spilled"] > 0  # genuinely degraded
+    monitor = QualityMonitor(sample_every=1, floor=0.97)
+    prepared = SimpleNamespace(problem=problem, nodes=None,
+                               considerable=[object()] * 256,
+                               feasible=None)
+    ratio = monitor.observe_cycle(prepared, np.asarray(result.assignment),
+                                  "xl-pool")
+    assert ratio is not None and ratio < 0.97
+    drifting = monitor.drifting_pools()
+    assert "xl-pool" in drifting
+    assert drifting["xl-pool"]["kind"] == "parity-floor"
+
+
+# ------------------------------------------------------ scheduler wiring
+
+
+def _scenario(match_config):
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.entities import Job, Pool, Resources
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    hosts = [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=32768.0,
+                      cpus=16.0, pool="default") for i in range(64)]
+    cluster = MockCluster("mock", hosts, clock=clock)
+    scheduler = Scheduler(store, [cluster],
+                          SchedulerConfig(match=match_config))
+    rng = np.random.default_rng(5)
+    jobs = [
+        Job(uuid=f"j{i:04d}", user=f"u{i % 4}", pool="default", priority=50,
+            resources=Resources(mem=float(rng.choice([512, 1024, 2048])),
+                                cpus=float(rng.choice([1, 2]))),
+            command="true")
+        for i in range(300)
+    ]
+    store.submit_jobs(jobs)
+    return store, scheduler
+
+
+def _hier_config(**kw):
+    from cook_tpu.scheduler.matcher import MatchConfig
+
+    return MatchConfig(chunk=64, chunk_kc=32, quality_audit_every=0,
+                       hierarchical_threshold=1,
+                       hierarchical_nodes_per_block=16, **kw)
+
+
+def test_match_cycle_hierarchical_threshold_and_record():
+    """Above the threshold the serial cycle routes to the two-level
+    matcher: jobs place, and the CycleRecord carries the hierarchical
+    identity (backend label, block count, coarse/fine/refine walls,
+    per-block stats)."""
+    store, scheduler = _scenario(_hier_config())
+    pool = store.pools["default"]
+    outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) > 250
+    record = scheduler.recorder.records(limit=1)[0]
+    assert record.hierarchical
+    assert record.backend.startswith("hier-")
+    assert record.hier_blocks == 4  # 64 hosts / 16 per block
+    assert set(record.hier_phases) == {"coarse_solve", "fine_solve",
+                                       "refine"}
+    assert record.hier_phases["coarse_solve"] > 0
+    assert len(record.block_stats) == record.hier_blocks
+    assert sum(b["jobs"] for b in record.block_stats) <= 300
+    # the record round-trips to JSON with the new fields
+    as_json = record.to_json()
+    assert as_json["hierarchical"] and as_json["hier_blocks"] == 4
+
+
+def test_match_cycle_below_threshold_stays_flat():
+    config = _hier_config()
+    config.hierarchical_threshold = 10**9  # never reached at this size
+    store, scheduler = _scenario(config)
+    outcome = scheduler.match_cycle(store.pools["default"])
+    assert len(outcome.matched) > 250
+    record = scheduler.recorder.records(limit=1)[0]
+    assert not record.hierarchical
+    assert not record.backend.startswith("hier-")
+
+
+def test_batched_cycle_routes_hierarchical_pools():
+    """match_cycle_all_pools must honor the threshold too: an
+    over-threshold pool solves through the two-level path (its record
+    carries the hierarchical identity) instead of riding the flat
+    batched kernel."""
+    store, scheduler = _scenario(_hier_config())
+    outcomes = scheduler.match_cycle_all_pools()
+    assert len(outcomes["default"].matched) > 250
+    record = scheduler.recorder.records(limit=1)[0]
+    assert record.batched and record.hierarchical
+    assert record.backend.startswith("hier-")
+    assert record.hier_blocks == 4
+
+
+def test_pipelined_cycle_threads_hierarchical():
+    store, scheduler = _scenario(_hier_config())
+    outcomes = scheduler.match_cycle_pipelined()
+    assert len(outcomes["default"].matched) > 250
+    record = scheduler.recorder.records(limit=1)[0]
+    assert record.pipelined and record.hierarchical
+    assert record.backend.startswith("hier-")
+
+
+def test_hierarchical_solve_error_rides_fallback_ladder():
+    """A raising hierarchical solve degrades through the PR 7 ladder:
+    the failing cycle re-solves on the CPU reference (no cycle lost) and
+    the pool reports device-degraded until a probe succeeds."""
+    from cook_tpu import faults
+
+    store, scheduler = _scenario(_hier_config(device_fallback_cycles=2))
+    pool = store.pools["default"]
+    with faults.injected({"point": faults.DEVICE_SOLVE, "times": 1}):
+        outcome = scheduler.match_cycle(pool)
+    assert len(outcome.matched) > 250  # CPU fallback solved THIS cycle
+    record = scheduler.recorder.records(limit=1)[0]
+    assert record.backend == "cpu-fallback"
+    assert scheduler.telemetry.device_fallbacks()  # degraded episode open

@@ -335,3 +335,69 @@ def test_chunked_match_tight_capacity_efficiency(seed):
     qf = ref.packing_quality(demands, fast)
     assert qf["cpus_placed"] >= 0.99 * qe["cpus_placed"]
     assert qf["mem_placed"] >= 0.99 * qe["mem_placed"]
+
+
+def _xl_problem(j, n, j_real, seed):
+    rng = np.random.default_rng(seed)
+    demands = np.stack([
+        rng.choice([512, 1024, 2048, 4096, 8192], j).astype(np.float32),
+        rng.choice([0.5, 1, 2, 4], j).astype(np.float32),
+        np.zeros(j, np.float32)], axis=-1)
+    totals = np.stack([np.full(n, 65536.0, np.float32),
+                       np.full(n, 32.0, np.float32)], axis=-1)
+    avail = np.concatenate(
+        [totals * rng.uniform(0.2, 1.0, (n, 1)).astype(np.float32),
+         np.zeros((n, 1), np.float32)], axis=-1)
+    job_valid = np.zeros(j, bool)
+    job_valid[:j_real] = True
+    problem = MatchProblem(jnp.asarray(demands), jnp.asarray(job_valid),
+                           jnp.asarray(avail), jnp.asarray(totals),
+                           jnp.ones(n, bool), None)
+    return demands, avail, totals, problem
+
+
+def _assert_chunk_boundary_invariants(demands, avail, totals, problem,
+                                      j_real, chunk):
+    """The XL verification the satellite asks for: across MANY chunk
+    boundaries, the conflict-resolution rounds must never oversubscribe
+    a node, new_avail must equal avail minus exactly the placed demand,
+    the padded job tail must stay empty, and packing must stay within 2%
+    of the flat sequential reference."""
+    result = chunked_match(problem, chunk=chunk, rounds=3, kc=64, passes=2)
+    a = np.asarray(result.assignment)
+    new_avail = np.asarray(result.new_avail)
+    assert (a[j_real:] == -1).all(), "padded tail jobs were placed"
+    placed = a >= 0
+    n = avail.shape[0]
+    use = np.zeros((n, 3), np.float64)
+    np.add.at(use, a[placed], demands[placed].astype(np.float64))
+    over = use - avail[:, :3].astype(np.float64)
+    assert over.max() <= 1e-2, f"oversubscribed by {over.max()}"
+    drift = np.abs(avail[:, :3].astype(np.float64) - use
+                   - new_avail[:, :3].astype(np.float64)).max()
+    assert drift <= 1e-2, f"new_avail inconsistent by {drift}"
+    flat = ref.np_greedy_match(demands[:j_real], avail[:, :3], totals)
+    qf = ref.packing_quality(demands[:j_real], flat)
+    qc = ref.packing_quality(demands[:j_real], a[:j_real])
+    assert qc["cpus_placed"] >= 0.98 * qf["cpus_placed"]
+
+
+def test_chunked_match_boundary_invariants_16k():
+    """Fast tier of the XL verification (16k jobs x 512 nodes, 16 chunk
+    boundaries) — runs in tier-1; the >= 64k tier is the slow test
+    below."""
+    demands, avail, totals, problem = _xl_problem(16384, 512, 16_000,
+                                                  seed=41)
+    _assert_chunk_boundary_invariants(demands, avail, totals, problem,
+                                      16_000, chunk=1024)
+
+
+@pytest.mark.slow
+def test_chunked_match_boundary_invariants_xl():
+    """The satellite's >= 64k-job verification: 65536 jobs x 1024 nodes,
+    64 chunk boundaries, checked against the flat reference.  (Run
+    explicitly: tier-1 excludes `slow`.)"""
+    demands, avail, totals, problem = _xl_problem(65536, 1024, 65_000,
+                                                  seed=42)
+    _assert_chunk_boundary_invariants(demands, avail, totals, problem,
+                                      65_000, chunk=1024)

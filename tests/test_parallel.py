@@ -176,3 +176,39 @@ def test_task_sharded_dru_parity(mesh):
                                rtol=1e-5)
     np.testing.assert_array_equal(np.asarray(got.order),
                                   np.asarray(want.order))
+
+
+def test_invalid_pool_padding_no_phantom_output(mesh):
+    """Regression for the invalid_match_problem padding edge: a pool (or
+    hierarchical block) count NOT divisible by the mesh size pads with
+    all-invalid lanes — those lanes must contribute ZERO assignments and
+    leave their (zero) availability untouched, while the real lanes
+    reproduce the single-device solve exactly."""
+    from cook_tpu.parallel.mesh import invalid_match_problem
+
+    real = make_pool_batch(n_pools=3, j=64, n=16, seed=21)
+    pad = invalid_match_problem(64, 16, n_res=real.demands.shape[-1])
+    problems = jax.tree.map(
+        lambda r, d: jnp.concatenate(
+            [r, jnp.broadcast_to(d, (5,) + d.shape)]),
+        real, pad)
+    problems = shard_pools(mesh, problems)
+    got = pool_sharded_match(mesh, problems)
+    a = np.asarray(got.assignment)
+    assert (a[3:] == -1).all(), "padded lanes produced phantom matches"
+    np.testing.assert_array_equal(np.asarray(got.new_avail[3:]), 0.0)
+    want = jax.vmap(greedy_match)(real)
+    np.testing.assert_array_equal(a[:3], np.asarray(want.assignment))
+
+
+def test_pool_sharded_match_without_constraint_mask(mesh):
+    """feasible=None batches (the hierarchical fine solve at XL sizes,
+    where a [J, N] mask would be GBs) shard with a None spec lane."""
+    real = make_pool_batch(n_pools=8, j=64, n=16, seed=33)
+    unmasked = real._replace(feasible=None)
+    unmasked = shard_pools(mesh, unmasked)
+    got = pool_sharded_match(mesh, unmasked, chunk=64, rounds=3, passes=2,
+                             kc=8)
+    a = np.asarray(got.assignment)
+    assert (a >= 0).sum() > 0
+    assert np.all(np.asarray(got.new_avail) >= -1e-3)

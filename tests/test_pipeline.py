@@ -121,10 +121,10 @@ def test_solve_failure_does_not_wedge_neighbor_pools(monkeypatch):
         def fetch(self):
             raise RuntimeError("injected device error")
 
-    def dispatch(prepared, config):
+    def dispatch(prepared, config, **kw):
         if prepared.pool.name == "pool1":
             return Boom()
-        return real_dispatch(prepared, config)
+        return real_dispatch(prepared, config, **kw)
 
     monkeypatch.setattr(pipeline_mod, "dispatch_pool_solve", dispatch)
     outcomes = scheduler.match_cycle_pipelined()

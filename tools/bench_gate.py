@@ -10,7 +10,13 @@ tier.  This gate:
      --dir, sorted by round number then mtime);
   2. keeps only comparable pairs — same schema, same mode, same platform
      (a CPU-fallback round must not "regress" against a real-TPU round);
-  3. compares each phase's p50_ms in the newest record against the
+  3. REFUSES to diff two records taken on different resolved JAX
+     backends (record-level `backend`, and per phase when phases carry
+     their own): a silent CPU-fallback round diffed against a real
+     accelerator round is not a regression signal, it is a measurement
+     error — the gate fails loudly instead of comparing.  Records
+     predating the backend stamp compare as before;
+  4. compares each phase's p50_ms in the newest record against the
      previous comparable one; any phase slower by more than --threshold
      (default 20%) AND by more than --min-delta-ms (default 2 ms,
      absolute) fails the gate — the absolute floor keeps sub-10 ms
@@ -53,8 +59,13 @@ def load_record(path: str) -> dict | None:
         "path": path,
         "mode": data.get("mode", "full"),
         "platform": data.get("platform", "unknown"),
+        # resolved JAX backend of the run (None on records predating the
+        # stamp); kept per phase too, so one phase measured on a
+        # different backend refuses on its own
+        "backend": data.get("backend"),
         "phases": {
-            name: float(info["p50_ms"])
+            name: {"p50_ms": float(info["p50_ms"]),
+                   "backend": info.get("backend")}
             for name, info in phases.items()
             if isinstance(info, dict) and "p50_ms" in info
         },
@@ -98,9 +109,29 @@ def gate(records: list[dict], threshold: float,
             f"bench_gate: {old['path']} -> {new['path']} "
             f"(mode={mode}, platform={platform}, "
             f"threshold {threshold:.0%})")
+        if (old.get("backend") and new.get("backend")
+                and old["backend"] != new["backend"]):
+            # diffing across backends is a measurement error, not a
+            # regression signal; refuse the pair loudly
+            messages.append(
+                f"bench_gate: REFUSED — records were taken on different "
+                f"resolved JAX backends ({old['backend']} vs "
+                f"{new['backend']}); re-run the bench on matching "
+                f"hardware before gating")
+            regressed_families += 1
+            continue
         regressions = []
         for phase in sorted(set(old["phases"]) & set(new["phases"])):
-            before, after = old["phases"][phase], new["phases"][phase]
+            oinfo, ninfo = old["phases"][phase], new["phases"][phase]
+            if (oinfo.get("backend") and ninfo.get("backend")
+                    and oinfo["backend"] != ninfo["backend"]):
+                messages.append(
+                    f"bench_gate:   {phase}: REFUSED — measured on "
+                    f"different backends ({oinfo['backend']} vs "
+                    f"{ninfo['backend']})")
+                regressions.append(f"{phase} (cross-backend)")
+                continue
+            before, after = oinfo["p50_ms"], ninfo["p50_ms"]
             if before <= 0:
                 continue
             delta = (after - before) / before

@@ -9,16 +9,20 @@ wrapper runs them as one pipeline with one verdict:
   1. `tools/lint_metrics.py`   — metric/span registration lint + the
      docs/observability.md catalog drift check;
   2. `python bench.py --smoke` — the tiny bench tier:
-     match/dru/rebalance/elastic solves, the pipelined-vs-serial
-     match-cycle comparison, AND the `control_plane` phase — the
-     loadtest (`tools/loadtest.py`, serial closed-loop so the gated p50
-     is commit SERVICE time, not same-process queueing jitter) against
-     an in-process control plane, so commit-ack p50/p99 is measured
-     every CI run (writes BENCH_rsmoke.json, rotating the previous
-     record to BENCH_rsmoke_prev.json so step 3 has a pair to diff);
+     match/dru/rebalance/elastic solves, the `match_xl` hierarchical
+     two-level solve (coarse/fine/refine phases, the 100k x 10k tier's
+     smoke variant), the pipelined-vs-serial match-cycle comparison,
+     AND the `control_plane` phase — the loadtest (`tools/loadtest.py`,
+     serial closed-loop so the gated p50 is commit SERVICE time, not
+     same-process queueing jitter) against an in-process control plane,
+     so commit-ack p50/p99 is measured every CI run (writes
+     BENCH_rsmoke.json, rotating the previous record to
+     BENCH_rsmoke_prev.json so step 3 has a pair to diff);
   3. `tools/bench_gate.py`     — phase-by-phase regression gate over
-     the latest comparable record pair (commit-ack p50 included, via
-     the control_plane phase);
+     the latest comparable record pair (commit-ack p50 and the
+     match_xl phases included), refusing pairs whose resolved JAX
+     backend differs (a CPU-fallback record never gates an
+     accelerator record);
   4. `tools/chaos.py --smoke`  — the fast chaos trio (fsync stall ->
      shed, launch failures -> breaker, device error -> CPU fallback):
      each scenario injects its fault, observes the /debug/health reason
