@@ -555,6 +555,59 @@ def bench_pipeline(jax, jnp, *, n_pools=6, hosts_per_pool=24,
     }
 
 
+def bench_speculation(*, smoke=False) -> dict:
+    """`speculation` phase: prediction-assisted speculative cycles
+    (scheduler/prediction.py) A/B on the seeded completion-heavy
+    wave-drain trace (sim/loadgen.completion_heavy_trace) — the SAME
+    simulator run with and without speculation.  Gated p50 is the
+    speculative run's cycle-start-to-first-launch latency (the window
+    speculation exists to close); the fraction of cycles served from a
+    committed speculation and the non-speculative baseline ride in the
+    record.  The ISSUE-10 acceptance bar is >= 20% of cycles served from
+    speculation with a measurably lower pre-launch p50."""
+    from cook_tpu.scheduler.core import SchedulerConfig
+    from cook_tpu.sim.loadgen import completion_heavy_trace
+    from cook_tpu.sim.simulator import SimConfig, Simulator
+
+    if smoke:
+        n_jobs, n_hosts, cycles = 24, 4, 40
+    else:
+        n_jobs, n_hosts, cycles = 192, 16, 80
+
+    def run(speculate):
+        jobs, hosts = completion_heavy_trace(jobs=n_jobs, hosts=n_hosts)
+        config = SimConfig(
+            cycle_ms=30_000, max_cycles=cycles, speculate=speculate,
+            scheduler=SchedulerConfig(device_telemetry=False),
+        )
+        return Simulator(jobs, hosts, config).run().speculation_stats()
+
+    # best-of-3 BOTH sides: the speculative pre-launch p50 is a
+    # sub-millisecond host measurement (the commit-validation wall) and
+    # a single run's p50 swings several ms under concurrent CPU load —
+    # the min is the honest "what the path costs" figure (the same
+    # robust-to-load idiom as the columnar rank-speed test), and the
+    # baseline gets the identical treatment so the A/B stays symmetric
+    base = min((run(False) for _ in range(3)),
+               key=lambda s: s["pre_launch_p50_ms"])
+    spec = min((run(True) for _ in range(3)),
+               key=lambda s: s["pre_launch_p50_ms"])
+    log(f"speculation {n_jobs} jobs x {n_hosts} hosts: hit fraction "
+        f"{spec['hit_fraction']:.2f} over {spec['cycles']} cycles; "
+        f"pre-launch p50 {spec['pre_launch_p50_ms']:.2f} ms speculative "
+        f"vs {base['pre_launch_p50_ms']:.2f} ms baseline")
+    return {
+        "speculation": {
+            "p50_ms": spec["pre_launch_p50_ms"],
+            "hit_fraction": spec["hit_fraction"],
+            "cycles": spec["cycles"],
+            "baseline_p50_ms": base["pre_launch_p50_ms"],
+            "jobs": n_jobs,
+            "hosts": n_hosts,
+        },
+    }
+
+
 def bench_control_plane(*, rps=150.0, duration_s=8.0, seed=13,
                         smoke=False) -> dict:
     """Control-plane write-path phase: sustained submit/query/kill
@@ -830,6 +883,7 @@ def device_main():
     control_plane = bench_control_plane()
     pipeline_phases = bench_pipeline(jax, jnp, n_pools=8, hosts_per_pool=96,
                                      jobs_per_pool=1536)
+    speculation_phases = bench_speculation()
     log(f"full-cycle estimate (rank+match+rebalance): "
         f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
     extra = f", dru_ms={dru_p50:.1f}, rebalance_ms={reb_p50:.1f}"
@@ -845,6 +899,7 @@ def device_main():
         "elastic_plan": {"p50_ms": elastic_p50, "pools": 64, "jobs": 16384},
         "control_plane": control_plane,
         **pipeline_phases,
+        **speculation_phases,
     }, headline), out=_record_out_arg())
     print(json.dumps(headline), flush=True)
 
@@ -876,6 +931,9 @@ def cpu_main():
         # the control plane never needed the accelerator; its phase is
         # measured at full scale even on the CPU fallback
         "control_plane": bench_control_plane(),
+        # the speculation A/B runs through the trace simulator on
+        # whatever backend is live — full scale here too
+        **bench_speculation(),
     }, headline), out=_record_out_arg())
     print(json.dumps(headline), flush=True)
 
@@ -966,6 +1024,10 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     # control plane: the smoke loadtest against an in-process server —
     # commit-ack latency under sustained submit/query/kill traffic
     phases["control_plane"] = bench_control_plane(smoke=True)
+
+    # prediction-assisted speculative cycles: the completion-heavy A/B
+    # (hit fraction + cycle-start-to-first-launch p50), tiny tier
+    phases.update(bench_speculation(smoke=True))
     return phases
 
 
@@ -1090,6 +1152,12 @@ def main():
     if probe == "error":
         log("probe failed fast (persistent init error, not a tunnel "
             "wedge) — skipping the retry window")
+        return
+    if os.environ.get("CI") or os.environ.get("BENCH_SMOKE"):
+        # CI-adjacent runs must not burn the full re-probe window on a
+        # machine that will never grow an accelerator (BENCH_r05 lost
+        # 600 s to exactly this); the CPU line already printed stands
+        log("CI run: skipping the device-upgrade re-probe window")
         return
     window = float(os.environ.get("BENCH_PROBE_WINDOW_S", "600"))
     interval = float(os.environ.get("BENCH_PROBE_INTERVAL_S", "120"))

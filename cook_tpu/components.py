@@ -262,6 +262,14 @@ def build_process(
         clusters,
         SchedulerConfig(match=settings.match, rebalancer=settings.rebalancer,
                         elastic=elastic_params,
+                        speculation=settings.speculation,
+                        speculation_horizon_ms=(
+                            settings.speculation_horizon_ms),
+                        predictor_quantile=settings.predictor_quantile,
+                        predictor_window=settings.predictor_window,
+                        predictor_min_samples=settings.predictor_min_samples,
+                        backfill_weight=settings.backfill_weight,
+                        backfill_norm_ms=settings.backfill_norm_ms,
                         incident_capacity=settings.incident_capacity,
                         incident_cooldown_s=settings.incident_cooldown_s,
                         incident_dir=incident_dir,

@@ -58,11 +58,24 @@ def dru_rank(
     gpu_div: jnp.ndarray,   # [U]
     *,
     gpu_mode: bool = False,
+    backfill: jnp.ndarray = None,        # [T] f32 in [0, 1], or None
+    backfill_weight: jnp.ndarray = None,  # scalar weight of the term
 ) -> DruResult:
     """Compute per-task cumulative DRU and the global fair-share order.
 
     gpu_mode selects the reference's `:pool.dru-mode/gpu` scoring
     (cumulative gpus/divisor) instead of max(mem, cpus) dominant share.
+
+    `backfill` is the predicted-duration column (scheduler/prediction.py):
+    a per-task normalized duration fraction in [0, 1] added to the DRU as
+    `dru + backfill_weight * fraction` BEFORE the global order sort, so
+    predicted-short jobs backfill ahead of predicted-long ones at
+    near-equal fairness.  BOUNDED by construction: the shift is at most
+    `backfill_weight`, so a short job can only jump jobs within that DRU
+    band — fairness inversions are capped, and weight 0 (or backfill
+    None) reproduces the unadjusted order bit-for-bit.  The returned
+    `dru` column stays the raw fair-share score either way (the term
+    reorders; it never rewrites the fairness accounting).
     """
     user = tasks.user
     valid = tasks.valid
@@ -97,7 +110,12 @@ def dru_rank(
     # global order: stable sort by dru, tie-broken by the per-user position
     # so the within-user order is preserved even on equal dru (critical: a
     # user's later task must never schedule before an earlier one).
-    order = lexsort_perm(dru, tasks.order_key)
+    score = dru
+    if backfill is not None:
+        w = backfill_weight if backfill_weight is not None else 0.0
+        score = jnp.where(valid,
+                          dru + w * jnp.clip(backfill, 0.0, 1.0), BIG)
+    order = lexsort_perm(score, tasks.order_key)
     rank = inverse_permutation(order)
     return DruResult(dru=dru, rank=rank.astype(jnp.int32),
                      order=order.astype(jnp.int32))

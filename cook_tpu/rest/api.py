@@ -274,6 +274,7 @@ class CookApi:
         r.add_get("/debug/faults", self.get_debug_faults)
         r.add_post("/debug/faults", self.post_debug_faults)
         r.add_get("/debug/elastic", self.get_debug_elastic)
+        r.add_get("/debug/predictions", self.get_debug_predictions)
         r.add_get("/debug/cycles", self.get_debug_cycles)
         r.add_get("/debug/cycles/{cycle_id}", self.get_debug_cycle)
         r.add_get("/debug/spans", self.get_debug_spans)
@@ -469,6 +470,26 @@ class CookApi:
                 if elastic is not None else []),
         }
         return web.json_response(body)
+
+    async def get_debug_predictions(self,
+                                    request: web.Request) -> web.Response:
+        """Prediction-assisted speculation surface (scheduler/
+        prediction.py): the runtime predictor's key/observation counts
+        and the speculator's dispatch/hit/drop tallies (drop reasons
+        included) — the operator view of how much of the match load is
+        being served ahead of the cycle clock."""
+        scheduler = self.scheduler
+        predictor = getattr(scheduler, "predictor", None) \
+            if scheduler is not None else None
+        speculator = getattr(scheduler, "speculator", None) \
+            if scheduler is not None else None
+        return web.json_response({
+            "enabled": speculator is not None,
+            "predictor": (predictor.stats_json()
+                          if predictor is not None else None),
+            "speculation": (speculator.stats_json()
+                            if speculator is not None else None),
+        })
 
     async def get_debug_cycles(self, request: web.Request) -> web.Response:
         """Flight-recorder ring: per-cycle structured decision records

@@ -65,6 +65,30 @@ class SchedulerConfig:
     # fan backend launches out on the per-cluster launch executors during
     # the pipelined pass (kills still exclude via the kill-lock)
     async_launch: bool = True
+    # prediction-assisted speculative cycles (scheduler/prediction.py):
+    # while cycle N's launches drain, cycle N+1's solve is pre-encoded
+    # and pre-dispatched against the predicted offer set; it commits at
+    # cycle N+1 start only if the stamped epoch is unchanged (a stale
+    # speculation is dropped, never repaired).  Off by default.
+    speculation: bool = False
+    # how far ahead (store-clock ms) a running task's predicted finish
+    # may sit and still be assumed complete by the speculative solve;
+    # the simulator pins this to its cycle_ms
+    speculation_horizon_ms: float = 30_000.0
+    # runtime predictor (per-(user, command-fingerprint) rolling
+    # quantiles; pluggable — ROADMAP item 5's learned model slots in)
+    predictor_quantile: float = 0.75
+    predictor_window: int = 64
+    predictor_min_samples: int = 3
+    # predicted-duration backfill (ops/dru.py): a bounded scoring term
+    # added to each pending task's DRU before the global order sort, so
+    # predicted-short jobs backfill ahead at near-equal fairness.  0
+    # disables (rank order untouched — the default); quality-guarded by
+    # the QualityMonitor like every approximate path.
+    backfill_weight: float = 0.0
+    # predicted duration that saturates the backfill term (fraction
+    # clamps to 1 at/above this)
+    backfill_norm_ms: float = 600_000.0
     # flight recorder: bounded ring of per-cycle decision records served
     # at GET /debug/cycles (flight_recorder.py); 0 disables
     flight_recorder_capacity: int = 512
@@ -153,6 +177,29 @@ class Scheduler:
             from cook_tpu.scheduler.encode_cache import EncodeCache
 
             self.encode_cache = EncodeCache(store)
+        # runtime prediction + speculative cycles (prediction.py):
+        # the predictor feeds from instance completions; the speculator
+        # pre-dispatches cycle N+1's solve while cycle N drains
+        self.predictor = None
+        self.speculator = None
+        if self.config.speculation or self.config.backfill_weight > 0:
+            from cook_tpu.scheduler.prediction import (
+                QuantileRuntimePredictor,
+            )
+
+            self.predictor = QuantileRuntimePredictor(
+                quantile=self.config.predictor_quantile,
+                window=self.config.predictor_window,
+                min_samples=self.config.predictor_min_samples,
+            ).attach(store)
+        if self.config.speculation:
+            from cook_tpu.scheduler.prediction import CycleSpeculator
+
+            self.speculator = CycleSpeculator(
+                store, self.clusters, self.predictor,
+                horizon_ms=self.config.speculation_horizon_ms,
+                encode_cache=self.encode_cache,
+            )
         self.pool_queues: dict[str, RankedQueue] = {}
         self.pool_match_state: dict[str, PoolMatchState] = {}
         self.last_unmatched_offers: dict[str, dict[str, Resources]] = {}
@@ -301,6 +348,41 @@ class Scheduler:
 
     # -------------------------------------------------------------- cycles
 
+    def _pool_capacity_probe(self, pool: Pool):
+        """(limits_active, max_mem, max_cpus, max_gpus) over the pool's
+        work-accepting clusters — the offensive-job filter's input
+        (scheduler.clj:2198-2257), shared by the rank cycle and the
+        speculative dispatch (whose predicted rank must apply the SAME
+        filter or the commit-time window-equality check can never pass).
+        An autoscaling cluster can grow capacity, so nothing is offensive
+        relative to its current nodes (limits inactive)."""
+        from cook_tpu.cluster.base import safe_pool_offers
+
+        max_mem = max_cpus = max_gpus = 0.0
+        autoscales = False
+        for cluster in self.clusters:
+            if not cluster.accepts_work:
+                continue
+            autoscales = autoscales or cluster.autoscaling(pool.name)
+            for offer in safe_pool_offers(cluster, pool.name) or ():
+                max_mem = max(max_mem, offer.total_mem or offer.mem)
+                max_cpus = max(max_cpus, offer.total_cpus or offer.cpus)
+                max_gpus = max(max_gpus, offer.gpus)
+        return max_mem > 0 and not autoscales, max_mem, max_cpus, max_gpus
+
+    def _offensive_filter(self, pool: Pool):
+        """The pool's current offensive-job filter (or None)."""
+        from cook_tpu.scheduler.ranking import offensive_job_filter
+
+        limits_active, max_mem, max_cpus, max_gpus = \
+            self._pool_capacity_probe(pool)
+        return (offensive_job_filter(max_mem, max_cpus, max_gpus)
+                if limits_active else None)
+
+    @property
+    def _backfill_active(self) -> bool:
+        return self.config.backfill_weight > 0 and self.predictor is not None
+
     def rank_cycle(self, pool: Pool) -> RankedQueue:
         # offensive-job filter: quarantine jobs no host in the pool could
         # ever hold (scheduler.clj:2198-2257)
@@ -310,22 +392,9 @@ class Scheduler:
 
         t_rank = _time.perf_counter()
 
-        from cook_tpu.cluster.base import safe_pool_offers
-
-        max_mem = max_cpus = max_gpus = 0.0
-        autoscales = False
-        for cluster in self.clusters:
-            if not cluster.accepts_work:
-                continue
-            # an autoscaling cluster can grow capacity, so nothing is
-            # offensive relative to its current nodes
-            autoscales = autoscales or cluster.autoscaling(pool.name)
-            for offer in safe_pool_offers(cluster, pool.name) or ():
-                max_mem = max(max_mem, offer.total_mem or offer.mem)
-                max_cpus = max(max_cpus, offer.total_cpus or offer.cpus)
-                max_gpus = max(max_gpus, offer.gpus)
-        limits_active = max_mem > 0 and not autoscales
-        if self.columnar is not None:
+        limits_active, max_mem, max_cpus, max_gpus = \
+            self._pool_capacity_probe(pool)
+        if self.columnar is not None and not self._backfill_active:
             from cook_tpu.scheduler.ranking_columnar import rank_pool_columnar
 
             queue = rank_pool_columnar(
@@ -334,9 +403,17 @@ class Scheduler:
                                  if limits_active else None),
             )
         else:
+            # predicted-duration backfill routes through the full encoder
+            # (the columnar fast path carries no duration column yet):
+            # the bounded term is added to the DRU tensor in ops/dru.py
             filt = (offensive_job_filter(max_mem, max_cpus, max_gpus)
                     if limits_active else None)
-            queue = rank_pool(self.store, pool, offensive_job_filter=filt)
+            queue = rank_pool(
+                self.store, pool, offensive_job_filter=filt,
+                predictor=(self.predictor if self._backfill_active
+                           else None),
+                backfill_weight=self.config.backfill_weight,
+                backfill_norm_ms=self.config.backfill_norm_ms)
         for uuid in queue.quarantined:
             self.placement_failures[uuid] = (
                 "The job's resource demands exceed every host in the pool."
@@ -426,22 +503,25 @@ class Scheduler:
         )
         self.admission.clamp(pool.name, state,
                              self.config.match.max_jobs_considered)
-        outcome = match_pool(
-            self.store,
-            pool,
-            queue,
-            self.clusters,
-            self.config.match,
-            state,
-            make_task_id=self._make_task_id,
-            launch_filter=self._make_launch_filter(),
-            record_placement_failure=self._record_placement_failure,
-            host_reservations=self.host_reservations,
-            host_attrs=self.host_attr_cache,
-            flight=flight,
-            telemetry=self.telemetry,
-            encode_cache=self.encode_cache,
-        )
+        outcome = self._try_speculative_cycle(pool, queue, state, flight)
+        if outcome is None:
+            outcome = match_pool(
+                self.store,
+                pool,
+                queue,
+                self.clusters,
+                self.config.match,
+                state,
+                make_task_id=self._make_task_id,
+                launch_filter=self._make_launch_filter(),
+                record_placement_failure=self._record_placement_failure,
+                host_reservations=self.host_reservations,
+                host_attrs=self.host_attr_cache,
+                flight=flight,
+                telemetry=self.telemetry,
+                encode_cache=self.encode_cache,
+                predictor=self.predictor,
+            )
         # charge launches against the per-user rate limiter (spend-through)
         if self.launch_rate_limiter is not None:
             for job, _ in outcome.matched:
@@ -482,7 +562,89 @@ class Scheduler:
         if flight.record is not None:
             flight.record.head_matched = outcome.head_matched
         self._commit_cycle(flight)
+        # speculate cycle N+1 while this cycle's work drains (launches
+        # in the serial path are synchronous, so every event this cycle
+        # produced has already landed — the guard token opens clean)
+        self._dispatch_speculation([pool])
         return outcome
+
+    # ------------------------------------------------ speculative cycles
+
+    def _speculation_commit(self, pool, queue, state, flight):
+        """One pool's speculation commit attempt (prediction.py commit
+        rule), recorded on the cycle record.  On a hit the cycle-record
+        bookkeeping a fresh prepare would have done (counts, rank
+        context, not-considered index, solve identity, quality sample)
+        runs here.  Returns the CommitResult, or None when no speculator
+        is attached."""
+        if self.speculator is None:
+            return None
+        from cook_tpu.obs.compile_observatory import shape_signature
+        from cook_tpu.scheduler.matcher import (
+            problem_shape,
+            record_considered,
+            solve_backend,
+        )
+
+        with flight.phase("speculation_commit"):
+            result = self.speculator.try_commit(
+                pool, queue, state, self.config.match,
+                launch_filter=self._make_launch_filter())
+        flight.note_speculation(result.status, result.reason)
+        if result.ok:
+            prepared = result.prepared
+            record_considered(flight, queue, prepared.considerable,
+                              len(prepared.cluster_offers))
+            # the backend label marks the cycle as speculative-served;
+            # no telemetry latency sample — the solve's wall spanned the
+            # previous cycle's drain, not this cycle's critical path
+            flight.note_solve(
+                shape_signature(problem_shape(prepared.problem)),
+                f"spec-{solve_backend(self.config.match)}", False)
+            if self.telemetry is not None:
+                self.telemetry.quality.observe_cycle(
+                    prepared, result.assignment, pool.name)
+        return result
+
+    def _try_speculative_cycle(self, pool, queue, state, flight):
+        """Serve the cycle from a committed speculation; None = solve
+        fresh (nothing in flight, or the speculation was dropped)."""
+        result = self._speculation_commit(pool, queue, state, flight)
+        if result is None or not result.ok:
+            return None
+        from cook_tpu.scheduler.matcher import finalize_pool_match
+
+        with flight.phase("launch"):
+            return finalize_pool_match(
+                self.store, result.prepared, result.assignment,
+                self.config.match, state, self.clusters,
+                make_task_id=self._make_task_id,
+                record_placement_failure=self._record_placement_failure,
+                flight=flight)
+
+    def _dispatch_speculation(self, pools) -> None:
+        """End-of-cycle speculative dispatch (prediction.py): predict the
+        completions the next cycle will see, pre-encode its problem and
+        start its solve — the device works through the drain and the
+        inter-cycle idle.  Must run AFTER the cycle's launches and their
+        store events have landed, or the guard would mark the fresh
+        speculation stale against our own events."""
+        if self.speculator is None:
+            return
+        for pool in pools:
+            state = self.pool_match_state.get(pool.name)
+            if state is None:
+                continue
+            self.speculator.dispatch(
+                pool, self.config.match, state,
+                launch_filter=self._make_launch_filter(),
+                host_reservations=self.host_reservations,
+                host_attrs=self.host_attr_cache,
+                offensive_job_filter=self._offensive_filter(pool),
+                predictor_for_rank=(self.predictor
+                                    if self._backfill_active else None),
+                backfill_weight=self.config.backfill_weight,
+                backfill_norm_ms=self.config.backfill_norm_ms)
 
     def match_cycle_all_pools(self, mesh=None) -> dict[str, MatchOutcome]:
         """Batched multi-pool match: every active pool's problem solved in
@@ -503,6 +665,7 @@ class Scheduler:
             flights=flights,
             telemetry=self.telemetry,
             encode_cache=self.encode_cache,
+            predictor=self.predictor,
         )
         self._finish_multi_pool_cycle(pools, outcomes, flights)
         return outcomes
@@ -518,6 +681,17 @@ class Scheduler:
         )
 
         pools, flights = self._begin_multi_pool_cycle()
+        # commit-or-drop each pool's in-flight speculation up front;
+        # committed pools enter the pipelined pass pre-solved (their
+        # solve ran while the PREVIOUS pass's launches drained)
+        speculative = {}
+        if self.speculator is not None:
+            for pool in pools:
+                result = self._speculation_commit(
+                    pool, self.pool_queues[pool.name],
+                    self.pool_match_state[pool.name], flights[pool.name])
+                if result is not None and result.ok:
+                    speculative[pool.name] = result
         outcomes = match_pools_pipelined(
             self.store, pools, self.pool_queues, self.clusters,
             self.config.match, self.pool_match_state,
@@ -532,8 +706,14 @@ class Scheduler:
             recorder=self.recorder,
             params=PipelineParams(depth=self.config.pipeline_depth,
                                   async_launch=self.config.async_launch),
+            predictor=self.predictor,
+            speculative=speculative,
         )
         self._finish_multi_pool_cycle(pools, outcomes, flights)
+        # the pass drained its async launches above (drain_launches
+        # default), so every launch event has landed: speculate the next
+        # pass's solves into the inter-cycle idle
+        self._dispatch_speculation(pools)
         return outcomes
 
     def drain_launches(self, timeout: Optional[float] = None) -> bool:

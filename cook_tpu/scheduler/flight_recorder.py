@@ -107,6 +107,16 @@ class CycleRecord:
     pipeline_wall_s: float = 0.0
     overlap_s: float = 0.0
     overlap_fraction: float = 0.0
+    # prediction-assisted speculation (scheduler/prediction.py): was this
+    # cycle served from a speculative solve dispatched while the PREVIOUS
+    # cycle drained?  `speculation` is the commit attempt's outcome
+    # ("hit" | "dropped" | "none"; "" on schedulers without a speculator)
+    # and `speculation_drop` the drop/skip reason (epoch-stale /
+    # prediction-miss / offers-changed / queue-shifted / predictor-cold /
+    # disabled / solve-error)
+    speculative: bool = False
+    speculation: str = ""
+    speculation_drop: str = ""
     phases: dict[str, float] = field(default_factory=dict)   # name -> seconds
     device_s: float = 0.0
     host_s: float = 0.0
@@ -161,6 +171,9 @@ class CycleRecord:
             "pipeline_wall_s": self.pipeline_wall_s,
             "overlap_s": self.overlap_s,
             "overlap_fraction": self.overlap_fraction,
+            "speculative": self.speculative,
+            "speculation": self.speculation,
+            "speculation_drop": self.speculation_drop,
             "phases": dict(self.phases),
             "device_s": self.device_s,
             "host_s": self.host_s,
@@ -255,6 +268,14 @@ class CycleBuilder:
         self.rank_jobs = jobs
         self.rank_dru = dru
 
+    def note_speculation(self, status: str, reason: str = "") -> None:
+        """Record the cycle's speculation-commit outcome ("hit" /
+        "dropped" / "none") and, for drops/skips, the reason code
+        (scheduler/prediction.py DROP_* constants)."""
+        self.record.speculation = status
+        self.record.speculation_drop = reason
+        self.record.speculative = status == "hit"
+
     def note_hierarchical(self, stats: dict) -> None:
         """Fold a two-level solve's accounting (ops/hierarchical.py
         stats) into the record: block geometry, coarse/fine/refine walls,
@@ -338,6 +359,9 @@ class NullCycle:
         pass
 
     def set_rank_context(self, *a) -> None:
+        pass
+
+    def note_speculation(self, *a, **kw) -> None:
         pass
 
     def note_hierarchical(self, *a) -> None:

@@ -660,13 +660,17 @@ AGENT_REMOVED_CODES = _agent_removed_codes()
 
 
 def estimated_end_times(store: JobStore, jobs: Sequence[Job],
-                        config: MatchConfig) -> Optional[np.ndarray]:
+                        config: MatchConfig,
+                        predictor=None) -> Optional[np.ndarray]:
     """Per-job estimated completion time in epoch ms, -1 = no estimate
     (build-estimated-completion-constraint, constraints.clj:410-432):
     max of scaled expected runtime and the runtimes of instances that
     died with the host (agent-removed analogs), capped at
     host-lifetime - grace so a full-lifetime job can still start on a
-    fresh host."""
+    fresh host.  `predictor` (scheduler/prediction.py) supplies an
+    observed-runtime estimate for jobs that declared no
+    expected_runtime_ms — the predicted-duration column threaded into
+    the match feasibility tensor."""
     if not (config.completion_multiplier > 0
             and config.host_lifetime_mins > 0):
         return None
@@ -675,8 +679,12 @@ def estimated_end_times(store: JobStore, jobs: Sequence[Job],
               - config.agent_start_grace_mins) * 60_000.0
     out = np.full(len(jobs), -1.0)
     for ji, job in enumerate(jobs):
-        expected = (job.expected_runtime_ms * config.completion_multiplier
-                    if job.expected_runtime_ms else 0.0)
+        runtime = job.expected_runtime_ms
+        if not runtime and predictor is not None:
+            runtime = predictor.predict_runtime_ms(job.user,
+                                                   job.command) or 0.0
+        expected = (runtime * config.completion_multiplier
+                    if runtime else 0.0)
         for inst in store.job_instances(job.uuid):
             if (inst.status.terminal
                     and inst.reason_code in AGENT_REMOVED_CODES
@@ -716,6 +724,30 @@ def previous_failed_hosts(store: JobStore, jobs: Sequence[Job]) -> dict[str, set
         if hosts:
             out[job.uuid] = hosts
     return out
+
+
+def record_considered(flight, queue, considerable, offers_count: int) -> None:
+    """Cycle-record bookkeeping for a selected considerable window —
+    shared by the fresh prepare and the speculative-commit path (a cycle
+    served from speculation must report the same counts, rank context,
+    and not-considered index a fresh prepare would).
+
+    The rank context is attached by reference (rank_cycle replaces,
+    never mutates); the not-considered indexing is skipped entirely when
+    no recorder is attached — it is O(queue) work on the latency-
+    critical match path."""
+    flight.set_counts(offers=offers_count, queue_len=len(queue.jobs),
+                      considered=len(considerable))
+    flight.set_rank_context(queue.jobs, queue.dru)
+    if flight is not NULL_CYCLE and len(considerable) < len(queue.jobs):
+        # jobs in the ranked queue but outside this cycle's considerable
+        # window (cap, quota, launch filter, dead-in-queue): indexed so
+        # /unscheduled_jobs answers with the CURRENT reason, not a stale
+        # decision from the last cycle that did consider them
+        selected = {j.uuid for j in considerable}
+        for job in queue.jobs:
+            if job.uuid not in selected:
+                flight.note_not_considered(job.uuid)
 
 
 @dataclass
@@ -761,6 +793,7 @@ def prepare_pool_problem(
     host_attrs: Optional[dict[str, dict]] = None,
     flight=NULL_CYCLE,
     encode_cache=None,
+    predictor=None,
 ) -> PreparedPool:
     """Gather offers + considerable jobs and encode the tensor problem.
 
@@ -799,28 +832,13 @@ def prepare_pool_problem(
         store, pool, queue, state.num_considerable, launch_filter=launch_filter
     )
     considerable = prepared.considerable
-    flight.set_counts(offers=len(prepared.cluster_offers),
-                      queue_len=len(queue.jobs),
-                      considered=len(considerable))
-    # rank context for the per-job cycle history (references, not
-    # copies): commit stamps each decision with queue position + DRU so
-    # GET /jobs/{uuid}/timeline can attribute waits to placement rank
-    flight.set_rank_context(queue.jobs, queue.dru)
-    if flight is not NULL_CYCLE and len(considerable) < len(queue.jobs):
-        # jobs in the ranked queue but outside this cycle's considerable
-        # window (cap, quota, launch filter, dead-in-queue): indexed so
-        # /unscheduled_jobs answers with the CURRENT reason, not a stale
-        # decision from the last cycle that did consider them.  Skipped
-        # entirely when no recorder is attached — this is O(queue) work
-        # on the latency-critical match path.
-        selected = {j.uuid for j in considerable}
-        for job in queue.jobs:
-            if job.uuid not in selected:
-                flight.note_not_considered(job.uuid)
+    record_considered(flight, queue, considerable,
+                      len(prepared.cluster_offers))
     if not considerable or not prepared.cluster_offers:
         return prepared
 
-    est_end_ms = estimated_end_times(store, considerable, config)
+    est_end_ms = estimated_end_times(store, considerable, config,
+                                     predictor=predictor)
     use_cache = encode_cache is not None and est_end_ms is None
     if use_cache:
         nodes, nodes_fp = encode_cache.encoded_nodes(
@@ -1256,6 +1274,7 @@ def match_pool(
     flight=NULL_CYCLE,
     telemetry=None,
     encode_cache=None,
+    predictor=None,
 ) -> MatchOutcome:
     """One pool's match cycle end to end (prepare -> solve -> finalize)."""
     import time as _time
@@ -1265,6 +1284,7 @@ def match_pool(
             store, pool, queue, clusters, config, state,
             launch_filter=launch_filter, host_reservations=host_reservations,
             host_attrs=host_attrs, flight=flight, encode_cache=encode_cache,
+            predictor=predictor,
         )
     assignment = np.empty(0, dtype=np.int32)
     if prepared.solvable:
@@ -1337,6 +1357,7 @@ def match_pools_batched(
     flights: Optional[dict] = None,
     telemetry=None,
     encode_cache=None,
+    predictor=None,
 ) -> dict[str, MatchOutcome]:
     """Solve EVERY pool's match problem in one batched device call.
 
@@ -1369,6 +1390,7 @@ def match_pools_batched(
                 states[pool.name], launch_filter=launch_filter,
                 host_reservations=host_reservations, host_attrs=host_attrs,
                 flight=flight, encode_cache=encode_cache,
+                predictor=predictor,
             ))
     # reaction (c) parity with the per-pool paths: pools already in
     # fallback mode solve host-side this cycle; the rest join the batch

@@ -78,8 +78,13 @@ log = logging.getLogger(__name__)
 # the pass wall (rank/preemption_search run outside the pipelined pass).
 # The four walls are DISJOINT per pool: the solve interval starts where
 # the dispatch phase ends, so nothing is double-counted and a pass that
-# degenerated to serial genuinely reports overlap 0
-PIPELINE_PHASES = ("tensor_build", "dispatch", "solve", "launch")
+# degenerated to serial genuinely reports overlap 0.
+# speculation_commit is the (tiny) validation wall of a pool served from
+# a committed speculative solve — such pools have no tensor_build /
+# dispatch / solve phases this cycle (that work ran during the PREVIOUS
+# cycle's drain; scheduler/prediction.py)
+PIPELINE_PHASES = ("tensor_build", "dispatch", "solve", "launch",
+                   "speculation_commit")
 
 
 @dataclass
@@ -110,6 +115,11 @@ class _Stage:
     pending: object = None          # PendingResult or None
     t_dispatch: float = 0.0
     fallback_reason: str = ""       # non-empty = CPU-fallback cycle
+    # committed speculative assignment (scheduler/prediction.py): the
+    # solve already ran during the previous cycle's drain — this stage
+    # skips dispatch/fetch entirely and finalizes at the queue head
+    # without holding a device-buffer slot
+    speculative_assignment: object = None
 
 
 def match_pools_pipelined(
@@ -130,14 +140,22 @@ def match_pools_pipelined(
     encode_cache=None,
     recorder=None,
     params: Optional[PipelineParams] = None,
+    predictor=None,
+    speculative: Optional[dict] = None,
 ) -> dict[str, MatchOutcome]:
     """Run every pool's match cycle through the pipelined engine.
 
     Same decision semantics as looping `matcher.match_pool` over the
     pools (the parity test pins this); only the schedule differs.
+
+    `speculative` maps pool name -> a COMMITTED prediction.CommitResult
+    (validated by the caller against the speculation commit rule): such
+    pools skip prepare + dispatch entirely — their solve already ran
+    while the previous cycle drained — and finalize straight away.
     """
     params = params or PipelineParams()
     flights = flights or {}
+    speculative = speculative or {}
     outcomes: dict[str, MatchOutcome] = {}
 
     def pool_flight(pool_name: str):
@@ -166,7 +184,12 @@ def match_pools_pipelined(
         """Fetch + finalize one pool.  Called strictly in pool order."""
         flight = stage.flight
         assignment = np.empty(0, dtype=np.int32)
-        if stage.pending is not None:
+        if stage.speculative_assignment is not None:
+            # cycle served from a committed speculation: the solve's
+            # telemetry/fallback protocol already ran when the
+            # speculation was validated — straight to the launch phase
+            assignment = stage.speculative_assignment
+        elif stage.pending is not None:
             solve_failed = False
             t_fetch = time.perf_counter()
             try:
@@ -259,13 +282,28 @@ def match_pools_pipelined(
     for pool in pools:
         flight = pool_flight(pool.name)
         state = states[pool.name]
+        hit = speculative.get(pool.name)
+        if hit is not None:
+            # pre-solved pool: no prepare, no dispatch, no buffer slot —
+            # pending stays None, so the drain condition below finalizes
+            # it as soon as it reaches the queue head (pool-order commits
+            # still hold; finish() routes via speculative_assignment)
+            inflight.append(_Stage(
+                pool=pool, prepared=hit.prepared, state=state,
+                flight=flight, speculative_assignment=hit.assignment))
+            while inflight and (
+                    inflight[0].pending is None
+                    or sum(1 for s in inflight if s.pending is not None)
+                    >= depth):
+                finish(inflight.popleft())
+            continue
         with flight.phase("tensor_build"):
             prepared = prepare_pool_problem(
                 store, pool, queues[pool.name], clusters, config, state,
                 launch_filter=launch_filter,
                 host_reservations=host_reservations,
                 host_attrs=host_attrs, flight=flight,
-                encode_cache=encode_cache,
+                encode_cache=encode_cache, predictor=predictor,
             )
         stage = _Stage(pool=pool, prepared=prepared, state=state,
                        flight=flight)

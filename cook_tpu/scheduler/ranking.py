@@ -139,8 +139,20 @@ def rank_pool(
     pool: Pool,
     *,
     offensive_job_filter=None,
+    predictor=None,
+    backfill_weight: float = 0.0,
+    backfill_norm_ms: float = 600_000.0,
 ) -> RankedQueue:
-    """Rank one pool's pending jobs by cumulative DRU."""
+    """Rank one pool's pending jobs by cumulative DRU.
+
+    With `predictor` (scheduler/prediction.py) and a positive
+    `backfill_weight`, each pending task carries a predicted-duration
+    column into the DRU kernel: fraction = min(predicted_runtime /
+    backfill_norm_ms, 1), no-estimate jobs pinned at 1 (never boosted).
+    The kernel adds `weight x fraction` to the DRU before the global
+    order sort — short-job backfill as a bounded scoring term
+    (arXiv:1106.4985), not a separate pass.  Weight 0 (the default)
+    reproduces the unadjusted order exactly."""
     pool_name = pool.name
     pending = store.pending_jobs(pool_name)
     quarantined: list[str] = []
@@ -220,6 +232,19 @@ def rank_pool(
         cpu_div[i] = min(share.cpus, BIG)
         gpu_div[i] = min(share.gpus, BIG)
 
+    # predicted-duration backfill column: pending tasks with an estimate
+    # get fraction = min(est / norm, 1); everything else (running tasks,
+    # cold keys) pins at 1.0 — neutral-worst, so an unestimated job is
+    # never boosted past an estimated one
+    backfill = None
+    if predictor is not None and backfill_weight > 0:
+        backfill = np.ones(n, dtype=np.float32)
+        norm = max(float(backfill_norm_ms), 1.0)
+        for k, job in enumerate(pending):
+            est = predictor.predict_runtime_ms(job.user, job.command)
+            if est is not None:
+                backfill[len(running) + k] = min(est / norm, 1.0)
+
     pad_t = bucket_size(n)
     tasks = DruTasks(
         user=jnp.asarray(pad_to(user, pad_t)),
@@ -235,6 +260,10 @@ def rank_pool(
         jnp.asarray(cpu_div),
         jnp.asarray(gpu_div),
         gpu_mode=(pool.dru_mode == DruMode.GPU),
+        backfill=(jnp.asarray(pad_to(backfill, pad_t, fill=1.0))
+                  if backfill is not None else None),
+        backfill_weight=(jnp.float32(backfill_weight)
+                         if backfill is not None else None),
     )
     order = np.asarray(result.order[:])
     dru = np.asarray(result.dru[:])

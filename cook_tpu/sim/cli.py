@@ -39,6 +39,7 @@ def cmd_run(args) -> int:
         elastic_every=(args.elastic_every if args.elastic else 0),
         max_cycles=args.max_cycles,
         batched_match=args.batched,
+        speculate=args.speculate,
         fault_schedule=fault_schedule,
         scheduler=SchedulerConfig(
             # chunk/backend default to the hardware-tuned config
@@ -105,6 +106,10 @@ def cmd_run(args) -> int:
         "queued_wait_p50_ms": (
             sorted(waits)[len(waits) // 2]
             if (waits := result.queued_wait_ms()) else None),
+        # speculation A/B numbers (with --speculate; zeros otherwise):
+        # fraction of cycles served from a committed speculative solve +
+        # the cycle-start-to-first-launch p50 it exists to lower
+        "speculation": result.speculation_stats(),
     }))
     if args.health_out:
         with open(args.health_out, "w") as f:
@@ -113,7 +118,14 @@ def cmd_run(args) -> int:
 
 
 def cmd_synth(args) -> int:
-    if args.imbalanced:
+    if args.completion_heavy:
+        # the speculative-cycle wave-drain scenario (sim/loadgen.py
+        # completion_heavy_trace); pair with `run --speculate`
+        from cook_tpu.sim.loadgen import completion_heavy_trace
+
+        jobs, hosts = completion_heavy_trace(jobs=args.jobs,
+                                             seed=args.seed)
+    elif args.imbalanced:
         # the elastic capacity plane's two-pool starving/idle scenario
         # (sim/loadgen.py imbalanced_pool_trace); pair with `run --elastic`
         from cook_tpu.sim.loadgen import imbalanced_pool_trace
@@ -230,6 +242,10 @@ def main(argv=None) -> int:
     r.add_argument("--elastic", action="store_true",
                    help="enable the elastic capacity plane (pool "
                         "loaning + reclaim, cook_tpu/elastic/)")
+    r.add_argument("--speculate", action="store_true",
+                   help="prediction-assisted speculative match cycles "
+                        "(scheduler/prediction.py): overlap cycle N+1's "
+                        "solve with cycle N's drain")
     r.add_argument("--faults", default="",
                    help="FaultSchedule JSON file armed for the run "
                         "(cook_tpu.faults; see docs/resilience.md)")
@@ -247,6 +263,9 @@ def main(argv=None) -> int:
     s.add_argument("--imbalanced", action="store_true",
                    help="two-pool starving/idle elastic scenario instead "
                         "of the skewed single-pool workload")
+    s.add_argument("--completion-heavy", action="store_true",
+                   help="wave-drain speculation scenario (one job per "
+                        "host per cycle); pair with `run --speculate`")
     s.add_argument("--out", default="trace.json")
     s.set_defaults(fn=cmd_synth)
 

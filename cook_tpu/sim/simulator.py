@@ -94,6 +94,11 @@ class SimConfig:
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     pools: tuple = (("default", "default"),)  # (name, dru_mode)
     batched_match: bool = False      # one device call for all pools
+    # prediction-assisted speculative cycles (scheduler/prediction.py):
+    # enables the scheduler's speculator with the horizon pinned to ONE
+    # sim cycle — a running task predicted to finish by the next cycle's
+    # clock is assumed complete by the speculative solve
+    speculate: bool = False
     # fault-injection schedule (cook_tpu/faults.FaultSchedule.from_dict
     # shape: {"seed": .., "rules": [{"point": .., "mode": .., ...}]}),
     # armed for the duration of run() — the chaos scenarios
@@ -137,6 +142,24 @@ class SimResult:
         elastic A/B compares (lower p50 with loaning enabled)."""
         return [r["start_ms"] - r["submit_ms"] for r in self.rows
                 if r["start_ms"] is not None]
+
+    def speculation_stats(self) -> dict:
+        """Speculation A/B summary off the cycle records: fraction of
+        job-considering cycles served from a committed speculative solve
+        plus the cycle-start-to-first-launch p50 (the latency speculation
+        exists to lower; scheduler/prediction.py PRE_LAUNCH_PHASES)."""
+        from cook_tpu.scheduler.prediction import pre_launch_ms
+
+        active = [r for r in self.cycle_records if r.get("considered")]
+        hits = sum(1 for r in active if r.get("speculation") == "hit")
+        latencies = sorted(pre_launch_ms(r) for r in active)
+        return {
+            "cycles": len(active),
+            "hits": hits,
+            "hit_fraction": hits / len(active) if active else 0.0,
+            "pre_launch_p50_ms": (latencies[len(latencies) // 2]
+                                  if latencies else 0.0),
+        }
 
     def cycle_records_json(self) -> str:
         return json.dumps({"cycles": self.cycle_records}, indent=1)
@@ -189,6 +212,13 @@ class Simulator:
 
             self.config.scheduler.elastic = _dc.replace(
                 self.config.scheduler.elastic, enabled=True)
+        if self.config.speculate:
+            self.config.scheduler.speculation = True
+            # completions flush exactly one cycle_ms ahead: predict to
+            # that horizon (a wider one would assume completions the
+            # next cycle won't see yet — guaranteed prediction-miss)
+            self.config.scheduler.speculation_horizon_ms = \
+                float(self.config.cycle_ms)
         self.store = JobStore(clock=lambda: self.now_ms)
         for name, mode in self.config.pools:
             self.store.set_pool(Pool(name=name, dru_mode=DruMode(mode)))

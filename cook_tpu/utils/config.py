@@ -107,6 +107,23 @@ class Settings:
     # host encode/launch with the device solve; takes precedence over
     # batched_match when both are set
     pipelined_match: bool = False
+    # prediction-assisted speculative cycles (scheduler/prediction.py):
+    # pre-dispatch cycle N+1's solve against the predicted offer set
+    # while cycle N's launches drain; a stale speculation is dropped at
+    # commit, never repaired.  Off by default.
+    speculation: bool = False
+    # how far ahead (wall ms) a running task's predicted finish may sit
+    # and still be assumed complete by the speculative solve
+    speculation_horizon_ms: float = 30_000.0
+    # runtime-predictor knobs (per-(user, command-fingerprint) rolling
+    # quantiles; scheduler/prediction.QuantileRuntimePredictor)
+    predictor_quantile: float = 0.75
+    predictor_window: int = 64
+    predictor_min_samples: int = 3
+    # predicted-duration backfill: bounded DRU scoring term (ops/dru.py);
+    # 0 disables (rank order untouched)
+    backfill_weight: float = 0.0
+    backfill_norm_ms: float = 600_000.0
     leader_lease_path: str = ""
     # networked election (control/lease_server.py — the ZK role): takes
     # precedence over leader_lease_path when set
@@ -246,7 +263,11 @@ def read_config(path: Optional[str] = None,
                 "replication_sync_ack", "replication_min_acks",
                 "replication_ack_timeout_s", "replication_ack_liveness_s",
                 "data_dir", "snapshot_interval_s", "platform",
-                "batched_match", "pipelined_match", "elastic_interval_s",
+                "batched_match", "pipelined_match", "speculation",
+                "speculation_horizon_ms", "predictor_quantile",
+                "predictor_window", "predictor_min_samples",
+                "backfill_weight", "backfill_norm_ms",
+                "elastic_interval_s",
                 "fault_injection", "journal_fsync_policy", "load_shedding",
                 "incident_dir", "incident_capacity", "incident_cooldown_s",
                 "health_watch_interval_s", "auto_profile", "profile_dir",
@@ -296,6 +317,11 @@ def read_config(path: Optional[str] = None,
 def _validate(s: Settings) -> None:
     if not (0 < s.port < 65536):
         raise ValueError(f"bad port {s.port}")
+    if not (0.0 < s.predictor_quantile <= 1.0):
+        raise ValueError(f"bad predictor_quantile {s.predictor_quantile} "
+                         "(expected (0, 1])")
+    if s.backfill_weight < 0:
+        raise ValueError(f"bad backfill_weight {s.backfill_weight}")
     if s.journal_fsync_policy not in ("fail-stop", "degrade-async"):
         raise ValueError(
             f"bad journal_fsync_policy {s.journal_fsync_policy!r} "

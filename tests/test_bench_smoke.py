@@ -42,7 +42,8 @@ def test_smoke_emits_structured_record(smoke_record):
     assert set(on_disk["phases"]) == {"match", "dru", "rebalance",
                                       "elastic_plan", "control_plane",
                                       "match_xl", "match_xl_coarse",
-                                      "match_xl_fine", "match_xl_refine"}
+                                      "match_xl_fine", "match_xl_refine",
+                                      "speculation"}
     # every record and every phase carries the resolved JAX backend —
     # the label bench_gate uses to refuse cross-backend comparisons
     assert on_disk["backend"] == "cpu"
@@ -77,6 +78,17 @@ def test_smoke_match_xl_tier(smoke_record):
     assert xl["packing_eff"] >= 0.95
     for phase in ("match_xl_coarse", "match_xl_fine"):
         assert record["phases"][phase]["p50_ms"] > 0
+
+
+def test_smoke_speculation_tier(smoke_record):
+    """The speculation phase: the completion-heavy A/B must show cycles
+    served from speculation (the >= 0.2 ISSUE-10 bar) and a pre-launch
+    p50 below the non-speculative baseline's."""
+    record, _, _ = smoke_record
+    spec = record["phases"]["speculation"]
+    assert spec["hit_fraction"] >= 0.2
+    assert spec["p50_ms"] < spec["baseline_p50_ms"]
+    assert spec["cycles"] > 0
 
 
 def test_next_phase_record_path_skips_driver_rounds(tmp_path):

@@ -1,0 +1,579 @@
+"""Prediction-assisted speculative cycles (scheduler/prediction.py):
+predictor edge cases (cold start, single sample, outliers, quantile
+monotonicity), the speculation commit rule — an epoch-stale speculation
+is DROPPED, never repaired (the inducing race: a store mutation landing
+between dispatch and commit vetoes the commit) — the pipelined path, the
+predicted-duration backfill term, and the completion-heavy A/B
+(>= 20% of cycles served from speculation, lower cycle-start-to-first-
+launch p50, identical placements on the standard trace)."""
+import numpy as np
+import pytest
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import Pool
+from cook_tpu.models.store import JobStore
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.scheduler.matcher import MatchConfig
+from cook_tpu.scheduler.prediction import (
+    DROP_EPOCH_STALE,
+    DROP_PREDICTION_MISS,
+    DROP_PREDICTOR_COLD,
+    QuantileRuntimePredictor,
+    SpeculationGuard,
+    command_fingerprint,
+    pre_launch_ms,
+)
+from tests.conftest import FakeClock, make_job
+
+
+# ----------------------------------------------------------- the predictor
+
+
+def test_predictor_cold_start_returns_none():
+    p = QuantileRuntimePredictor(min_samples=3)
+    assert p.predict_runtime_ms("u", "train.py") is None
+    p.observe("u", "train.py", 1000)
+    p.observe("u", "train.py", 1000)
+    assert p.predict_runtime_ms("u", "train.py") is None  # 2 < min_samples
+    p.observe("u", "train.py", 1000)
+    assert p.predict_runtime_ms("u", "train.py") == pytest.approx(1000)
+
+
+def test_predictor_single_sample_when_allowed():
+    p = QuantileRuntimePredictor(min_samples=1)
+    p.observe("u", "cmd", 4200)
+    assert p.predict_runtime_ms("u", "cmd") == pytest.approx(4200)
+
+
+def test_predictor_outlier_robustness():
+    """One wild outlier must not drag the rolling-quantile estimate far
+    from the workload's typical runtime (the median stays put)."""
+    p = QuantileRuntimePredictor(min_samples=3)
+    for _ in range(9):
+        p.observe("u", "cmd", 100)
+    p.observe("u", "cmd", 1_000_000)
+    assert p.predict_runtime_ms("u", "cmd", quantile=0.5) \
+        == pytest.approx(100)
+    # even the default p75 stays inside the bulk
+    assert p.predict_runtime_ms("u", "cmd") <= 200
+
+
+def test_predictor_quantile_monotonicity():
+    p = QuantileRuntimePredictor(min_samples=3)
+    for v in (100, 200, 300, 400, 500, 600, 700, 800):
+        p.observe("u", "cmd", v)
+    estimates = [p.predict_runtime_ms("u", "cmd", quantile=q)
+                 for q in (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)]
+    assert estimates == sorted(estimates)
+    assert estimates[-1] == pytest.approx(800)
+
+
+def test_predictor_window_evicts_old_samples():
+    p = QuantileRuntimePredictor(min_samples=1, window=4)
+    for _ in range(10):
+        p.observe("u", "cmd", 10_000)
+    for _ in range(4):  # the window is now entirely the new regime
+        p.observe("u", "cmd", 100)
+    assert p.predict_runtime_ms("u", "cmd") == pytest.approx(100)
+
+
+def test_predictor_key_lru_bound():
+    p = QuantileRuntimePredictor(min_samples=1, max_keys=3)
+    for i in range(6):
+        p.observe(f"u{i}", "cmd", 100)
+    assert len(p._samples) == 3
+    assert p.predict_runtime_ms("u0", "cmd") is None  # evicted
+    assert p.predict_runtime_ms("u5", "cmd") is not None
+
+
+def test_command_fingerprint_distinguishes_commands():
+    a = command_fingerprint("train.py --lr 1e-3")
+    assert a == command_fingerprint("train.py --lr 1e-3")
+    assert a != command_fingerprint("train.py --lr 3e-4")
+    assert command_fingerprint("").startswith("#")
+    # REST admits whitespace-only commands (`if not command` passes " ");
+    # the fingerprint must not crash the completion watcher on them
+    assert command_fingerprint(" ").startswith("#")
+    assert command_fingerprint("\t\n").startswith("#")
+    p = QuantileRuntimePredictor(min_samples=1)
+    p.observe("u", " ", 500)
+    assert p.predict_runtime_ms("u", " ") == pytest.approx(500)
+
+
+def test_predictor_feeds_from_store_completions():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    p = QuantileRuntimePredictor(min_samples=1).attach(store)
+    job = make_job(user="alice").with_(command="run.sh")
+    store.submit_jobs([job])
+    store.create_instance(job.uuid, "t1", hostname="h0")
+    clock.advance(7000)
+    from cook_tpu.models.entities import InstanceStatus
+
+    store.update_instance_state("t1", InstanceStatus.SUCCESS, "normal-exit")
+    assert p.predict_runtime_ms("alice", "run.sh") == pytest.approx(7000)
+
+
+# --------------------------------------------------------------- the guard
+
+
+def _fake_event(kind, data):
+    from cook_tpu.models.store import Event
+
+    return Event(seq=0, kind=kind, data=data)
+
+
+def test_guard_unexpected_event_marks_stale():
+    g = SpeculationGuard()
+    token = g.begin("default")
+    g.expect(token, [("instance/status", "t1", "success")])
+    g._on_event(_fake_event("quota/set", {"user": "u"}))
+    ok, reason = g.finish(token)
+    assert not ok and reason == DROP_EPOCH_STALE
+
+
+def test_guard_expected_completion_confirms():
+    g = SpeculationGuard()
+    token = g.begin("default")
+    g.expect(token, [("instance/status", "t1", "success"),
+                     ("job/state", "j1", "completed")])
+    g._on_event(_fake_event("instance/status",
+                            {"task_id": "t1", "status": "success"}))
+    g._on_event(_fake_event("job/state",
+                            {"uuid": "j1", "state": "completed"}))
+    ok, reason = g.finish(token)
+    assert ok and reason == ""
+
+
+def test_guard_missing_confirmation_is_prediction_miss():
+    g = SpeculationGuard()
+    token = g.begin("default")
+    g.expect(token, [("instance/status", "t1", "success")])
+    ok, reason = g.finish(token)
+    assert not ok and reason == DROP_PREDICTION_MISS
+
+
+def test_guard_assumed_task_failing_is_stale():
+    """The predicted task finishing with the WRONG terminal status is an
+    unexpected event (a failure re-queues the job), not a confirmation."""
+    g = SpeculationGuard()
+    token = g.begin("default")
+    g.expect(token, [("instance/status", "t1", "success")])
+    g._on_event(_fake_event("instance/status",
+                            {"task_id": "t1", "status": "failed"}))
+    ok, reason = g.finish(token)
+    assert not ok and reason == DROP_EPOCH_STALE
+
+
+def test_guard_pool_scoping():
+    """Job-lifecycle events attributable to ANOTHER pool leave the token
+    committable (pool-local match inputs are untouched); unattributable
+    kinds stay global and veto every token."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="a"))
+    store.set_pool(Pool(name="b"))
+    other = make_job(user="u", pool="b")
+    store.submit_jobs([other])
+    g = SpeculationGuard(store)
+    token = g.begin("a")
+    g._on_event(_fake_event("job/state",
+                            {"uuid": other.uuid, "state": "completed"}))
+    ok, _ = g.finish(token)
+    assert ok, "pool-b lifecycle event must not veto pool-a's token"
+    token = g.begin("a")
+    g._on_event(_fake_event("pool/capacity", {"uuid": "x"}))
+    ok, reason = g.finish(token)
+    assert not ok and reason == DROP_EPOCH_STALE
+
+
+# ------------------------------------------------- speculative cycles (e2e)
+
+
+def one_host_scenario(n_jobs=3, runtime_ms=10_000, **config_kw):
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock",
+        [MockHost(node_id="h0", hostname="h0", mem=1000, cpus=4,
+                  pool="default")],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=0),
+        speculation=True,
+        speculation_horizon_ms=runtime_ms,
+        predictor_min_samples=1,
+        **config_kw))
+    jobs = [make_job(user="u0", mem=1000, cpus=4).with_(
+        uuid=f"j{i}", expected_runtime_ms=runtime_ms)
+        for i in range(n_jobs)]
+    store.submit_jobs(jobs)
+    return clock, store, cluster, scheduler, jobs
+
+
+def run_cycle(scheduler, store):
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    return outcome, scheduler.recorder.records(limit=1)[0]
+
+
+def advance_wave(clock, cluster, ms=10_000):
+    clock.advance(ms)
+    cluster.advance_to(clock())
+
+
+def test_speculative_cycle_hit_end_to_end():
+    clock, store, cluster, scheduler, jobs = one_host_scenario()
+    _, r1 = run_cycle(scheduler, store)           # j0 fresh; predictor cold
+    assert r1.speculation == "none"
+    advance_wave(clock, cluster)                  # j0 completes (observed)
+    _, r2 = run_cycle(scheduler, store)           # j1 fresh; speculates j2
+    assert r2.speculation == "none"
+    assert r2.speculation_drop == DROP_PREDICTOR_COLD
+    advance_wave(clock, cluster)                  # j1 completes as predicted
+    out3, r3 = run_cycle(scheduler, store)        # served from speculation
+    assert r3.speculation == "hit" and r3.speculative
+    assert [j.uuid for j, _ in out3.matched] == ["j2"]
+    # the hit cycle never paid tensor_build or a solve
+    assert "tensor_build" not in r3.phases and "solve" not in r3.phases
+    assert "speculation_commit" in r3.phases
+    assert r3.backend.startswith("spec-")
+    stats = scheduler.speculator.stats_json()
+    assert stats["hits"] == 1 and stats["dropped"] == 0
+
+
+def test_epoch_stale_speculation_never_commits():
+    """THE inducing race: a store mutation landing between speculative
+    dispatch and commit must veto the commit — the speculation is
+    dropped (reason epoch-stale), never repaired, and the cycle solves
+    fresh against the mutated state."""
+    clock, store, cluster, scheduler, jobs = one_host_scenario()
+    run_cycle(scheduler, store)
+    advance_wave(clock, cluster)
+    run_cycle(scheduler, store)                   # speculation in flight
+    assert scheduler.speculator.stats_json()["inflight"] == ["default"]
+    # the race: a new submission lands before the next cycle
+    late = make_job(user="u9", mem=100, cpus=1).with_(uuid="late")
+    store.submit_jobs([late])
+    advance_wave(clock, cluster)
+    out3, r3 = run_cycle(scheduler, store)
+    assert r3.speculation == "dropped"
+    assert r3.speculation_drop == DROP_EPOCH_STALE
+    assert not r3.speculative
+    # the fresh solve saw the mutated state: the late job was considered
+    matched = {j.uuid for j, _ in out3.matched}
+    assert "late" in matched
+    assert scheduler.speculator.stats_json()["drop_reasons"] \
+        == {DROP_EPOCH_STALE: 1}
+
+
+def test_prediction_miss_drops_instead_of_committing():
+    """An assumed completion that does NOT land by the next cycle vetoes
+    the commit: the speculative offers counted capacity that is still
+    occupied."""
+    clock, store, cluster, scheduler, jobs = one_host_scenario()
+    run_cycle(scheduler, store)
+    advance_wave(clock, cluster)
+    run_cycle(scheduler, store)
+    assert scheduler.speculator.stats_json()["inflight"] == ["default"]
+    # advance less than the real runtime: the predicted completion
+    # (eta = exactly one horizon out) has NOT landed at the next cycle
+    clock.advance(2000)
+    cluster.advance_to(clock())
+    out3, r3 = run_cycle(scheduler, store)
+    assert r3.speculation == "dropped"
+    assert r3.speculation_drop == DROP_PREDICTION_MISS
+    assert not out3.matched  # host genuinely still busy
+
+
+def test_no_speculation_while_completion_constraint_active():
+    """Under the estimated-completion constraint feasibility rows are
+    clock/predictor-state-dependent, so a speculative solve can never be
+    provably identical to a fresh one — dispatch must refuse outright
+    (the encode cache bypasses itself in this mode for the same
+    reason)."""
+    clock, store, cluster, scheduler, jobs = one_host_scenario()
+    scheduler.config.match.completion_multiplier = 1.5
+    scheduler.config.match.host_lifetime_mins = 100.0
+    run_cycle(scheduler, store)
+    advance_wave(clock, cluster)
+    run_cycle(scheduler, store)
+    assert scheduler.speculator.stats_json()["inflight"] == []
+    assert scheduler.speculator.stats_json()["dispatched"] == 0
+
+
+def test_disabled_kill_switch_drops_inflight():
+    clock, store, cluster, scheduler, jobs = one_host_scenario()
+    run_cycle(scheduler, store)
+    advance_wave(clock, cluster)
+    run_cycle(scheduler, store)
+    scheduler.speculator.enabled = False
+    advance_wave(clock, cluster)
+    _, r3 = run_cycle(scheduler, store)
+    assert r3.speculation == "dropped"
+    assert r3.speculation_drop == "disabled"
+
+
+def test_offers_changed_drops():
+    """A host appearing between dispatch and commit changes the offer
+    STRUCTURE without any store event — the fingerprint check drops the
+    speculation."""
+    clock, store, cluster, scheduler, jobs = one_host_scenario(n_jobs=4)
+    run_cycle(scheduler, store)
+    advance_wave(clock, cluster)
+    run_cycle(scheduler, store)
+    assert scheduler.speculator.stats_json()["inflight"] == ["default"]
+    new_host = MockHost(node_id="h1", hostname="h1", mem=1000, cpus=4,
+                        pool="default")
+    cluster.hosts[new_host.node_id] = new_host  # no store event fires
+    advance_wave(clock, cluster)
+    _, r3 = run_cycle(scheduler, store)
+    assert r3.speculation == "dropped"
+    assert r3.speculation_drop == "offers-changed"
+
+
+def test_pipelined_speculation_hit():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    hosts = []
+    for p in range(2):
+        store.set_pool(Pool(name=f"pool{p}"))
+        hosts.append(MockHost(node_id=f"p{p}h0", hostname=f"p{p}h0",
+                              mem=1000, cpus=4, pool=f"pool{p}"))
+    cluster = MockCluster("mock", hosts, clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=0), speculation=True,
+        speculation_horizon_ms=10_000, predictor_min_samples=1))
+    jobs = []
+    for p in range(2):
+        for i in range(3):
+            jobs.append(make_job(user="u0", pool=f"pool{p}", mem=1000,
+                                 cpus=4).with_(uuid=f"p{p}j{i}",
+                                               expected_runtime_ms=10_000))
+    store.submit_jobs(jobs)
+    pools = list(store.pools.values())
+
+    def pcycle():
+        for pool in pools:
+            scheduler.rank_cycle(pool)
+        scheduler.match_cycle_pipelined()
+        return scheduler.recorder.records(limit=2)
+
+    pcycle()
+    advance_wave(clock, cluster)
+    pcycle()
+    advance_wave(clock, cluster)
+    records = pcycle()
+    for r in records:
+        # one pool's predicted completions must not veto the other's
+        # speculation (pool-scoped guard)
+        assert r.speculation == "hit" and r.pipelined
+    for p in range(2):
+        assert store.jobs[f"p{p}j2"].state.value == "running"
+
+
+def test_speculative_hit_placements_equal_fresh_solve():
+    """A committed speculation's placements must equal what a fresh
+    solve at cycle N+1 would have produced (the commit rule's whole
+    claim) — run the identical scenario with speculation on and off and
+    compare every placement."""
+    def run(speculation):
+        clock = FakeClock()
+        store = JobStore(clock=clock)
+        store.set_pool(Pool(name="default"))
+        cluster = MockCluster(
+            "mock",
+            [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=1000, cpus=4,
+                      pool="default") for i in range(2)],
+            clock=clock)
+        scheduler = Scheduler(store, [cluster], SchedulerConfig(
+            match=MatchConfig(chunk=0), speculation=speculation,
+            speculation_horizon_ms=10_000, predictor_min_samples=1))
+        jobs = [make_job(user=f"u{i % 2}", mem=1000, cpus=4).with_(
+            uuid=f"j{i}", expected_runtime_ms=10_000) for i in range(8)]
+        store.submit_jobs(jobs)
+        placements = []
+        for _ in range(6):
+            out, _ = run_cycle(scheduler, store)
+            placements.extend((j.uuid, o.hostname) for j, o in out.matched)
+            advance_wave(clock, cluster)
+        return placements
+
+    assert run(True) == run(False)
+
+
+# ------------------------------------------------------------- A/B (sim)
+
+
+def completion_heavy_results(speculate):
+    from cook_tpu.scheduler.core import SchedulerConfig as SC
+    from cook_tpu.sim.loadgen import completion_heavy_trace
+    from cook_tpu.sim.simulator import SimConfig, Simulator
+
+    jobs, hosts = completion_heavy_trace(jobs=24, hosts=4)
+    config = SimConfig(cycle_ms=30_000, max_cycles=40, speculate=speculate,
+                       scheduler=SC(device_telemetry=False))
+    return Simulator(jobs, hosts, config).run()
+
+
+def test_ab_completion_heavy_speculation():
+    """ISSUE-10 acceptance: >= 20% of cycles served from speculation and
+    a lower cycle-start-to-first-launch p50, with identical placements."""
+    base = completion_heavy_results(False)
+    spec = completion_heavy_results(True)
+    b, s = base.speculation_stats(), spec.speculation_stats()
+    assert b["hits"] == 0
+    assert s["hit_fraction"] >= 0.2, s
+    assert s["pre_launch_p50_ms"] < b["pre_launch_p50_ms"], (s, b)
+
+    def placements(result):
+        return sorted((r["job_uuid"], r["start_ms"], r["host"])
+                      for r in result.rows if r["start_ms"] is not None)
+
+    assert placements(base) == placements(spec)
+
+
+def test_ab_standard_trace_identical_placements():
+    """On the standard synthetic trace (varied runtimes — predictions
+    routinely miss), speculation must change NO placement: every commit
+    is provably identical to the fresh solve, every miss drops."""
+    from cook_tpu.scheduler.core import SchedulerConfig as SC
+    from cook_tpu.sim.simulator import SimConfig, Simulator, synth_trace
+
+    def run(speculate):
+        jobs, hosts = synth_trace(40, 6, n_users=4, seed=3,
+                                  mean_runtime_ms=45_000)
+        config = SimConfig(cycle_ms=30_000, max_cycles=60,
+                           speculate=speculate,
+                           scheduler=SC(device_telemetry=False))
+        result = Simulator(jobs, hosts, config).run()
+        return sorted((r["job_uuid"], r["start_ms"], r["host"])
+                      for r in result.rows if r["start_ms"] is not None)
+
+    assert run(True) == run(False)
+
+
+def test_pre_launch_ms_helper():
+    record = {"phases": {"rank": 1.0, "tensor_build": 0.002,
+                         "solve": 0.003, "launch": 0.5}}
+    assert pre_launch_ms(record) == pytest.approx(5.0)
+
+
+# -------------------------------------------------- backfill scoring term
+
+
+def test_dru_backfill_reorders_within_bound():
+    import jax.numpy as jnp
+
+    from cook_tpu.ops.dru import DruTasks, dru_rank
+
+    # two users, equal shares, one pending task each with identical
+    # demand -> equal DRU; the backfill term must put the predicted-short
+    # task first, and weight 0 must reproduce the unadjusted order
+    tasks = DruTasks(
+        user=jnp.asarray([0, 1], dtype=jnp.int32),
+        mem=jnp.asarray([100.0, 100.0]),
+        cpus=jnp.asarray([1.0, 1.0]),
+        gpus=jnp.zeros(2),
+        order_key=jnp.asarray([0.0, 1.0]),
+        valid=jnp.asarray([True, True]),
+    )
+    div = jnp.asarray([1000.0, 1000.0])
+    plain = dru_rank(tasks, div, div, div)
+    assert list(np.asarray(plain.order)) == [0, 1]
+    # task 1 predicted short (frac 0.1), task 0 long (frac 1.0)
+    adjusted = dru_rank(tasks, div, div, div,
+                        backfill=jnp.asarray([1.0, 0.1]),
+                        backfill_weight=jnp.float32(0.05))
+    assert list(np.asarray(adjusted.order)) == [1, 0]
+    # raw dru column is NOT rewritten by the term
+    np.testing.assert_allclose(np.asarray(adjusted.dru),
+                               np.asarray(plain.dru))
+    # bounded: a materially lower-DRU task cannot be jumped
+    tasks2 = tasks._replace(mem=jnp.asarray([100.0, 900.0]))
+    adjusted2 = dru_rank(tasks2, div, div, div,
+                         backfill=jnp.asarray([1.0, 0.0]),
+                         backfill_weight=jnp.float32(0.05))
+    assert list(np.asarray(adjusted2.order)) == [0, 1]
+
+
+def test_rank_pool_backfill_prefers_predicted_short_jobs():
+    from cook_tpu.scheduler.ranking import rank_pool
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    long_job = make_job(user="a", mem=100, cpus=1).with_(
+        uuid="long", command="long.sh")
+    short_job = make_job(user="b", mem=100, cpus=1).with_(
+        uuid="short", command="short.sh")
+    store.submit_jobs([long_job, short_job])
+    predictor = QuantileRuntimePredictor(min_samples=1)
+    predictor.observe("a", "long.sh", 600_000)
+    predictor.observe("b", "short.sh", 10_000)
+    pool = store.pools["default"]
+    plain = rank_pool(store, pool)
+    assert [j.uuid for j in plain.jobs] == ["long", "short"]  # submit order
+    boosted = rank_pool(store, pool, predictor=predictor,
+                        backfill_weight=0.05, backfill_norm_ms=600_000)
+    assert [j.uuid for j in boosted.jobs] == ["short", "long"]
+    # weight 0 keeps the exact unadjusted order
+    zero = rank_pool(store, pool, predictor=predictor, backfill_weight=0.0)
+    assert [j.uuid for j in zero.jobs] == [j.uuid for j in plain.jobs]
+
+
+def test_estimated_end_times_uses_predictor():
+    from cook_tpu.scheduler.matcher import estimated_end_times
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    job = make_job(user="a", mem=100, cpus=1).with_(
+        uuid="noest", command="run.sh", expected_runtime_ms=0)
+    store.submit_jobs([job])
+    config = MatchConfig(completion_multiplier=1.5,
+                         host_lifetime_mins=100.0)
+    # no declared expected runtime and no predictor -> no estimate
+    assert estimated_end_times(store, [job], config)[0] == -1.0
+    predictor = QuantileRuntimePredictor(min_samples=1)
+    predictor.observe("a", "run.sh", 60_000)
+    est = estimated_end_times(store, [job], config, predictor=predictor)
+    assert est[0] == pytest.approx(clock() + 90_000)
+
+
+# ----------------------------------------------------------- REST surface
+
+
+def test_debug_predictions_endpoint():
+    import requests
+
+    from cook_tpu.rest.api import ApiConfig, CookApi
+    from cook_tpu.rest.server import ServerThread
+
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock", [MockHost(node_id="h0", hostname="h0", mem=1000, cpus=4,
+                          pool="default")], clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=0), speculation=True,
+        predictor_min_samples=1))
+    scheduler.predictor.observe("alice", "run.sh", 5000)
+    api = CookApi(store, scheduler, ApiConfig())
+    server = ServerThread(api).start()
+    try:
+        r = requests.get(
+            f"{server.url}/debug/predictions",
+            headers={"X-Cook-Requesting-User": "alice"})
+        assert r.status_code == 200
+        body = r.json()
+        assert body["enabled"] is True
+        assert body["predictor"]["observations"] == 1
+        assert body["speculation"]["hits"] == 0
+        assert "drop_reasons" in body["speculation"]
+    finally:
+        server.stop()

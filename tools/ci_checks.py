@@ -12,6 +12,9 @@ wrapper runs them as one pipeline with one verdict:
      match/dru/rebalance/elastic solves, the `match_xl` hierarchical
      two-level solve (coarse/fine/refine phases, the 100k x 10k tier's
      smoke variant), the pipelined-vs-serial match-cycle comparison,
+     the `speculation` phase (prediction-assisted speculative-cycle
+     A/B on the completion-heavy trace: cycle-start-to-first-launch
+     p50 + fraction of cycles served from speculation),
      AND the `control_plane` phase — the loadtest (`tools/loadtest.py`,
      serial closed-loop so the gated p50 is commit SERVICE time, not
      same-process queueing jitter) against an in-process control plane,
