@@ -57,6 +57,34 @@ def make_problem(j, n, seed=0):
     return demands, avail, totals
 
 
+def _data_plane():
+    from cook_tpu.obs import data_plane
+
+    return data_plane
+
+
+def byte_mark():
+    """Ledger anchor for a phase's byte stamp (obs/data_plane.py)."""
+    return _data_plane().LEDGER.byte_totals()
+
+
+def byte_stamp(mark) -> dict:
+    """H2D/D2H byte deltas since `mark` — stamped onto bench phases.
+    Logical bytes are backend-stable (a CPU-fallback round moves the
+    same bytes as a TPU round), so these are the columns bench_gate can
+    diff even across backends."""
+    h2d, d2h = _data_plane().LEDGER.byte_totals()
+    return {"h2d_bytes": h2d - mark[0], "d2h_bytes": d2h - mark[1]}
+
+
+def note_problem_bytes(tree, family=None):
+    """Account a hand-built device problem's H2D (bench constructs its
+    tensors with raw jnp.asarray, outside the scheduler's instrumented
+    builds)."""
+    dp = _data_plane()
+    dp.note_h2d(dp.tree_nbytes(tree), family=family or dp.FAM_NODE_ENCODE)
+
+
 def time_fn(fn, repeats=5):
     """Each fn MUST end in `cook_tpu.ops.common.fetch_result` (the one
     shared definition of "the solve finished": a device-to-host fetch,
@@ -120,6 +148,7 @@ def bench_match(jax, jnp, platform):
     job_valid[:j_real] = True
     node_valid = np.zeros(N, dtype=bool)
     node_valid[:n_real] = True
+    mark = byte_mark()
     problem = MatchProblem(
         demands=jnp.asarray(demands),
         job_valid=jnp.asarray(job_valid),
@@ -128,6 +157,7 @@ def bench_match(jax, jnp, platform):
         node_valid=jnp.asarray(node_valid),
         feasible=None,
     )
+    note_problem_bytes(problem)
 
     tuned = load_tuned()
     # chunk and J are both powers of two, so min() keeps j % chunk == 0
@@ -164,6 +194,9 @@ def bench_match(jax, jnp, platform):
         t0 = time.perf_counter()
         assignment = solve()
     log(f"match compile+first run: {(time.perf_counter()-t0)*1000:.0f} ms")
+    # byte stamp: problem build + ONE solve's fetch — deterministic, so
+    # the gate can diff it exactly record-to-record
+    match_bytes = byte_stamp(mark)
     p50, times = time_fn(solve)
     tpu_assign = assignment[:j_real]
 
@@ -180,7 +213,7 @@ def bench_match(jax, jnp, platform):
         f"(all {[f'{t:.0f}' for t in times]}); cpu[{baseline_kind}] "
         f"{cpu_ms:.0f} ms; placed device {q_tpu['num_placed']} vs cpu "
         f"{q_cpu['num_placed']}; packing efficiency {eff:.4f}")
-    return p50, cpu_ms, eff, (j_real, n_real)
+    return p50, cpu_ms, eff, (j_real, n_real), match_bytes
 
 
 def make_dru_problem(jnp, t, u, t_real=None, seed=3):
@@ -268,11 +301,13 @@ def bench_match_xl(jax, jnp, platform, *, smoke=False, repeats=3) -> dict:
     job_valid[:j_real] = True
     node_valid = np.zeros(N, dtype=bool)
     node_valid[:n_real] = True
+    mark = byte_mark()
     problem = MatchProblem(
         demands=jnp.asarray(demands), job_valid=jnp.asarray(job_valid),
         avail=jnp.asarray(avail), totals=jnp.asarray(totals),
         node_valid=jnp.asarray(node_valid), feasible=None,
     )
+    note_problem_bytes(problem)
     mesh = None
     if len(jax.devices()) > 1:
         from cook_tpu.parallel.mesh import make_mesh
@@ -292,6 +327,8 @@ def bench_match_xl(jax, jnp, platform, *, smoke=False, repeats=3) -> dict:
     log(f"match_xl compile+first run: "
         f"{(time.perf_counter() - t0) * 1000:.0f} ms "
         f"(blocks {runs[-1]['blocks']}, fine {runs[-1]['fine_shape']})")
+    # problem build + one full coarse/fine/refine solve's traffic
+    xl_bytes = byte_stamp(mark)
     p50, times = time_fn(solve, repeats=repeats)
     timed = runs[-repeats:]
 
@@ -325,7 +362,7 @@ def bench_match_xl(jax, jnp, platform, *, smoke=False, repeats=3) -> dict:
         "match_xl": {"p50_ms": p50, "jobs": j_real, "nodes": n_real,
                      "blocks": stats["blocks"],
                      "nodes_per_block": stats["nodes_per_block"],
-                     "spilled": stats["spilled"],
+                     "spilled": stats["spilled"], **xl_bytes,
                      **({"packing_eff": eff} if eff is not None else {})},
         "match_xl_coarse": {"p50_ms": phase_p50("coarse_s")},
         "match_xl_fine": {"p50_ms": phase_p50("fine_s")},
@@ -874,7 +911,8 @@ def device_main():
 
     platform = jax.devices()[0].platform
     log(f"device: {jax.devices()[0]} ({platform})")
-    match_p50, cpu_ms, eff, (j_real, n_real) = bench_match(jax, jnp, platform)
+    match_p50, cpu_ms, eff, (j_real, n_real), match_bytes = bench_match(
+        jax, jnp, platform)
     xl_phases = bench_match_xl(jax, jnp, platform)
     dru_p50 = bench_dru(jax, jnp)
     reb_p50 = bench_rebalance(jax, jnp)
@@ -891,7 +929,8 @@ def device_main():
                             platform, extra=extra)
     write_bench_record(make_record("full", platform, {
         "match": {"p50_ms": match_p50, "jobs": j_real, "nodes": n_real,
-                  "packing_eff": eff, "baseline_ms": cpu_ms},
+                  "packing_eff": eff, "baseline_ms": cpu_ms,
+                  **match_bytes},
         **xl_phases,
         "dru": {"p50_ms": dru_p50},
         "rebalance": {"p50_ms": reb_p50},
@@ -912,7 +951,8 @@ def cpu_main():
     import jax.numpy as jnp
 
     log(f"device: {jax.devices()[0]} (cpu fallback)")
-    match_p50, cpu_ms, eff, (j_real, n_real) = bench_match(jax, jnp, "cpu")
+    match_p50, cpu_ms, eff, (j_real, n_real), match_bytes = bench_match(
+        jax, jnp, "cpu")
     # the accelerator was unreachable; this measures CPU XLA vs the C++
     # baseline at reduced size — see docs/status.md for the real-TPU
     # numbers measured interactively (552 ms for 100k x 10k vs 5.3-6.3 s
@@ -926,7 +966,8 @@ def cpu_main():
     xl_phases = bench_match_xl(jax, jnp, "cpu")
     write_bench_record(make_record("full", "cpu", {
         "match": {"p50_ms": match_p50, "jobs": j_real, "nodes": n_real,
-                  "packing_eff": eff, "baseline_ms": cpu_ms},
+                  "packing_eff": eff, "baseline_ms": cpu_ms,
+                  **match_bytes},
         **xl_phases,
         # the control plane never needed the accelerator; its phase is
         # measured at full scale even on the CPU fallback
@@ -959,11 +1000,13 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     job_valid[:j_real] = True
     node_valid = np.zeros(N, bool)
     node_valid[:n_real] = True
+    mark = byte_mark()
     problem = MatchProblem(
         demands=jnp.asarray(demands), job_valid=jnp.asarray(job_valid),
         avail=jnp.asarray(avail), totals=jnp.asarray(totals),
         node_valid=jnp.asarray(node_valid), feasible=None,
     )
+    note_problem_bytes(problem)
 
     def solve_match():
         # kc=32/rounds=3/passes=3: full parity (eff 1.0) with the CPU
@@ -974,6 +1017,7 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
             **backend_flags("xla")).assignment)
 
     assignment = solve_match()
+    match_bytes = byte_stamp(mark)  # problem build + one solve's fetch
     p50, _ = time_fn(solve_match, repeats=repeats)
     cpu_assign = ref.np_greedy_match(demands[:j_real], avail[:n_real],
                                      totals[:n_real])
@@ -982,33 +1026,40 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     eff = (q_dev["cpus_placed"] / q_cpu["cpus_placed"]
            if q_cpu["cpus_placed"] else 1.0)
     phases["match"] = {"p50_ms": p50, "jobs": j_real, "nodes": n_real,
-                       "packing_eff": eff}
+                       "packing_eff": eff, **match_bytes}
     log(f"smoke match {j_real} x {n_real}: p50 {p50:.2f} ms, eff {eff:.4f}")
 
     # dru rank: 2k tasks x 8 users (same construction as the full tier)
     T, U = 2048, 8
+    mark = byte_mark()
     tasks, div, _ = make_dru_problem(jnp, T, U, seed=8)
+    note_problem_bytes((tasks, div), family=_data_plane().FAM_DRU)
 
     def solve_dru():
         return fetch_result(dru_rank(tasks, div, div, div).rank)
 
     solve_dru()
+    dru_bytes = byte_stamp(mark)
     dru_p50, _ = time_fn(solve_dru, repeats=repeats)
-    phases["dru"] = {"p50_ms": dru_p50, "tasks": T}
+    phases["dru"] = {"p50_ms": dru_p50, "tasks": T, **dru_bytes}
     log(f"smoke dru {T} tasks: p50 {dru_p50:.2f} ms")
 
     # rebalance victim search: 2k tasks x 256 hosts (shared construction)
     T2, H = 2048, 256
+    mark = byte_mark()
     state = make_rebalance_state(jnp, T2, H, seed=9)
     demand = jnp.asarray([8000.0, 16.0, 0.0], dtype=jnp.float32)
+    note_problem_bytes((state, demand))
 
     def solve_reb():
         return fetch_result(
             find_preemption_decision(state, demand, 0.3, 1.0, 0.5))
 
     solve_reb()
+    reb_bytes = byte_stamp(mark)
     reb_p50, _ = time_fn(solve_reb, repeats=repeats)
-    phases["rebalance"] = {"p50_ms": reb_p50, "tasks": T2, "hosts": H}
+    phases["rebalance"] = {"p50_ms": reb_p50, "tasks": T2, "hosts": H,
+                           **reb_bytes}
     log(f"smoke rebalance {T2} x {H}: p50 {reb_p50:.2f} ms")
 
     # elastic capacity plan: 8 pools x 256 queued jobs (shared construction)

@@ -36,6 +36,12 @@ package makes the DEVICE side and the CONTROL-PLANE write path legible:
   * `profiling.ProfileCapturer` — single-flight, duration-bounded,
     cooldown-rate-limited `jax.profiler` capture behind
     `POST /debug/profile` and the incident auto-capture.
+  * `data_plane.TransferLedger` — the device DATA-PLANE side: every
+    host<->device crossing accounted per tensor family, the per-cycle
+    residency ledger (`rebuild_fraction` — bytes re-transferred for
+    unchanged encode rows), padding-waste per padded bucket, and
+    roofline attribution via `compiled.cost_analysis()` — served at
+    `GET /debug/device`; the measurement layer under ROADMAP item 2(a).
 
 Exports resolve lazily (PEP 562): `models/store.py` and
 `models/persistence.py` import `cook_tpu.obs.contention` at module
@@ -75,6 +81,8 @@ _EXPORTS = {
     "COMMIT_ACK_SLO_BURN": ("cook_tpu.obs.contention",
                             "COMMIT_ACK_SLO_BURN"),
     "JOB_STARVATION": ("cook_tpu.obs.contention", "JOB_STARVATION"),
+    "TransferLedger": ("cook_tpu.obs.data_plane", "TransferLedger"),
+    "CycleDataPlane": ("cook_tpu.obs.data_plane", "CycleDataPlane"),
     "IncidentRecorder": ("cook_tpu.obs.incident", "IncidentRecorder"),
     "job_timeline": ("cook_tpu.obs.incident", "job_timeline"),
     "ProfileCapturer": ("cook_tpu.obs.profiling", "ProfileCapturer"),

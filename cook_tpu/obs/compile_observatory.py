@@ -59,6 +59,12 @@ class CompileObservatory:
         self._recent: dict[str, collections.deque] = {}
         self._solve_totals: dict[str, int] = {}
         self._storming: dict[str, bool] = {}
+        # roofline attribution (obs/data_plane.py): per-program FLOPs +
+        # bytes accessed from compiled.cost_analysis(), plus the last
+        # observed non-overlapped solve wall — together they turn the
+        # CPU-vs-device gap into a number per program
+        self._costs: dict[tuple[str, str, str], dict] = {}
+        self._last_seconds: dict[tuple[str, str, str], float] = {}
         self._lock = threading.Lock()
         self._compile_counter = global_registry.counter(
             "obs.compile.count",
@@ -76,15 +82,24 @@ class CompileObservatory:
             "obs.compile.programs",
             "distinct compiled programs (op-wide jit cache size)")
 
-    def observe_solve(self, op: str, shape, backend: str) -> bool:
+    def observe_solve(self, op: str, shape, backend: str, *,
+                      seconds: float = None) -> bool:
         """Report one device solve; returns True when this (op, shape,
-        backend) key was first seen — i.e. the solve paid a compile."""
+        backend) key was first seen — i.e. the solve paid a compile.
+        `seconds` (optional, warm non-overlapped walls only) feeds the
+        roofline join: cost_stats() divides the program's FLOPs by the
+        last observed wall to report achieved throughput."""
         sig = shape if isinstance(shape, str) else shape_signature(shape)
         key = (op, sig, backend)
         with self._lock:
             compiled = key not in self._seen
             if compiled:
                 self._seen.add(key)
+            elif seconds is not None and seconds > 0:
+                # warm walls only: a compile-paying run's wall is XLA
+                # time, not execution — it would poison the achieved-
+                # throughput join exactly like the latency baseline
+                self._last_seconds[key] = seconds
             total = self._solve_totals.get(op, 0) + 1
             self._solve_totals[op] = total
             recent = self._recent.setdefault(
@@ -121,6 +136,49 @@ class CompileObservatory:
                     "threshold": self.storm_threshold,
                 }
             return out
+
+    # ------------------------------------------------- roofline cost cache
+
+    def observe_cost(self, op: str, shape, backend: str,
+                     cost: dict) -> None:
+        """Cache one program's cost_analysis() result ({"flops",
+        "bytes_accessed"}), keyed exactly like the compile accounting."""
+        sig = shape if isinstance(shape, str) else shape_signature(shape)
+        with self._lock:
+            self._costs[(op, sig, backend)] = dict(cost)
+
+    def cost(self, op: str, shape, backend: str):
+        sig = shape if isinstance(shape, str) else shape_signature(shape)
+        with self._lock:
+            return self._costs.get((op, sig, backend))
+
+    def cost_stats(self) -> list[dict]:
+        """Roofline rows for `/debug/device`: per-program FLOPs, bytes
+        accessed, arithmetic intensity, and — when a warm solve wall has
+        been observed — achieved GFLOP/s, so the CPU-vs-device gap is a
+        number per program."""
+        with self._lock:
+            rows = []
+            for (op, sig, backend), cost in sorted(self._costs.items()):
+                if cost.get("unavailable"):
+                    # negative-cache sentinel (the backend reported no
+                    # cost table) — cached so probes don't re-lower, but
+                    # not a roofline row
+                    continue
+                flops = cost.get("flops", 0.0)
+                nbytes = cost.get("bytes_accessed", 0.0)
+                row = {
+                    "op": op, "shape": sig, "backend": backend,
+                    "flops": flops, "bytes_accessed": nbytes,
+                    "arithmetic_intensity": (flops / nbytes
+                                             if nbytes > 0 else None),
+                }
+                seconds = self._last_seconds.get((op, sig, backend))
+                if seconds:
+                    row["last_solve_s"] = seconds
+                    row["achieved_gflops"] = flops / seconds / 1e9
+                rows.append(row)
+            return rows
 
     def stats(self) -> dict:
         """Snapshot for the health verdict: per-op program counts and

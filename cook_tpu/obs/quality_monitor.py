@@ -80,17 +80,25 @@ class QualityMonitor:
 
     def shadow_solve(self, prepared, assignment: np.ndarray,
                      pool: str) -> float:
+        from cook_tpu.obs import data_plane
         from cook_tpu.ops import cpu_reference as ref
 
         n_jobs = len(prepared.considerable)
         problem = prepared.problem
         # the padded tensors were built for the kernel; fetch the unpadded
-        # rows back (D2H via the one shared completion-observing fetch)
-        demands = fetch_result(problem.demands)[:n_jobs]
-        n_nodes = (prepared.nodes.n if prepared.nodes is not None
-                   else fetch_result(problem.avail).shape[0])
-        avail = fetch_result(problem.avail)[:n_nodes]
-        totals = fetch_result(problem.totals)[:n_nodes]
+        # rows back (D2H via the one shared completion-observing fetch).
+        # Detached + fallback-bucketed: these fetches are reference-
+        # sampling overhead — they must neither inflate device-family
+        # transfer numbers nor land on the driving cycle's record (a
+        # speculation hit's only data-plane transfer stays the
+        # assignment fetch)
+        with data_plane.detached(), \
+                data_plane.family(data_plane.FAM_FALLBACK):
+            demands = fetch_result(problem.demands)[:n_jobs]
+            n_nodes = (prepared.nodes.n if prepared.nodes is not None
+                       else fetch_result(problem.avail).shape[0])
+            avail = fetch_result(problem.avail)[:n_nodes]
+            totals = fetch_result(problem.totals)[:n_nodes]
         feasible = prepared.feasible
         # np_greedy_match is resource-count generic: pass every column
         # (mem, cpus, gpus, disk...) so feasibility matches the kernel's

@@ -81,7 +81,8 @@ class DeviceTelemetry:
         (first-seen (op, shape, backend) key).  `seconds` feeds the
         latency histogram; match solves additionally feed the per-pool
         regression baseline via `record_match_solve`."""
-        compiled = self.observatory.observe_solve(op, shape, backend)
+        compiled = self.observatory.observe_solve(op, shape, backend,
+                                                  seconds=seconds)
         if seconds is not None:
             self._solve_hist.observe(seconds, {"op": op, "backend": backend})
         if pool is not None:
@@ -122,7 +123,7 @@ class DeviceTelemetry:
         pool's latency baseline observes the shared batch wall time (no
         pool's cycle can finish sooner than the batch)."""
         compiled = self.observatory.observe_solve("match_batched", shape,
-                                                  backend)
+                                                  backend, seconds=seconds)
         self._solve_hist.observe(seconds,
                                  {"op": "match_batched", "backend": backend})
         sig = shape if isinstance(shape, str) else shape_signature(shape)

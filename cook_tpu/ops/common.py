@@ -27,10 +27,19 @@ def fetch_result(tree):
     semantics, since the scheduler consumes assignments host-side.
     Every timed solve (bench, smoke bench, match cycle, quality monitor)
     must end in this call so timing means the same thing everywhere.
+
+    Being THE completion observation also makes it THE D2H accounting
+    site: the materialized result's logical bytes land in the data-plane
+    ledger (obs/data_plane.py), attributed to the ambient tensor family
+    and the active cycle scope.
     """
     import jax
 
-    return jax.tree.map(np.asarray, tree)
+    from cook_tpu.obs import data_plane
+
+    out = jax.tree.map(np.asarray, tree)
+    data_plane.note_d2h(data_plane.tree_nbytes(out))
+    return out
 
 
 class PendingResult:

@@ -52,6 +52,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from cook_tpu.obs import data_plane
 from cook_tpu.ops.common import BIG, bucket_size, fetch_result
 from cook_tpu.ops.match import (
     MatchProblem,
@@ -397,6 +398,8 @@ def hierarchical_match(
     slots = min(slots, bucket_size(j))
 
     job_valid_np = np.asarray(problem.job_valid)
+    data_plane.note_d2h(int(job_valid_np.nbytes),
+                        family=data_plane.FAM_HIER_COARSE)
     out = np.full(j, -1, dtype=np.int32)
     block_pad_axis = b_pad - b_real
     coarse_backend = params.coarse_backend
@@ -409,7 +412,13 @@ def hierarchical_match(
     def coarse_pass(active_mask: np.ndarray) -> np.ndarray:
         """One coarse jobs x blocks assignment against the CURRENT block
         availabilities (refine rounds re-enter here with only the
-        leftover jobs active)."""
+        leftover jobs active).  Transfers ride the `hier-coarse` family
+        (the active mask up, the coarse assignment down); the padded
+        jobs x blocks grid feeds the padding-waste account."""
+        data_plane.note_padding(
+            "match_coarse", (j, b_pad),
+            valid_cells=int(active_mask.sum()) * b_real,
+            padded_cells=j * b_pad)
         block_sum, block_max, block_tot, block_valid = block_aggregates(
             avail_now, totals, node_valid, npb)
         if block_pad_axis:
@@ -419,7 +428,8 @@ def hierarchical_match(
             block_tot = jnp.pad(block_tot, ((0, block_pad_axis), (0, 0)),
                                 constant_values=1.0)
             block_valid = jnp.pad(block_valid, (0, block_pad_axis))
-        active = jnp.asarray(active_mask)
+        active = data_plane.h2d(active_mask,
+                                family=data_plane.FAM_HIER_COARSE)
         if coarse_backend == "pallas":
             interpret = jax.default_backend() != "tpu"
             assignment = _coarse_pallas(
@@ -441,21 +451,32 @@ def hierarchical_match(
         if observatory is not None:
             observatory.observe_solve("match_coarse", (j, b_pad),
                                       coarse_backend)
-        return np.asarray(fetch_result(assignment))
+        with data_plane.family(data_plane.FAM_HIER_COARSE):
+            return np.asarray(fetch_result(assignment))
 
     def fine_pass(job_idx: np.ndarray):
         """Scattered fine batch solve; returns (assignment [b_real, s]
-        local node indices, updated flat availability)."""
+        local node indices, updated flat availability).  Transfers ride
+        the `hier-fine` family; the block-fill fraction of the padded
+        [b_pad, slots] grid is the hierarchical padding-waste signal."""
+        data_plane.note_padding(
+            "match_fine", (b_pad, slots, npb),
+            valid_cells=int((job_idx >= 0).sum()) * npb,
+            padded_cells=b_pad * slots * npb)
         problems = gather_fine(problem.demands, problem.job_valid, feasible,
                                avail_now, totals, node_valid,
-                               jnp.asarray(job_idx), npb)
+                               data_plane.h2d(
+                                   job_idx,
+                                   family=data_plane.FAM_HIER_FINE), npb)
         problems = _pad_block_axis(problems, block_pad_axis, n_res)
         result = _fine_solve(problems, params, mesh)
         if observatory is not None:
             observatory.observe_solve(
                 "match_fine", (b_pad, slots, npb),
                 vmap_safe_backend(params.backend))
-        assignment = np.asarray(fetch_result(result.assignment))[:b_real]
+        with data_plane.family(data_plane.FAM_HIER_FINE):
+            assignment = np.asarray(
+                fetch_result(result.assignment))[:b_real]
         new_avail = result.new_avail[:b_real].reshape(n_pad, n_res)
         return assignment, new_avail
 

@@ -60,9 +60,15 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "pool") -> Mesh:
 
 def shard_pools(mesh: Mesh, tree, axis: str = "pool"):
     """Place a pool-batched pytree (leading axis = pools) with the pool axis
-    sharded across the mesh."""
+    sharded across the mesh.  The put is data-plane accounted under the
+    `mesh-shard` family (on multi-device meshes this is a real copy; the
+    ledger counts logical bytes either way so the number is
+    backend-stable)."""
+    from cook_tpu.obs import data_plane
+
     sharding = NamedSharding(mesh, P(axis))
-    return jax.device_put(tree, sharding)
+    return data_plane.device_put(tree, sharding,
+                                 family=data_plane.FAM_MESH)
 
 
 def invalid_match_problem(j: int, n: int, n_res: int = 4,
@@ -137,12 +143,16 @@ def task_sharded_dru(mesh: Mesh, tasks: DruTasks, mem_div, cpu_div, gpu_div,
     jit + shardings — no shard_map needed, since every op in the kernel is
     collective-friendly.
     """
+    from cook_tpu.obs import data_plane
+
     axis = mesh.axis_names[0]
     spec = P(axis)
     sharded = DruTasks(*[
-        jax.device_put(leaf, NamedSharding(mesh, spec)) for leaf in tasks
+        data_plane.device_put(leaf, NamedSharding(mesh, spec),
+                              family=data_plane.FAM_DRU) for leaf in tasks
     ])
-    divs = [jax.device_put(d, NamedSharding(mesh, P())) for d in
+    divs = [data_plane.device_put(d, NamedSharding(mesh, P()),
+                                  family=data_plane.FAM_DRU) for d in
             (mem_div, cpu_div, gpu_div)]
     return dru_rank(sharded, *divs, gpu_mode=gpu_mode)
 

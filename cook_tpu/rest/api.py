@@ -274,6 +274,7 @@ class CookApi:
         r.add_get("/debug/faults", self.get_debug_faults)
         r.add_post("/debug/faults", self.post_debug_faults)
         r.add_get("/debug/elastic", self.get_debug_elastic)
+        r.add_get("/debug/device", self.get_debug_device)
         r.add_get("/debug/predictions", self.get_debug_predictions)
         r.add_get("/debug/cycles", self.get_debug_cycles)
         r.add_get("/debug/cycles/{cycle_id}", self.get_debug_cycle)
@@ -469,6 +470,26 @@ class CookApi:
                 limit=limit, kind=request.query.get("kind"))
                 if elastic is not None else []),
         }
+        return web.json_response(body)
+
+    async def get_debug_device(self, request: web.Request) -> web.Response:
+        """Device data-plane observatory (cook_tpu/obs/data_plane.py):
+        host<->device transfer totals per tensor family (the matcher's
+        CPU-fallback/audit puts bucketed separately under `fallback`),
+        the per-pool residency ledger (`rebuild_fraction` — the fraction
+        of encode-row bytes freshly recomputed; 1 - this is the traffic
+        a device-resident encode cache would remove), padding waste per
+        (op, padded bucket), recent per-cycle byte summaries, and the
+        roofline rows (FLOPs + bytes accessed per compiled program from
+        cost_analysis(), joined with observed warm solve walls).  The
+        before/after instrument for ROADMAP item 2(a)."""
+        from cook_tpu.obs import data_plane
+
+        body = data_plane.LEDGER.snapshot()
+        telemetry = self._telemetry()
+        body["roofline"] = (telemetry.observatory.cost_stats()
+                            if telemetry is not None else [])
+        body["device_telemetry"] = telemetry is not None
         return web.json_response(body)
 
     async def get_debug_predictions(self,

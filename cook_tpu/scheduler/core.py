@@ -503,7 +503,16 @@ class Scheduler:
         )
         self.admission.clamp(pool.name, state,
                              self.config.match.max_jobs_considered)
-        outcome = self._try_speculative_cycle(pool, queue, state, flight)
+        from cook_tpu.obs import data_plane
+
+        # the cycle's data-plane scope covers the speculation commit
+        # too: a hit's only transfer is the speculative assignment's
+        # fetch (its tensor build ran during the PREVIOUS cycle's drain,
+        # scope-less), so hit cycles report near-zero H2D — the
+        # device-residency behavior item 2(a) generalizes
+        with data_plane.activate(flight.dp):
+            outcome = self._try_speculative_cycle(pool, queue, state,
+                                                  flight)
         if outcome is None:
             outcome = match_pool(
                 self.store,
@@ -686,10 +695,16 @@ class Scheduler:
         # solve ran while the PREVIOUS pass's launches drained)
         speculative = {}
         if self.speculator is not None:
+            from cook_tpu.obs import data_plane
+
             for pool in pools:
-                result = self._speculation_commit(
-                    pool, self.pool_queues[pool.name],
-                    self.pool_match_state[pool.name], flights[pool.name])
+                # per-pool scope: the commit's assignment fetch (a hit's
+                # only transfer) attributes to its own cycle record
+                with data_plane.activate(flights[pool.name].dp):
+                    result = self._speculation_commit(
+                        pool, self.pool_queues[pool.name],
+                        self.pool_match_state[pool.name],
+                        flights[pool.name])
                 if result is not None and result.ok:
                     speculative[pool.name] = result
         outcomes = match_pools_pipelined(

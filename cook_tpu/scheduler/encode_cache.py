@@ -38,6 +38,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from cook_tpu.models.store import Event, JobStore
+from cook_tpu.obs import data_plane
 from cook_tpu.utils.metrics import global_registry
 
 # events that can change which quota/share/config-derived constraints
@@ -188,6 +189,12 @@ class EncodeCache:
                 # change invalidates every cached row of the pool
                 entry.rows.clear()
         self._nodes_counter.inc(1, {"result": "hit" if hit else "miss"})
+        # residency ledger: the node tensors are re-transferred every
+        # cycle; a fingerprint hit means their encode-relevant content
+        # was unchanged — the transfer was residency waste
+        node_bytes = data_plane.NODE_ROW_BYTES * len(offers)
+        data_plane.note_residency(0 if hit else node_bytes,
+                                  node_bytes if hit else 0, kind="nodes")
         return nodes, fp
 
     # -------------------------------------------------------- feasibility
@@ -278,4 +285,9 @@ class EncodeCache:
             self._rows_counter.inc(hits, {"result": "hit"})
         if subset_idx:
             self._rows_counter.inc(len(subset_idx), {"result": "miss"})
+        # residency ledger (obs/data_plane.py): a cache-hit row's bytes
+        # were re-transferred UNCHANGED — the per-cycle rebuild_fraction
+        # is fresh / (fresh + cached) over exactly these row bytes
+        data_plane.note_residency(len(subset_idx) * n_nodes,
+                                  hits * n_nodes)
         return feasible

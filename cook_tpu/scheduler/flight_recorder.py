@@ -30,6 +30,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Optional
 
+from cook_tpu.obs import data_plane
 from cook_tpu.utils.metrics import global_registry
 
 # ---------------------------------------------------------------- reason codes
@@ -147,6 +148,17 @@ class CycleRecord:
     # (cook_tpu/elastic/) correlates with match outcomes record-to-record
     pool_capacity: dict = field(default_factory=dict)
     elastic_plan: int = 0
+    # data-plane accounting (obs/data_plane.py): logical host<->device
+    # bytes this cycle moved, the fraction of encode-row bytes freshly
+    # recomputed (1 - this = re-transferred unchanged — the waste a
+    # device-resident encode cache removes), the padded-bucket waste of
+    # the tensors built, and the per-tensor-family breakdown.  None =
+    # the cycle built/encoded nothing (idle pool, speculative hit)
+    h2d_bytes: int = 0
+    d2h_bytes: int = 0
+    rebuild_fraction: Optional[float] = None
+    padding_waste: Optional[float] = None
+    data_plane: dict = field(default_factory=dict)
     offers: int = 0
     queue_len: int = 0
     considered: int = 0
@@ -189,6 +201,11 @@ class CycleRecord:
             "block_stats": list(self.block_stats),
             "pool_capacity": dict(self.pool_capacity),
             "elastic_plan": self.elastic_plan,
+            "h2d_bytes": self.h2d_bytes,
+            "d2h_bytes": self.d2h_bytes,
+            "rebuild_fraction": self.rebuild_fraction,
+            "padding_waste": self.padding_waste,
+            "data_plane": dict(self.data_plane),
             "offers": self.offers,
             "queue_len": self.queue_len,
             "considered": self.considered,
@@ -220,6 +237,11 @@ class CycleBuilder:
         # for the cycle's lifetime (rank_cycle replaces, never mutates)
         self.rank_jobs: Optional[list] = None
         self.rank_dru: Optional[dict] = None
+        # per-cycle data-plane scope: the match paths activate it around
+        # their prepare/solve/launch sections (data_plane.activate) so
+        # transfer/residency/padding notes attribute to THIS cycle even
+        # under pipelined overlap; finish() folds it into the record
+        self.dp = data_plane.CycleDataPlane(pool, cycle_id)
         self._t0 = time.perf_counter()
 
     @contextmanager
@@ -308,6 +330,12 @@ class CycleBuilder:
         self.record.preemptions.append(preemption)
 
     def finish(self) -> CycleRecord:
+        rec = self.record
+        rec.h2d_bytes = self.dp.h2d_bytes
+        rec.d2h_bytes = self.dp.d2h_bytes
+        rec.rebuild_fraction = self.dp.rebuild_fraction
+        rec.padding_waste = self.dp.padding_waste
+        rec.data_plane = self.dp.families_json()
         if self.record.batched or self.record.pipelined:
             # the pool-batched and pipelined paths start every pool's
             # builder before any pool's work begins, so builder-lifetime
@@ -329,9 +357,11 @@ class CycleBuilder:
 class NullCycle:
     """No-op builder so instrumented code never branches on None.
     `record` is None so call sites can uniformly test `flight.record is
-    not None` instead of hasattr."""
+    not None` instead of hasattr (`dp` likewise — data_plane.activate
+    treats None as a no-op scope)."""
 
     record = None
+    dp = None
 
     @contextmanager
     def phase(self, name: str, device: bool = False):
@@ -407,6 +437,9 @@ class FlightRecorder:
 
     def commit(self, builder: CycleBuilder) -> CycleRecord:
         record = builder.finish()
+        # fold the cycle's data-plane scope into the process ledger
+        # (per-pool residency surface + /debug/device cycle ring)
+        data_plane.LEDGER.finish_cycle(builder.dp)
         record.not_considered = len(builder.not_considered)
         # rank position + DRU score per uuid for the history entries —
         # O(queue), same order as the not_considered indexing below

@@ -52,6 +52,7 @@ import numpy as np
 from cook_tpu.cluster.base import ComputeCluster
 from cook_tpu.models.entities import Job, Pool
 from cook_tpu.models.store import JobStore
+from cook_tpu.obs import data_plane
 from cook_tpu.scheduler import flight_recorder as flight_codes
 from cook_tpu.scheduler.flight_recorder import NULL_CYCLE
 from cook_tpu.scheduler.matcher import (
@@ -193,7 +194,14 @@ def match_pools_pipelined(
             solve_failed = False
             t_fetch = time.perf_counter()
             try:
-                assignment = stage.pending.fetch()
+                # re-activate THIS pool's data-plane scope for the
+                # fetch: under overlap the driving thread interleaves
+                # pool k's fetch with pool k±1's prepare/finalize, and
+                # each stage must credit its own cycle's byte counts
+                # (the disjointness the ledger tests pin)
+                with data_plane.activate(flight.dp), \
+                        data_plane.family(data_plane.FAM_SOLVE):
+                    assignment = stage.pending.fetch()
             except Exception:  # noqa: BLE001 — pool k's kernel raising
                 # (deferred device error surfaces at fetch) must not
                 # wedge pools k±1
@@ -264,7 +272,7 @@ def match_pools_pipelined(
                                      flight, telemetry, overlapped=True)
                 exit_device_fallback(stage.state, telemetry,
                                      stage.pool.name)
-        with flight.phase("launch"):
+        with data_plane.activate(flight.dp), flight.phase("launch"):
             outcomes[stage.pool.name] = finalize_pool_match(
                 store, stage.prepared, assignment, config, stage.state,
                 clusters,
@@ -297,7 +305,7 @@ def match_pools_pipelined(
                     >= depth):
                 finish(inflight.popleft())
             continue
-        with flight.phase("tensor_build"):
+        with data_plane.activate(flight.dp), flight.phase("tensor_build"):
             prepared = prepare_pool_problem(
                 store, pool, queues[pool.name], clusters, config, state,
                 launch_filter=launch_filter,
@@ -317,7 +325,8 @@ def match_pools_pipelined(
                 stage.pending = CpuFallbackPending(prepared, config)
                 stage.fallback_reason = fb_reason
             else:
-                with flight.phase("dispatch"):
+                with data_plane.activate(flight.dp), \
+                        flight.phase("dispatch"):
                     try:
                         stage.pending = dispatch_pool_solve(
                             prepared, config, telemetry=telemetry)

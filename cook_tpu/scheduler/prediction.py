@@ -696,7 +696,14 @@ class CycleSpeculator:
         if [j.uuid for j in fresh] != spec.considerable_uuids:
             return self._drop(name, DROP_QUEUE_SHIFTED)
         try:
-            assignment = np.asarray(spec.pending.fetch())
+            from cook_tpu.obs import data_plane
+
+            # a hit's ONLY transfer this cycle: the pre-solved
+            # assignment's D2H fetch (the tensor build ran during the
+            # previous cycle's drain) — labeled so hit cycles legibly
+            # report near-zero H2D on their records
+            with data_plane.family(data_plane.FAM_SOLVE):
+                assignment = np.asarray(spec.pending.fetch())
         except Exception:  # noqa: BLE001 — a deferred device error
             # surfaces at the speculative fetch; the cycle solves fresh
             log.exception("speculative solve failed at fetch (pool %s)",

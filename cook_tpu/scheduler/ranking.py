@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from cook_tpu.models.entities import DruMode, Job, Pool, Resources
 from cook_tpu.models.store import JobStore
+from cook_tpu.obs import data_plane
 from cook_tpu.ops.common import BIG, bucket_size, pad_to
 from cook_tpu.ops.dru import DruTasks, dru_rank
 
@@ -246,27 +247,36 @@ def rank_pool(
                 backfill[len(running) + k] = min(est / norm, 1.0)
 
     pad_t = bucket_size(n)
+    # DRU columns are their own data-plane family: the rank cycle's
+    # transfers are the second-largest per-cycle flow after the match
+    # tensors, and item 2(a)'s device-resident encode covers them too
+    h2d = data_plane.h2d
+    fam = data_plane.FAM_DRU
+    data_plane.note_padding("dru", (pad_t,), valid_cells=n,
+                            padded_cells=pad_t)
     tasks = DruTasks(
-        user=jnp.asarray(pad_to(user, pad_t)),
-        mem=jnp.asarray(pad_to(mem, pad_t)),
-        cpus=jnp.asarray(pad_to(cpus, pad_t)),
-        gpus=jnp.asarray(pad_to(gpus, pad_t)),
-        order_key=jnp.asarray(pad_to(order_key, pad_t, fill=BIG)),
-        valid=jnp.asarray(pad_to(np.ones(n, dtype=bool), pad_t, fill=False)),
+        user=h2d(pad_to(user, pad_t), family=fam),
+        mem=h2d(pad_to(mem, pad_t), family=fam),
+        cpus=h2d(pad_to(cpus, pad_t), family=fam),
+        gpus=h2d(pad_to(gpus, pad_t), family=fam),
+        order_key=h2d(pad_to(order_key, pad_t, fill=BIG), family=fam),
+        valid=h2d(pad_to(np.ones(n, dtype=bool), pad_t, fill=False),
+                  family=fam),
     )
     result = dru_rank(
         tasks,
-        jnp.asarray(mem_div),
-        jnp.asarray(cpu_div),
-        jnp.asarray(gpu_div),
+        h2d(mem_div, family=fam),
+        h2d(cpu_div, family=fam),
+        h2d(gpu_div, family=fam),
         gpu_mode=(pool.dru_mode == DruMode.GPU),
-        backfill=(jnp.asarray(pad_to(backfill, pad_t, fill=1.0))
+        backfill=(h2d(pad_to(backfill, pad_t, fill=1.0), family=fam)
                   if backfill is not None else None),
         backfill_weight=(jnp.float32(backfill_weight)
                          if backfill is not None else None),
     )
     order = np.asarray(result.order[:])
     dru = np.asarray(result.dru[:])
+    data_plane.note_d2h(order.nbytes + dru.nbytes, family=fam)
 
     ranked_jobs: list[Job] = []
     dru_map: dict[str, float] = {}

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from cook_tpu.models.columnar import ColumnarJobIndex
 from cook_tpu.models.entities import DruMode, Pool
 from cook_tpu.models.store import JobStore
+from cook_tpu.obs import data_plane
 from cook_tpu.ops.common import BIG, bucket_size, pad_to
 from cook_tpu.ops.dru import DruTasks, dru_rank
 from cook_tpu.scheduler.ranking import RankedQueue
@@ -135,21 +136,29 @@ def rank_pool_columnar(
         gpu_div[code] = min(share.gpus, BIG)
 
     pad_t = bucket_size(n)
+    # same data-plane accounting as the full encoder (ranking.rank_pool):
+    # DRU columns are their own transfer family
+    h2d = data_plane.h2d
+    fam = data_plane.FAM_DRU
+    data_plane.note_padding("dru", (pad_t,), valid_cells=n,
+                            padded_cells=pad_t)
     tasks = DruTasks(
-        user=jnp.asarray(pad_to(user, pad_t)),
-        mem=jnp.asarray(pad_to(mem.astype(np.float32), pad_t)),
-        cpus=jnp.asarray(pad_to(cpus.astype(np.float32), pad_t)),
-        gpus=jnp.asarray(pad_to(gpus.astype(np.float32), pad_t)),
-        order_key=jnp.asarray(pad_to(order_key, pad_t, fill=BIG)),
-        valid=jnp.asarray(pad_to(np.ones(n, bool), pad_t, fill=False)),
+        user=h2d(pad_to(user, pad_t), family=fam),
+        mem=h2d(pad_to(mem.astype(np.float32), pad_t), family=fam),
+        cpus=h2d(pad_to(cpus.astype(np.float32), pad_t), family=fam),
+        gpus=h2d(pad_to(gpus.astype(np.float32), pad_t), family=fam),
+        order_key=h2d(pad_to(order_key, pad_t, fill=BIG), family=fam),
+        valid=h2d(pad_to(np.ones(n, bool), pad_t, fill=False), family=fam),
     )
     result = dru_rank(
         tasks,
-        jnp.asarray(mem_div), jnp.asarray(cpu_div), jnp.asarray(gpu_div),
+        h2d(mem_div, family=fam), h2d(cpu_div, family=fam),
+        h2d(gpu_div, family=fam),
         gpu_mode=(pool.dru_mode == DruMode.GPU),
     )
     kernel_order = np.asarray(result.order)
     dru = np.asarray(result.dru)
+    data_plane.note_d2h(kernel_order.nbytes + dru.nbytes, family=fam)
 
     # pending positions in kernel order -> job objects
     pend_positions = kernel_order[(kernel_order >= n_run)

@@ -110,6 +110,11 @@ def cmd_run(args) -> int:
         # fraction of cycles served from a committed speculative solve +
         # the cycle-start-to-first-launch p50 it exists to lower
         "speculation": result.speculation_stats(),
+        # device data-plane summary (obs/data_plane.py): bytes the run
+        # moved host<->device, and how much of the encode traffic was
+        # re-transferred unchanged (mean rebuild_fraction ~0 on steady
+        # pools = the waste ROADMAP item 2(a) removes)
+        "data_plane": result.data_plane,
     }))
     if args.health_out:
         with open(args.health_out, "w") as f:

@@ -136,6 +136,11 @@ class SimResult:
     # incident bundles captured during the run (GET /debug/incidents
     # schema, full evidence) — written by sim.cli --incidents-out
     incidents: list[dict] = field(default_factory=list)
+    # device data-plane summary (obs/data_plane.py): H2D/D2H byte deltas
+    # this run moved (process-ledger delta, so concurrent sims in one
+    # process overlap — the simulator is single-flight in practice) plus
+    # the mean rebuild_fraction/padding_waste off the cycle records
+    data_plane: dict = field(default_factory=dict)
 
     def queued_wait_ms(self) -> list[int]:
         """Per-started-task queued wait (start - submit): the metric the
@@ -279,6 +284,9 @@ class Simulator:
                     faults.disarm()
 
     def _run(self) -> SimResult:
+        from cook_tpu.obs import data_plane as _dp
+
+        led_h2d0, led_d2h0 = _dp.LEDGER.byte_totals()
         cfg = self.config
         submitted = 0
         phase_wall: dict[str, float] = {"rank": 0.0, "match": 0.0,
@@ -375,14 +383,28 @@ class Simulator:
         # final flush so trailing completions land in the trace
         self.cluster.advance_to(self.now_ms)
         recorder = self.scheduler.recorder
+        led_h2d1, led_d2h1 = _dp.LEDGER.byte_totals()
+        records = (recorder.records_json(limit=recorder.capacity)
+                   if recorder is not None else [])
+        rebuilds = [r["rebuild_fraction"] for r in records
+                    if r.get("rebuild_fraction") is not None]
+        wastes = [r["padding_waste"] for r in records
+                  if r.get("padding_waste") is not None]
+        data_plane_summary = {
+            "h2d_bytes": led_h2d1 - led_h2d0,
+            "d2h_bytes": led_d2h1 - led_d2h0,
+            "mean_rebuild_fraction": (sum(rebuilds) / len(rebuilds)
+                                      if rebuilds else None),
+            "mean_padding_waste": (sum(wastes) / len(wastes)
+                                   if wastes else None),
+        }
         return SimResult(
             rows=self._collect_rows(),
             cycles=cycle,
             virtual_ms=self.now_ms,
             phase_wall_s=phase_wall,
             cycle_wall_s=cycle_wall,
-            cycle_records=(recorder.records_json(limit=recorder.capacity)
-                           if recorder is not None else []),
+            cycle_records=records,
             health=(self.scheduler.telemetry.health()
                     if self.scheduler.telemetry is not None else {}),
             elastic_plans=(
@@ -390,6 +412,7 @@ class Simulator:
                 if self.scheduler.elastic is not None else []),
             capacity_ledger=self.store.encoded_capacity_ledger(),
             incidents=self.scheduler.incidents.dump(),
+            data_plane=data_plane_summary,
         )
 
     def _collect_rows(self) -> list[dict]:

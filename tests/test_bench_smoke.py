@@ -50,6 +50,12 @@ def test_smoke_emits_structured_record(smoke_record):
     for phase in on_disk["phases"].values():
         assert phase["p50_ms"] > 0
         assert phase["backend"] == "cpu"
+    # data-plane byte stamps (obs/data_plane.py): the kernel phases
+    # carry deterministic h2d/d2h byte columns — the one signal
+    # bench_gate can diff even across a CPU-fallback/accelerator pair
+    for phase in ("match", "dru", "rebalance", "match_xl"):
+        assert on_disk["phases"][phase]["h2d_bytes"] > 0, phase
+        assert on_disk["phases"][phase]["d2h_bytes"] > 0, phase
     assert on_disk["headline"]["unit"] == "ms"
     assert record["phases"]["match"]["jobs"] == 1000
     # the control-plane phase gates commit-ack p50 and records the p99

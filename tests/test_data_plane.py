@@ -1,0 +1,559 @@
+"""Device data-plane observatory (cook_tpu/obs/data_plane.py): transfer
+ledger families, the residency ledger's rebuild_fraction (THE inducing
+test: cold cycle ~1.0, unchanged-pool re-cycle ~0.0, single-row store
+mutation in between), padding-waste accounting, fallback-family
+bucketing of the quality audit's device_put, pipelined per-cycle
+disjointness, speculation-hit near-zero H2D, roofline attribution, the
+`GET /debug/device` endpoint, and the bench-gate byte columns."""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import (
+    ConstraintOperator,
+    Job,
+    JobConstraint,
+    Pool,
+    Resources,
+)
+from cook_tpu.models.store import JobStore
+from cook_tpu.obs import data_plane
+from cook_tpu.obs.compile_observatory import CompileObservatory
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.scheduler.matcher import MatchConfig
+from tests.conftest import FakeClock, make_job
+
+NOWHERE = (JobConstraint("rack", ConstraintOperator.EQUALS, "nowhere"),)
+
+
+def blocked_job(uuid, user="u", mem=200.0):
+    """A job no host can satisfy (EQUALS constraint on an attribute no
+    host carries): it stays WAITING across cycles — the steady-queue
+    shape the residency ledger measures — while still encoding real
+    feasibility rows."""
+    return Job(uuid=uuid, user=user, pool="default", command="t",
+               resources=Resources(mem=mem, cpus=1),
+               constraints=NOWHERE)
+
+
+def make_scheduler(n_hosts=2, clock=None, **config_kw):
+    store = JobStore(clock=clock) if clock is not None else JobStore()
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock",
+        [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=8,
+                  pool="default") for i in range(n_hosts)],
+        clock=store.clock)
+    scheduler = Scheduler(store, [cluster],
+                          SchedulerConfig(match=MatchConfig(chunk=0),
+                                          **config_kw))
+    return store, cluster, scheduler
+
+
+def run_cycle(scheduler, store):
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    return scheduler.recorder.records(limit=1)[-1]
+
+
+# ------------------------------------------------------- scope mechanics
+
+
+def test_scope_attribution_and_family_labels():
+    scope = data_plane.CycleDataPlane("p", 1)
+    with data_plane.activate(scope):
+        data_plane.note_h2d(100, family=data_plane.FAM_NODE_ENCODE)
+        with data_plane.family(data_plane.FAM_DRU):
+            data_plane.note_d2h(40)          # labeled by ambient family
+        data_plane.note_d2h(7)               # no family -> "other"
+        data_plane.note_residency(30, 70)
+        data_plane.note_padding("match", (8, 8), 10, 64)
+    assert scope.h2d_bytes == 100 and scope.d2h_bytes == 47
+    fams = scope.families_json()
+    assert fams[data_plane.FAM_DRU]["d2h_bytes"] == 40
+    assert fams[data_plane.FAM_OTHER]["d2h_bytes"] == 7
+    assert scope.rebuild_fraction == pytest.approx(0.3)
+    assert scope.padding_waste == pytest.approx(1 - 10 / 64)
+    # zero-byte notes are dropped, not minted as empty family slots
+    data_plane.note_h2d(0, family="never")
+    assert "never" not in data_plane.LEDGER.family_totals()
+
+
+def test_activate_is_reentrant_and_none_tolerant():
+    scope = data_plane.CycleDataPlane("p", 1)
+    with data_plane.activate(None):
+        assert data_plane.active_scope() is None
+    with data_plane.activate(scope), data_plane.activate(scope):
+        data_plane.note_h2d(5, family="x")
+    # credited ONCE (innermost wins; same object either way)
+    assert scope.h2d_bytes == 5
+
+
+def test_empty_scope_not_folded_into_cycle_ring():
+    before = len(data_plane.LEDGER.snapshot()["cycles"])
+    data_plane.LEDGER.finish_cycle(data_plane.CycleDataPlane("idle", 9))
+    assert len(data_plane.LEDGER.snapshot()["cycles"]) == before
+
+
+def test_detached_masks_the_enclosing_scope():
+    """Audit/shadow sections inside an activated cycle report to the
+    ledger only — never to the driving cycle's record."""
+    scope = data_plane.CycleDataPlane("p", 1)
+    fam = data_plane.FAM_FALLBACK
+
+    def fallback_d2h():
+        slot = data_plane.LEDGER.family_totals().get(fam, {})
+        return slot.get("d2h_bytes", 0)
+
+    before = fallback_d2h()
+    with data_plane.activate(scope):
+        with data_plane.detached(), data_plane.family(fam):
+            data_plane.note_d2h(512)
+    assert scope.d2h_bytes == 0
+    assert fallback_d2h() == before + 512
+
+
+def test_snapshot_cycles_zero_returns_no_cycles():
+    scope = data_plane.CycleDataPlane("p", 3)
+    scope.note_h2d(1, "x")
+    data_plane.LEDGER.finish_cycle(scope)
+    assert data_plane.LEDGER.snapshot(cycles=0)["cycles"] == []
+    assert data_plane.LEDGER.snapshot(cycles=1)["cycles"]
+
+
+def test_quality_shadow_solve_stays_off_the_cycle_record():
+    """Every-cycle shadow sampling must not inflate the record's D2H:
+    its full-problem fetches bucket under `fallback` in the ledger and
+    bypass the active cycle scope."""
+    store, _cluster, scheduler = make_scheduler(quality_sample_every=1)
+    store.submit_jobs([blocked_job("j0")])
+    record = run_cycle(scheduler, store)
+    # only the assignment fetch lands on the record (shadow fetched the
+    # whole padded problem — orders of magnitude more than this)
+    assert record.d2h_bytes < 1024
+    assert data_plane.FAM_FALLBACK not in record.data_plane
+    slot = data_plane.LEDGER.family_totals()[data_plane.FAM_FALLBACK]
+    # the shadow fetched the padded demand/avail/totals tensors — far
+    # more than the record's own (assignment-only) D2H
+    assert slot["d2h_bytes"] > max(record.d2h_bytes, 1024)
+
+
+# --------------------------------------------- residency (inducing test)
+
+
+def test_rebuild_fraction_cold_warm_and_single_row_mutation():
+    """THE headline signal: a cold cycle rebuilds everything (~1.0), an
+    unchanged pool re-served from the encode cache rebuilds nothing
+    (~0.0) — yet still re-transfers the full encode tensors, the waste
+    item 2(a) removes — and one store mutation (a new job = one fresh
+    row) lands strictly in between."""
+    store, _cluster, scheduler = make_scheduler()
+    store.submit_jobs([blocked_job(f"j{i}") for i in range(10)])
+    r1 = run_cycle(scheduler, store)
+    assert r1.rebuild_fraction == pytest.approx(1.0)
+    assert r1.h2d_bytes > 0
+
+    r2 = run_cycle(scheduler, store)
+    assert r2.rebuild_fraction == pytest.approx(0.0)
+    # the unchanged pool still re-transferred the full encode tensors:
+    # that H2D times (1 - rebuild_fraction) is the device-residency waste
+    assert r2.h2d_bytes == r1.h2d_bytes
+
+    store.submit_jobs([blocked_job("fresh")])
+    r3 = run_cycle(scheduler, store)
+    assert r3.rebuild_fraction == pytest.approx(1 / 11)
+    assert 0.0 < r3.rebuild_fraction < 0.5
+
+    # the per-pool residency surface mirrors the last cycle
+    res = data_plane.LEDGER.snapshot()["residency"]["default"]
+    assert res["rebuild_fraction"] == pytest.approx(r3.rebuild_fraction)
+    # and the record's JSON render carries every data-plane field
+    body = r3.to_json()
+    for key in ("h2d_bytes", "d2h_bytes", "rebuild_fraction",
+                "padding_waste", "data_plane"):
+        assert key in body
+    assert body["data_plane"][data_plane.FAM_FEASIBILITY]["h2d_bytes"] > 0
+
+
+def test_cache_bypass_reports_full_rebuild_every_cycle():
+    store, _cluster, scheduler = make_scheduler(use_encode_cache=False)
+    store.submit_jobs([blocked_job(f"j{i}") for i in range(4)])
+    run_cycle(scheduler, store)
+    r2 = run_cycle(scheduler, store)
+    assert r2.rebuild_fraction == pytest.approx(1.0)
+
+
+def test_padding_waste_on_record_matches_bucket_math():
+    store, _cluster, scheduler = make_scheduler(n_hosts=2)
+    store.submit_jobs([blocked_job(f"j{i}") for i in range(10)])
+    record = run_cycle(scheduler, store)
+    # 10 jobs x 2 nodes valid inside the 64 x 64 minimum buckets
+    assert record.padding_waste == pytest.approx(1 - 20 / 4096)
+
+
+# ------------------------------------------------- pipelined disjointness
+
+
+def test_pipelined_cycles_report_disjoint_byte_counts():
+    """Overlapping pool k/k+1 solves attribute bytes to THEIR OWN cycle
+    records: per-pool sums equal the ledger's family deltas exactly (no
+    double count), and the bigger pool's padded bucket shows up only on
+    its own record."""
+    store = JobStore()
+    store.set_pool(Pool(name="a"))
+    store.set_pool(Pool(name="b"))
+    hosts_a = [MockHost(node_id="a0", hostname="a0", mem=4000, cpus=8,
+                        pool="a")]
+    # pool b pads its node axis to 128 (> the 64 minimum bucket), so its
+    # per-cycle bytes are strictly larger than pool a's — shared/global
+    # accounting could never reproduce that split
+    hosts_b = [MockHost(node_id=f"b{i}", hostname=f"b{i}", mem=4000,
+                        cpus=8, pool="b") for i in range(70)]
+    cluster = MockCluster("mock", hosts_a + hosts_b, clock=store.clock)
+    scheduler = Scheduler(store, [cluster],
+                          SchedulerConfig(match=MatchConfig(chunk=0)))
+    store.submit_jobs(
+        [blocked_job(f"a{i}").with_(pool="a") for i in range(3)]
+        + [blocked_job(f"b{i}").with_(pool="b") for i in range(3)])
+    for name in ("a", "b"):
+        scheduler.rank_cycle(store.pools[name])
+
+    families = (data_plane.FAM_NODE_ENCODE, data_plane.FAM_FEASIBILITY,
+                data_plane.FAM_SOLVE)
+    before = {f: dict(data_plane.LEDGER.family_totals().get(
+        f, {"h2d_bytes": 0, "d2h_bytes": 0})) for f in families}
+    scheduler.match_cycle_pipelined()
+    after = data_plane.LEDGER.family_totals()
+
+    records = {r.pool: r for r in scheduler.recorder.records(limit=2)}
+    ra, rb = records["a"], records["b"]
+    assert ra.pipelined and rb.pipelined
+    assert ra.h2d_bytes > 0 and rb.h2d_bytes > 0
+    assert rb.h2d_bytes > ra.h2d_bytes  # 128-node bucket vs 64
+    for fam in families:
+        delta_h2d = after[fam]["h2d_bytes"] - before[fam]["h2d_bytes"]
+        delta_d2h = after[fam]["d2h_bytes"] - before[fam]["d2h_bytes"]
+        fa = ra.data_plane.get(fam, {})
+        fb = rb.data_plane.get(fam, {})
+        assert fa.get("h2d_bytes", 0) + fb.get("h2d_bytes", 0) \
+            == delta_h2d, fam
+        assert fa.get("d2h_bytes", 0) + fb.get("d2h_bytes", 0) \
+            == delta_d2h, fam
+
+
+# -------------------------------------------------- speculation-hit H2D
+
+
+def test_speculation_hit_reports_near_zero_h2d():
+    """A cycle served from a committed speculation moved its tensors
+    during the PREVIOUS cycle's drain: the hit cycle's own record shows
+    zero H2D and only the tiny assignment fetch as D2H — the
+    device-residency behavior item 2(a) generalizes."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock", [MockHost(node_id="h0", hostname="h0", mem=1000, cpus=4,
+                          pool="default")], clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=0), speculation=True,
+        speculation_horizon_ms=10_000, predictor_min_samples=1))
+    store.submit_jobs([
+        make_job(user="u0", mem=1000, cpus=4).with_(
+            uuid=f"j{i}", expected_runtime_ms=10_000) for i in range(3)])
+
+    def cycle():
+        pool = store.pools["default"]
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+        return scheduler.recorder.records(limit=1)[-1]
+
+    r1 = cycle()                      # j0 fresh
+    assert r1.h2d_bytes > 0
+    clock.advance(10_000); cluster.advance_to(clock())
+    cycle()                           # j1 fresh; speculates j2
+    clock.advance(10_000); cluster.advance_to(clock())
+    r3 = cycle()                      # served from speculation
+    assert r3.speculation == "hit"
+    assert r3.h2d_bytes == 0
+    assert 0 < r3.d2h_bytes < 4096
+    assert r3.data_plane.get(data_plane.FAM_SOLVE, {}).get("d2h_bytes",
+                                                           0) > 0
+
+
+# -------------------------------------------------- fallback bucketing
+
+
+def test_quality_audit_device_put_buckets_under_fallback_family():
+    """The audit re-stages the whole problem host-side (scheduler/
+    matcher.audit_match_quality): those bytes land in the distinct
+    `fallback` family — device-family totals must not move."""
+    from cook_tpu.scheduler.matcher import (
+        PoolMatchState,
+        audit_match_quality,
+        prepare_pool_problem,
+    )
+    from cook_tpu.scheduler.flight_recorder import NULL_CYCLE
+
+    store, _cluster, scheduler = make_scheduler()
+    store.submit_jobs([Job(uuid="j0", user="u", pool="default",
+                           command="t",
+                           resources=Resources(mem=200, cpus=1))])
+    pool = store.pools["default"]
+    queue = scheduler.rank_cycle(pool)
+    config = MatchConfig(chunk=0)
+    prepared = prepare_pool_problem(
+        store, pool, queue, scheduler.clusters, config,
+        PoolMatchState(num_considerable=100), flight=NULL_CYCLE)
+    assert prepared.solvable
+
+    totals_before = data_plane.LEDGER.family_totals()
+
+    def fam_bytes(totals, fam):
+        slot = totals.get(fam, {})
+        return (slot.get("h2d_bytes", 0), slot.get("d2h_bytes", 0))
+
+    audit_match_quality(prepared, np.zeros(1, dtype=np.int32), "default")
+    totals_after = data_plane.LEDGER.family_totals()
+    fb_before = fam_bytes(totals_before, data_plane.FAM_FALLBACK)
+    fb_after = fam_bytes(totals_after, data_plane.FAM_FALLBACK)
+    assert fb_after[0] > fb_before[0]   # the problem's put
+    assert fb_after[1] > fb_before[1]   # the exact assignment's fetch
+    for fam in (data_plane.FAM_NODE_ENCODE, data_plane.FAM_FEASIBILITY):
+        assert fam_bytes(totals_after, fam) == \
+            fam_bytes(totals_before, fam), fam
+
+
+def test_cpu_fallback_cycle_moves_no_device_bytes():
+    """Reaction-(c) cycles solve on the host reference: their records
+    carry the tensor-build H2D (the problem was still encoded) but no
+    solve-fetch D2H, and nothing lands in the device solve family."""
+    from cook_tpu import faults
+
+    store, _cluster, scheduler = make_scheduler(
+        )
+    scheduler.config.match.device_fallback_cycles = 4
+    store.submit_jobs([blocked_job("j0")])
+    with faults.injected({"point": faults.DEVICE_SOLVE, "times": 1}):
+        r1 = run_cycle(scheduler, store)
+    assert r1.backend == "cpu-fallback"
+    assert r1.data_plane.get(data_plane.FAM_SOLVE,
+                             {}).get("d2h_bytes", 0) == 0
+
+
+# ------------------------------------------------------------- roofline
+
+
+def test_roofline_probe_inline_caches_cost_in_observatory():
+    obs = CompileObservatory()
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = np.ones((32, 32), dtype=np.float32)
+    cost = data_plane.probe_roofline(obs, "toy", (32, 32), "xla", f, x,
+                                     inline=True)
+    assert cost is not None and cost["flops"] > 0
+    assert obs.cost("toy", "32x32", "xla") == cost
+    # second probe is a no-op (cost cached)
+    assert data_plane.probe_roofline(obs, "toy", (32, 32), "xla", f,
+                                     x, inline=True) is None
+    # a warm solve wall joins into achieved throughput
+    obs.observe_solve("toy", (32, 32), "xla", seconds=0.5)  # compile
+    obs.observe_solve("toy", (32, 32), "xla", seconds=0.5)  # warm
+    rows = obs.cost_stats()
+    assert rows and rows[0]["op"] == "toy"
+    assert rows[0]["achieved_gflops"] == pytest.approx(
+        cost["flops"] / 0.5 / 1e9)
+    assert rows[0]["arithmetic_intensity"] > 0
+
+
+def test_match_cycle_populates_roofline_cache():
+    store, _cluster, scheduler = make_scheduler()
+    store.submit_jobs([blocked_job("j0")])
+    run_cycle(scheduler, store)
+    # the background probe is single-flight; join it via the lock
+    with data_plane._probe_lock:
+        pass
+    rows = scheduler.telemetry.observatory.cost_stats()
+    assert any(r["op"] == "match" for r in rows)
+
+
+def test_cost_analysis_never_raises_on_unlowerable_fn():
+    assert data_plane.cost_analysis(lambda x: x, 1) is None
+
+
+# ------------------------------------------------------- REST endpoint
+
+
+def test_debug_device_endpoint():
+    from cook_tpu.rest.api import ApiConfig, CookApi
+    from cook_tpu.rest.server import ServerThread
+    import urllib.request
+
+    store, _cluster, scheduler = make_scheduler()
+    store.submit_jobs([blocked_job("j0")])
+    run_cycle(scheduler, store)
+    api = CookApi(store, scheduler, ApiConfig())
+    server = ServerThread(api).start()
+    try:
+        req = urllib.request.Request(
+            server.url + "/debug/device",
+            headers={"X-Cook-Requesting-User": "admin"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+    finally:
+        server.stop()
+    assert body["device_telemetry"] is True
+    assert body["transfers"]["h2d_bytes"] > 0
+    assert set(body) >= {"transfers", "residency", "padding", "cycles",
+                         "roofline"}
+    assert data_plane.FAM_NODE_ENCODE in body["transfers"]["families"]
+    assert "default" in body["residency"]
+
+
+# -------------------------------------------------- bench gate / history
+
+
+def _record(path, backend, phases):
+    return {"path": path, "mode": "smoke", "platform": backend,
+            "backend": backend, "phases": phases}
+
+
+def _gate():
+    import importlib.util
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+    import bench_gate
+
+    return bench_gate
+
+
+def test_bench_gate_diffs_byte_columns_same_backend():
+    bench_gate = _gate()
+    old = _record("r1", "cpu", {"match": {"p50_ms": 10.0,
+                                          "h2d_bytes": 100,
+                                          "d2h_bytes": 50}})
+    new = _record("r2", "cpu", {"match": {"p50_ms": 10.5,
+                                          "h2d_bytes": 100,
+                                          "d2h_bytes": 50}})
+    code, messages = bench_gate.gate([old, new], 0.2)
+    assert code == 0
+    assert any("h2d_bytes 100 -> 100" in m for m in messages)
+
+
+def test_bench_gate_bytes_threshold_fails_on_growth():
+    bench_gate = _gate()
+    old = _record("r1", "cpu", {"match": {"p50_ms": 10.0,
+                                          "h2d_bytes": 100}})
+    new = _record("r2", "cpu", {"match": {"p50_ms": 10.0,
+                                          "h2d_bytes": 300}})
+    code, messages = bench_gate.gate([old, new], 0.2,
+                                     bytes_threshold=0.5)
+    assert code == 1
+    assert any("h2d_bytes 100 -> 300" in m and "REGRESSION" in m
+               for m in messages)
+    # without the threshold the growth is informational only
+    code, _ = bench_gate.gate([old, new], 0.2)
+    assert code == 0
+
+
+def test_bench_gate_zero_baseline_growth_trips_threshold():
+    """Growth from a zero baseline is unbounded, not 0%: a phase that
+    moved no bytes suddenly moving megabytes must trip any threshold."""
+    bench_gate = _gate()
+    old = _record("r1", "cpu", {"match": {"p50_ms": 10.0,
+                                          "d2h_bytes": 0}})
+    new = _record("r2", "cpu", {"match": {"p50_ms": 10.0,
+                                          "d2h_bytes": 52428800}})
+    code, messages = bench_gate.gate([old, new], 0.2,
+                                     bytes_threshold=0.1)
+    assert code == 1
+    assert any("from zero" in m and "REGRESSION" in m for m in messages)
+
+
+def test_bench_gate_bytes_only_cli_inherits_threshold(tmp_path):
+    """--bytes-only without --bytes-threshold must still be a GATE:
+    main() inherits --threshold so arbitrary byte growth fails."""
+    bench_gate = _gate()
+    base = {"schema": "cook-bench/v1", "mode": "smoke",
+            "platform": "cpu", "backend": "cpu"}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        dict(base, phases={"match": {"p50_ms": 10.0,
+                                     "h2d_bytes": 100}})))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        dict(base, phases={"match": {"p50_ms": 10.0,
+                                     "h2d_bytes": 1000}})))
+    assert bench_gate.main(["--dir", str(tmp_path), "--bytes-only"]) == 1
+    # generous explicit threshold passes the same pair
+    assert bench_gate.main(["--dir", str(tmp_path), "--bytes-only",
+                            "--bytes-threshold", "20.0"]) == 0
+
+
+def test_bench_gate_bytes_only_fails_on_dropped_measurements():
+    """--bytes-only IS the whole gate: a byte column or phase that
+    silently vanished from the new record must fail it, exactly like
+    the timing gate's missing-phase rule."""
+    bench_gate = _gate()
+    old = _record("r1", "cpu", {
+        "match": {"p50_ms": 10.0, "h2d_bytes": 100},
+        "match_xl": {"p50_ms": 5.0, "h2d_bytes": 7}})
+    new = _record("r2", "cpu", {"match": {"p50_ms": 10.0}})
+    code, messages = bench_gate.gate([old, new], 0.2, bytes_only=True)
+    assert code == 1
+    assert any("h2d_bytes dropped" in m for m in messages)
+    assert any("match_xl: missing" in m for m in messages)
+
+
+def test_bench_gate_bytes_survive_cross_backend_refusal():
+    """Bytes are backend-stable: the byte diff renders even for a pair
+    whose timings the gate refuses, and --bytes-only gates such a pair
+    cleanly on traffic alone."""
+    bench_gate = _gate()
+    old = _record("r1", "cpu", {"match": {"p50_ms": 800.0,
+                                          "h2d_bytes": 100,
+                                          "d2h_bytes": 50}})
+    new = dict(_record("r2", "tpu", {"match": {"p50_ms": 5.0,
+                                               "h2d_bytes": 100,
+                                               "d2h_bytes": 50}}),
+               platform="cpu")  # same (mode, platform) family
+    code, messages = bench_gate.gate([old, new], 0.2)
+    assert code == 1  # timing refusal stands
+    assert any("REFUSED" in m for m in messages)
+    assert any("h2d_bytes 100 -> 100" in m for m in messages)
+    code, messages = bench_gate.gate([old, new], 0.2, bytes_only=True)
+    assert code == 0
+    assert not any("REFUSED" in m for m in messages)
+
+
+def test_bench_history_table(tmp_path):
+    import bench_history
+
+    record = {"schema": "cook-bench/v1", "mode": "smoke",
+              "platform": "cpu", "backend": "cpu",
+              "phases": {"match": {"p50_ms": 12.5, "h2d_bytes": 640,
+                                   "d2h_bytes": 64},
+                         "dru": {"p50_ms": 3.0}}}
+    path = tmp_path / "BENCH_r01.json"
+    path.write_text(json.dumps(record))
+    bench_gate = _gate()
+    rows = bench_history.history_rows(
+        bench_gate.collect_records([str(path)]))
+    assert {r["phase"] for r in rows} == {"match", "dru"}
+    match = next(r for r in rows if r["phase"] == "match")
+    assert match["h2d_bytes"] == "640" and match["backend"] == "cpu"
+    dru = next(r for r in rows if r["phase"] == "dru")
+    assert dru["h2d_bytes"] == "-"  # records without the stamp render -
+    table = bench_history.render_table(rows)
+    assert "BENCH_r01.json" in table and "640" in table
+    md = bench_history.render_table(rows, markdown=True)
+    assert md.startswith("| round |")

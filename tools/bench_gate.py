@@ -21,12 +21,23 @@ tier.  This gate:
      (default 20%) AND by more than --min-delta-ms (default 2 ms,
      absolute) fails the gate — the absolute floor keeps sub-10 ms
      phases, whose 20% band sits inside OS scheduler jitter on a loaded
-     box, from flapping the gate.
+     box, from flapping the gate;
+  5. diffs the data-plane byte columns (`h2d_bytes`/`d2h_bytes`,
+     obs/data_plane.py) on every shared phase that carries them —
+     BEFORE and regardless of the backend refusal, because logical
+     bytes are backend-stable (a CPU-fallback round moves the same
+     bytes a TPU round would).  Byte diffs are informational by
+     default; --bytes-threshold makes a relative H2D/D2H growth past
+     it fail the gate, and --bytes-only restricts the whole gate to the
+     byte columns (the cross-backend-safe mode: compare a CPU-fallback
+     round against an accelerator round by traffic alone).
 
 Exit codes: 0 pass / nothing to compare, 1 regression, 2 usage error.
 
     python tools/bench_gate.py [--dir ROOT] [--threshold 0.2]
-                               [--min-delta-ms 2.0] [files...]
+                               [--min-delta-ms 2.0]
+                               [--bytes-threshold R] [--bytes-only]
+                               [files...]
 """
 from __future__ import annotations
 
@@ -65,7 +76,11 @@ def load_record(path: str) -> dict | None:
         "backend": data.get("backend"),
         "phases": {
             name: {"p50_ms": float(info["p50_ms"]),
-                   "backend": info.get("backend")}
+                   "backend": info.get("backend"),
+                   # data-plane byte stamps (optional: records predating
+                   # the ledger simply diff nothing)
+                   **{col: int(info[col]) for col in
+                      ("h2d_bytes", "d2h_bytes") if col in info}}
             for name, info in phases.items()
             if isinstance(info, dict) and "p50_ms" in info
         },
@@ -86,8 +101,63 @@ def collect_records(paths: list[str]) -> list[dict]:
     return records
 
 
+def diff_bytes(old: dict, new: dict, bytes_threshold,
+               messages: list[str], regressions: list[str],
+               require: bool = False) -> None:
+    """Diff the data-plane byte columns of every shared phase carrying
+    them.  Bytes are DETERMINISTIC (same code -> same logical bytes) and
+    backend-stable, so this runs even for pairs the timing gate refuses.
+    Informational unless `bytes_threshold` is set, in which case a
+    relative byte GROWTH past it regresses the phase.  `require=True`
+    (the --bytes-only mode, where this IS the whole gate) additionally
+    counts a byte column or whole phase that VANISHED from the new
+    record as regressed — the same silently-dropped-measurement rule
+    the timing gate applies to missing phases."""
+    if require:
+        for phase in sorted(set(old["phases"]) - set(new["phases"])):
+            messages.append(f"bench_gate:   {phase}: missing from the "
+                            f"new record — counted as regressed")
+            regressions.append(f"{phase} (missing)")
+    for phase in sorted(set(old["phases"]) & set(new["phases"])):
+        oinfo, ninfo = old["phases"][phase], new["phases"][phase]
+        for col in ("h2d_bytes", "d2h_bytes"):
+            if col not in oinfo:
+                continue
+            if col not in ninfo:
+                if require:
+                    messages.append(
+                        f"bench_gate:   {phase}: {col} dropped from the "
+                        f"new record — counted as regressed")
+                    regressions.append(f"{phase} ({col} missing)")
+                continue
+            before, after = oinfo[col], ninfo[col]
+            if before > 0:
+                delta = (after - before) / before
+                delta_txt = f"{delta:+.1%}"
+            elif after > 0:
+                # growth from a zero baseline is unbounded, not 0%: it
+                # must trip any threshold (a phase that moved no bytes
+                # suddenly moving megabytes is the largest possible
+                # regression, not a non-event)
+                delta = float("inf")
+                delta_txt = "from zero"
+            else:
+                delta = 0.0
+                delta_txt = "+0.0%"
+            regressed = (bytes_threshold is not None
+                         and delta > bytes_threshold)
+            status = "REGRESSION" if regressed else (
+                "ok" if after == before else "changed")
+            messages.append(
+                f"bench_gate:   {phase}: {col} {before} -> {after} "
+                f"({delta_txt}) {status}")
+            if regressed:
+                regressions.append(f"{phase} ({col})")
+
+
 def gate(records: list[dict], threshold: float,
-         min_delta_ms: float = 2.0) -> tuple[int, list[str]]:
+         min_delta_ms: float = 2.0, bytes_threshold: float = None,
+         bytes_only: bool = False) -> tuple[int, list[str]]:
     """(exit_code, messages).  Records are grouped by (mode, platform) —
     a CPU-fallback round must not "regress" against a real-TPU round,
     and the singleton smoke record must not shadow the full-round family
@@ -109,18 +179,32 @@ def gate(records: list[dict], threshold: float,
             f"bench_gate: {old['path']} -> {new['path']} "
             f"(mode={mode}, platform={platform}, "
             f"threshold {threshold:.0%})")
-        if (old.get("backend") and new.get("backend")
-                and old["backend"] != new["backend"]):
-            # diffing across backends is a measurement error, not a
-            # regression signal; refuse the pair loudly
+        regressions: list[str] = []
+        # byte columns diff FIRST — they are backend-stable, so they
+        # survive the cross-backend refusal below
+        diff_bytes(old, new, bytes_threshold, messages, regressions,
+                   require=bytes_only)
+        cross_backend = (old.get("backend") and new.get("backend")
+                         and old["backend"] != new["backend"])
+        if bytes_only:
+            if regressions:
+                regressed_families += 1
+                messages.append(
+                    f"bench_gate: FAIL — {len(regressions)} byte "
+                    f"column(s) regressed: {', '.join(regressions)}")
+            continue
+        if cross_backend:
+            # diffing TIMINGS across backends is a measurement error,
+            # not a regression signal; refuse the pair loudly (the byte
+            # diff above already ran — use --bytes-only to gate such
+            # pairs on traffic alone)
             messages.append(
                 f"bench_gate: REFUSED — records were taken on different "
                 f"resolved JAX backends ({old['backend']} vs "
                 f"{new['backend']}); re-run the bench on matching "
-                f"hardware before gating")
+                f"hardware before gating (or pass --bytes-only)")
             regressed_families += 1
             continue
-        regressions = []
         for phase in sorted(set(old["phases"]) & set(new["phases"])):
             oinfo, ninfo = old["phases"][phase], new["phases"][phase]
             if (oinfo.get("backend") and ninfo.get("backend")
@@ -183,6 +267,17 @@ def main(argv: list[str] | None = None) -> int:
                         help="absolute slowdown below this never counts "
                              "as a regression (jitter floor for tiny "
                              "phases)")
+    parser.add_argument("--bytes-threshold", type=float, default=None,
+                        help="fail when a phase's h2d/d2h bytes GREW by "
+                             "more than this fraction (default: byte "
+                             "diffs are informational)")
+    parser.add_argument("--bytes-only", action="store_true",
+                        help="gate ONLY the data-plane byte columns — "
+                             "bytes are backend-stable, so this mode "
+                             "compares across CPU-fallback/accelerator "
+                             "pairs the timing gate refuses; inherits "
+                             "--threshold when --bytes-threshold is "
+                             "not given (a gate must be able to fail)")
     args = parser.parse_args(argv)
     if args.threshold <= 0:
         print("bench_gate: --threshold must be positive", file=sys.stderr)
@@ -190,11 +285,18 @@ def main(argv: list[str] | None = None) -> int:
     if args.min_delta_ms < 0:
         print("bench_gate: --min-delta-ms must be >= 0", file=sys.stderr)
         return 2
+    if args.bytes_only and args.bytes_threshold is None:
+        # --bytes-only IS a gate: without an enforcing threshold it
+        # would print informational diffs and pass unconditionally —
+        # inherit the timing threshold so the mode fails on real growth
+        args.bytes_threshold = args.threshold
     paths = args.files or sorted(
         glob.glob(os.path.join(args.dir, "BENCH_r*.json")),
         key=lambda p: (_round_key(p), os.path.getmtime(p)))
     code, messages = gate(collect_records(paths), args.threshold,
-                          args.min_delta_ms)
+                          args.min_delta_ms,
+                          bytes_threshold=args.bytes_threshold,
+                          bytes_only=args.bytes_only)
     for message in messages:
         print(message)
     return code

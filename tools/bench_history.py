@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Bench trajectory table: every BENCH_r*.json round at a glance.
+
+The repo accumulates one structured bench record per round
+(BENCH_r{NN}_phases.json, BENCH_rsmoke.json) plus the driver's wrapper
+artifacts, but nothing rendered the TRAJECTORY — which rounds ran on
+which backend, how each phase's p50 moved, and (since the data-plane
+observatory) how many bytes each phase pushes across the host<->device
+boundary.  `tools/bench_gate.py` judges the newest pair; this tool
+prints the whole history as one compact aligned table:
+
+    round              mode   backend  phase         p50_ms  h2d_bytes  d2h_bytes
+    BENCH_r01.json     full   cpu      match        16234.0          -          -
+    ...
+
+Byte columns render `-` for records predating the ledger; the backend
+stamp makes CPU-fallback rounds legible in the same view (all five
+seed rounds are exactly that).  See docs/operations.md for the
+reporting recipe.
+
+    python tools/bench_history.py [--dir ROOT] [--phases match,match_xl]
+                                  [--markdown] [files...]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from bench_gate import _round_key, collect_records  # noqa: E402
+
+COLUMNS = ("round", "mode", "backend", "phase", "p50_ms", "h2d_bytes",
+           "d2h_bytes")
+
+
+def history_rows(records: list[dict],
+                 phases: list[str] | None = None) -> list[dict]:
+    """One row per (record, phase), record order preserved (callers pass
+    round-sorted records).  `phases` filters; None keeps everything."""
+    rows = []
+    for record in records:
+        for name, info in sorted(record["phases"].items()):
+            if phases and name not in phases:
+                continue
+            rows.append({
+                "round": os.path.basename(record["path"]),
+                "mode": record["mode"],
+                # phase-level stamp wins (one phase can be measured on a
+                # different backend than the record's resolved one)
+                "backend": (info.get("backend") or record.get("backend")
+                            or "?"),
+                "phase": name,
+                "p50_ms": f"{info['p50_ms']:.1f}",
+                "h2d_bytes": (str(info["h2d_bytes"])
+                              if "h2d_bytes" in info else "-"),
+                "d2h_bytes": (str(info["d2h_bytes"])
+                              if "d2h_bytes" in info else "-"),
+            })
+    return rows
+
+
+def render_table(rows: list[dict], markdown: bool = False) -> str:
+    if not rows:
+        return "bench_history: no structured bench records found"
+    widths = {col: max(len(col), *(len(r[col]) for r in rows))
+              for col in COLUMNS}
+    if markdown:
+        lines = ["| " + " | ".join(COLUMNS) + " |",
+                 "|" + "|".join("---" for _ in COLUMNS) + "|"]
+        lines += ["| " + " | ".join(r[col] for col in COLUMNS) + " |"
+                  for r in rows]
+        return "\n".join(lines)
+    lines = ["  ".join(col.ljust(widths[col]) for col in COLUMNS)]
+    for r in rows:
+        lines.append("  ".join(r[col].ljust(widths[col])
+                               for col in COLUMNS))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="print the bench-record trajectory as one table")
+    parser.add_argument("files", nargs="*",
+                        help="explicit record paths (oldest first); "
+                             "default: BENCH_r*.json in --dir")
+    parser.add_argument("--dir", default=os.path.dirname(_TOOLS))
+    parser.add_argument("--phases", default="",
+                        help="comma-separated phase filter "
+                             "(default: every phase)")
+    parser.add_argument("--markdown", action="store_true",
+                        help="emit a markdown table (paste into docs/"
+                             "status reports)")
+    args = parser.parse_args(argv)
+    paths = args.files or sorted(
+        glob.glob(os.path.join(args.dir, "BENCH_r*.json")),
+        key=lambda p: (_round_key(p), os.path.getmtime(p)))
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()] \
+        or None
+    rows = history_rows(collect_records(paths), phases)
+    print(render_table(rows, markdown=args.markdown))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
