@@ -645,6 +645,103 @@ def bench_speculation(*, smoke=False) -> dict:
     }
 
 
+def encode_family_mark():
+    """Node-encode + job-feasibility H2D totals — the exact families the
+    device-resident mirror (scheduler/device_state.py) keeps on device;
+    the match_resident phase's warm-vs-cold claim is judged on these."""
+    totals = _data_plane().LEDGER.family_totals()
+    dp = _data_plane()
+    return sum(totals.get(fam, {}).get("h2d_bytes", 0)
+               for fam in (dp.FAM_NODE_ENCODE, dp.FAM_FEASIBILITY))
+
+
+def bench_match_resident(*, smoke=False) -> dict:
+    """`match_resident` tier: device-resident match state
+    (scheduler/device_state.py) through a REAL scheduler — one cold
+    cycle (full rebuild upload) then three warm cycles (two unchanged,
+    one with a single submitted job exercising the O(delta) scatter).
+    The gated columns are the WARM phase's p50 and its `h2d_bytes` —
+    byte growth on warm cycles is a regression, not informational
+    (tools/bench_gate.py gates match_resident* byte columns by
+    default).  `encode_h2d_bytes` carries the node-encode +
+    job-feasibility split the >=90% warm-reduction acceptance bar is
+    judged on (PR 11 TransferLedger stamps)."""
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.models.entities import Job, Pool, Resources
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+    from cook_tpu.scheduler.matcher import MatchConfig
+
+    if smoke:
+        n_jobs, n_hosts = 1000, 16
+    else:
+        n_jobs, n_hosts = 8000, 128
+    store = JobStore(clock=lambda: 1_000_000)
+    store.set_pool(Pool(name="default"))
+    hosts = [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4096.0,
+                      cpus=8.0) for i in range(n_hosts)]
+    cluster = MockCluster("bench", hosts, clock=store.clock)
+    config = SchedulerConfig(
+        match=MatchConfig(chunk=0, device_residency=True,
+                          quality_audit_every=0),
+        device_telemetry=False,
+    )
+    scheduler = Scheduler(store, [cluster], config)
+    # near-host-size jobs: a handful match on the cold cycle, the rest
+    # wait — so warm cycles see an UNCHANGED pool (the residency case)
+    # while the solve still runs the real kernel end to end
+    store.submit_jobs([
+        Job(uuid=f"res-{i}", user=f"u{i % 8}", pool="default", priority=50,
+            resources=Resources(mem=4000.0, cpus=8.0), command="true")
+        for i in range(n_jobs)
+    ])
+    pool = store.pools["default"]
+
+    def cycle():
+        mark, enc = byte_mark(), encode_family_mark()
+        t0 = time.perf_counter()
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+        wall_ms = (time.perf_counter() - t0) * 1000
+        stamp = byte_stamp(mark)
+        stamp["encode_h2d_bytes"] = encode_family_mark() - enc
+        return wall_ms, stamp
+
+    cold_ms, cold = cycle()
+    warm_walls, warm = [], {"h2d_bytes": 0, "d2h_bytes": 0,
+                            "encode_h2d_bytes": 0}
+    for i in range(3):
+        if i == 2:
+            # one delta cycle: a single new job must ride the donated-
+            # buffer scatter, not a rebuild
+            store.submit_jobs([Job(
+                uuid=f"res-delta-{i}", user="delta", pool="default",
+                priority=50, resources=Resources(mem=4000.0, cpus=8.0),
+                command="true")])
+        wall_ms, stamp = cycle()
+        warm_walls.append(wall_ms)
+        for col in warm:
+            warm[col] += stamp[col]
+    warm_p50 = float(np.percentile(warm_walls, 50))
+    reduction = (1.0 - warm["encode_h2d_bytes"] / 3.0
+                 / max(cold["encode_h2d_bytes"], 1))
+    last = scheduler.recorder.records(limit=1)[0].device_state
+    log(f"match_resident {n_jobs} jobs x {n_hosts} hosts: cold "
+        f"{cold_ms:.1f} ms / {cold['encode_h2d_bytes']} encode B; warm "
+        f"p50 {warm_p50:.1f} ms / {warm['encode_h2d_bytes']} encode B "
+        f"over 3 cycles (per-cycle reduction {reduction:.1%}); last "
+        f"cycle delta_rows={last.get('delta_rows')} "
+        f"rebuild={last.get('rebuild')}")
+    return {
+        "match_resident": {"p50_ms": warm_p50, "jobs": n_jobs,
+                           "hosts": n_hosts, "warm_cycles": 3,
+                           **warm,
+                           "encode_reduction": reduction},
+        "match_resident_cold": {"p50_ms": cold_ms, "jobs": n_jobs,
+                                "hosts": n_hosts, **cold},
+    }
+
+
 def bench_control_plane(*, rps=150.0, duration_s=8.0, seed=13,
                         smoke=False) -> dict:
     """Control-plane write-path phase: sustained submit/query/kill
@@ -918,6 +1015,7 @@ def device_main():
     reb_p50 = bench_rebalance(jax, jnp)
     multi_p50 = bench_multipool(jax, jnp, load_tuned())
     elastic_p50 = bench_elastic(jax, jnp)
+    resident_phases = bench_match_resident()
     control_plane = bench_control_plane()
     pipeline_phases = bench_pipeline(jax, jnp, n_pools=8, hosts_per_pool=96,
                                      jobs_per_pool=1536)
@@ -936,6 +1034,7 @@ def device_main():
         "rebalance": {"p50_ms": reb_p50},
         "multipool": {"p50_ms": multi_p50},
         "elastic_plan": {"p50_ms": elastic_p50, "pools": 64, "jobs": 16384},
+        **resident_phases,
         "control_plane": control_plane,
         **pipeline_phases,
         **speculation_phases,
@@ -969,6 +1068,8 @@ def cpu_main():
                   "packing_eff": eff, "baseline_ms": cpu_ms,
                   **match_bytes},
         **xl_phases,
+        # device residency moves the same logical bytes on any backend
+        **bench_match_resident(),
         # the control plane never needed the accelerator; its phase is
         # measured at full scale even on the CPU fallback
         "control_plane": bench_control_plane(),
@@ -1071,6 +1172,11 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     # tier, so the gate tracks the XL trajectory every CI run
     phases.update(bench_match_xl(jax, jnp, jax.devices()[0].platform,
                                  smoke=True, repeats=repeats))
+
+    # device-resident match state: cold rebuild + 3 warm delta cycles
+    # (warm p50 AND warm h2d_bytes are gate-visible; bytes growth on
+    # warm cycles is a regression)
+    phases.update(bench_match_resident(smoke=True))
 
     # control plane: the smoke loadtest against an in-process server —
     # commit-ack latency under sustained submit/query/kill traffic

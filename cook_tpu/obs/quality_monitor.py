@@ -42,6 +42,11 @@ class QualityMonitor:
         self._baselines: dict[str, RollingBaseline] = {}
         self._last: dict[str, float] = {}
         self._in_drift: dict[str, bool] = {}
+        # sample listeners (fn(pool, ratio)): the quantization parity
+        # guard (scheduler/device_state.py) rides every shadow-solve
+        # sample this way — ONE wiring site covers the serial, batched,
+        # pipelined, and speculative paths
+        self._listeners: list = []
         self._lock = threading.Lock()
         self._gauge = global_registry.gauge(
             "obs.quality.efficiency",
@@ -94,11 +99,17 @@ class QualityMonitor:
         # assignment fetch)
         with data_plane.detached(), \
                 data_plane.family(data_plane.FAM_FALLBACK):
-            demands = fetch_result(problem.demands)[:n_jobs]
+            # f32 casts: quantized pools carry bf16 cost tensors, and
+            # the reference solve + weight math must run at full width
+            # (the ratio then measures exactly quantized-vs-f32 parity)
+            demands = fetch_result(
+                problem.demands)[:n_jobs].astype(np.float32)
             n_nodes = (prepared.nodes.n if prepared.nodes is not None
                        else fetch_result(problem.avail).shape[0])
-            avail = fetch_result(problem.avail)[:n_nodes]
-            totals = fetch_result(problem.totals)[:n_nodes]
+            avail = fetch_result(
+                problem.avail)[:n_nodes].astype(np.float32)
+            totals = fetch_result(
+                problem.totals)[:n_nodes].astype(np.float32)
         feasible = prepared.feasible
         # np_greedy_match is resource-count generic: pass every column
         # (mem, cpus, gpus, disk...) so feasibility matches the kernel's
@@ -126,9 +137,22 @@ class QualityMonitor:
             return 1.0
         return dev_w / ref_w
 
+    def add_listener(self, fn) -> None:
+        """Register fn(pool, ratio), called on every recorded sample
+        (outside the monitor lock; must not call back into the
+        monitor)."""
+        with self._lock:
+            self._listeners.append(fn)
+
     def record_sample(self, pool: str, ratio: float) -> None:
         """Feed one efficiency sample (the shadow path calls this; tests
-        and offline replays can inject samples directly)."""
+        and offline replays can inject samples directly).  Listener
+        failures are logged, never propagated — a guard must not cost
+        the monitor its sample."""
+        from cook_tpu.utils.callbacks import notify_all
+
+        notify_all(self._listeners, f"quality-sample pool={pool}",
+                   pool, ratio)
         with self._lock:
             baseline = self._baselines.get(pool)
             if baseline is None:

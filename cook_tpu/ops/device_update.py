@@ -1,0 +1,100 @@
+"""Donated-buffer O(delta) row updaters for device-resident match state.
+
+The device mirror (scheduler/device_state.py) keeps per-pool encode
+tensors resident across match cycles; what changes between cycles is a
+handful of rows (new jobs, invalidated feasibility rows).  These
+updaters turn those deltas into in-place device scatters:
+
+  * the resident buffer is DONATED (`donate_argnums=0`): XLA may update
+    it in place, so a delta cycle allocates and transfers only the
+    delta rows, never the full buffer.  On backends without donation
+    support (CPU) jax falls back to a copy — semantics identical, the
+    transfer saving (the point of the mirror) is unaffected;
+  * the delta row count is padded to a power-of-two bucket
+    (`update_bucket`) by REPEATING the last (index, row) pair — a
+    duplicate-index `.set` with identical payloads is idempotent — so
+    one XLA program serves every delta size within a bucket.  The
+    CompileObservatory pins this: `device_update` programs are keyed by
+    (buffer shape, update bucket), never by the raw delta size.
+
+Transfers are accounted through `obs/data_plane.h2d` like every other
+instrumented put; callers pass the tensor family so delta traffic lands
+in the same ledger columns the full rebuild would.
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+import jax
+import numpy as np
+
+from cook_tpu.obs import data_plane
+from cook_tpu.ops.common import bucket_size
+
+# the smallest update program: single-row deltas (the steady-state case)
+# share one program with anything up to this many rows
+UPDATE_BUCKET_MIN = 8
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(buf, idx, rows):
+    return buf.at[idx].set(rows)
+
+
+@jax.jit
+def _gather_rows(buf, perm):
+    return buf[perm]
+
+
+def update_bucket(k: int) -> int:
+    """Padded row count of a k-row delta update."""
+    return bucket_size(max(int(k), 1), minimum=UPDATE_BUCKET_MIN)
+
+
+def pad_update(idx: np.ndarray, rows: np.ndarray):
+    """Pad a delta to its bucket by repeating the last (index, row) pair
+    (idempotent under `.set`: duplicates carry identical payloads)."""
+    k = idx.shape[0]
+    kb = update_bucket(k)
+    if kb == k:
+        return idx, rows
+    idx = np.concatenate([idx, np.full(kb - k, idx[-1], dtype=idx.dtype)])
+    rows = np.concatenate([rows, np.repeat(rows[-1:], kb - k, axis=0)])
+    return idx, rows
+
+
+def scatter_rows(buf, idx: np.ndarray, rows: np.ndarray, *,
+                 family: str = None, observatory=None,
+                 op: str = "device_update"):
+    """Scatter `rows` into the DONATED resident `buf` at `idx`; returns
+    the updated buffer (the caller must replace its reference — the old
+    buffer is consumed).  Only the bucket-padded delta crosses the bus.
+    """
+    idx, rows = pad_update(np.asarray(idx, dtype=np.int32),
+                           np.ascontiguousarray(rows))
+    idx_dev = data_plane.h2d(idx, family=family)
+    rows_dev = data_plane.h2d(rows, family=family)
+    with warnings.catch_warnings():
+        # CPU XLA cannot honor donation and jax warns per call; the
+        # fallback copy is correct.  Scoped to THIS call so a lost
+        # donation anywhere else in the process still surfaces
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        out = _scatter_rows(buf, idx_dev, rows_dev)
+    if observatory is not None:
+        observatory.observe_solve(
+            op, tuple(buf.shape) + (idx.shape[0],), "xla")
+    return out
+
+
+def gather_rows(buf, perm, *, observatory=None, op: str = "device_gather"):
+    """Device-side gather of the resident buffer's rows into schedule
+    order.  Returns a FRESH array: the mirror's buffers are private (a
+    later delta cycle donates them), so the problem tensors handed to
+    the solver must never alias them."""
+    out = _gather_rows(buf, perm)
+    if observatory is not None:
+        observatory.observe_solve(
+            op, tuple(buf.shape) + (int(perm.shape[0]),), "xla")
+    return out

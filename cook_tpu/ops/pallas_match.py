@@ -28,11 +28,13 @@ from jax.experimental import pallas as pl
 from cook_tpu.ops.common import BIG
 
 
-def _score_and_accumulate(d, avail, totals, valid, feas_mask,
-                          n_tile, best_val_ref, best_idx_ref):
-    """Shared kernel body: feasibility + cpuMemBinPacker fitness + argmax
-    for one (job-block, node-tile) pair, accumulated across node tiles.
-    `feas_mask` is an optional [BK, BN] constraint-mask tile."""
+def _score_tile(d, avail, totals, valid, feas_mask, n_tile):
+    """Shared scoring math of every best-* kernel: feasibility +
+    cpuMemBinPacker fitness + argmax for one (job-block, node-tile)
+    pair.  Returns (local_best [BK], local_idx [BK] — GLOBAL node
+    indices).  `feas_mask` is an optional [BK, BN] constraint tile.
+    ONE definition so the flat, block-aggregate, and batched-fine
+    kernels can never rank candidates by diverging rules."""
     bn = avail.shape[0]
 
     # feasibility: every resource fits  -> [BK, BN]
@@ -56,11 +58,20 @@ def _score_and_accumulate(d, avail, totals, valid, feas_mask,
     )
     # first-index tie-break: largest (bn - col) = smallest col
     local_idx = (bn - local_idx) + n_tile * bn       # global node index
+    return local_best, local_idx.astype(jnp.int32)
+
+
+def _score_and_accumulate(d, avail, totals, valid, feas_mask,
+                          n_tile, best_val_ref, best_idx_ref):
+    """`_score_tile` + the (max, argmax) accumulation across node tiles
+    (the node axis is the grid's innermost, sequential dimension)."""
+    local_best, local_idx = _score_tile(d, avail, totals, valid,
+                                        feas_mask, n_tile)
 
     @pl.when(n_tile == 0)
     def _init():
         best_val_ref[:] = local_best
-        best_idx_ref[:] = local_idx.astype(jnp.int32)
+        best_idx_ref[:] = local_idx
 
     @pl.when(n_tile > 0)
     def _accum():
@@ -68,9 +79,7 @@ def _score_and_accumulate(d, avail, totals, valid, feas_mask,
         prev_idx = best_idx_ref[:]
         take_new = local_best > prev_val  # strict: earlier tile wins ties
         best_val_ref[:] = jnp.where(take_new, local_best, prev_val)
-        best_idx_ref[:] = jnp.where(
-            take_new, local_idx.astype(jnp.int32), prev_idx
-        )
+        best_idx_ref[:] = jnp.where(take_new, local_idx, prev_idx)
 
 
 def _best_node_kernel(d_ref, avail_ref, totals_ref, valid_ref,
@@ -255,3 +264,123 @@ def best_block(
               block_totals.astype(jnp.float32), valid_i),
         interpret=interpret)
     return _unpad_best(best_val, best_idx, k)
+
+
+# ------------------------------------------------------- hierarchical fine
+
+
+def _batched_accumulate(local_best, local_idx, n_tile,
+                        best_val_ref, best_idx_ref):
+    """The (max, argmax) accumulation for batched kernels whose output
+    blocks carry a leading singleton batch dim ([1, BK])."""
+    @pl.when(n_tile == 0)
+    def _init():
+        best_val_ref[0, :] = local_best
+        best_idx_ref[0, :] = local_idx
+
+    @pl.when(n_tile > 0)
+    def _accum():
+        prev_val = best_val_ref[0, :]
+        prev_idx = best_idx_ref[0, :]
+        take_new = local_best > prev_val  # strict: earlier tile wins ties
+        best_val_ref[0, :] = jnp.where(take_new, local_best, prev_val)
+        best_idx_ref[0, :] = jnp.where(take_new, local_idx, prev_idx)
+
+
+def _fine_kernel(d_ref, avail_ref, totals_ref, valid_ref,
+                 best_val_ref, best_idx_ref):
+    """Grid = (blocks, slots/BK, npb/BN); node axis innermost.  Every
+    ref carries a leading singleton batch dim — the block axis is owned
+    by the GRID, so the fine batch never rides jax.vmap (whose
+    pallas_call batching is not guaranteed)."""
+    local_best, local_idx = _score_tile(
+        d_ref[0], avail_ref[0], totals_ref[0], valid_ref[0], None,
+        pl.program_id(2))
+    _batched_accumulate(local_best, local_idx, pl.program_id(2),
+                        best_val_ref, best_idx_ref)
+
+
+def _fine_masked_kernel(d_ref, avail_ref, totals_ref, valid_ref,
+                        feas_ref, best_val_ref, best_idx_ref):
+    """`_fine_kernel` with the per-(block, slot, node) constraint-mask
+    tile riding along in VMEM."""
+    local_best, local_idx = _score_tile(
+        d_ref[0], avail_ref[0], totals_ref[0], valid_ref[0],
+        feas_ref[0] > 0, pl.program_id(2))
+    _batched_accumulate(local_best, local_idx, pl.program_id(2),
+                        best_val_ref, best_idx_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("block_jobs", "block_nodes",
+                                             "interpret"))
+def best_node_batched(
+    demands: jnp.ndarray,     # [B, S, R]
+    avail: jnp.ndarray,       # [B, N, R]
+    totals: jnp.ndarray,      # [B, N, 2]
+    node_valid: jnp.ndarray,  # [B, N] (bool or int)
+    feasible=None,            # optional [B, S, N] constraint mask
+    *,
+    block_jobs: int = 256,
+    block_nodes: int = 512,
+    interpret: bool = False,
+):
+    """Per-job best feasible node for a BATCH of per-block problems —
+    the fused fine-pass scorer of the hierarchical matcher
+    (ops/hierarchical.py): fit + fitness + argmax in one VMEM sweep per
+    (block, job-tile), with the block axis as the grid's outer
+    dimension.  Returns (best_score [B, S], best_idx [B, S]); idx -1
+    (score -BIG) where nothing is feasible.  Same layout/padding
+    discipline as `best_node`."""
+    b, s = demands.shape[0], demands.shape[1]
+    n = avail.shape[1]
+    block_jobs = min(block_jobs, s)
+    block_nodes = min(block_nodes, n)
+    pad_s = (-s) % block_jobs
+    pad_n = (-n) % block_nodes
+    valid_i = node_valid.astype(jnp.int32)
+    if pad_s:
+        demands = jnp.pad(demands, ((0, 0), (0, pad_s), (0, 0)),
+                          constant_values=2 * BIG)
+    if pad_n:
+        avail = jnp.pad(avail, ((0, 0), (0, pad_n), (0, 0)))
+        totals = jnp.pad(totals, ((0, 0), (0, pad_n), (0, 0)))
+        valid_i = jnp.pad(valid_i, ((0, 0), (0, pad_n)))
+    if feasible is not None and (pad_s or pad_n):
+        feasible = jnp.pad(feasible, ((0, 0), (0, pad_s), (0, pad_n)))
+    padded_s = s + pad_s
+    padded_n = n + pad_n
+    r = demands.shape[-1]
+
+    in_specs = [
+        pl.BlockSpec((1, block_jobs, r), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_nodes, r), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_nodes, 2), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_nodes), lambda b, i, j: (b, j)),
+    ]
+    args = (demands.astype(jnp.float32), avail.astype(jnp.float32),
+            totals.astype(jnp.float32), valid_i)
+    kernel = _fine_kernel
+    if feasible is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_jobs, block_nodes),
+                         lambda b, i, j: (b, i, j)))
+        args = args + (feasible.astype(jnp.int32),)
+        kernel = _fine_masked_kernel
+    best_val, best_idx = pl.pallas_call(
+        kernel,
+        grid=(b, padded_s // block_jobs, padded_n // block_nodes),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_jobs), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_jobs), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, padded_s), jnp.float32),
+            jax.ShapeDtypeStruct((b, padded_s), jnp.int32),
+        ],
+        interpret=interpret,
+    )(*args)
+    best_val = best_val[:, :s]
+    best_idx = best_idx[:, :s]
+    found = best_val > -BIG
+    return best_val, jnp.where(found, best_idx, -1)

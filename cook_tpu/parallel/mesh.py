@@ -72,7 +72,8 @@ def shard_pools(mesh: Mesh, tree, axis: str = "pool"):
 
 
 def invalid_match_problem(j: int, n: int, n_res: int = 4,
-                          with_feasible: bool = True) -> MatchProblem:
+                          with_feasible: bool = True,
+                          dtype=jnp.float32) -> MatchProblem:
     """An all-invalid padded problem used to fill the pool axis up to a
     mesh multiple (matcher.match_pools_batched) and the BLOCK axis of the
     hierarchical fine batch (ops/hierarchical.py): job_valid/node_valid
@@ -81,12 +82,15 @@ def invalid_match_problem(j: int, n: int, n_res: int = 4,
     multiples.  `totals` is ones so the binpack fitness arithmetic stays
     finite on the dead lanes.  `with_feasible=False` matches batches
     whose real problems carry no constraint mask (the pytree structures
-    must agree for stacking/vmap)."""
+    must agree for stacking/vmap).  `dtype` must match the real
+    problems' cost-tensor dtype (bf16 under MatchConfig.quantized) —
+    a mismatched pad lane would silently promote the whole stacked
+    batch back to f32."""
     return MatchProblem(
-        demands=jnp.zeros((j, n_res), jnp.float32),
+        demands=jnp.zeros((j, n_res), dtype),
         job_valid=jnp.zeros((j,), bool),
-        avail=jnp.zeros((n, n_res), jnp.float32),
-        totals=jnp.ones((n, 2), jnp.float32),
+        avail=jnp.zeros((n, n_res), dtype),
+        totals=jnp.ones((n, 2), dtype),
         node_valid=jnp.zeros((n,), bool),
         feasible=jnp.zeros((j, n), bool) if with_feasible else None,
     )

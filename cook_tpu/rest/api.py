@@ -484,12 +484,18 @@ class CookApi:
         cost_analysis(), joined with observed warm solve walls).  The
         before/after instrument for ROADMAP item 2(a)."""
         from cook_tpu.obs import data_plane
+        from cook_tpu.scheduler import device_state as _device_state
 
         body = data_plane.LEDGER.snapshot()
         telemetry = self._telemetry()
         body["roofline"] = (telemetry.observatory.cost_stats()
                             if telemetry is not None else [])
         body["device_telemetry"] = telemetry is not None
+        # device-resident match state (scheduler/device_state.py):
+        # per-pool resident bytes, delta-vs-rebuild counts, update-kernel
+        # walls, quantization demotions — the item-2(a) after picture
+        # next to the ledger's before picture
+        body["device_state"] = _device_state.snapshot_all()
         return web.json_response(body)
 
     async def get_debug_predictions(self,

@@ -177,6 +177,18 @@ class Scheduler:
             from cook_tpu.scheduler.encode_cache import EncodeCache
 
             self.encode_cache = EncodeCache(store)
+        # device-resident match state (scheduler/device_state.py):
+        # per-pool encode tensors stay on device across cycles with
+        # O(delta) donated-buffer updates; also hosts the quantization
+        # parity guard, so it exists whenever either knob is on (the
+        # observatory reference is patched in after telemetry below)
+        self.device_state = None
+        if self.config.match.device_residency or self.config.match.quantized:
+            from cook_tpu.scheduler.device_state import DeviceResidentState
+
+            self.device_state = DeviceResidentState(
+                encode_cache=self.encode_cache,
+                parity_floor=self.config.match.quantization_parity_floor)
         # runtime prediction + speculative cycles (prediction.py):
         # the predictor feeds from instance completions; the speculator
         # pre-dispatches cycle N+1's solve while cycle N drains
@@ -199,6 +211,7 @@ class Scheduler:
                 store, self.clusters, self.predictor,
                 horizon_ms=self.config.speculation_horizon_ms,
                 encode_cache=self.encode_cache,
+                device_state=self.device_state,
             )
         self.pool_queues: dict[str, RankedQueue] = {}
         self.pool_match_state: dict[str, PoolMatchState] = {}
@@ -237,6 +250,23 @@ class Scheduler:
                 quality_sample_every=self.config.quality_sample_every,
                 oom_threshold=self.config.device_oom_threshold,
             )
+        if self.device_state is not None and self.telemetry is not None:
+            # compile accounting for the update/gather programs, and the
+            # quantization parity guard riding every shadow-solve sample
+            # (one wiring site covers serial/batched/pipelined/spec)
+            self.device_state.observatory = self.telemetry.observatory
+            self.telemetry.quality.add_listener(
+                self.device_state.note_quality)
+        elif self.config.match.quantized:
+            # the parity guard rides the QualityMonitor's shadow-solve
+            # samples; without device telemetry no samples ever flow, so
+            # bf16 drift would go undetected AND undemoted — say so
+            # loudly instead of quietly running unguarded
+            log.warning(
+                "MatchConfig.quantized is on but device_telemetry is "
+                "off: the QualityMonitor parity guard cannot run, so "
+                "bf16 packing drift will never demote to f32 — enable "
+                "device_telemetry or disable quantized")
         # incident observatory + profile capture (diagnosis layer,
         # cook_tpu/obs/incident.py): the scheduler contributes cycle
         # records, the span-ring chrome trace, and the armed fault
@@ -394,6 +424,11 @@ class Scheduler:
 
         limits_active, max_mem, max_cpus, max_gpus = \
             self._pool_capacity_probe(pool)
+        # DRU-column residency rides the match knob: with residency on,
+        # the rank cycle's task columns reuse their resident device
+        # copies when content is unchanged (device_state.resident_array)
+        dru_state = (self.device_state
+                     if self.config.match.device_residency else None)
         if self.columnar is not None and not self._backfill_active:
             from cook_tpu.scheduler.ranking_columnar import rank_pool_columnar
 
@@ -401,6 +436,7 @@ class Scheduler:
                 self.store, self.columnar, pool,
                 capacity_limits=((max_mem, max_cpus, max_gpus)
                                  if limits_active else None),
+                device_state=dru_state,
             )
         else:
             # predicted-duration backfill routes through the full encoder
@@ -413,7 +449,8 @@ class Scheduler:
                 predictor=(self.predictor if self._backfill_active
                            else None),
                 backfill_weight=self.config.backfill_weight,
-                backfill_norm_ms=self.config.backfill_norm_ms)
+                backfill_norm_ms=self.config.backfill_norm_ms,
+                device_state=dru_state)
         for uuid in queue.quarantined:
             self.placement_failures[uuid] = (
                 "The job's resource demands exceed every host in the pool."
@@ -530,6 +567,7 @@ class Scheduler:
                 telemetry=self.telemetry,
                 encode_cache=self.encode_cache,
                 predictor=self.predictor,
+                device_state=self.device_state,
             )
         # charge launches against the per-user rate limiter (spend-through)
         if self.launch_rate_limiter is not None:
@@ -675,6 +713,7 @@ class Scheduler:
             telemetry=self.telemetry,
             encode_cache=self.encode_cache,
             predictor=self.predictor,
+            device_state=self.device_state,
         )
         self._finish_multi_pool_cycle(pools, outcomes, flights)
         return outcomes
@@ -723,6 +762,7 @@ class Scheduler:
                                   async_launch=self.config.async_launch),
             predictor=self.predictor,
             speculative=speculative,
+            device_state=self.device_state,
         )
         self._finish_multi_pool_cycle(pools, outcomes, flights)
         # the pass drained its async launches above (drain_launches

@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Callable, Optional, Sequence
+from typing import Callable, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -87,8 +87,29 @@ def offers_fingerprint(cluster_offers: Sequence[tuple]) -> int:
     ))
 
 
+class RowServe(NamedTuple):
+    """How one cacheable job's feasibility row was served this cycle —
+    the per-row report consumers (the device mirror) key residency on.
+    `cached` is False when the row could not be written back (epoch
+    moved mid-compute, open balanced pre-row, mid-compute invalidation):
+    such rows must not be treated as stable by any downstream cache."""
+
+    epoch: int
+    fresh: bool      # recomputed this cycle (False = served from cache)
+    cached: bool     # the row is (still) in the cache at `epoch`
+
+
 class EncodeCache:
-    """Per-pool incremental encode state, invalidated by store events."""
+    """Per-pool incremental encode state, invalidated by store events.
+
+    Consumers that mirror this cache (the device-resident state,
+    future shards) `subscribe()` a callback and observe invalidations
+    as they land — `("row-dropped", job_uuid=...)` when a job's rows
+    drop, `("epoch-bumped", epoch=...)` on a conservative full
+    invalidation — instead of diffing fingerprints every cycle.
+    Callbacks run OUTSIDE the cache lock (they may take their own
+    locks) on the event-delivering thread; they must be cheap and must
+    not call back into the cache."""
 
     def __init__(self, store: Optional[JobStore] = None, *,
                  max_rows_per_pool: int = 100_000):
@@ -96,6 +117,7 @@ class EncodeCache:
         self._epoch = 0
         self._lock = threading.Lock()
         self._max_rows = max_rows_per_pool
+        self._subscribers: list[Callable] = []
         self._rows_counter = global_registry.counter(
             "match.encode_cache.rows",
             "feasibility rows served from / recomputed into the host-"
@@ -110,6 +132,24 @@ class EncodeCache:
             if resync is not None:
                 resync(self.clear)
 
+    # ------------------------------------------------------ subscribers
+
+    def subscribe(self, callback: Callable) -> None:
+        """Register an invalidation observer: callback(kind, **info)
+        with kind "row-dropped" (job_uuid=...) or "epoch-bumped"
+        (epoch=...)."""
+        with self._lock:
+            self._subscribers.append(callback)
+
+    def _notify(self, kind: str, **info) -> None:
+        # a sick subscriber must never block store-event delivery (the
+        # mirror rebuilds from its own staleness checks; losing one
+        # notification costs a rebuild, not correctness)
+        from cook_tpu.utils.callbacks import notify_all
+
+        notify_all(self._subscribers, f"encode-cache {kind}", kind,
+                   **info)
+
     # ------------------------------------------------------- invalidation
 
     def _on_event(self, event: Event) -> None:
@@ -117,6 +157,8 @@ class EncodeCache:
         if kind in _EPOCH_EVENTS:
             with self._lock:
                 self._epoch += 1
+                epoch = self._epoch
+            self._notify("epoch-bumped", epoch=epoch)
             return
         if kind == "instance/status":
             # failed-instance history feeds the novel-host constraint.
@@ -130,6 +172,7 @@ class EncodeCache:
     def _drop_job(self, job_uuid: Optional[str]) -> None:
         if not job_uuid:
             return
+        epoch_bumped = False
         with self._lock:
             for entry in self._pools.values():
                 entry.rows.pop(job_uuid, None)
@@ -142,12 +185,19 @@ class EncodeCache:
                     # back to a conservative epoch bump rather than
                     # forgetting an invalidation
                     self._epoch += 1
+                    epoch_bumped = True
                     entry.dropped.clear()
+            epoch = self._epoch
+        self._notify("row-dropped", job_uuid=job_uuid)
+        if epoch_bumped:
+            self._notify("epoch-bumped", epoch=epoch)
 
     def clear(self) -> None:
         with self._lock:
             self._pools.clear()
             self._epoch += 1
+            epoch = self._epoch
+        self._notify("epoch-bumped", epoch=epoch)
 
     @property
     def epoch(self) -> int:
@@ -167,7 +217,12 @@ class EncodeCache:
         fp = offers_fingerprint(cluster_offers)
         with self._lock:
             entry = self._pools.setdefault(pool, _PoolEntry())
-            hit = entry.nodes_fp == fp
+            # collision guard: a colliding fingerprint with a DIFFERENT
+            # node count must rebuild — serving the cached attr/gpu
+            # columns against a differently-sized offer list would
+            # corrupt every downstream mask
+            hit = (entry.nodes_fp == fp and entry.has_gpus is not None
+                   and len(entry.has_gpus) == len(offers))
             if hit:
                 nodes = EncodedNodes(
                     offers=offers,
@@ -213,6 +268,7 @@ class EncodeCache:
         nodes_fp: int,
         compute: Callable[[list, dict[int, np.ndarray]], np.ndarray],
         balanced_pre_rows: Optional[dict[int, np.ndarray]] = None,
+        served: Optional[dict[str, RowServe]] = None,
     ) -> np.ndarray:
         """Assemble the [J, N] mask from cached rows plus a delta
         computation.
@@ -222,7 +278,13 @@ class EncodeCache:
         etc.); its balanced_pre_rows (keyed by subset index) are remapped
         into the caller's dict keyed by full-window index.  Returns a
         FRESH array — callers may mutate it (host reservations) without
-        corrupting the cache."""
+        corrupting the cache.
+
+        `served` (out-param) collects a RowServe per CACHEABLE job: how
+        its row was obtained this cycle.  The device mirror keys slot
+        persistence on it — a row the host cache itself refused to keep
+        (mid-compute invalidation, open pre-closure) must not persist on
+        device either."""
         j = len(jobs)
         feasible = np.empty((j, n_nodes), dtype=bool)
         with self._lock:
@@ -238,6 +300,9 @@ class EncodeCache:
                         and cached[1].shape[0] == n_nodes):
                     feasible[ji] = cached[1]
                     rows.move_to_end(job.uuid)
+                    if served is not None:
+                        served[job.uuid] = RowServe(epoch, fresh=False,
+                                                    cached=True)
                 else:
                     subset_idx.append(ji)
             if subset_idx:
@@ -257,17 +322,24 @@ class EncodeCache:
                                   and self._epoch == epoch else None)
                     for k, ji in enumerate(subset_idx):
                         feasible[ji] = submask[k]
-                        if (store_rows is not None
-                                and self.cacheable_job(jobs[ji])
-                                # a row with an open pre-closure variant
-                                # is cycle-dependent; don't cache it
-                                and k not in sub_pre_rows
-                                # an event invalidated this job while the
-                                # row was being computed: the compute may
-                                # predate the event's effect — don't cache
-                                and jobs[ji].uuid not in entry.dropped):
+                        cacheable = (store_rows is not None
+                                     and self.cacheable_job(jobs[ji])
+                                     # a row with an open pre-closure
+                                     # variant is cycle-dependent; don't
+                                     # cache it
+                                     and k not in sub_pre_rows
+                                     # an event invalidated this job while
+                                     # the row was being computed: the
+                                     # compute may predate the event's
+                                     # effect — don't cache
+                                     and jobs[ji].uuid not in entry.dropped)
+                        if cacheable:
                             store_rows[jobs[ji].uuid] = (epoch,
                                                          submask[k].copy())
+                        if served is not None \
+                                and self.cacheable_job(jobs[ji]):
+                            served[jobs[ji].uuid] = RowServe(
+                                epoch, fresh=True, cached=cacheable)
                     if store_rows is not None:
                         while len(store_rows) > self._max_rows:
                             store_rows.popitem(last=False)

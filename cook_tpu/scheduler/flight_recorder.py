@@ -159,6 +159,11 @@ class CycleRecord:
     rebuild_fraction: Optional[float] = None
     padding_waste: Optional[float] = None
     data_plane: dict = field(default_factory=dict)
+    # device-resident match state (scheduler/device_state.py): set when
+    # the cycle's tensors came from the resident mirror — resident
+    # buffer bytes, delta rows scattered vs full rebuild (+ reason),
+    # the update-kernel wall, and whether the cost tensors were bf16
+    device_state: dict = field(default_factory=dict)
     offers: int = 0
     queue_len: int = 0
     considered: int = 0
@@ -206,6 +211,7 @@ class CycleRecord:
             "rebuild_fraction": self.rebuild_fraction,
             "padding_waste": self.padding_waste,
             "data_plane": dict(self.data_plane),
+            "device_state": dict(self.device_state),
             "offers": self.offers,
             "queue_len": self.queue_len,
             "considered": self.considered,
@@ -314,6 +320,13 @@ class CycleBuilder:
         rec.hier_refine_placed = int(stats.get("refine_placed", 0))
         rec.block_stats = list(stats.get("block_stats", []))
 
+    def note_device_state(self, stats: dict) -> None:
+        """Record the cycle's device-resident state outcome
+        (scheduler/device_state.py build stats: resident bytes, delta
+        rows vs rebuild, update-kernel wall)."""
+        self.record.device_state = {
+            k: v for k, v in stats.items() if not k.startswith("_")}
+
     def note_match(self, job_uuid: str, hostname: str, task_id: str) -> None:
         self.record.matched.append(
             {"job": job_uuid, "host": hostname, "task_id": task_id})
@@ -395,6 +408,9 @@ class NullCycle:
         pass
 
     def note_hierarchical(self, *a) -> None:
+        pass
+
+    def note_device_state(self, *a) -> None:
         pass
 
 

@@ -132,6 +132,24 @@ class MatchConfig:
     # shard the fine batch's block axis over the device mesh when the
     # process holds more than one device
     hierarchical_use_mesh: bool = True
+    # fine-solve backend: "xla" (vmapped chunked kernel, mesh-shardable)
+    # or "pallas" (ops/pallas_match.best_node_batched — the fused
+    # fit+fitness+argmax scorer owning the block axis natively, so the
+    # hierarchical inner loop stops depending on XLA fusion luck;
+    # single-process only — the fused kernel is not mesh-sharded)
+    hierarchical_fine_backend: str = "xla"
+    # device-resident match state (scheduler/device_state.py): per-pool
+    # demand/feasibility tensors stay on device across cycles; unchanged
+    # rows move ZERO bytes, deltas apply via donated-buffer scatters.
+    # Off by default — enable per deployment after reading
+    # docs/operations.md "Reading rebuild_fraction and resident bytes"
+    device_residency: bool = False
+    # quantized cost tensors: demands/avail/totals cross (and stay
+    # resident) as bfloat16 — half the bytes; feasibility is already
+    # bool.  Guarded by the QualityMonitor parity floor below: a pool
+    # whose packing efficiency drifts under it demotes to f32
+    quantized: bool = False
+    quantization_parity_floor: float = 0.98
 
     def __post_init__(self):
         backend_flags(self.backend)  # raises on unknown names
@@ -139,6 +157,11 @@ class MatchConfig:
             raise ValueError(
                 f"unknown hierarchical coarse backend "
                 f"{self.hierarchical_coarse_backend!r} "
+                "(expected xla | pallas)")
+        if self.hierarchical_fine_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown hierarchical fine backend "
+                f"{self.hierarchical_fine_backend!r} "
                 "(expected xla | pallas)")
         if self.backend == "bucketed" and 0 < self.chunk and \
                 self.chunk_passes < 2:
@@ -241,6 +264,18 @@ def encode_problem_arrays(
     return demands, avail, totals
 
 
+def padded_job_axis(j: int, chunk: int = 0) -> int:
+    """Padded job-axis size of a match problem: the power-of-two bucket,
+    rounded up to a chunk multiple when the chunked matcher is in use.
+    ONE definition shared by the classic tensor build and the device-
+    resident mirror (their problems must land on identical shapes)."""
+    pad_j = bucket_size(max(j, 1))
+    if chunk:
+        pad_j = max(pad_j, chunk)
+        pad_j += (-pad_j) % chunk
+    return pad_j
+
+
 def build_match_problem(
     jobs: Sequence[Job],
     nodes: EncodedNodes,
@@ -248,15 +283,22 @@ def build_match_problem(
     *,
     chunk: int = 0,
     config: Optional["MatchConfig"] = None,
+    quantized: bool = False,
 ) -> MatchProblem:
     j, n = len(jobs), nodes.n
-    pad_j = bucket_size(max(j, 1))
-    if chunk:
-        pad_j = max(pad_j, chunk)
-        pad_j += (-pad_j) % chunk
+    pad_j = padded_job_axis(j, chunk)
     pad_n = bucket_size(max(n, 1))
     demands, avail, totals = encode_problem_arrays(jobs, nodes.offers,
                                                    config)
+    if quantized:
+        # bf16 cost tensors (MatchConfig.quantized): half the transfer
+        # bytes; parity guarded by the QualityMonitor demotion ladder
+        from cook_tpu.scheduler.device_state import quantized_dtype
+
+        dtype = quantized_dtype()
+        demands = demands.astype(dtype)
+        avail = avail.astype(dtype)
+        totals = totals.astype(dtype)
     feas = np.zeros((pad_j, pad_n), dtype=bool)
     feas[:j, :n] = feasible
     # data-plane accounting: the padded host arrays are what cross to
@@ -317,6 +359,7 @@ def hier_params_from_config(config: "MatchConfig"):
         kc=config.chunk_kc,
         backend=vmap_safe_backend(config.backend),
         coarse_backend=config.hierarchical_coarse_backend,
+        fine_backend=config.hierarchical_fine_backend,
     )
 
 
@@ -831,6 +874,7 @@ def prepare_pool_problem(
     flight=NULL_CYCLE,
     encode_cache=None,
     predictor=None,
+    device_state=None,
 ) -> PreparedPool:
     """Gather offers + considerable jobs and encode the tensor problem.
 
@@ -838,7 +882,14 @@ def prepare_pool_problem(
     per-job feasibility rows are incremental: an unchanged pool re-encodes
     O(delta) rows instead of O(J×N).  The cache is bypassed while the
     estimated-completion constraint is active (rows become clock-
-    dependent)."""
+    dependent).
+
+    With `device_state` (scheduler/device_state.py) AND
+    `config.device_residency`, the padded problem tensors additionally
+    stay device-resident across cycles: unchanged rows transfer zero
+    bytes, deltas apply via donated-buffer scatters.  The mirror is
+    bypassed alongside the host cache (completion constraint), and on
+    reservation cycles (host reservations mutate rows after assembly)."""
     prepared = PreparedPool(pool=pool, outcome=MatchOutcome())
 
     # offers from every running cluster (scheduler.clj:1574-1585); an
@@ -898,6 +949,9 @@ def prepare_pool_problem(
      prepared.group_balance_counts) = gather_group_context(
         store, considerable, host_attrs=merged_attrs)
     offer_locations = [c.location for c, _ in prepared.cluster_offers]
+    use_mirror = (use_cache and device_state is not None
+                  and config.device_residency and not host_reservations)
+    served: Optional[dict] = {} if use_mirror else None
     if use_cache:
         def compute_rows(subset, pre_rows):
             return feasibility_mask(
@@ -916,6 +970,7 @@ def prepare_pool_problem(
         feasible = encode_cache.feasibility(
             pool.name, considerable, nodes.n, nodes_fp, compute_rows,
             balanced_pre_rows=prepared.balanced_pre_rows,
+            served=served,
         )
     else:
         feasible = feasibility_mask(
@@ -953,9 +1008,21 @@ def prepare_pool_problem(
             if ji in prepared.balanced_pre_rows:
                 prepared.balanced_pre_rows[ji] &= allowed
     prepared.feasible = feasible
-    prepared.problem = build_match_problem(considerable, nodes, feasible,
-                                           chunk=config.chunk,
-                                           config=config)
+    if use_mirror:
+        # device-resident path: unchanged rows move zero bytes; the
+        # mirror's problem is shape- and content-identical to the
+        # classic build below (padded_job_axis is shared)
+        prepared.problem = device_state.build_problem(
+            pool.name, considerable, nodes, feasible, nodes_fp, served,
+            config, flight=flight)
+    else:
+        quantized = (device_state.quantized_for(config, pool.name)
+                     if device_state is not None else config.quantized)
+        prepared.problem = build_match_problem(considerable, nodes,
+                                               feasible,
+                                               chunk=config.chunk,
+                                               config=config,
+                                               quantized=quantized)
     return prepared
 
 
@@ -1020,8 +1087,12 @@ def finalize_pool_match(
             + int(prepared.problem.avail.nbytes)
             + int(prepared.problem.totals.nbytes),
             family=data_plane.FAM_NODE_ENCODE)
-        demands = np.asarray(prepared.problem.demands)[:len(considerable)]
-        remaining = np.asarray(prepared.problem.avail)[:nodes.n].copy()
+        # float32 casts: under MatchConfig.quantized the device tensors
+        # are bf16, whose numpy ufunc coverage (subtract.at) is partial
+        demands = np.asarray(prepared.problem.demands).astype(
+            np.float32)[:len(considerable)]
+        remaining = np.asarray(prepared.problem.avail).astype(
+            np.float32)[:nodes.n].copy()
         placed_mask = assignment >= 0
         np.subtract.at(remaining, assignment[placed_mask],
                        demands[placed_mask])
@@ -1029,7 +1100,8 @@ def finalize_pool_match(
             considerable, assignment, nodes, prepared.groups,
             live_balance_counts, prepared.balanced_pre_rows,
             remaining, demands,
-            totals=np.asarray(prepared.problem.totals)[:nodes.n],
+            totals=np.asarray(prepared.problem.totals).astype(
+                np.float32)[:nodes.n],
         )
 
     # transact + launch (scheduler.clj:790-1048)
@@ -1332,6 +1404,7 @@ def match_pool(
     telemetry=None,
     encode_cache=None,
     predictor=None,
+    device_state=None,
 ) -> MatchOutcome:
     """One pool's match cycle end to end (prepare -> solve -> finalize)."""
     import time as _time
@@ -1345,7 +1418,7 @@ def match_pool(
             store, pool, queue, clusters, config, state,
             launch_filter=launch_filter, host_reservations=host_reservations,
             host_attrs=host_attrs, flight=flight, encode_cache=encode_cache,
-            predictor=predictor,
+            predictor=predictor, device_state=device_state,
         )
     assignment = np.empty(0, dtype=np.int32)
     if prepared.solvable:
@@ -1423,6 +1496,7 @@ def match_pools_batched(
     telemetry=None,
     encode_cache=None,
     predictor=None,
+    device_state=None,
 ) -> dict[str, MatchOutcome]:
     """Solve EVERY pool's match problem in one batched device call.
 
@@ -1459,7 +1533,7 @@ def match_pools_batched(
                 states[pool.name], launch_filter=launch_filter,
                 host_reservations=host_reservations, host_attrs=host_attrs,
                 flight=flight, encode_cache=encode_cache,
-                predictor=predictor,
+                predictor=predictor, device_state=device_state,
             ))
     # reaction (c) parity with the per-pool paths: pools already in
     # fallback mode solve host-side this cycle; the rest join the batch
@@ -1564,7 +1638,8 @@ def match_pools_batched(
                 if n_pad:
                     pad_p = invalid_match_problem(
                         max_j, max_n,
-                        n_res=int(solvable[0].problem.demands.shape[-1]))
+                        n_res=int(solvable[0].problem.demands.shape[-1]),
+                        dtype=solvable[0].problem.demands.dtype)
                     padded_problems.extend([pad_p] * n_pad)
             stacked = jax.tree.map(
                 lambda *leaves: jnp.stack(leaves), *padded_problems,

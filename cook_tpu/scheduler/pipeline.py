@@ -143,6 +143,7 @@ def match_pools_pipelined(
     params: Optional[PipelineParams] = None,
     predictor=None,
     speculative: Optional[dict] = None,
+    device_state=None,
 ) -> dict[str, MatchOutcome]:
     """Run every pool's match cycle through the pipelined engine.
 
@@ -312,6 +313,7 @@ def match_pools_pipelined(
                 host_reservations=host_reservations,
                 host_attrs=host_attrs, flight=flight,
                 encode_cache=encode_cache, predictor=predictor,
+                device_state=device_state,
             )
         stage = _Stage(pool=pool, prepared=prepared, state=state,
                        flight=flight)

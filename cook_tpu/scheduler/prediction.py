@@ -442,6 +442,11 @@ class SpeculativeSolve:
     offers_fp: int
     considerable_uuids: list[str]
     t_dispatch: float = 0.0
+    # device-resident state generation at dispatch (device_state.py): a
+    # bump (encode epoch invalidation, explicit clear) between dispatch
+    # and commit means the speculative problem was built from dropped
+    # resident tensors — the commit must not trust it
+    resident_epoch: int = 0
 
 
 @dataclass
@@ -465,12 +470,13 @@ class CycleSpeculator:
 
     def __init__(self, store: JobStore, clusters, predictor, *,
                  horizon_ms: float = 30_000.0, encode_cache=None,
-                 telemetry=None):
+                 telemetry=None, device_state=None):
         self.store = store
         self.clusters = clusters      # live reference (add_cluster appends)
         self.predictor = predictor
         self.horizon_ms = float(horizon_ms)
         self.encode_cache = encode_cache
+        self.device_state = device_state
         self.telemetry = telemetry
         self.enabled = True           # runtime kill-switch
         self._match_config = None     # last dispatch's MatchConfig
@@ -610,6 +616,7 @@ class CycleSpeculator:
                 host_attrs=host_attrs, flight=NULL_CYCLE,
                 encode_cache=self.encode_cache,
                 predictor=self.predictor,
+                device_state=self.device_state,
             )
             if not prepared.solvable:
                 self.guard.cancel(token)
@@ -633,6 +640,8 @@ class CycleSpeculator:
             offers_fp=offers_fingerprint(prepared.cluster_offers),
             considerable_uuids=[j.uuid for j in prepared.considerable],
             t_dispatch=time.perf_counter(),
+            resident_epoch=(self.device_state.epoch
+                            if self.device_state is not None else 0),
         )
         with self._lock:
             self._inflight[name] = spec
@@ -673,6 +682,11 @@ class CycleSpeculator:
             return self._drop(name, reason)
         if self.encode_cache is not None \
                 and self.encode_cache.epoch != spec.encode_epoch:
+            return self._drop(name, DROP_EPOCH_STALE)
+        if self.device_state is not None \
+                and self.device_state.epoch != spec.resident_epoch:
+            # the resident mirror was invalidated while this solve was
+            # in flight: its tensors were built from dropped state
             return self._drop(name, DROP_EPOCH_STALE)
         # offer STRUCTURE must be unchanged (hosts come and go without
         # store events; spare amounts are covered by the guard — only

@@ -143,6 +143,7 @@ def rank_pool(
     predictor=None,
     backfill_weight: float = 0.0,
     backfill_norm_ms: float = 600_000.0,
+    device_state=None,
 ) -> RankedQueue:
     """Rank one pool's pending jobs by cumulative DRU.
 
@@ -249,27 +250,35 @@ def rank_pool(
     pad_t = bucket_size(n)
     # DRU columns are their own data-plane family: the rank cycle's
     # transfers are the second-largest per-cycle flow after the match
-    # tensors, and item 2(a)'s device-resident encode covers them too
-    h2d = data_plane.h2d
+    # tensors.  With device residency (scheduler/device_state.py) each
+    # column stays resident and re-uploads only when its content
+    # changed — an unchanged queue's rank cycle moves zero DRU bytes
     fam = data_plane.FAM_DRU
+    if device_state is not None:
+        def put(name, arr):
+            return device_state.resident_array(pool_name, "dru." + name,
+                                               arr, family=fam)
+    else:
+        def put(name, arr):
+            return data_plane.h2d(arr, family=fam)
     data_plane.note_padding("dru", (pad_t,), valid_cells=n,
                             padded_cells=pad_t)
     tasks = DruTasks(
-        user=h2d(pad_to(user, pad_t), family=fam),
-        mem=h2d(pad_to(mem, pad_t), family=fam),
-        cpus=h2d(pad_to(cpus, pad_t), family=fam),
-        gpus=h2d(pad_to(gpus, pad_t), family=fam),
-        order_key=h2d(pad_to(order_key, pad_t, fill=BIG), family=fam),
-        valid=h2d(pad_to(np.ones(n, dtype=bool), pad_t, fill=False),
-                  family=fam),
+        user=put("user", pad_to(user, pad_t)),
+        mem=put("mem", pad_to(mem, pad_t)),
+        cpus=put("cpus", pad_to(cpus, pad_t)),
+        gpus=put("gpus", pad_to(gpus, pad_t)),
+        order_key=put("order_key", pad_to(order_key, pad_t, fill=BIG)),
+        valid=put("valid", pad_to(np.ones(n, dtype=bool), pad_t,
+                                  fill=False)),
     )
     result = dru_rank(
         tasks,
-        h2d(mem_div, family=fam),
-        h2d(cpu_div, family=fam),
-        h2d(gpu_div, family=fam),
+        put("mem_div", mem_div),
+        put("cpu_div", cpu_div),
+        put("gpu_div", gpu_div),
         gpu_mode=(pool.dru_mode == DruMode.GPU),
-        backfill=(h2d(pad_to(backfill, pad_t, fill=1.0), family=fam)
+        backfill=(put("backfill", pad_to(backfill, pad_t, fill=1.0))
                   if backfill is not None else None),
         backfill_weight=(jnp.float32(backfill_weight)
                          if backfill is not None else None),

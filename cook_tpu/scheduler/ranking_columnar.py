@@ -40,6 +40,7 @@ def rank_pool_columnar(
     pool: Pool,
     *,
     capacity_limits=None,  # (max_mem, max_cpus, max_gpus) offensive filter
+    device_state=None,     # DRU-column residency (device_state.py)
 ) -> RankedQueue:
     pending, inst_sel = index.pool_view(pool.name)
     n_idx = index._n
@@ -137,23 +138,31 @@ def rank_pool_columnar(
 
     pad_t = bucket_size(n)
     # same data-plane accounting as the full encoder (ranking.rank_pool):
-    # DRU columns are their own transfer family
-    h2d = data_plane.h2d
+    # DRU columns are their own transfer family; with device residency
+    # each column reuses its resident device copy when content is
+    # unchanged (device_state.resident_array — zero re-upload)
     fam = data_plane.FAM_DRU
+    if device_state is not None:
+        def put(name, arr):
+            return device_state.resident_array(pool.name, "dru." + name,
+                                               arr, family=fam)
+    else:
+        def put(name, arr):
+            return data_plane.h2d(arr, family=fam)
     data_plane.note_padding("dru", (pad_t,), valid_cells=n,
                             padded_cells=pad_t)
     tasks = DruTasks(
-        user=h2d(pad_to(user, pad_t), family=fam),
-        mem=h2d(pad_to(mem.astype(np.float32), pad_t), family=fam),
-        cpus=h2d(pad_to(cpus.astype(np.float32), pad_t), family=fam),
-        gpus=h2d(pad_to(gpus.astype(np.float32), pad_t), family=fam),
-        order_key=h2d(pad_to(order_key, pad_t, fill=BIG), family=fam),
-        valid=h2d(pad_to(np.ones(n, bool), pad_t, fill=False), family=fam),
+        user=put("user", pad_to(user, pad_t)),
+        mem=put("mem", pad_to(mem.astype(np.float32), pad_t)),
+        cpus=put("cpus", pad_to(cpus.astype(np.float32), pad_t)),
+        gpus=put("gpus", pad_to(gpus.astype(np.float32), pad_t)),
+        order_key=put("order_key", pad_to(order_key, pad_t, fill=BIG)),
+        valid=put("valid", pad_to(np.ones(n, bool), pad_t, fill=False)),
     )
     result = dru_rank(
         tasks,
-        h2d(mem_div, family=fam), h2d(cpu_div, family=fam),
-        h2d(gpu_div, family=fam),
+        put("mem_div", mem_div), put("cpu_div", cpu_div),
+        put("gpu_div", gpu_div),
         gpu_mode=(pool.dru_mode == DruMode.GPU),
     )
     kernel_order = np.asarray(result.order)

@@ -40,6 +40,7 @@ def cmd_run(args) -> int:
         max_cycles=args.max_cycles,
         batched_match=args.batched,
         speculate=args.speculate,
+        resident=args.resident,
         fault_schedule=fault_schedule,
         scheduler=SchedulerConfig(
             # chunk/backend default to the hardware-tuned config
@@ -251,6 +252,10 @@ def main(argv=None) -> int:
                    help="prediction-assisted speculative match cycles "
                         "(scheduler/prediction.py): overlap cycle N+1's "
                         "solve with cycle N's drain")
+    r.add_argument("--resident", action="store_true",
+                   help="device-resident match state "
+                        "(scheduler/device_state.py): encode tensors "
+                        "stay on device across cycles, O(delta) updates")
     r.add_argument("--faults", default="",
                    help="FaultSchedule JSON file armed for the run "
                         "(cook_tpu.faults; see docs/resilience.md)")

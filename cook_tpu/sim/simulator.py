@@ -99,6 +99,10 @@ class SimConfig:
     # sim cycle — a running task predicted to finish by the next cycle's
     # clock is assumed complete by the speculative solve
     speculate: bool = False
+    # device-resident match state (scheduler/device_state.py): keep the
+    # encode tensors on device across cycles with O(delta) updates —
+    # flips the scheduler's MatchConfig.device_residency knob
+    resident: bool = False
     # fault-injection schedule (cook_tpu/faults.FaultSchedule.from_dict
     # shape: {"seed": .., "rules": [{"point": .., "mode": .., ...}]}),
     # armed for the duration of run() — the chaos scenarios
@@ -217,6 +221,11 @@ class Simulator:
 
             self.config.scheduler.elastic = _dc.replace(
                 self.config.scheduler.elastic, enabled=True)
+        if self.config.resident:
+            import dataclasses as _dc
+
+            self.config.scheduler.match = _dc.replace(
+                self.config.scheduler.match, device_residency=True)
         if self.config.speculate:
             self.config.scheduler.speculation = True
             # completions flush exactly one cycle_ms ahead: predict to
@@ -390,6 +399,11 @@ class Simulator:
                     if r.get("rebuild_fraction") is not None]
         wastes = [r["padding_waste"] for r in records
                   if r.get("padding_waste") is not None]
+        # device-residency attribution off the same records: how many
+        # match cycles rode O(delta) updates vs full rebuilds, and the
+        # rows scattered — the after picture next to rebuild_fraction
+        ds_records = [r["device_state"] for r in records
+                      if r.get("device_state")]
         data_plane_summary = {
             "h2d_bytes": led_h2d1 - led_h2d0,
             "d2h_bytes": led_d2h1 - led_d2h0,
@@ -397,6 +411,17 @@ class Simulator:
                                       if rebuilds else None),
             "mean_padding_waste": (sum(wastes) / len(wastes)
                                    if wastes else None),
+            "device_state": {
+                "cycles": len(ds_records),
+                "rebuilds": sum(1 for d in ds_records if d.get("rebuild")),
+                "delta_cycles": sum(1 for d in ds_records
+                                    if not d.get("rebuild")),
+                "delta_rows": sum(d.get("delta_rows", 0)
+                                  for d in ds_records
+                                  if not d.get("rebuild")),
+                "resident_bytes": (ds_records[-1].get("resident_bytes", 0)
+                                   if ds_records else 0),
+            },
         }
         return SimResult(
             rows=self._collect_rows(),

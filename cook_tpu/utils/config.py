@@ -236,6 +236,14 @@ def _match_config(d: dict) -> MatchConfig:
         hierarchical_coarse_backend=str(
             d.get("hierarchical_coarse_backend", "xla")),
         hierarchical_use_mesh=bool(d.get("hierarchical_use_mesh", True)),
+        hierarchical_fine_backend=str(
+            d.get("hierarchical_fine_backend", "xla")),
+        # device-resident match state + quantized cost tensors
+        # (scheduler/device_state.py; docs/configuration.md)
+        device_residency=bool(d.get("device_residency", False)),
+        quantized=bool(d.get("quantized", False)),
+        quantization_parity_floor=float(
+            d.get("quantization_parity_floor", 0.98)),
     )
 
 

@@ -16,6 +16,29 @@ import jax  # noqa: E402
 # (jax_platforms=axon,cpu); force pure-CPU for deterministic 8-device tests.
 jax.config.update("jax_platforms", "cpu")
 
+import atexit  # noqa: E402
+import gc  # noqa: E402
+
+
+def _jax_teardown_barrier():
+    """Interpreter-teardown barrier for the intermittent SIGABRT
+    ("terminate called without an active exception") the FULL tier-1
+    run sometimes hits at exit — jax/XLA worker threads torn down while
+    still holding work (pre-existing, reproduced identically on the
+    seed commit; see docs/status.md).  Registered AFTER jax's import so
+    it runs BEFORE jax's own atexit hooks (LIFO): clear the executable
+    caches and collect while the runtime is still fully alive, so
+    nothing is mid-flight when the backend unwinds."""
+    try:
+        jax.clear_caches()
+        gc.collect()
+    except Exception:  # noqa: BLE001 — a teardown helper must never
+        # turn a green run red
+        pass
+
+
+atexit.register(_jax_teardown_barrier)
+
 import pytest  # noqa: E402
 
 from cook_tpu.models.entities import (  # noqa: E402

@@ -467,6 +467,54 @@ def test_bench_gate_bytes_threshold_fails_on_growth():
     assert code == 0
 
 
+def test_bench_gate_match_resident_bytes_gated_by_default():
+    """match_resident* phases byte-gate at the TIMING threshold even
+    with no --bytes-threshold: warm-cycle byte growth is the regression
+    the residency tier exists to catch, never informational."""
+    bench_gate = _gate()
+    old = _record("r1", "cpu", {
+        "match_resident": {"p50_ms": 10.0, "h2d_bytes": 1000},
+        "match": {"p50_ms": 10.0, "h2d_bytes": 1000}})
+    new = _record("r2", "cpu", {
+        "match_resident": {"p50_ms": 10.0, "h2d_bytes": 5000},
+        "match": {"p50_ms": 10.0, "h2d_bytes": 5000}})
+    code, messages = bench_gate.gate([old, new], 0.2)
+    assert code == 1
+    assert any("match_resident: h2d_bytes 1000 -> 5000" in m
+               and "REGRESSION" in m for m in messages)
+    # the ordinary phase's identical growth stays informational
+    assert not any("  match: h2d_bytes" in m and "REGRESSION" in m
+                   for m in messages)
+    # unchanged warm bytes pass
+    code, _ = bench_gate.gate([old, old | {"path": "r3"}], 0.2)
+    assert code == 0
+
+
+def test_bench_history_renders_vs_cold_split(tmp_path):
+    """The residency warm/cold split: bench_history shows warm-cycle
+    H2D as a fraction of the cold rebuild's."""
+    import json as _json
+
+    import bench_history
+
+    record = {
+        "schema": "cook-bench/v1", "mode": "smoke", "platform": "cpu",
+        "backend": "cpu",
+        "phases": {
+            "match_resident": {"p50_ms": 10.0, "h2d_bytes": 300,
+                               "warm_cycles": 3},
+            "match_resident_cold": {"p50_ms": 50.0, "h2d_bytes": 1000},
+        },
+    }
+    path = tmp_path / "BENCH_r01_phases.json"
+    path.write_text(_json.dumps(record))
+    rows = bench_history.history_rows(
+        bench_history.collect_records([str(path)]))
+    by_phase = {r["phase"]: r for r in rows}
+    assert by_phase["match_resident"]["vs_cold"] == "10.0%"
+    assert by_phase["match_resident_cold"]["vs_cold"] == "-"
+
+
 def test_bench_gate_zero_baseline_growth_trips_threshold():
     """Growth from a zero baseline is unbounded, not 0%: a phase that
     moved no bytes suddenly moving megabytes must trip any threshold."""

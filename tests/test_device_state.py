@@ -1,0 +1,636 @@
+"""Device-resident match state (scheduler/device_state.py +
+ops/device_update.py): warm-cycle transfer floor, O(delta) donated-buffer
+updates, invalidation/rebuild ladder, quantization parity guard, the
+offers_fingerprint contract, and the fused fine-pass scorer."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import Job, Pool, Resources
+from cook_tpu.models.store import JobStore
+from cook_tpu.obs import data_plane
+from cook_tpu.scheduler import encode_cache as encode_cache_mod
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.scheduler.device_state import (
+    DeviceResidentState,
+    quantized_dtype,
+    snapshot_all,
+)
+from cook_tpu.scheduler.encode_cache import EncodeCache, offers_fingerprint
+from cook_tpu.scheduler.matcher import MatchConfig
+
+from conftest import FakeClock, make_job
+
+
+ENCODE_FAMS = (data_plane.FAM_NODE_ENCODE, data_plane.FAM_FEASIBILITY)
+
+
+def encode_h2d():
+    totals = data_plane.LEDGER.family_totals()
+    return sum(totals.get(f, {}).get("h2d_bytes", 0) for f in ENCODE_FAMS)
+
+
+def resident_rig(n_jobs=200, n_hosts=8, host_mem=4096.0, *,
+                 resident=True, quantized=False, telemetry=False,
+                 chunk=0, job_mem=4000.0, **sched_kw):
+    """Scheduler + near-host-size jobs: a handful match on the cold
+    cycle, the rest wait — warm cycles then see an unchanged pool."""
+    store = JobStore(clock=lambda: 1_000_000)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m",
+        [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=host_mem,
+                  cpus=8.0) for i in range(n_hosts)],
+        clock=store.clock)
+    config = SchedulerConfig(
+        match=MatchConfig(chunk=chunk, device_residency=resident,
+                          quantized=quantized, quality_audit_every=0),
+        device_telemetry=telemetry, **sched_kw)
+    scheduler = Scheduler(store, [cluster], config)
+    store.submit_jobs([
+        Job(uuid=f"j{i}", user=f"u{i % 4}", pool="default", priority=50,
+            resources=Resources(mem=job_mem, cpus=8.0), command="true")
+        for i in range(n_jobs)
+    ])
+    return store, scheduler
+
+
+def run_cycle(store, scheduler):
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    record = scheduler.recorder.records(limit=1)[0]
+    return outcome, record
+
+
+# --------------------------------------------------- warm-cycle transfers
+
+
+def test_warm_cycles_cut_encode_h2d_by_90_percent():
+    """THE acceptance bar: with residency enabled, a warm unchanged-pool
+    cycle moves >= 90% fewer node-encode + job-feasibility H2D bytes
+    than the cold rebuild cycle (PR 11 TransferLedger stamps)."""
+    store, scheduler = resident_rig(n_jobs=1000, n_hosts=16)
+    m0 = encode_h2d()
+    _, r_cold = run_cycle(store, scheduler)
+    cold = encode_h2d() - m0
+    assert r_cold.device_state["rebuild"] is True
+    assert r_cold.device_state["reason"] == "cold"
+    for _ in range(2):
+        m0 = encode_h2d()
+        _, r_warm = run_cycle(store, scheduler)
+        warm = encode_h2d() - m0
+        assert r_warm.device_state["rebuild"] is False
+        assert r_warm.device_state["delta_rows"] == 0
+        assert warm <= 0.1 * cold, (warm, cold)
+
+
+def test_resident_placements_identical_to_classic_path():
+    """Residency is a transfer optimization, never a decision change:
+    serial cycles match identical (job, host) pairs with it on or off."""
+    def matched(resident):
+        store, scheduler = resident_rig(n_jobs=60, n_hosts=6,
+                                        job_mem=900.0, host_mem=4096.0,
+                                        resident=resident)
+        out = []
+        for _ in range(3):
+            outcome, _ = run_cycle(store, scheduler)
+            out.append(sorted((j.uuid, o.hostname)
+                              for j, o in outcome.matched))
+        return out
+
+    assert matched(True) == matched(False)
+
+
+def test_single_new_job_is_one_delta_row():
+    store, scheduler = resident_rig()
+    run_cycle(store, scheduler)
+    run_cycle(store, scheduler)
+    store.submit_jobs([Job(uuid="delta", user="d", pool="default",
+                           priority=50,
+                           resources=Resources(mem=4000.0, cpus=8.0),
+                           command="true")])
+    _, record = run_cycle(store, scheduler)
+    assert record.device_state["rebuild"] is False
+    assert record.device_state["delta_rows"] == 1
+
+
+def test_row_invalidation_re_uploads_only_that_row():
+    """An instance/status event drops the job's feasibility rows (host
+    cache AND mirror slot, via the subscriber): the next cycle scatters
+    exactly the invalidated rows, no rebuild."""
+    store, scheduler = resident_rig(n_jobs=40, job_mem=900.0)
+    outcome, _ = run_cycle(store, scheduler)
+    assert outcome.matched
+    run_cycle(store, scheduler)
+    # fail one matched instance: the job re-queues and its rows drop
+    from cook_tpu.models.entities import InstanceStatus
+
+    job, _offer = outcome.matched[0]
+    inst = store.job_instances(job.uuid)[0]
+    store.update_instance_state(inst.task_id, InstanceStatus.FAILED,
+                                "preempted-by-rebalancer")
+    _, record = run_cycle(store, scheduler)
+    assert record.device_state["rebuild"] is False
+    assert record.device_state["delta_rows"] >= 1
+    assert record.device_state["delta_rows"] <= 3
+
+
+def test_epoch_bump_forces_clean_rebuild():
+    from cook_tpu.models.entities import Quota
+
+    store, scheduler = resident_rig()
+    run_cycle(store, scheduler)
+    _, r_warm = run_cycle(store, scheduler)
+    assert r_warm.device_state["rebuild"] is False
+    store.set_quota(Quota(user="u0", pool="default",
+                          resources=Resources(mem=10_000.0, cpus=100.0),
+                          count=1000))
+    _, record = run_cycle(store, scheduler)
+    assert record.device_state["rebuild"] is True
+    assert record.device_state["reason"] == "epoch-bumped"
+
+
+def test_offer_structure_change_forces_rebuild():
+    store, scheduler = resident_rig(n_hosts=4)
+    run_cycle(store, scheduler)
+    host = MockHost(node_id="grow", hostname="grow", mem=4096.0, cpus=8.0)
+    scheduler.clusters[0].hosts[host.node_id] = host
+    _, record = run_cycle(store, scheduler)
+    assert record.device_state["rebuild"] is True
+    assert record.device_state["reason"] == "offers-changed"
+
+
+def test_job_bucket_growth_forces_rebuild():
+    store, scheduler = resident_rig(n_jobs=60)
+    _, r = run_cycle(store, scheduler)
+    cap = r.device_state["resident_bytes"]
+    # push the considerable window past the padded job bucket (64 -> 128)
+    store.submit_jobs([
+        Job(uuid=f"grow{i}", user="g", pool="default", priority=50,
+            resources=Resources(mem=4000.0, cpus=8.0), command="true")
+        for i in range(30)
+    ])
+    _, record = run_cycle(store, scheduler)
+    assert record.device_state["rebuild"] is True
+    assert record.device_state["reason"] == "bucket-growth"
+    assert record.device_state["resident_bytes"] > cap
+
+
+# ------------------------------------------------ compile-program pinning
+
+
+def test_delta_updates_stay_on_one_program_per_bucket():
+    """The CompileObservatory inducing test: delta sizes 1..4 share ONE
+    update bucket (UPDATE_BUCKET_MIN=8), so the donated-buffer scatter
+    compiles exactly one program per resident buffer — not one per
+    delta size."""
+    store, scheduler = resident_rig(n_jobs=40, telemetry=True)
+    run_cycle(store, scheduler)
+    observatory = scheduler.telemetry.observatory
+
+    def submit(k, tag):
+        store.submit_jobs([
+            Job(uuid=f"{tag}-{i}", user="d", pool="default", priority=50,
+                resources=Resources(mem=4000.0, cpus=8.0), command="true")
+            for i in range(k)
+        ])
+
+    programs = []
+    for delta, tag in ((1, "a"), (2, "b"), (3, "c"), (4, "d")):
+        submit(delta, tag)
+        _, record = run_cycle(store, scheduler)
+        assert record.device_state["rebuild"] is False
+        assert record.device_state["delta_rows"] == delta
+        stats = observatory.stats()
+        programs.append(stats["device_update"]["programs"])
+    # 2 resident buffers (demands + feasibility) x 1 bucket = 2 programs,
+    # STABLE across every delta size
+    assert programs[0] == programs[-1] == 2, programs
+
+
+# -------------------------------------------------- fingerprint contract
+
+
+def test_offers_fingerprint_deterministic_and_order_sensitive():
+    """Identical offer sets (fresh objects) fingerprint identically;
+    DIFFERENT ARRIVAL ORDER fingerprints differently — feasibility rows
+    are node-indexed in offer order, so order IS structure and a
+    reordered set must never serve another order's cached rows."""
+    def offers(order):
+        cluster = MockCluster(
+            "m",
+            [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=100.0,
+                      cpus=1.0) for i in order],
+            clock=lambda: 0)
+        return [(cluster, o) for o in cluster.pending_offers("default")]
+
+    fp_a = offers_fingerprint(offers([0, 1, 2]))
+    fp_b = offers_fingerprint(offers([0, 1, 2]))
+    fp_c = offers_fingerprint(offers([2, 1, 0]))
+    assert fp_a == fp_b
+    assert fp_a != fp_c
+
+
+def test_fingerprint_collision_with_different_node_count_rebuilds(
+        monkeypatch):
+    """Collision-shaped regression: even if offers_fingerprint COLLIDES
+    across a node-count change, both the host cache (row-shape check)
+    and the device mirror (n_real/n_pad key) must refuse the stale
+    state and rebuild."""
+    monkeypatch.setattr(encode_cache_mod, "offers_fingerprint",
+                        lambda cluster_offers: 42)
+    store, scheduler = resident_rig(n_hosts=4, n_jobs=30)
+    _, r1 = run_cycle(store, scheduler)
+    assert r1.device_state["rebuild"] is True
+    _, r2 = run_cycle(store, scheduler)
+    assert r2.device_state["rebuild"] is False  # collision-keyed warm hit
+    for i in range(3):
+        host = MockHost(node_id=f"x{i}", hostname=f"x{i}", mem=4096.0,
+                        cpus=8.0)
+        scheduler.clusters[0].hosts[host.node_id] = host
+    out3, r3 = run_cycle(store, scheduler)
+    assert r3.device_state["rebuild"] is True
+    assert r3.device_state["reason"] == "offers-changed"
+    # the rebuilt problem is shaped for the REAL node count: the three
+    # fresh hosts are matchable this very cycle
+    assert {o.hostname for _, o in out3.matched} == {"x0", "x1", "x2"}
+
+
+# ----------------------------------------------------- encode-cache hook
+
+
+def test_encode_cache_subscriber_callbacks():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cache = EncodeCache(store)
+    events = []
+    cache.subscribe(lambda kind, **info: events.append((kind, info)))
+    job = make_job()
+    store.submit_jobs([job])
+    from cook_tpu.models.entities import InstanceStatus, Quota
+
+    store.create_instance(job.uuid, "t1", hostname="h", node_id="n",
+                          compute_cluster="c")
+    store.update_instance_state("t1", InstanceStatus.FAILED, "failed")
+    assert ("row-dropped", {"job_uuid": job.uuid}) in events
+    store.set_quota(Quota(user="u", pool="default",
+                          resources=Resources(mem=1.0, cpus=1.0), count=1))
+    assert any(kind == "epoch-bumped" for kind, _ in events)
+
+
+def test_subscriber_failure_never_blocks_events():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cache = EncodeCache(store)
+
+    def bad(kind, **info):
+        raise RuntimeError("sick subscriber")
+
+    seen = []
+    cache.subscribe(bad)
+    cache.subscribe(lambda kind, **info: seen.append(kind))
+    cache.clear()
+    assert "epoch-bumped" in seen
+
+
+# -------------------------------------------------------- quantization
+
+
+def test_quantized_parity_holds_and_matches_f32_decisions():
+    """Packing-efficiency parity of the quantized path vs f32 >= 0.98
+    (here: identical placements on the seeded problem — parity 1.0)."""
+    def matched(quantized):
+        store, scheduler = resident_rig(n_jobs=80, job_mem=700.0,
+                                        host_mem=8192.0,
+                                        quantized=quantized)
+        outcome, record = run_cycle(store, scheduler)
+        if quantized:
+            assert record.device_state["quantized"] is True
+        return sorted((j.uuid, o.hostname) for j, o in outcome.matched)
+
+    q, f = matched(True), matched(False)
+    assert len(q) >= 0.98 * len(f)
+    assert q == f  # at this shape bf16 rounding changes nothing
+
+
+def test_quality_drift_demotes_quantized_pool_to_f32():
+    """The drift-inducing test: a QualityMonitor sample under the
+    parity floor demotes the pool — the next cycle rebuilds the mirror
+    at f32 (reason dtype-changed) and stays f32."""
+    store, scheduler = resident_rig(n_jobs=40, quantized=True,
+                                    telemetry=True)
+    _, r1 = run_cycle(store, scheduler)
+    assert r1.device_state["quantized"] is True
+    # the guard rides the monitor's sample feed (one wiring site covers
+    # every match path)
+    scheduler.telemetry.quality.record_sample("default", 0.5)
+    assert scheduler.device_state.demoted_pools() == ["default"]
+    _, r2 = run_cycle(store, scheduler)
+    assert r2.device_state["quantized"] is False
+    assert r2.device_state["rebuild"] is True
+    assert r2.device_state["reason"] == "dtype-changed"
+    _, r3 = run_cycle(store, scheduler)
+    assert r3.device_state["quantized"] is False
+    assert r3.device_state["rebuild"] is False
+
+
+def test_healthy_quality_sample_never_demotes():
+    store, scheduler = resident_rig(n_jobs=20, quantized=True,
+                                    telemetry=True)
+    run_cycle(store, scheduler)
+    scheduler.telemetry.quality.record_sample("default", 0.995)
+    assert scheduler.device_state.demoted_pools() == []
+
+
+# ------------------------------------------------- multi-path + the sim
+
+
+def test_pipelined_and_batched_paths_share_the_mirror():
+    def run(mode):
+        store = JobStore(clock=lambda: 1_000_000)
+        hosts = []
+        for p in range(2):
+            store.set_pool(Pool(name=f"pool{p}"))
+            hosts += [MockHost(node_id=f"p{p}h{i}", hostname=f"p{p}h{i}",
+                               mem=8192.0, cpus=16.0, pool=f"pool{p}")
+                      for i in range(3)]
+        cluster = MockCluster("m", hosts, clock=store.clock)
+        scheduler = Scheduler(store, [cluster], SchedulerConfig(
+            match=MatchConfig(chunk=0, device_residency=True,
+                              quality_audit_every=0),
+            device_telemetry=False))
+        store.submit_jobs([
+            Job(uuid=f"j{p}-{i}", user=f"u{i % 3}", pool=f"pool{p}",
+                priority=50, resources=Resources(mem=600.0, cpus=1.0),
+                command="true")
+            for p in range(2) for i in range(30)
+        ])
+        pools = [p for p in store.pools.values() if p.schedules_jobs]
+        for pool in pools:
+            scheduler.rank_cycle(pool)
+        if mode == "pipelined":
+            outcomes = scheduler.match_cycle_pipelined()
+        elif mode == "batched":
+            outcomes = scheduler.match_cycle_all_pools()
+        else:
+            outcomes = {p.name: scheduler.match_cycle(p) for p in pools}
+        return sorted((j.uuid, o.hostname)
+                      for out in outcomes.values()
+                      for j, o in out.matched)
+
+    serial = run("serial")
+    assert run("pipelined") == serial
+    assert run("batched") == serial
+
+
+@pytest.mark.parametrize("trace", ["standard", "completion_heavy"])
+def test_sim_trace_placements_identical_with_residency(trace):
+    """Acceptance bar: the standard and completion-heavy sim traces
+    place identically with residency on and off."""
+    from cook_tpu.sim.loadgen import completion_heavy_trace
+    from cook_tpu.sim.simulator import (SimConfig, Simulator, TraceHost,
+                                        TraceJob)
+
+    def standard_trace():
+        rng = np.random.default_rng(3)
+        jobs = [TraceJob(uuid=f"j{i}", user=f"u{i % 4}",
+                         submit_time_ms=int(rng.integers(0, 120_000)),
+                         runtime_ms=int(rng.integers(30_000, 120_000)),
+                         mem=float(rng.choice([200, 400, 800])),
+                         cpus=float(rng.choice([1, 2])))
+                for i in range(40)]
+        hosts = [TraceHost(node_id=f"n{i}", hostname=f"n{i}", mem=2000,
+                           cpus=8) for i in range(8)]
+        return jobs, hosts
+
+    def run(resident):
+        if trace == "standard":
+            jobs, hosts = standard_trace()
+        else:
+            jobs, hosts = completion_heavy_trace(jobs=24, hosts=4)
+        config = SimConfig(
+            cycle_ms=30_000, max_cycles=30, resident=resident,
+            scheduler=SchedulerConfig(device_telemetry=False),
+        )
+        result = Simulator(jobs, hosts, config).run()
+        return sorted((r["job_uuid"], r["host"], r["start_ms"])
+                      for r in result.rows
+                      if r.get("start_ms") is not None)
+
+    assert run(True) == run(False)
+
+
+def test_sim_summary_reports_device_state():
+    from cook_tpu.sim.simulator import (SimConfig, Simulator, TraceHost,
+                                        TraceJob)
+
+    jobs = [TraceJob(uuid=f"j{i}", user="u", submit_time_ms=0,
+                     runtime_ms=60_000, mem=300.0, cpus=1.0)
+            for i in range(20)]
+    hosts = [TraceHost(node_id=f"n{i}", hostname=f"n{i}", mem=1000,
+                       cpus=4) for i in range(4)]
+    result = Simulator(jobs, hosts, SimConfig(
+        cycle_ms=30_000, max_cycles=20, resident=True,
+        scheduler=SchedulerConfig(device_telemetry=False))).run()
+    ds = result.data_plane["device_state"]
+    assert ds["cycles"] > 0
+    assert ds["rebuilds"] >= 1
+
+
+# ------------------------------------------------------------ speculation
+
+
+def test_speculation_drops_on_resident_epoch_bump():
+    """A resident-state invalidation between speculative dispatch and
+    commit vetoes the commit: the speculative problem was built from
+    dropped device tensors."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock",
+        [MockHost(node_id="h0", hostname="h0", mem=1000, cpus=4,
+                  pool="default")],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=0, device_residency=True,
+                          quality_audit_every=0),
+        speculation=True,
+        speculation_horizon_ms=10_000,
+        predictor_min_samples=1))
+    jobs = [make_job(user="u0", mem=1000, cpus=4).with_(
+        uuid=f"j{i}", expected_runtime_ms=10_000) for i in range(3)]
+    store.submit_jobs(jobs)
+
+    def cycle():
+        pool = store.pools["default"]
+        scheduler.rank_cycle(pool)
+        scheduler.match_cycle(pool)
+        return scheduler.recorder.records(limit=1)[0]
+
+    cycle()                                   # j0 fresh; predictor cold
+    clock.advance(10_000)
+    cluster.advance_to(clock())
+    cycle()                                   # j1 fresh; speculates j2
+    assert scheduler.speculator.stats_json()["inflight"] == ["default"]
+    # the inducing invalidation: resident state dropped mid-flight
+    scheduler.device_state.invalidate()
+    clock.advance(10_000)
+    cluster.advance_to(clock())
+    record = cycle()
+    assert record.speculation == "dropped"
+    assert record.speculation_drop == "epoch-stale"
+
+
+def test_speculation_hit_with_residency_enabled():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "mock",
+        [MockHost(node_id="h0", hostname="h0", mem=1000, cpus=4,
+                  pool="default")],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster], SchedulerConfig(
+        match=MatchConfig(chunk=0, device_residency=True,
+                          quality_audit_every=0),
+        speculation=True,
+        speculation_horizon_ms=10_000,
+        predictor_min_samples=1))
+    jobs = [make_job(user="u0", mem=1000, cpus=4).with_(
+        uuid=f"j{i}", expected_runtime_ms=10_000) for i in range(3)]
+    store.submit_jobs(jobs)
+
+    def cycle():
+        pool = store.pools["default"]
+        scheduler.rank_cycle(pool)
+        outcome = scheduler.match_cycle(pool)
+        return outcome, scheduler.recorder.records(limit=1)[0]
+
+    cycle()
+    clock.advance(10_000)
+    cluster.advance_to(clock())
+    cycle()
+    clock.advance(10_000)
+    cluster.advance_to(clock())
+    outcome, record = cycle()
+    assert record.speculation == "hit"
+    assert [j.uuid for j, _ in outcome.matched] == ["j2"]
+
+
+# ---------------------------------------------------- resident DRU columns
+
+
+def test_resident_array_reuses_unchanged_content():
+    state = DeviceResidentState()
+    a = np.arange(16, dtype=np.float32)
+    d1 = state.resident_array("p", "dru.mem", a)
+    d2 = state.resident_array("p", "dru.mem", a.copy())
+    assert d1 is d2
+    d3 = state.resident_array("p", "dru.mem", a + 1)
+    assert d3 is not d1
+    assert np.allclose(np.asarray(d3), a + 1)
+
+
+def test_rank_cycle_moves_zero_dru_bytes_when_queue_unchanged():
+    store, scheduler = resident_rig(n_jobs=50)
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    scheduler.rank_cycle(pool)  # queue membership unchanged
+    totals0 = data_plane.LEDGER.family_totals().get(
+        data_plane.FAM_DRU, {}).get("h2d_bytes", 0)
+    scheduler.rank_cycle(pool)
+    totals1 = data_plane.LEDGER.family_totals().get(
+        data_plane.FAM_DRU, {}).get("h2d_bytes", 0)
+    assert totals1 == totals0
+
+
+# ---------------------------------------------------------- debug surface
+
+
+def test_snapshot_all_reports_mirrors():
+    store, scheduler = resident_rig(n_jobs=20)
+    run_cycle(store, scheduler)
+    # the process-wide snapshot may hold OTHER live schedulers' states
+    # (weakref registry); assert on THIS scheduler's entry
+    snap = snapshot_all()
+    assert snap["enabled"]
+    mine = scheduler.device_state.debug_json()
+    assert mine in snap["states"]
+    assert mine["pools"]["default"]["resident_bytes"] > 0
+    assert mine["pools"]["default"]["last"]["rebuild"] is True
+
+
+def test_quantized_dtype_is_two_bytes():
+    assert quantized_dtype().itemsize == 2
+
+
+# ------------------------------------------------- fused fine-pass scorer
+
+
+def test_best_node_batched_matches_per_block_best_node():
+    from cook_tpu.ops.pallas_match import best_node, best_node_batched
+
+    rng = np.random.default_rng(0)
+    b, s, n, r = 3, 16, 32, 4
+    d = rng.uniform(1, 10, (b, s, r)).astype(np.float32)
+    av = rng.uniform(0, 20, (b, n, r)).astype(np.float32)
+    tot = (av[:, :, :2] + 5).astype(np.float32)
+    nv = rng.uniform(size=(b, n)) > 0.2
+    feas = rng.uniform(size=(b, s, n)) > 0.3
+    bv, bi = best_node_batched(jnp.asarray(d), jnp.asarray(av),
+                               jnp.asarray(tot), jnp.asarray(nv),
+                               jnp.asarray(feas), interpret=True)
+    for k in range(b):
+        v1, i1 = best_node(jnp.asarray(d[k]), jnp.asarray(av[k]),
+                           jnp.asarray(tot[k]), jnp.asarray(nv[k]),
+                           jnp.asarray(feas[k]), interpret=True)
+        assert np.allclose(np.asarray(bv[k]), np.asarray(v1))
+        assert np.array_equal(np.asarray(bi[k]), np.asarray(i1))
+
+
+def test_hierarchical_fused_fine_backend_parity():
+    """The fused fine-pass scorer holds packing parity vs the flat CPU
+    greedy (>= 0.95, the hierarchical parity floor) and stamps its
+    backend label."""
+    from cook_tpu.ops import cpu_reference as ref
+    from cook_tpu.ops.hierarchical import HierParams, hierarchical_match
+    from cook_tpu.ops.match import MatchProblem
+
+    rng = np.random.default_rng(0)
+    j, n = 512, 128
+    demands = np.stack([rng.choice([512, 1024, 2048], j),
+                        rng.choice([1, 2, 4], j),
+                        np.zeros(j)], axis=-1).astype(np.float32)
+    totals = np.stack([np.full(n, 65536.0), np.full(n, 32.0)],
+                      axis=-1).astype(np.float32)
+    avail = np.concatenate(
+        [totals * rng.uniform(0.2, 1.0, (n, 1)).astype(np.float32),
+         np.zeros((n, 1), np.float32)], axis=-1)
+    problem = MatchProblem(
+        demands=jnp.asarray(demands), job_valid=jnp.ones(j, bool),
+        avail=jnp.asarray(avail), totals=jnp.asarray(totals),
+        node_valid=jnp.ones(n, bool), feasible=None)
+    result, stats = hierarchical_match(problem, params=HierParams(
+        nodes_per_block=32, chunk=128, kc=16, fine_backend="pallas"))
+    assert stats["backend"] == "pallas-fine"
+    cpu = ref.np_greedy_match(demands, avail, totals)
+    q_cpu = ref.packing_quality(demands, cpu)
+    q_dev = ref.packing_quality(demands, np.asarray(result.assignment))
+    eff = q_dev["cpus_placed"] / q_cpu["cpus_placed"]
+    assert eff >= 0.95, eff
+
+
+def test_hier_fine_backend_validated():
+    from cook_tpu.ops.hierarchical import HierParams
+
+    with pytest.raises(ValueError):
+        HierParams(fine_backend="nope")
+    with pytest.raises(ValueError):
+        MatchConfig(hierarchical_fine_backend="nope")

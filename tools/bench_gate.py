@@ -50,6 +50,12 @@ import sys
 
 SCHEMA = "cook-bench/v1"
 
+# phases whose byte columns are ALWAYS gated (at the timing threshold)
+# even without --bytes-threshold: the match_resident tier's whole point
+# is its warm-cycle transfer floor — bytes growing back on warm cycles
+# is the regression the phase exists to catch, not an informational diff
+BYTE_GATED_PREFIXES = ("match_resident",)
+
 
 def load_record(path: str) -> dict | None:
     """Parse one bench artifact; returns a normalized record or None for
@@ -78,9 +84,11 @@ def load_record(path: str) -> dict | None:
             name: {"p50_ms": float(info["p50_ms"]),
                    "backend": info.get("backend"),
                    # data-plane byte stamps (optional: records predating
-                   # the ledger simply diff nothing)
+                   # the ledger simply diff nothing); warm_cycles feeds
+                   # bench_history's warm/cold residency split
                    **{col: int(info[col]) for col in
-                      ("h2d_bytes", "d2h_bytes") if col in info}}
+                      ("h2d_bytes", "d2h_bytes", "warm_cycles")
+                      if col in info}}
             for name, info in phases.items()
             if isinstance(info, dict) and "p50_ms" in info
         },
@@ -103,7 +111,8 @@ def collect_records(paths: list[str]) -> list[dict]:
 
 def diff_bytes(old: dict, new: dict, bytes_threshold,
                messages: list[str], regressions: list[str],
-               require: bool = False) -> None:
+               require: bool = False,
+               gated_threshold: float = None) -> None:
     """Diff the data-plane byte columns of every shared phase carrying
     them.  Bytes are DETERMINISTIC (same code -> same logical bytes) and
     backend-stable, so this runs even for pairs the timing gate refuses.
@@ -112,7 +121,9 @@ def diff_bytes(old: dict, new: dict, bytes_threshold,
     (the --bytes-only mode, where this IS the whole gate) additionally
     counts a byte column or whole phase that VANISHED from the new
     record as regressed — the same silently-dropped-measurement rule
-    the timing gate applies to missing phases."""
+    the timing gate applies to missing phases.  Phases named in
+    BYTE_GATED_PREFIXES gate their byte growth at `gated_threshold`
+    even when no --bytes-threshold was given."""
     if require:
         for phase in sorted(set(old["phases"]) - set(new["phases"])):
             messages.append(f"bench_gate:   {phase}: missing from the "
@@ -120,11 +131,15 @@ def diff_bytes(old: dict, new: dict, bytes_threshold,
             regressions.append(f"{phase} (missing)")
     for phase in sorted(set(old["phases"]) & set(new["phases"])):
         oinfo, ninfo = old["phases"][phase], new["phases"][phase]
+        byte_gated = phase.startswith(BYTE_GATED_PREFIXES)
+        threshold = bytes_threshold
+        if threshold is None and byte_gated:
+            threshold = gated_threshold
         for col in ("h2d_bytes", "d2h_bytes"):
             if col not in oinfo:
                 continue
             if col not in ninfo:
-                if require:
+                if require or byte_gated:
                     messages.append(
                         f"bench_gate:   {phase}: {col} dropped from the "
                         f"new record — counted as regressed")
@@ -144,8 +159,7 @@ def diff_bytes(old: dict, new: dict, bytes_threshold,
             else:
                 delta = 0.0
                 delta_txt = "+0.0%"
-            regressed = (bytes_threshold is not None
-                         and delta > bytes_threshold)
+            regressed = threshold is not None and delta > threshold
             status = "REGRESSION" if regressed else (
                 "ok" if after == before else "changed")
             messages.append(
@@ -181,9 +195,10 @@ def gate(records: list[dict], threshold: float,
             f"threshold {threshold:.0%})")
         regressions: list[str] = []
         # byte columns diff FIRST — they are backend-stable, so they
-        # survive the cross-backend refusal below
+        # survive the cross-backend refusal below.  match_resident*
+        # phases byte-gate at the timing threshold unconditionally
         diff_bytes(old, new, bytes_threshold, messages, regressions,
-                   require=bytes_only)
+                   require=bytes_only, gated_threshold=threshold)
         cross_backend = (old.get("backend") and new.get("backend")
                          and old["backend"] != new["backend"])
         if bytes_only:

@@ -35,18 +35,29 @@ if _TOOLS not in sys.path:
 from bench_gate import _round_key, collect_records  # noqa: E402
 
 COLUMNS = ("round", "mode", "backend", "phase", "p50_ms", "h2d_bytes",
-           "d2h_bytes")
+           "d2h_bytes", "vs_cold")
 
 
 def history_rows(records: list[dict],
                  phases: list[str] | None = None) -> list[dict]:
     """One row per (record, phase), record order preserved (callers pass
-    round-sorted records).  `phases` filters; None keeps everything."""
+    round-sorted records).  `phases` filters; None keeps everything.
+
+    The residency warm/cold split: a record carrying both a `<name>`
+    and `<name>_cold` phase (the match_resident tier) gets a `vs_cold`
+    column on the warm row — warm-cycle H2D as a fraction of the cold
+    rebuild's, the transfer cliff device residency exists to create."""
     rows = []
     for record in records:
         for name, info in sorted(record["phases"].items()):
             if phases and name not in phases:
                 continue
+            vs_cold = "-"
+            cold = record["phases"].get(name + "_cold")
+            if (cold and cold.get("h2d_bytes") and "h2d_bytes" in info
+                    and "warm_cycles" in info):
+                per_warm = info["h2d_bytes"] / max(info["warm_cycles"], 1)
+                vs_cold = f"{per_warm / cold['h2d_bytes']:.1%}"
             rows.append({
                 "round": os.path.basename(record["path"]),
                 "mode": record["mode"],
@@ -60,6 +71,7 @@ def history_rows(records: list[dict],
                               if "h2d_bytes" in info else "-"),
                 "d2h_bytes": (str(info["d2h_bytes"])
                               if "d2h_bytes" in info else "-"),
+                "vs_cold": vs_cold,
             })
     return rows
 

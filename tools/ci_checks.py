@@ -15,6 +15,10 @@ wrapper runs them as one pipeline with one verdict:
      the `speculation` phase (prediction-assisted speculative-cycle
      A/B on the completion-heavy trace: cycle-start-to-first-launch
      p50 + fraction of cycles served from speculation),
+     the `match_resident` tier (device-resident match state: one cold
+     rebuild + three warm delta cycles; the warm phase's p50 AND its
+     h2d_bytes column are gate-enforced — warm-cycle byte growth is a
+     regression, not informational),
      AND the `control_plane` phase — the loadtest (`tools/loadtest.py`,
      serial closed-loop so the gated p50 is commit SERVICE time, not
      same-process queueing jitter) against an in-process control plane,

@@ -51,8 +51,11 @@ def build_rig():
         [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=8)
          for i in range(2)],
         clock=store.clock)
+    # device residency on: /debug/device must serve populated
+    # device_state residency fields (mirror pools, resident bytes)
     scheduler = Scheduler(store, [cluster],
-                          SchedulerConfig(match=MatchConfig(chunk=0)))
+                          SchedulerConfig(match=MatchConfig(
+                              chunk=0, device_residency=True)))
     store.submit_jobs([
         Job(uuid=f"smoke-{i}", user="smoke", pool="default", command="true",
             resources=Resources(mem=200, cpus=1)) for i in range(3)])
@@ -111,9 +114,22 @@ def main(argv=None) -> int:
                 problem = f"status {status}"
             else:
                 try:
-                    json.loads(body)
+                    parsed = json.loads(body)
                 except ValueError as e:
                     problem = f"unparseable JSON: {e}"
+                else:
+                    if path == "/debug/device":
+                        # residency fields: the rig runs with
+                        # device_residency on, so the device_state
+                        # section must exist AND carry a mirror
+                        ds = parsed.get("device_state") or {}
+                        if not ds.get("enabled"):
+                            problem = ("device_state residency section "
+                                       "missing/empty")
+                        elif not any(s.get("pools")
+                                     for s in ds.get("states", [])):
+                            problem = ("device_state has no resident "
+                                       "pool mirrors")
             if problem:
                 failures.append(f"{path}: {problem}")
                 print(f"debug_smoke: {path}: FAIL ({problem})")
