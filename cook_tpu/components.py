@@ -177,6 +177,9 @@ class CookProcess:
     heartbeats: object = None
     sandbox_publisher: object = None
     journal: object = None
+    # sharded layout: one JournalWriter per shard segment (journal stays
+    # None); unsharded: [journal]
+    journals: list = field(default_factory=list)
     follower: object = None  # standby-side journal replication
 
     def is_leader(self) -> bool:
@@ -189,6 +192,7 @@ def build_process(
     clock: Callable[[], int] = wall_clock_ms,
     start_rest: bool = True,
 ) -> CookProcess:
+    sharded = settings.shards > 1
     store = None
     if settings.data_dir:
         # failover recovery: load the last snapshot, then replay the
@@ -204,23 +208,56 @@ def build_process(
         from cook_tpu.models import persistence
 
         os.makedirs(settings.data_dir, exist_ok=True)
-        store = persistence.recover(settings.data_dir, clock=clock)
+        if sharded:
+            from cook_tpu.shard import journal as shard_journal
+
+            if shard_journal.has_single_journal_layout(settings.data_dir):
+                # exactly-once layout conversion (manifest-stamped);
+                # tools/migrate_journal.py is the offline form
+                outcome = shard_journal.migrate_single_journal(
+                    settings.data_dir, settings.shards, clock=clock)
+                log_info("migrated data_dir to per-shard journal "
+                         "segments", component="startup", **{
+                             k: v for k, v in outcome.items()
+                             if k != "per_shard_jobs"})
+            store = shard_journal.recover_sharded(
+                settings.data_dir, settings.shards, clock=clock)
+        else:
+            store = persistence.recover(settings.data_dir, clock=clock)
         if store is not None:
             store.mea_culpa_limit = settings.mea_culpa_failure_limit
             log_info("recovered store from snapshot+journal",
                      component="startup", jobs=len(store.jobs),
                      **store.recovered_stats)
     if store is None:
-        store = JobStore(mea_culpa_limit=settings.mea_culpa_failure_limit,
-                         clock=clock)
+        if sharded:
+            from cook_tpu.shard import ShardedStore
+
+            store = ShardedStore(
+                settings.shards,
+                mea_culpa_limit=settings.mea_culpa_failure_limit,
+                clock=clock)
+        else:
+            store = JobStore(
+                mea_culpa_limit=settings.mea_culpa_failure_limit,
+                clock=clock)
     journal = None
+    journals = []
     if settings.data_dir:
         from cook_tpu.models import persistence
 
-        journal = persistence.attach_journal(
-            store, os.path.join(settings.data_dir, "journal.jsonl"),
-            fsync_policy=settings.journal_fsync_policy,
-        )
+        if sharded:
+            from cook_tpu.shard import journal as shard_journal
+
+            journals = shard_journal.attach_shard_journals(
+                store, settings.data_dir,
+                fsync_policy=settings.journal_fsync_policy)
+        else:
+            journal = persistence.attach_journal(
+                store, os.path.join(settings.data_dir, "journal.jsonl"),
+                fsync_policy=settings.journal_fsync_policy,
+            )
+            journals = [journal]
     from cook_tpu.utils.logging import attach_passport
 
     attach_passport(store)
@@ -242,8 +279,16 @@ def build_process(
 
     # ONE commit pipeline for the process: REST mutations and the
     # elastic capacity plane's pool/capacity-delta commits share the
-    # journal-backed log (durable-on-ack for both)
-    txn = TransactionLog(store, journal=journal)
+    # journal-backed log (durable-on-ack for both).  Sharded deployments
+    # get the partitioned pipeline — per-shard locks, segments,
+    # idempotency — behind the same commit() seam.
+    if sharded:
+        from cook_tpu.shard import ShardedTransactionLog
+
+        txn = ShardedTransactionLog(
+            store, journals=journals if journals else None)
+    else:
+        txn = TransactionLog(store, journal=journal)
     from cook_tpu.elastic import ElasticParams
 
     elastic_conf = settings.elastic
@@ -293,6 +338,9 @@ def build_process(
         replication_ack_liveness_s=settings.replication_ack_liveness_s,
         load_shedding=settings.load_shedding,
         fault_injection=settings.fault_injection,
+        replica_reads=settings.replica_reads,
+        replica_staleness_ceiling_ms=settings.replica_staleness_ceiling_ms,
+        replica_refuse_after_s=settings.replica_refuse_after_s,
     ), plugins=plugins, txn=txn)
     # close the overload loop (docs/resilience.md reaction (d)): the
     # contention observatory's shed signal also drives the scheduler's
@@ -305,6 +353,7 @@ def build_process(
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user
     process = CookProcess(settings=settings, store=store, clusters=clusters,
                           scheduler=scheduler, api=api, journal=journal,
+                          journals=journals,
                           member_id=str(uuid_mod.uuid4())[:8])
     if start_rest:
         process.server = ServerThread(api, port=settings.port).start()
@@ -349,16 +398,34 @@ def start_leader_duties(process: CookProcess,
             if not process.selector.is_leader:
                 process.api.leader_url = url if url != advertised else ""
 
-        process.follower = JournalFollower(
-            process.store,
-            leader_url_fn=elector.current_leader_url,
-            self_url=advertised,
-            data_dir=settings.data_dir,
-            journal=process.journal,
-            as_user=settings.replication_user,
-            member_id=process.member_id,
-            on_leader_url=set_leader_url,
-        ).start()
+        if settings.shards > 1:
+            # one follower per shard segment (cook_tpu/shard/replica.py)
+            from cook_tpu.shard.replica import ShardedJournalFollower
+
+            process.follower = ShardedJournalFollower(
+                process.store,
+                leader_url_fn=elector.current_leader_url,
+                self_url=advertised,
+                data_dir=settings.data_dir,
+                journals=process.journals or None,
+                as_user=settings.replication_user,
+                member_id=process.member_id,
+                on_leader_url=set_leader_url,
+            ).start()
+        else:
+            process.follower = JournalFollower(
+                process.store,
+                leader_url_fn=elector.current_leader_url,
+                self_url=advertised,
+                data_dir=settings.data_dir,
+                journal=process.journal,
+                as_user=settings.replication_user,
+                member_id=process.member_id,
+                on_leader_url=set_leader_url,
+            ).start()
+        # replica-served reads: heavy GETs on this standby answer from
+        # the replayed journal with the follower's staleness bound
+        process.api.staleness_fn = process.follower.staleness_view
     process.selector.wait_for_leadership()
     if not process.selector.is_leader:
         return  # stopped while standing by (shutdown during wait)
@@ -383,10 +450,14 @@ def start_leader_duties(process: CookProcess,
     process.scheduler.active = True
     process.api.leader = True
     process.api.leader_url = ""
+    # the leader's reads are authoritative — no staleness stamping
+    process.api.staleness_fn = None
     log_info("leadership acquired", component="leader",
              member=process.member_id)
-    if process.journal is not None and \
-            getattr(process.journal, "fsync_policy", "") == "fail-stop":
+    fail_stop_journals = [
+        j for j in (process.journals or [process.journal])
+        if j is not None and getattr(j, "fsync_policy", "") == "fail-stop"]
+    if fail_stop_journals:
         # reaction (e), docs/resilience.md: under the fail-stop policy a
         # journal fsync FAILURE demotes this leader (fail-fast,
         # mesos.clj:296-313) so a standby with a working disk takes
@@ -411,7 +482,10 @@ def start_leader_duties(process: CookProcess,
             threading.Thread(target=_demote, daemon=True,
                              name="fsync-fail-stop").start()
 
-        process.journal.on_fsync_error = _fsync_fail_stop
+        # sharded: ANY segment's disk failing demotes — a leader that
+        # can only persist some shards' commits is not a leader
+        for fs_journal in fail_stop_journals:
+            fs_journal.on_fsync_error = _fsync_fail_stop
     process.selector.start_heartbeat_thread()
 
     scheduler = process.scheduler
@@ -525,6 +599,13 @@ def start_leader_duties(process: CookProcess,
         snap_path = _os.path.join(settings.data_dir, "snapshot.json")
 
         def snapshot_and_rotate():
+            if settings.shards > 1:
+                from cook_tpu.shard import journal as _shard_journal
+
+                _shard_journal.snapshot_sharded(store, settings.data_dir)
+                for j in process.journals:
+                    j.rotate()
+                return
             _persistence.snapshot(store, snap_path)
             if process.journal is not None:
                 process.journal.rotate()

@@ -63,8 +63,13 @@ class JournalFollower:
         member_id: str = "",
         on_leader_url: Optional[Callable[[str], None]] = None,
         reconnect_policy: Optional[RetryPolicy] = None,
+        shard: Optional[int] = None,
     ):
         self.store = store
+        # sharded control plane (cook_tpu/shard/): this follower tails
+        # ONE shard's journal segment (`?shard=` on the feed/snapshot
+        # endpoints, `shard` in every ack).  None = the unsharded feed.
+        self.shard = shard
         self.leader_url_fn = leader_url_fn
         self.self_url = self_url.rstrip("/")
         self.data_dir = data_dir
@@ -109,6 +114,13 @@ class JournalFollower:
         self._consecutive_failures = 0
         self._transport_error = False
         self.reconnect_attempts = 0  # lifetime total, tests/chaos read it
+        # replica-read staleness (cook_tpu/shard/replica.py): when this
+        # follower last PROVED it held the leader's head (applied >= the
+        # feed's last_seq on a successful poll), and when it last made
+        # any successful poll at all.  Replica-served reads bound their
+        # staleness from the first and refuse off the second.
+        self._fresh_at: Optional[float] = None
+        self._last_progress: Optional[float] = None
         self._reconnects = global_registry.counter(
             "replication.reconnects",
             "follower reconnect attempts after leader transport errors")
@@ -170,15 +182,20 @@ class JournalFollower:
             # of a backlog should stream back-to-back
             wait_s = self.long_poll_s if first_fetch else 0.0
             first_fetch = False
+            shard_q = f"&shard={self.shard}" if self.shard is not None \
+                else ""
             resp = self._get(
                 f"{leader}/replication/journal?after_seq={after}"
-                f"&wait_s={wait_s}",
+                f"&wait_s={wait_s}{shard_q}",
                 timeout_s=self.timeout_s + wait_s)
             # a response landing after stop() is the promotion race: we
             # may already be (about to be) the leader, and a reply from a
             # still-alive deposed leader must not clobber our state
             if resp is None or self._stop.is_set():
                 break
+            import time as _time
+
+            self._last_progress = _time.monotonic()
             incarnation = resp.get("incarnation")
             if incarnation and self._leader_incarnation not in (
                     None, incarnation):
@@ -197,6 +214,11 @@ class JournalFollower:
             events = resp.get("events", [])
             if events:
                 applied += self._apply(events)
+            # freshness proof: our applied head covers the feed's head
+            # at the moment the leader answered — staleness_ms() counts
+            # from the newest such proof
+            if self.store.last_seq() >= int(resp.get("last_seq", 0)):
+                self._fresh_at = _time.monotonic()
             if not resp.get("more"):
                 break
         # confirm what we hold: sync-ack commits on the leader block
@@ -215,10 +237,12 @@ class JournalFollower:
                     # the ack could still lose the write the leader just
                     # told its client was replicated
                     self.journal.sync()
-                if self._post(f"{leader}/replication/ack",
-                              {"follower": self.member_id, "seq": seq,
-                               "durable": durable,
-                               "last_txn_id": self.last_txn_id}):
+                ack = {"follower": self.member_id, "seq": seq,
+                       "durable": durable,
+                       "last_txn_id": self.last_txn_id}
+                if self.shard is not None:
+                    ack["shard"] = self.shard
+                if self._post(f"{leader}/replication/ack", ack):
                     self._last_acked = seq
                     # one correlation event per txn: later acks driven by
                     # non-txn events (status updates) must not keep
@@ -264,7 +288,8 @@ class JournalFollower:
         return applied
 
     def _full_resync(self, leader: str) -> bool:
-        state = self._get(f"{leader}/replication/snapshot")
+        shard_q = f"?shard={self.shard}" if self.shard is not None else ""
+        state = self._get(f"{leader}/replication/snapshot{shard_q}")
         if state is None or "seq" not in state or self._stop.is_set():
             return False
         if state.get("incarnation"):
@@ -287,9 +312,52 @@ class JournalFollower:
             if self.journal is not None:
                 self.journal.rotate()
         self.full_resyncs += 1
+        import time as _time
+
+        # the snapshot IS the leader's head as of the fetch
+        now = _time.monotonic()
+        self._fresh_at = now
+        self._last_progress = now
         log.info("replication: full resync from %s at seq %s", leader,
                  state["seq"])
         return True
+
+    # ------------------------------------------------------- staleness
+    # Replica-served reads (cook_tpu/shard/replica.py, rest/api.py):
+    # how stale is the state this follower serves, and is it still
+    # applying at all.
+
+    def staleness_ms(self, now: Optional[float] = None) -> float:
+        """Milliseconds since this follower last PROVED it held the
+        leader's head.  +inf before the first proof (a replica that
+        never synced must not serve 'slightly stale' reads).  Monotone
+        while the follower is behind; resets on catch-up."""
+        import time as _time
+
+        if self._fresh_at is None:
+            return float("inf")
+        now = _time.monotonic() if now is None else now
+        return max(0.0, (now - self._fresh_at) * 1000.0)
+
+    def stalled_s(self, now: Optional[float] = None) -> float:
+        """Seconds since the last successful leader poll — the
+        stopped-applying signal replica reads refuse on."""
+        import time as _time
+
+        if self._last_progress is None:
+            return float("inf")
+        now = _time.monotonic() if now is None else now
+        return max(0.0, now - self._last_progress)
+
+    def staleness_view(self) -> dict[int, dict]:
+        """Per-shard staleness rows ({shard: row}); an unsharded
+        follower is shard 0 of a 1-shard view."""
+        shard = self.shard if self.shard is not None else 0
+        return {shard: {
+            "staleness_ms": self.staleness_ms(),
+            "stalled_s": self.stalled_s(),
+            "applied_seq": self.store.last_seq(),
+        }}
 
     # --------------------------------------------------------------- running
 

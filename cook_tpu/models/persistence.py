@@ -82,10 +82,11 @@ def snapshot(store: JobStore, path: str) -> None:
     os.replace(tmp, path)
 
 
-def load_snapshot(path: str, *, clock=None) -> JobStore:
+def load_snapshot(path: str, *, clock=None, store_factory=None) -> JobStore:
     with open(path) as f:
         state = json.load(f)
-    store = JobStore(clock=clock)
+    store = store_factory() if store_factory is not None \
+        else JobStore(clock=clock)
     _populate(store, state)
     return store
 
@@ -437,7 +438,19 @@ def apply_journal(store: JobStore, events: list[dict],
             quota = codec.dec_quota(entities["quota"])
             store.quotas[(quota.user, quota.pool)] = quota
             decoded["quota"] = quota
-        if kind == "share/retracted":
+        if kind == "job/shard-out":
+            # cross-shard pool move (cook_tpu/shard/): this shard stops
+            # owning the job; the destination shard's own journal carries
+            # the matching upsert
+            gone = store.jobs.pop(data.get("uuid", ""), None)
+            if gone is not None:
+                store.job_seq.pop(gone.uuid, None)
+                store._user_jobs.get(gone.user, set()).discard(gone.uuid)
+                store._pool_pending.get(gone.pool, set()).discard(gone.uuid)
+                store._pool_running.get(gone.pool, set()).discard(gone.uuid)
+            for tid in data.get("instances", ()):
+                store.instances.pop(tid, None)
+        elif kind == "share/retracted":
             store.shares.pop((data["user"], data["pool"]), None)
         elif kind == "quota/retracted":
             store.quotas.pop((data["user"], data["pool"]), None)
@@ -471,10 +484,14 @@ def apply_journal(store: JobStore, events: list[dict],
 
 def recover(data_dir: str, *, clock=None,
             snapshot_name: str = "snapshot.json",
-            journal_name: str = "journal.jsonl") -> Optional[JobStore]:
+            journal_name: str = "journal.jsonl",
+            store_factory=None) -> Optional[JobStore]:
     """Rebuild a store from the last snapshot plus the journal suffix after
     it (the documented failover path).  Returns None when the data dir holds
-    neither a snapshot nor a journal (fresh start).
+    neither a snapshot nor a journal (fresh start).  `store_factory`
+    overrides the bare-JobStore construction — the sharded layout
+    (cook_tpu/shard/journal.py) recovers each segment into a
+    shard-labeled store.
 
     The rotated journal (`journal.jsonl.1`) is replayed too: rotation only
     happens after a successful snapshot, so its entries are normally all
@@ -486,7 +503,8 @@ def recover(data_dir: str, *, clock=None,
     store = None
     snap_seq = 0
     if os.path.exists(snap_path):
-        store = load_snapshot(snap_path, clock=clock)
+        store = load_snapshot(snap_path, clock=clock,
+                              store_factory=store_factory)
         snap_seq = store.last_seq()
     replayed = 0
     for path in (journal_path + ".1", journal_path):
@@ -494,7 +512,8 @@ def recover(data_dir: str, *, clock=None,
         if not entries:
             continue
         if store is None:
-            store = JobStore(clock=clock)
+            store = store_factory() if store_factory is not None \
+                else JobStore(clock=clock)
         replayed += apply_journal(store, entries, after_seq=snap_seq)
     if store is not None:
         store.recovered_stats = {"snapshot_seq": snap_seq,

@@ -658,7 +658,8 @@ class ContentionObservatory:
                      Callable[[], Optional[JournalTelemetry]]] = None,
                  commit_ack: Optional[SloBurnTracker] = None,
                  replication_meta_fn: Optional[Callable[[], dict]] = None,
-                 starvation_fn: Optional[Callable[[], dict]] = None):
+                 starvation_fn: Optional[Callable[[], dict]] = None,
+                 shards_fn: Optional[Callable[[], list]] = None):
         self.store = store
         self.params = params or ContentionParams()
         self.endpoints = endpoints
@@ -676,6 +677,12 @@ class ContentionObservatory:
         self.replication_meta_fn = replication_meta_fn or (lambda: {})
         # pool -> starvation stats (scheduler/monitor.starvation_stats)
         self.starvation_fn = starvation_fn or (lambda: {})
+        # sharded control plane (cook_tpu/shard/): per-shard rows — each
+        # shard's lock profiler, journal-segment telemetry, and commit
+        # service-time window (ShardedTransactionLog.shard_view); None on
+        # single-shard deployments.  rest/api.py wires this after
+        # construction (the txn log is built before the observatory).
+        self.shards_fn = shards_fn
         self._lag_gauge = global_registry.gauge(
             "replication.follower_lag_events",
             "events the follower's last ack trails the leader by")
@@ -698,17 +705,26 @@ class ContentionObservatory:
 
     def replication_view(self) -> list[dict]:
         """Per-follower ack lag, computed leader-side: event delta vs
-        the store head, seconds since the last ack, durable split."""
+        the store head, seconds since the last ack, durable split.  On a
+        sharded store each ack names its shard and lags against THAT
+        shard's head (sequence numbers are per-shard)."""
+        shards = getattr(self.store, "shards", None)
         last_seq = self.store.last_seq()
         now = time.monotonic()
         out = []
         for follower, meta in sorted(self.replication_meta_fn().items()):
-            lag_events = max(0, last_seq - int(meta.get("seq", 0)))
+            shard = int(meta.get("shard", 0))
+            if shards is not None and 0 <= shard < len(shards):
+                head = shards[shard].last_seq()
+            else:
+                head = last_seq
+            lag_events = max(0, head - int(meta.get("seq", 0)))
             ack_age_s = now - meta.get("time", now)
             out.append({
                 "follower": follower,
+                "shard": shard,
                 "acked_seq": int(meta.get("seq", 0)),
-                "leader_seq": last_seq,
+                "leader_seq": head,
                 "lag_events": lag_events,
                 "ack_age_s": ack_age_s,
                 "durable": bool(meta.get("durable", False)),
@@ -726,7 +742,7 @@ class ContentionObservatory:
 
     def snapshot(self) -> dict:
         profiler = self._lock_profiler()
-        return {
+        body = {
             "store_lock": (profiler.snapshot() if profiler is not None
                            else {"profiled": False}),
             "journal": self._journal().snapshot(),
@@ -737,6 +753,12 @@ class ContentionObservatory:
             "starvation": self.starvation_fn(),
             "wall_time": time.time(),
         }
+        if self.shards_fn is not None:
+            # per-shard attribution (cook_tpu/shard/): each shard's lock,
+            # journal segment, and commit service-time window — the
+            # hottest-shard answer tools/loadtest.py scrapes
+            body["shards"] = self.shards_fn()
+        return body
 
     # ------------------------------------------------------------- health
 
@@ -796,6 +818,47 @@ class ContentionObservatory:
                     f"waits on this disk barrier"),
                 "recent_fsync_max_s": stall,
             })
+
+        if self.shards_fn is not None:
+            # per-shard fsync health: a wedged SEGMENT degrades with its
+            # shard id attached, so the chaos wedged-shard drill (and an
+            # operator) can see exactly which shard's keys are affected
+            shard_checks = {}
+            for row in self.shards_fn():
+                shard = row.get("shard")
+                jstats = row.get("journal") or {}
+                stall_s = float(jstats.get("recent_fsync_max_s", 0.0))
+                shard_checks[str(shard)] = {
+                    "recent_fsync_max_s": stall_s,
+                    "degraded": bool(jstats.get("degraded")),
+                    "commit_p99_ms": (row.get("commit_ack") or {}).get(
+                        "p99_ms", 0.0),
+                }
+                if jstats.get("degraded"):
+                    degradations.append({
+                        "reason": JOURNAL_FSYNC_DEGRADED,
+                        "shard": shard,
+                        "detail": (
+                            f"shard {shard}'s journal segment is running "
+                            f"degraded-async after an fsync FAILURE — "
+                            f"only this shard's keys ride the page cache; "
+                            f"see docs/operations.md (diagnosing a hot "
+                            f"shard)"),
+                        "fsync_errors": jstats.get("fsync_errors", 0),
+                    })
+                if stall_s >= p.fsync_stall_s:
+                    degradations.append({
+                        "reason": FSYNC_STALL,
+                        "shard": shard,
+                        "detail": (
+                            f"shard {shard}'s journal segment fsync "
+                            f"stalled {stall_s * 1000:.0f} ms (threshold "
+                            f"{p.fsync_stall_s * 1000:.0f} ms) — commits "
+                            f"ROUTED TO THIS SHARD wait on it; other "
+                            f"shards' segments are unaffected"),
+                        "recent_fsync_max_s": stall_s,
+                    })
+            checks["shards"] = shard_checks
 
         followers = self.replication_view()
         checks["replication"] = {"followers": followers}

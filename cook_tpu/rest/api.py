@@ -116,6 +116,16 @@ class ApiConfig:
     # by default and admin-only when on; never enable in production
     # outside a chaos drill (docs/resilience.md)
     fault_injection: bool = False
+    # replica-served reads (cook_tpu/shard/replica.py): a non-leader
+    # with a journal follower serves heavy read endpoints from its
+    # replayed state, advertising bounded staleness
+    # (X-Cook-Staleness-Ms + staleness_ms in JSON-object bodies).
+    # Above the freshness ceiling the read falls back to the leader
+    # (307); a replica that stopped applying for replica_refuse_after_s
+    # refuses reads (503) instead of serving stale forever.
+    replica_reads: bool = True
+    replica_staleness_ceiling_ms: float = 5000.0
+    replica_refuse_after_s: float = 30.0
 
 
 class CookApi:
@@ -167,9 +177,18 @@ class CookApi:
         # replication_ack_meta only — they must not satisfy min_acks,
         # or "replicated: true" would not mean what it says.
         self.replication_acks: dict[str, int] = {}
-        # follower -> {seq, durable, time(monotonic)} for every ack seen;
-        # liveness pruning keys off `time`
+        # sharded control plane: shard -> follower -> highest durable
+        # acked seq ON THAT SHARD (sequence numbers are per shard).
+        # Unsharded acks land under shard 0, so the same await path
+        # serves both layouts.
+        self.replication_shard_acks: dict[int, dict[str, int]] = {}
+        # (follower, shard) -> {seq, durable, time(monotonic)} for every
+        # ack seen; liveness pruning keys off `time`
         self.replication_ack_meta: dict[str, dict] = {}
+        # replica-served reads (cook_tpu/shard/replica.py): a standby's
+        # wiring (components.py) points this at its journal follower's
+        # staleness_view; None = no replica-read surface on this node
+        self.staleness_fn = None
         # long-poll/sync-ack wakeups: per-waiter events, set from the
         # store's watcher thread via call_soon_threadsafe
         self._repl_waiters: set = set()
@@ -188,6 +207,19 @@ class CookApi:
             replication_meta_fn=lambda: self.replication_ack_meta,
             starvation_fn=self._starvation_view,
         )
+        if hasattr(self.txn, "shard_view"):
+            # sharded pipeline (cook_tpu/shard/ShardedTransactionLog):
+            # per-shard lock/journal/commit attribution rides the same
+            # /debug/contention surface
+            self.contention.shards_fn = (
+                lambda: self.txn.shard_view(self.contention.params))
+        self._replica_refusals = global_registry.counter(
+            "shard.replica_reads_refused",
+            "replica reads refused because the replica stopped applying")
+        self._replica_fallbacks = global_registry.counter(
+            "shard.replica_reads_fallback",
+            "replica reads redirected to the leader over the staleness "
+            "ceiling")
         # overload reaction: heavy reads shed while the SLO burns
         # (cook_tpu/faults/reactions.py; also the scheduler's admission-
         # scaleback signal — components.py wires overload_fn to this)
@@ -223,7 +255,8 @@ class CookApi:
         # measured too (an auth-storm is control-plane load like any
         # other); aiohttp applies middlewares in list order
         app = web.Application(middlewares=[self._endpoint_middleware,
-                                           self._auth_middleware])
+                                           self._auth_middleware,
+                                           self._replica_middleware])
         r = app.router
         for path in ("/rawscheduler", "/jobs"):
             r.add_get(path, self.get_jobs)
@@ -269,6 +302,7 @@ class CookApi:
         r.add_get("/replication/snapshot", self.get_replication_snapshot)
         r.add_post("/replication/ack", self.post_replication_ack)
         r.add_get("/debug", self.get_debug)
+        r.add_get("/debug/replica", self.get_debug_replica)
         r.add_get("/debug/health", self.get_debug_health)
         r.add_get("/debug/contention", self.get_debug_contention)
         r.add_get("/debug/faults", self.get_debug_faults)
@@ -729,6 +763,108 @@ class CookApi:
         self._apply_cors(request, response)
         return response
 
+    # ------------------------------------------------------ replica reads
+    # Heavy read endpoints a non-leader replica serves from its replayed
+    # journal, each response advertising bounded staleness
+    # (cook_tpu/shard/replica.py has the full contract).
+    REPLICA_READ_ROUTES = frozenset((
+        "/jobs", "/jobs/{uuid}", "/rawscheduler", "/list", "/running",
+        "/unscheduled_jobs", "/stats/instances", "/instances",
+        "/instances/{uuid}", "/group", "/usage",
+    ))
+
+    def _replica_evaluation(self) -> Optional[dict]:
+        """The per-shard staleness decision, or None when this node is
+        the leader / has no follower wired."""
+        if self.leader or not self.config.replica_reads \
+                or self.staleness_fn is None:
+            return None
+        from cook_tpu.shard.replica import evaluate_staleness
+
+        return evaluate_staleness(
+            self.staleness_fn(),
+            ceiling_ms=self.config.replica_staleness_ceiling_ms,
+            refuse_after_s=self.config.replica_refuse_after_s)
+
+    @web.middleware
+    async def _replica_middleware(self, request: web.Request, handler):
+        """Replica-read gate + staleness stamping.  Leader (or
+        follower-less) nodes pass straight through.  On a replica:
+        refusal (stopped applying) and leader fallback (over the
+        freshness ceiling) short-circuit heavy reads; served reads —
+        including /debug/* — carry X-Cook-Staleness-Ms (worst shard) and
+        X-Cook-Shard-Staleness (per-shard split), and JSON-object bodies
+        gain a staleness_ms field."""
+        verdict = self._replica_evaluation()
+        if verdict is None or request.method != "GET":
+            return await handler(request)
+        resource = request.match_info.route.resource \
+            if request.match_info.route is not None else None
+        route = resource.canonical if resource is not None else ""
+        gated = route in self.REPLICA_READ_ROUTES
+        if gated and verdict["action"] == "refuse":
+            self._replica_refusals.inc()
+            return _err(503, "replica stopped applying the leader's "
+                             "journal; refusing stale reads "
+                             "(X-Cook-Staleness-Ms unbounded)")
+        if gated and verdict["action"] == "fallback":
+            if self.leader_url:
+                self._replica_fallbacks.inc()
+                raise web.HTTPTemporaryRedirect(
+                    f"{self.leader_url}{request.path_qs}")
+            if verdict["staleness_ms"] == float("inf"):
+                # never-synced AND no leader to redirect to: nothing
+                # safe to serve
+                self._replica_refusals.inc()
+                return _err(503, "replica has not caught up with any "
+                                 "leader yet and no leader is known")
+        response = await handler(request)
+        if gated or route.startswith("/debug"):
+            self._stamp_staleness(response, verdict)
+        return response
+
+    @staticmethod
+    def _stamp_staleness(response, verdict: dict) -> None:
+        worst = verdict["staleness_ms"]
+        worst_txt = "inf" if worst == float("inf") else str(int(worst))
+        response.headers["X-Cook-Staleness-Ms"] = worst_txt
+        response.headers["X-Cook-Shard-Staleness"] = json.dumps({
+            str(shard): ("inf" if ms == float("inf") else int(ms))
+            for shard, ms in verdict["shards"].items()})
+        if response.content_type == "application/json" and response.body:
+            try:
+                payload = json.loads(response.body)
+            except ValueError:
+                return
+            if isinstance(payload, dict):
+                payload["staleness_ms"] = (
+                    None if worst == float("inf") else worst)
+                response.body = json.dumps(payload).encode()
+
+    async def get_debug_replica(self, request: web.Request) -> web.Response:
+        """Replica-read surface: whether this node serves replica reads,
+        the per-shard staleness/stall view, and the decision the gate
+        would take right now (serve / fallback / refuse)."""
+        verdict = self._replica_evaluation()
+        view = self.staleness_fn() if self.staleness_fn is not None else {}
+        def clean(row):
+            return {k: (None if v == float("inf") else v)
+                    for k, v in row.items()}
+        return web.json_response({
+            "leader": self.leader,
+            "replica_reads": self.config.replica_reads,
+            "ceiling_ms": self.config.replica_staleness_ceiling_ms,
+            "refuse_after_s": self.config.replica_refuse_after_s,
+            "shards": {str(s): clean(row)
+                       for s, row in sorted(view.items())},
+            "decision": (None if verdict is None else {
+                "action": verdict["action"],
+                "staleness_ms": (None if verdict["staleness_ms"]
+                                 == float("inf")
+                                 else verdict["staleness_ms"]),
+            }),
+        })
+
     def _auth_exempt(self, request: web.Request) -> bool:
         path = request.path
         if path in ("/debug", "/debug/health"):
@@ -802,7 +938,8 @@ class CookApi:
         outcome = await self._run_commit(op, payload, txn_id)
         outcome.replicated = True
         if self.config.replication_sync_ack and not outcome.duplicate:
-            outcome.replicated = await self._await_replication(outcome.seq)
+            outcome.replicated = await self._await_replication_outcome(
+                outcome)
             if not outcome.replicated:
                 global_registry.counter(
                     "replication_ack_timeouts",
@@ -1368,7 +1505,7 @@ class CookApi:
         if retries is None and increment is None:
             return _err(400, "retries or increment required")
         txn_id = request.headers.get("X-Cook-Txn-Id") or None
-        last_seq = 0
+        last_seqs: dict[int, int] = {}
         duplicates = 0
         for uuid in uuids:
             if uuid not in self.store.jobs:
@@ -1388,18 +1525,35 @@ class CookApi:
                     txn_id=f"{txn_id}:{uuid}" if txn_id else None)
             except (TransactionVetoed, ValueError) as e:
                 return _err(400, str(e))
-            last_seq = max(last_seq, outcome.seq)
+            if not outcome.duplicate:
+                # duplicates met their bound when first acked; merging
+                # their (possibly reconstructed) seqs would make the
+                # batch wait on replication that already happened
+                self._merge_batch_seqs(last_seqs, outcome)
             duplicates += outcome.duplicate
         body_out = {"jobs": uuids}
         if self.config.replication_sync_ack and duplicates < len(uuids):
-            # one replication wait covers the whole batch (acks are
-            # cumulative sequence numbers)
-            if not await self._await_replication(last_seq):
+            # one replication wait per touched shard covers the whole
+            # batch (acks are cumulative sequence numbers per shard)
+            if not await self._await_batch_replication(last_seqs):
                 global_registry.counter(
                     "replication_ack_timeouts",
                     "sync-ack replication bounds missed").inc()
                 body_out["replicated"] = False
         return web.json_response(body_out, status=201)
+
+    @staticmethod
+    def _merge_batch_seqs(last_seqs: dict[int, int],
+                          outcome: TxnOutcome) -> None:
+        for shard, seq in (outcome.shard_seqs or {0: outcome.seq}).items():
+            last_seqs[shard] = max(last_seqs.get(shard, 0), seq)
+
+    async def _await_batch_replication(self,
+                                       last_seqs: dict[int, int]) -> bool:
+        for shard, seq in sorted(last_seqs.items()):
+            if not await self._await_replication(seq, shard):
+                return False
+        return True
 
     # ------------------------------------------------------------- pool move
 
@@ -1421,7 +1575,7 @@ class CookApi:
                 return _err(404, f"unknown job {uuid}")
         txn_id = request.headers.get("X-Cook-Txn-Id") or None
         moved, skipped = [], []
-        last_seq = 0
+        last_seqs: dict[int, int] = {}
         duplicates = 0
         for uuid in uuids:
             outcome = await self._run_commit(
@@ -1429,13 +1583,14 @@ class CookApi:
                 f"{txn_id}:{uuid}" if txn_id else None)
             result = outcome.result or {}
             (moved if result.get("moved") else skipped).append(uuid)
-            last_seq = max(last_seq, outcome.seq)
+            if not outcome.duplicate:
+                self._merge_batch_seqs(last_seqs, outcome)
             duplicates += outcome.duplicate
         body_out = {"moved": moved, "skipped": skipped, "pool": pool}
-        # one replication wait covers the whole batch (acks are
-        # cumulative sequence numbers)
+        # one replication wait per touched shard covers the whole batch
+        # (acks are cumulative sequence numbers per shard)
         if self.config.replication_sync_ack and duplicates < len(uuids):
-            if not await self._await_replication(last_seq):
+            if not await self._await_batch_replication(last_seqs):
                 global_registry.counter(
                     "replication_ack_timeouts",
                     "sync-ack replication bounds missed").inc()
@@ -1856,11 +2011,24 @@ class CookApi:
         finally:
             self._repl_waiters.discard(waiter)
 
-    def _journal_slice(self, after_seq: int):
+    def _replication_store(self, shard: Optional[int]):
+        """The store whose feed a follower asked for: shard i of a
+        sharded store, or the whole (unsharded) store.  None = bad
+        shard index."""
+        shards = getattr(self.store, "shards", None)
+        if shards is None:
+            return self.store if shard in (None, 0) else None
+        if shard is None:
+            shard = 0
+        if not 0 <= shard < len(shards):
+            return None
+        return shards[shard]
+
+    def _journal_slice(self, after_seq: int, store=None):
         """Copy the event batch under the store lock; encode nothing
         there (events are immutable — serialization happens outside so
         standby polls never stall leader writes)."""
-        store = self.store
+        store = store if store is not None else self.store
         with store._lock:
             last_seq = store.last_seq()
             window = store._events
@@ -1895,14 +2063,19 @@ class CookApi:
         try:
             after_seq = int(request.query.get("after_seq", "0"))
             wait_s = float(request.query.get("wait_s", "0"))
+            shard = (int(request.query["shard"])
+                     if "shard" in request.query else None)
         except ValueError:
-            return _err(400, "after_seq/wait_s must be numeric")
+            return _err(400, "after_seq/wait_s/shard must be numeric")
         wait_s = min(wait_s, self.REPLICATION_MAX_WAIT_S)
+        target = self._replication_store(shard)
+        if target is None:
+            return _err(400, f"unknown shard {shard}")
         self._ensure_repl_watcher()
         loop = asyncio.get_running_loop()
         deadline = loop.time() + wait_s
         while True:
-            batch, last_seq, more = self._journal_slice(after_seq)
+            batch, last_seq, more = self._journal_slice(after_seq, target)
             if batch is None:
                 return web.json_response({
                     "snapshot_required": True, "last_seq": last_seq,
@@ -1927,10 +2100,18 @@ class CookApi:
 
         from cook_tpu.models import persistence
 
+        try:
+            shard = (int(request.query["shard"])
+                     if "shard" in request.query else None)
+        except ValueError:
+            return _err(400, "shard must be an integer")
+        target = self._replication_store(shard)
+        if target is None:
+            return _err(400, f"unknown shard {shard}")
         # snapshot_state copies entity references under the store lock and
         # encodes outside it; the executor keeps the encode off the loop
         state = await asyncio.get_running_loop().run_in_executor(
-            None, persistence.snapshot_state, self.store)
+            None, persistence.snapshot_state, target)
         state["incarnation"] = self.incarnation
         return web.json_response(state)
 
@@ -1952,22 +2133,34 @@ class CookApi:
         if not follower:
             return _err(400, "follower required")
         durable = bool(body.get("durable", True))
+        try:
+            # sharded feeds ack per shard (sequence numbers are only
+            # comparable within one shard's history); unsharded acks are
+            # shard 0
+            shard = int(body.get("shard", 0))
+        except (TypeError, ValueError):
+            return _err(400, "shard must be an integer")
         # correlation: the follower reports the txn id of the newest
         # txn/committed event its ack covers, so the ack is attributable
         # to the mutation it makes durable (and the span ring links it)
         last_txn_id = str(body.get("last_txn_id", "") or "")
         import time as _time
 
-        self.replication_ack_meta[follower] = {
+        meta_key = follower if shard == 0 else f"{follower}[s{shard}]"
+        self.replication_ack_meta[meta_key] = {
             "seq": seq, "durable": durable, "time": _time.monotonic(),
-            "last_txn_id": last_txn_id}
+            "last_txn_id": last_txn_id, "shard": shard,
+            "follower": follower}
         global_registry.counter(
             "replication.acks",
             "replication acks received, split durable vs memory-only").inc(
             1, {"durable": str(durable).lower()})
         if durable:
-            prev = self.replication_acks.get(follower, 0)
-            self.replication_acks[follower] = max(prev, seq)
+            acks = self.replication_shard_acks.setdefault(shard, {})
+            acks[follower] = max(acks.get(follower, 0), seq)
+            if shard == 0:
+                prev = self.replication_acks.get(follower, 0)
+                self.replication_acks[follower] = max(prev, seq)
         if last_txn_id:
             from cook_tpu.utils import tracing
 
@@ -1987,15 +2180,20 @@ class CookApi:
         import time as _time
 
         now = _time.monotonic()
-        for follower, meta in list(self.replication_ack_meta.items()):
+        for meta_key, meta in list(self.replication_ack_meta.items()):
             if now - meta["time"] > ttl:
-                del self.replication_ack_meta[follower]
-                self.replication_acks.pop(follower, None)
+                del self.replication_ack_meta[meta_key]
+                follower = meta.get("follower", meta_key)
+                shard = meta.get("shard", 0)
+                self.replication_shard_acks.get(shard, {}).pop(
+                    follower, None)
+                if shard == 0:
+                    self.replication_acks.pop(follower, None)
 
-    async def _await_replication(self, seq: int) -> bool:
+    async def _await_replication(self, seq: int, shard: int = 0) -> bool:
         """Block until >= replication_min_acks LIVE, durable followers
-        confirm `seq`, or the configured timeout lapses.  True =
-        durability bound met."""
+        confirm `seq` ON `shard`, or the configured timeout lapses.
+        True = durability bound met."""
         import asyncio
 
         self._ensure_repl_watcher()
@@ -2004,14 +2202,27 @@ class CookApi:
         need = self.config.replication_min_acks
         while True:
             self._prune_stale_acks()
-            acked = sum(1 for s in self.replication_acks.values()
-                        if s >= seq)
+            acks = self.replication_shard_acks.get(shard, {})
+            if shard == 0 and not acks:
+                acks = self.replication_acks
+            acked = sum(1 for s in acks.values() if s >= seq)
             if acked >= need:
                 return True
             remaining = deadline - loop.time()
             if remaining <= 0:
                 return False
             await self._repl_wait(remaining)
+
+    async def _await_replication_outcome(self, outcome: TxnOutcome) -> bool:
+        """Sync-ack wait for one commit: every shard the transaction
+        touched must meet the durability bound (a cross-shard commit is
+        durable only when BOTH segments are replicated)."""
+        if outcome.shard_seqs:
+            for shard, seq in sorted(outcome.shard_seqs.items()):
+                if not await self._await_replication(seq, shard):
+                    return False
+            return True
+        return await self._await_replication(outcome.seq)
 
 
 def _res_json(res: Resources) -> dict:

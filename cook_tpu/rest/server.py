@@ -74,7 +74,7 @@ class InprocessControlPlane:
 
     def __init__(self, *, data_dir: Optional[str] = None,
                  pools: tuple = ("default",), config=None, clock=None,
-                 journal_kw: Optional[dict] = None):
+                 journal_kw: Optional[dict] = None, shards: int = 1):
         import tempfile
         import time as _time
 
@@ -86,16 +86,33 @@ class InprocessControlPlane:
 
         self._own_dir = data_dir is None
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="cook-cp-")
-        self.store = JobStore(
-            clock=clock or (lambda: int(_time.time() * 1000)))
+        clock = clock or (lambda: int(_time.time() * 1000))
+        self.shards = shards
+        if shards > 1:
+            # sharded control plane (cook_tpu/shard/): N store shards,
+            # N journal segments, the sharded commit pipeline
+            from cook_tpu.shard import (ShardedStore,
+                                        ShardedTransactionLog)
+            from cook_tpu.shard import journal as shard_journal
+
+            self.store = ShardedStore(shards, clock=clock)
+            self.journals = shard_journal.attach_shard_journals(
+                self.store, self.data_dir, **(journal_kw or {}))
+            self.journal = None
+            self.txn = ShardedTransactionLog(self.store,
+                                             journals=self.journals)
+        else:
+            self.store = JobStore(clock=clock)
+            # journal_kw: JournalWriter knobs (fsync_policy,
+            # degraded_retry_s, ...) — the chaos fsync scenarios
+            # exercise both failure policies
+            self.journal = persistence.attach_journal(
+                self.store, f"{self.data_dir}/journal.jsonl",
+                **(journal_kw or {}))
+            self.journals = [self.journal]
+            self.txn = TransactionLog(self.store, journal=self.journal)
         for pool in pools:
             self.store.set_pool(Pool(name=pool))
-        # journal_kw: JournalWriter knobs (fsync_policy, degraded_retry_s,
-        # ...) — the chaos fsync scenarios exercise both failure policies
-        self.journal = persistence.attach_journal(
-            self.store, f"{self.data_dir}/journal.jsonl",
-            **(journal_kw or {}))
-        self.txn = TransactionLog(self.store, journal=self.journal)
         self.api = CookApi(self.store, None, config or ApiConfig(),
                            txn=self.txn)
         self.server = ServerThread(self.api)
@@ -112,6 +129,7 @@ class InprocessControlPlane:
         import shutil
 
         self.server.stop()
-        self.journal.close()
+        for journal in self.journals:
+            journal.close()
         if self._own_dir:
             shutil.rmtree(self.data_dir, ignore_errors=True)

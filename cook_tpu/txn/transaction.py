@@ -49,3 +49,8 @@ class TxnOutcome:
     duplicate: bool = False
     attempts: int = 1
     replicated: Optional[bool] = None
+    # sharded control plane (cook_tpu/shard/): shard id -> the commit's
+    # sequence number ON THAT SHARD.  Sequence numbers are only
+    # comparable within one shard's history, so sync-ack replication
+    # awaits each entry separately.  None on unsharded commits.
+    shard_seqs: Optional[dict[int, int]] = None

@@ -148,6 +148,21 @@ class Settings:
     replication_ack_liveness_s: float = 30.0
     data_dir: str = ""                  # "" = in-memory only
     snapshot_interval_s: float = 300.0
+    # sharded control plane (cook_tpu/shard/): partition the store,
+    # journal, idempotency table, and replication stream into this many
+    # shards (per-pool routing, hashed-user fallback).  1 = the classic
+    # single-store layout, byte-for-byte unchanged.  A data_dir laid out
+    # for the single journal auto-migrates (exactly once, manifest-
+    # stamped) at startup when shards > 1.
+    shards: int = 1
+    # replica-served reads (cook_tpu/shard/replica.py): non-leader nodes
+    # serve heavy read endpoints from their replayed journal with
+    # bounded staleness (X-Cook-Staleness-Ms); above the ceiling the
+    # read falls back to the leader, and a replica that stopped applying
+    # for replica_refuse_after_s refuses reads
+    replica_reads: bool = True
+    replica_staleness_ceiling_ms: float = 5000.0
+    replica_refuse_after_s: float = 30.0
     # pin jax to a platform at process start ("cpu", "tpu", ...); "" =
     # environment default.  Scheduler nodes doing pure control-plane
     # work (tests, standbys on cpu machines) set "cpu" so a wedged or
@@ -271,6 +286,8 @@ def read_config(path: Optional[str] = None,
                 "replication_sync_ack", "replication_min_acks",
                 "replication_ack_timeout_s", "replication_ack_liveness_s",
                 "data_dir", "snapshot_interval_s", "platform",
+                "shards", "replica_reads",
+                "replica_staleness_ceiling_ms", "replica_refuse_after_s",
                 "batched_match", "pipelined_match", "speculation",
                 "speculation_horizon_ms", "predictor_quantile",
                 "predictor_window", "predictor_min_samples",

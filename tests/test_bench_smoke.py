@@ -41,6 +41,7 @@ def test_smoke_emits_structured_record(smoke_record):
     assert on_disk["mode"] == "smoke"
     assert set(on_disk["phases"]) == {"match", "dru", "rebalance",
                                       "elastic_plan", "control_plane",
+                                      "control_plane_sharded",
                                       "match_xl", "match_xl_coarse",
                                       "match_xl_fine", "match_xl_refine",
                                       "speculation", "match_resident",
@@ -64,6 +65,15 @@ def test_smoke_emits_structured_record(smoke_record):
     control = record["phases"]["control_plane"]
     assert control["commit_ack_p99_ms"] >= control["p50_ms"]
     assert control["errors"] == 0 and control["submits"] > 0
+    # the sharded phase (cook_tpu/shard/) records the 4-shard run AND
+    # its concurrency-matched single-shard baseline on the same trace,
+    # so the partitioning comparison is self-contained in the record
+    sharded = record["phases"]["control_plane_sharded"]
+    assert sharded["shards"] == 4
+    assert sharded["errors"] == 0 and sharded["submits"] > 0
+    assert set(sharded["per_shard"]) == {"0", "1", "2", "3"}
+    assert sharded["single_shard"]["achieved_rps"] > 0
+    assert sharded["rps_speedup_vs_single"] > 0
 
 
 def test_smoke_match_holds_packing_parity(smoke_record):

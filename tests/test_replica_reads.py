@@ -1,0 +1,193 @@
+"""Replica-served reads: the bounded-staleness contract (ISSUE 14).
+
+A non-leader replica serves heavy reads off its replayed per-shard
+journals with:
+
+  * `X-Cook-Staleness-Ms` (worst shard) + `X-Cook-Shard-Staleness`
+    (per-shard split) on every replica-served read, and a
+    `staleness_ms` field in JSON-object bodies;
+  * staleness MONOTONE per shard while the replica is behind;
+  * leader fallback (307) above the freshness ceiling;
+  * refusal (503) when the replica stops applying — never served
+    arbitrarily stale forever.
+"""
+import http.client
+import json
+import time
+import urllib.parse
+
+import pytest
+
+from cook_tpu import faults
+from cook_tpu.rest.api import ApiConfig, CookApi
+from cook_tpu.rest.server import InprocessControlPlane, ServerThread
+from cook_tpu.shard import ShardedStore
+from cook_tpu.shard.replica import (ShardedJournalFollower,
+                                    evaluate_staleness)
+
+N_SHARDS = 2
+
+
+def raw_get(url: str, path: str):
+    """(status, headers, body) WITHOUT following redirects."""
+    parsed = urllib.parse.urlparse(url)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                      timeout=10)
+    try:
+        conn.request("GET", path,
+                     headers={"X-Cook-Requesting-User": "admin"})
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, dict(resp.getheaders()), body
+    finally:
+        conn.close()
+
+
+@pytest.fixture
+def rig():
+    leader = InprocessControlPlane(shards=N_SHARDS,
+                                   pools=("pool0", "pool1")).start()
+    store2 = ShardedStore(N_SHARDS)
+    follower = ShardedJournalFollower(
+        store2, leader_url_fn=lambda: leader.url,
+        self_url="http://replica", member_id="replica",
+        poll_s=0.05, timeout_s=2.0, long_poll_s=0.1).start()
+    api2 = CookApi(store2, None, ApiConfig())
+    api2.leader = False
+    api2.leader_url = leader.url
+    api2.staleness_fn = follower.staleness_view
+    replica = ServerThread(api2).start()
+    try:
+        yield leader, replica, api2, follower, store2
+    finally:
+        faults.disarm()
+        follower.stop()
+        replica.stop()
+        leader.stop()
+
+
+def submit(leader, uuid, pool):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{leader.url}/jobs",
+        data=json.dumps({"jobs": [{"uuid": uuid, "command": "true",
+                                   "mem": 64, "cpus": 0.1,
+                                   "pool": pool}]}).encode(),
+        headers={"X-Cook-Requesting-User": "admin",
+                 "Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 201
+
+
+def wait_until(pred, timeout_s=10.0, what="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def shard_staleness(headers) -> dict:
+    return json.loads(headers["X-Cook-Shard-Staleness"])
+
+
+def test_replica_serves_with_staleness_headers_and_field(rig):
+    leader, replica, api2, follower, store2 = rig
+    submit(leader, "r-0", "pool0")
+    submit(leader, "r-1", "pool1")
+    wait_until(lambda: "r-0" in store2.jobs and "r-1" in store2.jobs,
+               what="replica sync")
+    status, headers, body = raw_get(replica.url, "/jobs/r-1")
+    assert status == 200
+    staleness = headers["X-Cook-Staleness-Ms"]
+    assert staleness != "inf" and int(staleness) < 60_000
+    per_shard = shard_staleness(headers)
+    assert set(per_shard) == {"0", "1"}
+    payload = json.loads(body)
+    assert payload["uuid"] == "r-1"
+    assert "staleness_ms" in payload
+    # /debug/* is stamped too (served, never redirected)
+    status, headers, _ = raw_get(replica.url, "/debug/contention")
+    assert status == 200 and "X-Cook-Staleness-Ms" in headers
+    # the leader never stamps staleness: its reads are authoritative
+    status, headers, _ = raw_get(leader.url, "/jobs/r-1")
+    assert status == 200 and "X-Cook-Staleness-Ms" not in headers
+
+
+def test_staleness_is_monotone_per_shard_while_behind(rig):
+    leader, replica, api2, follower, store2 = rig
+    submit(leader, "m-0", "pool0")
+    wait_until(lambda: "m-0" in store2.jobs, what="replica sync")
+    # cut replication: the replica's freshness proof stops refreshing
+    faults.arm(faults.FaultSchedule([faults.FaultRule(
+        point=faults.REPLICATION_FETCH, mode="error")]))
+    submit(leader, "m-1", "pool0")
+    time.sleep(0.2)
+    _, headers_a, _ = raw_get(replica.url, "/jobs/m-0")
+    time.sleep(0.3)
+    _, headers_b, _ = raw_get(replica.url, "/jobs/m-0")
+    a, b = shard_staleness(headers_a), shard_staleness(headers_b)
+    for shard in a:
+        assert b[shard] >= a[shard], (a, b)
+    assert int(headers_b["X-Cook-Staleness-Ms"]) > \
+        int(headers_a["X-Cook-Staleness-Ms"])
+
+
+def test_replica_that_stops_applying_refuses_reads(rig):
+    leader, replica, api2, follower, store2 = rig
+    submit(leader, "s-0", "pool0")
+    wait_until(lambda: "s-0" in store2.jobs, what="replica sync")
+    faults.arm(faults.FaultSchedule([faults.FaultRule(
+        point=faults.REPLICATION_FETCH, mode="error")]))
+    api2.config.replica_refuse_after_s = 0.05
+    time.sleep(0.3)  # several failed polls: stalled_s passes the bound
+    status, _, body = raw_get(replica.url, "/jobs/s-0")
+    assert status == 503
+    assert b"stopped applying" in body
+    # /debug/replica names the decision
+    status, _, body = raw_get(replica.url, "/debug/replica")
+    assert json.loads(body)["decision"]["action"] == "refuse"
+
+
+def test_staleness_over_ceiling_falls_back_to_leader(rig):
+    leader, replica, api2, follower, store2 = rig
+    submit(leader, "f-0", "pool1")
+    wait_until(lambda: "f-0" in store2.jobs, what="replica sync")
+    # ceiling below any possible staleness: every gated read redirects
+    api2.config.replica_staleness_ceiling_ms = -1.0
+    status, headers, _ = raw_get(replica.url, "/jobs/f-0")
+    assert status == 307
+    assert headers["Location"].startswith(leader.url)
+    assert headers["Location"].endswith("/jobs/f-0")
+    # back under the ceiling: served locally again
+    api2.config.replica_staleness_ceiling_ms = 60_000.0
+    status, headers, _ = raw_get(replica.url, "/jobs/f-0")
+    assert status == 200 and "X-Cook-Staleness-Ms" in headers
+
+
+def test_evaluate_staleness_decision_table():
+    fresh = {0: {"staleness_ms": 10.0, "stalled_s": 0.1},
+             1: {"staleness_ms": 40.0, "stalled_s": 0.1}}
+    verdict = evaluate_staleness(fresh, ceiling_ms=100.0,
+                                 refuse_after_s=30.0)
+    assert verdict["action"] == "serve"
+    assert verdict["staleness_ms"] == 40.0
+    over = {**fresh, 1: {"staleness_ms": 500.0, "stalled_s": 0.1}}
+    assert evaluate_staleness(over, ceiling_ms=100.0,
+                              refuse_after_s=30.0)["action"] == "fallback"
+    stalled = {**fresh, 1: {"staleness_ms": 50.0, "stalled_s": 90.0}}
+    assert evaluate_staleness(stalled, ceiling_ms=100.0,
+                              refuse_after_s=30.0)["action"] == "refuse"
+    # never-synced but actively polling (fresh standby catching up a
+    # backlog): fall back to the leader — reads stay available through
+    # restarts; never served locally (staleness is unbounded)
+    catching_up = {0: {"staleness_ms": float("inf"), "stalled_s": 0.1}}
+    assert evaluate_staleness(catching_up, ceiling_ms=1e12,
+                              refuse_after_s=30.0)["action"] == "fallback"
+    # never synced AND not polling either: refuse outright
+    never = {0: {"staleness_ms": float("inf"),
+                 "stalled_s": float("inf")}}
+    assert evaluate_staleness(never, ceiling_ms=1e12,
+                              refuse_after_s=1e12)["action"] == "refuse"

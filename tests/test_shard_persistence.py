@@ -1,0 +1,158 @@
+"""Per-shard journal segments: layout, recovery, migration, replay.
+
+The durability contract per shard: each segment (snapshot + journal
+suffix) reconstructs ITS shard exactly, cross-shard moves replay from
+the two segments independently, and the single-journal -> sharded
+migration is exactly-once and lossless.
+"""
+import os
+
+import pytest
+
+from cook_tpu.models import persistence
+from cook_tpu.models.entities import InstanceStatus, Job, Pool, Resources
+from cook_tpu.models.store import JobStore
+from cook_tpu.shard import ShardedStore, ShardedTransactionLog, ShardRouter
+from cook_tpu.shard import journal as shard_journal
+
+
+def job(uuid, pool, user="u0"):
+    return Job(uuid=uuid, user=user, pool=pool, command="true",
+               resources=Resources(mem=64, cpus=1))
+
+
+def build_plane(tmp_path, n_shards=4):
+    store = ShardedStore(n_shards)
+    pools = store.router.pools_for_distinct_shards()
+    journals = shard_journal.attach_shard_journals(store, str(tmp_path))
+    for name in pools:
+        store.set_pool(Pool(name=name))
+    txn = ShardedTransactionLog(store, journals=journals)
+    return store, txn, journals, pools
+
+
+def test_sharded_recovery_replays_each_segment(tmp_path):
+    store, txn, journals, pools = build_plane(tmp_path)
+    for i in range(8):
+        txn.commit("jobs/submit", {"jobs": [job(f"d{i}", pools[i % 4])]})
+    store.create_instance("d0", "t0", hostname="h0")
+    store.update_instance_state("t0", InstanceStatus.SUCCESS)
+    for journal in journals:
+        journal.close()
+    recovered = shard_journal.recover_sharded(str(tmp_path), 4)
+    assert recovered is not None
+    assert len(recovered.jobs) == 8
+    assert recovered.jobs["d3"].pool == pools[3]
+    assert recovered.job_instances("d0")[0].status is \
+        InstanceStatus.SUCCESS
+    # per-shard sequence numbering survives (replication resumes from
+    # each shard's own head)
+    assert recovered.last_seqs() == store.last_seqs()
+    # idempotency tables recovered per shard: a replayed commit dedupes
+    outcome = ShardedTransactionLog(recovered).commit(
+        "jobs/submit", {"jobs": [job("d0", pools[0])]},
+        txn_id=next(iter(store.shards[0].txn_results)))
+    assert outcome.duplicate
+
+
+def test_cross_shard_move_survives_per_segment_replay(tmp_path):
+    store, txn, journals, pools = build_plane(tmp_path)
+    txn.commit("jobs/submit", {"jobs": [job("mv", pools[0])]})
+    txn.commit("job/pool-move", {"uuid": "mv", "pool": pools[3]})
+    for journal in journals:
+        journal.close()
+    recovered = shard_journal.recover_sharded(str(tmp_path), 4)
+    router = recovered.router
+    src = recovered.shards[router.shard_for_pool(pools[0])]
+    dst = recovered.shards[router.shard_for_pool(pools[3])]
+    # the source segment's shard-out replayed (no duplicate ownership)
+    assert "mv" not in src.jobs
+    assert dst.jobs["mv"].pool == pools[3]
+    assert len(recovered.jobs) == 1
+
+
+def test_snapshot_sharded_plus_suffix(tmp_path):
+    store, txn, journals, pools = build_plane(tmp_path)
+    txn.commit("jobs/submit", {"jobs": [job("pre", pools[1])]})
+    shard_journal.snapshot_sharded(store, str(tmp_path))
+    for journal in journals:
+        journal.rotate()
+    txn.commit("jobs/submit", {"jobs": [job("post", pools[1])]})
+    for journal in journals:
+        journal.close()
+    recovered = shard_journal.recover_sharded(str(tmp_path), 4)
+    assert set(recovered.jobs.keys()) == {"pre", "post"}
+
+
+def test_recover_uses_on_disk_shard_count(tmp_path):
+    store, txn, journals, pools = build_plane(tmp_path, n_shards=4)
+    txn.commit("jobs/submit", {"jobs": [job("a", pools[0])]})
+    for journal in journals:
+        journal.close()
+    # a misconfigured node asking for 8 shards still recovers the
+    # 4-shard layout (resharding is a migration, not a config edit)
+    recovered = shard_journal.recover_sharded(str(tmp_path), 8)
+    assert recovered.n_shards == 4
+    assert "a" in recovered.jobs
+
+
+# ---------------------------------------------------------------- migration
+
+
+def make_single_layout(tmp_path, n_jobs=10):
+    store = JobStore()
+    journal = persistence.attach_journal(
+        store, os.path.join(str(tmp_path), "journal.jsonl"))
+    pools = ShardRouter(4).pools_for_distinct_shards()
+    for name in pools:
+        store.set_pool(Pool(name=name))
+    store.submit_jobs([job(f"m{i:02d}", pools[i % 4])
+                       for i in range(n_jobs)])
+    store.create_instance("m00", "mt0", hostname="h0")
+    store.note_txn("txn-old", "jobs/submit", {"jobs": ["m00"]})
+    journal.close()
+    return store, pools
+
+
+def test_migration_round_trip_and_idempotence(tmp_path):
+    source, pools = make_single_layout(tmp_path)
+    first = shard_journal.migrate_single_journal(str(tmp_path), 4)
+    assert first["migrated"] and first["jobs"] == 10
+    assert sum(first["per_shard_jobs"]) == 10
+    # exactly-once: the manifest marks the dir sharded
+    again = shard_journal.migrate_single_journal(str(tmp_path), 4)
+    assert not again["migrated"]
+    assert again["reason"] == "already-sharded"
+    # originals renamed, never replayed by an unsharded recover
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "journal.jsonl.premigrate"))
+    assert persistence.recover(str(tmp_path)) is None
+    recovered = shard_journal.recover_sharded(str(tmp_path), 4)
+    assert set(recovered.jobs.keys()) == set(source.jobs.keys())
+    assert recovered.jobs["m03"].pool == source.jobs["m03"].pool
+    assert recovered.job_instances("m00")[0].task_id == "mt0"
+    assert set(recovered.pools) == set(pools)
+    # submission-order tie-break survives per shard
+    shard = recovered.shard_of_job("m00")
+    same_shard = sorted((u for u in source.jobs
+                         if shard.jobs.get(u) is not None),
+                        key=lambda u: source.job_seq[u])
+    assert sorted(shard.job_seq, key=lambda u: shard.job_seq[u]) == \
+        same_shard
+    # the idempotency table migrated to every shard
+    txn = ShardedTransactionLog(recovered)
+    assert txn.commit("jobs/submit", {"jobs": [job("m00", pools[0])]},
+                      txn_id="txn-old").duplicate
+
+
+def test_migration_of_fresh_dir_stamps_manifest(tmp_path):
+    outcome = shard_journal.migrate_single_journal(str(tmp_path), 4)
+    assert outcome["reason"] == "fresh"
+    manifest = shard_journal.read_manifest(str(tmp_path))
+    assert manifest["shards"] == 4
+    assert not shard_journal.has_single_journal_layout(str(tmp_path))
+
+
+def test_migration_rejects_single_shard_target(tmp_path):
+    with pytest.raises(ValueError):
+        shard_journal.migrate_single_journal(str(tmp_path), 1)

@@ -553,6 +553,116 @@ def scenario_failover_fsync() -> list:
         shutil.rmtree(follower_dir, ignore_errors=True)
 
 
+def scenario_wedged_shard() -> list:
+    """journal.fsync delay on ONE shard's segment -> only that shard's
+    keys degrade (slow-path commits), other shards' commit-ack p99 stays
+    within SLO, health names the wedged shard -> a leader failover
+    mid-drill (recover from the per-shard segments) loses no acked txn
+    -> fault clears -> the wedged shard serves at full speed again."""
+    import statistics as _stats
+
+    from cook_tpu import faults
+    from cook_tpu.obs.contention import ContentionParams
+    from cook_tpu.rest.api import ApiConfig
+    from cook_tpu.rest.server import InprocessControlPlane
+    from cook_tpu.shard import ShardRouter
+    from cook_tpu.shard import journal as shard_journal
+
+    steps = []
+    n_shards = 4
+    router = ShardRouter(n_shards)
+    pools = router.pools_for_distinct_shards()
+    params = ContentionParams(
+        fsync_stall_s=0.25, lock_min_acquisitions=1_000_000_000)
+    cp = InprocessControlPlane(
+        shards=n_shards, pools=tuple(pools),
+        config=ApiConfig(contention=params)).start()
+    wedged = 2
+    wedged_pool = pools[wedged]
+    delay_s = 0.3
+    slo_ms = 150.0
+    acked: list = []
+
+    def submit_timed(pool: str, uuid: str) -> float:
+        t0 = time.perf_counter()
+        status, _ = _post(f"{cp.url}/jobs", {"jobs": [{
+            "uuid": uuid, "command": "true", "mem": 64, "cpus": 0.1,
+            "pool": pool}]})
+        _check(status == 201, f"submit {uuid} -> {status}")
+        acked.append(uuid)
+        return (time.perf_counter() - t0) * 1000
+
+    try:
+        faults.arm(faults.FaultSchedule([faults.FaultRule(
+            point=faults.JOURNAL_FSYNC, mode="delay", delay_s=delay_s,
+            match={"path": cp.journals[wedged].path})]))
+        walls: dict[str, list] = {p: [] for p in pools}
+        for i in range(6):
+            for p in pools:
+                walls[p].append(submit_timed(p, f"wedge-{p}-{i:02d}"))
+        wedged_p99 = max(walls[wedged_pool])
+        other_p99 = max(max(walls[p]) for p in pools
+                        if p != wedged_pool)
+        _check(wedged_p99 >= delay_s * 1000 * 0.8,
+               f"wedged shard commits were not slowed "
+               f"({wedged_p99:.0f} ms)")
+        _check(other_p99 < slo_ms,
+               f"healthy shards degraded too: worst p99 "
+               f"{other_p99:.0f} ms (SLO {slo_ms:.0f} ms)")
+        healthy_p50 = _stats.median(
+            w for p in pools if p != wedged_pool for w in walls[p])
+        steps.append(
+            f"shard {wedged} wedged ({delay_s * 1000:.0f} ms fsync "
+            f"delay): its commits take {wedged_p99:.0f} ms while other "
+            f"shards stay at p50 {healthy_p50:.1f} ms / worst "
+            f"{other_p99:.0f} ms — blast radius is ONE shard")
+
+        _, _, health = _get(f"{cp.url}/debug/health")
+        stalls = [d for d in health.get("degradations", [])
+                  if d.get("reason") == "fsync-stall"]
+        _check(any(d.get("shard") == wedged for d in stalls),
+               f"health does not attribute the stall to shard "
+               f"{wedged}: {stalls}")
+        _check(all(d.get("shard") in (None, wedged) for d in stalls),
+               f"healthy shards flagged too: {stalls}")
+        steps.append(f"health: fsync-stall names shard {wedged} (and "
+                     f"only it)")
+
+        # leader failover MID-DRILL: a promoted process recovers from
+        # the per-shard segments — every acked txn must be there
+        recovered = shard_journal.recover_sharded(cp.data_dir, n_shards)
+        _check(recovered is not None, "nothing recoverable on disk")
+        missing = [u for u in acked if u not in recovered.jobs]
+        _check(not missing,
+               f"acked txns lost across mid-drill failover: {missing}")
+        steps.append(f"failover mid-drill: recovery from the segment "
+                     f"layout holds all {len(acked)} acked jobs")
+
+        faults.disarm()
+        # roll the wedged segment's recent-fsync window (64 entries)
+        # with clean commits, then health must clear
+        for i in range(70):
+            submit_timed(wedged_pool, f"wedge-post-{i:03d}")
+
+        def cleared():
+            _, _, h = _get(f"{cp.url}/debug/health")
+            return "fsync-stall" not in h.get("reasons", [])
+        _wait_until(cleared, timeout_s=20.0, what="fsync-stall to clear")
+        fast = submit_timed(wedged_pool, "wedge-final")
+        _check(fast < slo_ms,
+               f"wedged shard still slow after recovery ({fast:.0f} ms)")
+        for uuid in acked:
+            status, _, _ = _get(f"{cp.url}/jobs/{uuid}")
+            _check(status == 200, f"acked job {uuid} lost ({status})")
+        steps.append(f"recovery: shard {wedged} back to "
+                     f"{fast:.1f} ms commits, health ok, all "
+                     f"{len(acked)} acked jobs present")
+        return steps
+    finally:
+        faults.disarm()
+        cp.stop()
+
+
 SCENARIOS = {
     "fsync-stall-sheds": scenario_fsync_stall_sheds,
     "launch-breaker": scenario_launch_breaker,
@@ -560,10 +670,13 @@ SCENARIOS = {
     "fsync-degrade": scenario_fsync_degrade,
     "replication-lag": scenario_replication_lag,
     "failover-fsync": scenario_failover_fsync,
+    "wedged-shard": scenario_wedged_shard,
 }
 
-# the fast trio ci_checks runs on every build
-SMOKE = ("fsync-stall-sheds", "launch-breaker", "device-fallback")
+# the fast set ci_checks runs on every build (the original trio plus
+# the sharded control plane's blast-radius drill)
+SMOKE = ("fsync-stall-sheds", "launch-breaker", "device-fallback",
+         "wedged-shard")
 
 
 def run_scenario(name: str) -> ScenarioResult:

@@ -22,7 +22,13 @@ wrapper runs them as one pipeline with one verdict:
      AND the `control_plane` phase — the loadtest (`tools/loadtest.py`,
      serial closed-loop so the gated p50 is commit SERVICE time, not
      same-process queueing jitter) against an in-process control plane,
-     so commit-ack p50/p99 is measured every CI run (writes
+     so commit-ack p50/p99 is measured every CI run,
+     AND the `control_plane_sharded` phase — the same seeded trace
+     against a 4-shard partitioned plane (cook_tpu/shard/) at
+     concurrency, with a concurrency-matched single-shard baseline
+     recorded alongside (`single_shard` / `rps_speedup_vs_single`) so
+     the sharded-vs-single comparison is measured every run; the gate
+     enforces the sharded run's commit-ack p50 round over round (writes
      BENCH_rsmoke.json, rotating the previous record to
      BENCH_rsmoke_prev.json so step 3 has a pair to diff);
   3. `tools/bench_gate.py`     — phase-by-phase regression gate over
@@ -30,8 +36,9 @@ wrapper runs them as one pipeline with one verdict:
      match_xl phases included), refusing pairs whose resolved JAX
      backend differs (a CPU-fallback record never gates an
      accelerator record);
-  4. `tools/chaos.py --smoke`  — the fast chaos trio (fsync stall ->
-     shed, launch failures -> breaker, device error -> CPU fallback):
+  4. `tools/chaos.py --smoke`  — the fast chaos set (fsync stall ->
+     shed, launch failures -> breaker, device error -> CPU fallback,
+     wedged shard -> single-shard blast radius + mid-drill failover):
      each scenario injects its fault, observes the /debug/health reason
      AND the automatic reaction, then asserts full recovery invariants
      (docs/resilience.md);

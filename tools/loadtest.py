@@ -107,9 +107,17 @@ def _execute_op(session_factory, url, op, uuids, recorder):
         if op.kind == "submit":
             spec = dict(op.spec)
             spec["uuid"] = uuids[op.index]
+            if op.pool:
+                spec["pool"] = op.pool
             r = session.post(f"{url}/jobs", json={"jobs": [spec]},
                              headers=headers, timeout=30)
             status = r.status_code
+            if op.pool:
+                # per-pool split (a per-SHARD split when the pools were
+                # drawn from ShardRouter.pools_for_distinct_shards):
+                # skew and wedged-shard isolation show in one run
+                recorder.note(f"submit@{op.pool}",
+                              (time.perf_counter() - t0) * 1000, status)
         elif op.kind == "query":
             r = session.get(f"{url}/jobs", params={"uuid": uuids[op.ref]},
                             headers=headers, timeout=30)
@@ -145,7 +153,8 @@ def _thread_sessions():
 def run_loadtest(url: str, *, rps: float = 50.0, duration_s: float = 5.0,
                  mode: str = "open", workers: int = 32,
                  mix: tuple = (0.7, 0.2, 0.1), n_users: int = 8,
-                 seed: int = 0, pool=None, admin_user: str = "admin",
+                 seed: int = 0, pool=None, pools=None,
+                 admin_user: str = "admin",
                  warmup: int = 0, log=lambda *a: None) -> dict:
     """Drive the trace against a live server; return the report dict.
     `warmup` serial submits are issued first and NOT recorded — they pay
@@ -181,7 +190,8 @@ def run_loadtest(url: str, *, rps: float = 50.0, duration_s: float = 5.0,
         if op.kind == "submit"}
 
     class _Op:
-        __slots__ = ("index", "offset_s", "kind", "user", "spec", "ref")
+        __slots__ = ("index", "offset_s", "kind", "user", "spec", "ref",
+                     "pool")
 
         def __init__(self, index, src):
             self.index = index
@@ -190,8 +200,18 @@ def run_loadtest(url: str, *, rps: float = 50.0, duration_s: float = 5.0,
             self.user = src.user
             self.spec = src.spec
             self.ref = src.ref
+            self.pool = None
 
     run_ops = [_Op(i, op) for i, op in enumerate(ops)]
+    if pools:
+        # spread submits round-robin over the pool list (with pools
+        # drawn per shard, this is the per-shard traffic split the
+        # sharded control plane is judged on)
+        submit_i = 0
+        for op in run_ops:
+            if op.kind == "submit":
+                op.pool = pools[submit_i % len(pools)]
+                submit_i += 1
     for op in run_ops:
         if op.kind == "kill":
             # only the owner (or an admin) may kill: issue the kill as
@@ -215,8 +235,10 @@ def run_loadtest(url: str, *, rps: float = 50.0, duration_s: float = 5.0,
     wall_s = time.perf_counter() - start
     kinds = recorder.kind_summary()
     submit = kinds.get("submit", {})
+    # "submit@pool" rows are the per-pool SPLIT of the "submit" row,
+    # not extra traffic — exclude them from the volume totals
     total = sum(k["count"] + k["errors"] + k["rejected_4xx"]
-                for k in kinds.values())
+                for name, k in kinds.items() if "@" not in name)
     report = {
         "mode": mode,
         "target_rps": rps,
@@ -226,7 +248,8 @@ def run_loadtest(url: str, *, rps: float = 50.0, duration_s: float = 5.0,
         "commit_ack": {"p50_ms": submit.get("p50_ms"),
                        "p99_ms": submit.get("p99_ms"),
                        "count": submit.get("count", 0)},
-        "errors": sum(k["errors"] for k in kinds.values()),
+        "errors": sum(k["errors"] for name, k in kinds.items()
+                      if "@" not in name),
     }
     # close with the server's own attribution: where the run's write-
     # path time went (store lock / fsync / replication / per-endpoint)
@@ -241,16 +264,59 @@ def run_loadtest(url: str, *, rps: float = 50.0, duration_s: float = 5.0,
     except Exception as e:  # noqa: BLE001 — attribution is best-effort;
         # the latency numbers stand on their own
         log(f"loadtest: /debug/contention scrape failed: {e}")
+    shard_summary = per_shard_summary(report.get("contention"))
+    if shard_summary is not None:
+        report["per_shard"] = shard_summary
     return report
 
 
-def run_inprocess(**kw) -> dict:
+def per_shard_summary(contention) -> "dict | None":
+    """Per-shard commit-ack breakdown from a /debug/contention scrape
+    (the sharded control plane's `shards` section): p50/p99 commit
+    service time, commits, lock contention — and the hottest-shard
+    attribution, so skew is visible in one loadtest run."""
+    if not contention or "shards" not in contention:
+        return None
+    rows = {}
+    hottest, hottest_p99 = None, -1.0
+    for row in contention["shards"]:
+        shard = row.get("shard")
+        ack = row.get("commit_ack") or {}
+        lock = row.get("lock") or {}
+        p99 = float(ack.get("p99_ms") or 0.0)
+        rows[str(shard)] = {
+            "commit_p50_ms": ack.get("p50_ms"),
+            "commit_p99_ms": ack.get("p99_ms"),
+            "commits": ack.get("slow_samples", 0),
+            "jobs": row.get("jobs", 0),
+            "lock_contention_ratio": lock.get("contention_ratio", 0.0),
+        }
+        if p99 > hottest_p99:
+            hottest, hottest_p99 = shard, p99
+    return {"shards": rows, "hottest_shard": hottest,
+            "hottest_commit_p99_ms": hottest_p99}
+
+
+def run_inprocess(shards: int = 1, **kw) -> dict:
     """Smoke form: spin an InprocessControlPlane (real store lock, real
     journal fsyncs, real REST stack — no scheduler/device) and drive it.
-    What bench.py's `control_plane` phase wraps."""
+    What bench.py's `control_plane` (shards=1) and `control_plane_sharded`
+    phases wrap.  shards > 1 builds the sharded plane and spreads the
+    submit traffic over one pool per shard, so the summary's per-shard
+    breakdown covers every shard."""
     from cook_tpu.rest.server import InprocessControlPlane
 
-    plane = InprocessControlPlane().start()
+    if shards > 1:
+        from cook_tpu.shard import ShardRouter
+
+        pools = ShardRouter(shards).pools_for_distinct_shards()
+        # "default" stays for warmup traffic; the trace rides the
+        # per-shard pools
+        plane = InprocessControlPlane(
+            shards=shards, pools=("default", *pools)).start()
+        kw.setdefault("pools", pools)
+    else:
+        plane = InprocessControlPlane().start()
     try:
         return run_loadtest(plane.url, **kw)
     finally:
@@ -276,6 +342,11 @@ def main(argv=None) -> int:
                         help="submit:query:kill fractions")
     parser.add_argument("--smoke", action="store_true",
                         help="tiny in-process run (rps 40, 2 s)")
+    parser.add_argument("--shards", type=int, default=1,
+                        help="with --smoke: drive a SHARDED in-process "
+                             "control plane (one traffic pool per "
+                             "shard; per-shard breakdown in the "
+                             "summary)")
     parser.add_argument("--out", default="",
                         help="write the JSON report here too")
     args = parser.parse_args(argv)
@@ -287,13 +358,15 @@ def main(argv=None) -> int:
               log=lambda *a: print(*a, file=sys.stderr))
     if args.smoke:
         kw.update(rps=min(args.rps, 40.0), duration_s=min(args.duration, 2.0))
-        report = run_inprocess(**kw)
+        report = run_inprocess(shards=args.shards, **kw)
     elif args.url:
         report = run_loadtest(args.url, **kw)
     else:
         parser.error("--url required (or --smoke for in-process)")
     summary = {k: report[k] for k in ("mode", "target_rps", "achieved_rps",
                                       "duration_s", "commit_ack", "errors")}
+    if "per_shard" in report:
+        summary["per_shard"] = report["per_shard"]
     print(json.dumps(summary))
     if args.out:
         with open(args.out, "w") as f:
