@@ -354,6 +354,122 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: Sequence[float], width: int = 60) -> str:
+    """Render a value series as a unicode sparkline, downsampled to
+    `width` columns (mean per column) — the terminal form of "what did
+    this gauge look like for the last N minutes"."""
+    values = [v for v in values if v is not None]
+    if not values:
+        return ""
+    if len(values) > width:
+        # mean-pool into `width` columns so a long window still fits
+        chunk = len(values) / width
+        values = [
+            sum(col) / len(col) for col in (
+                values[int(i * chunk):max(int(i * chunk) + 1,
+                                          int((i + 1) * chunk))]
+                for i in range(width))]
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(values)
+    return "".join(
+        _SPARK_BLOCKS[min(len(_SPARK_BLOCKS) - 1,
+                          int((v - lo) / span * len(_SPARK_BLOCKS)))]
+        for v in values)
+
+
+def _fmt_value(v: Optional[float]) -> str:
+    if v is None:
+        return "?"
+    if v == int(v) and abs(v) < 1e9:
+        return str(int(v))
+    return f"{v:.4g}"
+
+
+def cmd_history(args) -> int:
+    """Render metric history (GET /debug/history) as sparklines."""
+    (cluster, client) = _clients(args)[0]
+    body = client.history(args.metric, since=-abs(args.window),
+                          step=args.step)
+    if args.json:
+        print(json.dumps(body, indent=2))
+        return 0
+    if not args.metric:
+        for key, info in sorted(body.get("series", {}).items()):
+            print(f"{info['points']:>6}  {key}")
+        return 0
+    series = body.get("series", {})
+    if not any(series.values()):
+        print(f"{args.metric}: no points in the last {args.window:.0f}s "
+              f"on {cluster.name} (is the history sampler running?)",
+              file=sys.stderr)
+        return 1
+    for key in sorted(series):
+        points = series[key]
+        if not points:
+            continue
+        if args.step == "raw":
+            values = [v for _, v in points]
+        else:
+            values = [p["mean"] for p in points]
+        print(f"{key}  [{args.step}] "
+              f"last={_fmt_value(values[-1])} "
+              f"min={_fmt_value(min(values))} "
+              f"max={_fmt_value(max(values))} n={len(points)}")
+        print(f"  {sparkline(values)}")
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """Render the merged fleet verdict (GET /debug/fleet)."""
+    rc = 0
+    for cluster, client in _clients(args):
+        fleet = client.fleet()
+        if args.json:
+            print(json.dumps({"cluster": cluster.name, **fleet}, indent=2))
+            continue
+        if not fleet.get("enabled"):
+            print(f"{cluster.name}: fleet observatory disabled "
+                  f"({fleet.get('detail', 'no peers configured')})")
+            continue
+        status = fleet.get("status", "?")
+        reasons = ", ".join(fleet.get("reasons", [])) or "-"
+        print(f"{cluster.name}: {status}  ({fleet.get('peers', 0)} peers, "
+              f"reasons: {reasons})")
+        for node in fleet.get("nodes", []):
+            mark = "*" if node.get("self") else " "
+            stale = node.get("staleness") or {}
+            worst = max((ms for ms in stale.values() if ms is not None),
+                        default=None)
+            head = node.get("headline") or {}
+            line = (f" {mark} {node.get('url', '?'):40s} "
+                    f"{node.get('status', '?'):12s} "
+                    f"poll-age {node.get('poll_age_s', 0):5.1f}s")
+            if worst is not None:
+                line += f"  staleness {worst:.0f}ms"
+            if node.get("reasons"):
+                line += f"  [{', '.join(node['reasons'])}]"
+            if node.get("error"):
+                line += f"  ({node['error']})"
+            if head:
+                line += "  " + " ".join(
+                    f"{k.split('.')[-1]}={_fmt_value(v)}"
+                    for k, v in sorted(head.items()))
+            print(line)
+        worst_shard = fleet.get("worst_shard")
+        if worst_shard:
+            print(f"  worst shard: {worst_shard['node']} "
+                  f"shard {worst_shard['shard']} "
+                  f"({worst_shard['staleness_ms']:.0f}ms behind)")
+        if status != "ok":
+            rc = 1
+    return rc
+
+
 def cmd_usage(args) -> int:
     for cluster, client in _clients(args):
         usage = client.usage(args.lookup_user)
@@ -512,6 +628,25 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("uuid")
     q.add_argument("--json", action="store_true")
     q.set_defaults(fn=cmd_timeline)
+
+    q = sub.add_parser(
+        "history",
+        help="render a metric's retained history as a sparkline "
+             "(GET /debug/history); no metric = list tracked series")
+    q.add_argument("metric", nargs="?", default="",
+                   help="series key, base name, or trailing-* prefix")
+    q.add_argument("--step", choices=("raw", "1m", "10m"), default="raw")
+    q.add_argument("--window", type=float, default=3600.0,
+                   help="seconds of history to render (default 1h)")
+    q.add_argument("--json", action="store_true")
+    q.set_defaults(fn=cmd_history)
+
+    q = sub.add_parser(
+        "fleet",
+        help="render the leader's merged fleet verdict (GET /debug/fleet):"
+             " one row per node with peer health/staleness")
+    q.add_argument("--json", action="store_true")
+    q.set_defaults(fn=cmd_fleet)
 
     q = sub.add_parser("config", help="show or edit the federation config")
     q.add_argument("--add-cluster", nargs=2, metavar=("NAME", "URL"))

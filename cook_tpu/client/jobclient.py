@@ -146,6 +146,25 @@ class JobClient:
         lifecycle with per-cycle skip/wait attribution."""
         return self._request("GET", f"/jobs/{uuid}/timeline").json()
 
+    def history(self, metric: str = "", *, since: float = 0.0,
+                step: str = "raw") -> dict:
+        """GET /debug/history: multi-resolution metrics history — the
+        series index when `metric` is empty, else the selected series'
+        points at the requested resolution (docs/observability.md)."""
+        params: dict = {}
+        if metric:
+            params["metric"] = metric
+        if since:
+            params["since"] = since
+        if step != "raw":
+            params["step"] = step
+        return self._request("GET", "/debug/history", params=params).json()
+
+    def fleet(self) -> dict:
+        """GET /debug/fleet: the leader's merged fleet verdict (one row
+        per node, peer staleness, federation reasons)."""
+        return self._request("GET", "/debug/fleet").json()
+
     def unscheduled_reasons(self, uuid: str) -> list[dict]:
         resp = self._request("GET", "/unscheduled_jobs",
                              params={"job": uuid})

@@ -181,6 +181,11 @@ class CookProcess:
     # None); unsharded: [journal]
     journals: list = field(default_factory=list)
     follower: object = None  # standby-side journal replication
+    # durable multi-resolution metrics history (obs/tsdb.py): sampler
+    # runs on EVERY node role — a standby's history is the evidence a
+    # post-failover investigation reads
+    history: object = None
+    fleet: object = None     # leader-side fleet observatory (obs/fleet.py)
 
     def is_leader(self) -> bool:
         return self.selector is not None and self.selector.is_leader
@@ -323,6 +328,19 @@ def build_process(
         plugins=plugins,
         txn=txn,
     )
+    # metrics history: durable under data_dir/metrics when persistence
+    # is configured, memory-only rings otherwise; the sampler thread
+    # runs on every node role (a standby's history survives into the
+    # post-failover investigation).  history_sample_s <= 0 leaves the
+    # instance queryable but unsampled.
+    from cook_tpu.obs.tsdb import HistoryConfig, MetricsHistory
+
+    history = MetricsHistory(
+        dir=(os.path.join(settings.data_dir, "metrics")
+             if settings.data_dir else None),
+        config=HistoryConfig.from_retention(settings.history_sample_s,
+                                            settings.history_retention),
+    ).start()
     from cook_tpu.rest.auth import authenticator_from_config
     api = CookApi(store, scheduler, ApiConfig(
         default_pool=settings.default_pool,
@@ -341,7 +359,7 @@ def build_process(
         replica_reads=settings.replica_reads,
         replica_staleness_ceiling_ms=settings.replica_staleness_ceiling_ms,
         replica_refuse_after_s=settings.replica_refuse_after_s,
-    ), plugins=plugins, txn=txn)
+    ), plugins=plugins, txn=txn, history=history)
     # close the overload loop (docs/resilience.md reaction (d)): the
     # contention observatory's shed signal also drives the scheduler's
     # considerable-window scaleback.  One flag governs BOTH halves of
@@ -353,7 +371,7 @@ def build_process(
     api.queue_limits.limits.per_user_per_pool = settings.queue_limit_per_user
     process = CookProcess(settings=settings, store=store, clusters=clusters,
                           scheduler=scheduler, api=api, journal=journal,
-                          journals=journals,
+                          journals=journals, history=history,
                           member_id=str(uuid_mod.uuid4())[:8])
     if start_rest:
         process.server = ServerThread(api, port=settings.port).start()
@@ -454,6 +472,32 @@ def start_leader_duties(process: CookProcess,
     process.api.staleness_fn = None
     log_info("leadership acquired", component="leader",
              member=process.member_id)
+    if settings.fleet_poll_s > 0:
+        # fleet observatory (obs/fleet.py), a LEADER duty: poll every
+        # known peer — the configured Settings.peers list plus every
+        # standby that registered itself (with its URL) through the
+        # replication ack registry — and serve the merged verdict at
+        # GET /debug/fleet.  A peer's ok->degraded edge captures a
+        # federated entry in THIS node's incident ring.
+        from cook_tpu.obs.fleet import FleetObservatory
+
+        def peer_urls():
+            urls = set(settings.peers)
+            for meta in list(process.api.replication_ack_meta.values()):
+                url = meta.get("url") or ""
+                if url.startswith("http"):
+                    urls.add(url)
+            return sorted(urls)
+
+        process.fleet = FleetObservatory(
+            self_url=advertised,
+            peers_fn=peer_urls,
+            poll_s=settings.fleet_poll_s,
+            incidents=process.api.incidents,
+            self_verdict_fn=process.api.health_verdict,
+            as_user=settings.replication_user,
+        ).start()
+        process.api.fleet = process.fleet
     fail_stop_journals = [
         j for j in (process.journals or [process.journal])
         if j is not None and getattr(j, "fsync_policy", "") == "fail-stop"]
@@ -665,6 +709,10 @@ def start_leader_duties(process: CookProcess,
 def shutdown(process: CookProcess) -> None:
     for loop in process.loops:
         loop.stop()
+    if process.fleet is not None:
+        process.fleet.stop()
+    if process.history is not None:
+        process.history.stop()
     if process.follower is not None:
         process.follower.stop()
     if process.selector is not None:

@@ -239,7 +239,11 @@ class JournalFollower:
                     self.journal.sync()
                 ack = {"follower": self.member_id, "seq": seq,
                        "durable": durable,
-                       "last_txn_id": self.last_txn_id}
+                       "last_txn_id": self.last_txn_id,
+                       # fleet federation (obs/fleet.py): the ack doubles
+                       # as peer registration — the leader's fleet
+                       # observatory polls this URL for health/staleness
+                       "url": self.self_url}
                 if self.shard is not None:
                     ack["shard"] = self.shard
                 if self._post(f"{leader}/replication/ack", ack):

@@ -33,6 +33,18 @@ package makes the DEVICE side and the CONTROL-PLANE write path legible:
     faults, optional device profile) served at `GET /debug/incidents`;
     `incident.job_timeline` reconstructs one job's lifecycle for
     `GET /jobs/{uuid}/timeline`.
+  * `tsdb.MetricsHistory` — durable multi-resolution metrics history:
+    a background sampler turns the registry into per-series points
+    (gauge values, counter rates, histogram p50/p99) retained in
+    raw -> 1m -> 10m rings, persisted as bounded JSONL segments under
+    `data_dir/metrics/`, recovered on restart, served at
+    `GET /debug/history` and embedded (key-series slice) in every
+    incident bundle.
+  * `fleet.FleetObservatory` — cross-process federation: the leader
+    polls every known peer (config + replication ack registry) for
+    health/staleness/headline gauges and serves the merged fleet
+    verdict at `GET /debug/fleet`, with peer ok->degraded edges landing
+    federated entries in the leader's incident ring.
   * `profiling.ProfileCapturer` — single-flight, duration-bounded,
     cooldown-rate-limited `jax.profiler` capture behind
     `POST /debug/profile` and the incident auto-capture.
@@ -85,6 +97,11 @@ _EXPORTS = {
     "CycleDataPlane": ("cook_tpu.obs.data_plane", "CycleDataPlane"),
     "IncidentRecorder": ("cook_tpu.obs.incident", "IncidentRecorder"),
     "job_timeline": ("cook_tpu.obs.incident", "job_timeline"),
+    "MetricsHistory": ("cook_tpu.obs.tsdb", "MetricsHistory"),
+    "HistoryConfig": ("cook_tpu.obs.tsdb", "HistoryConfig"),
+    "FleetObservatory": ("cook_tpu.obs.fleet", "FleetObservatory"),
+    "PEER_UNREACHABLE": ("cook_tpu.obs.fleet", "PEER_UNREACHABLE"),
+    "PEER_DEGRADED": ("cook_tpu.obs.fleet", "PEER_DEGRADED"),
     "ProfileCapturer": ("cook_tpu.obs.profiling", "ProfileCapturer"),
     "AUTO_PROFILE_REASONS": ("cook_tpu.obs.profiling",
                              "AUTO_PROFILE_REASONS"),

@@ -136,6 +136,7 @@ class CookApi:
         config: Optional[ApiConfig] = None,
         plugins: Optional[PluginRegistry] = None,
         txn: Optional[TransactionLog] = None,
+        history=None,
     ):
         self.store = store
         self.scheduler = scheduler
@@ -241,6 +242,23 @@ class CookApi:
         if self.incidents is None:
             self.incidents = add_default_collectors(IncidentRecorder())
         self.incidents.add_collector("contention", self.contention.snapshot)
+        # durable multi-resolution metrics history (cook_tpu/obs/tsdb.py):
+        # components.py passes the data_dir-backed, sampler-started
+        # instance; a bare CookApi gets a memory-only one so
+        # GET /debug/history always serves (tests/smoke force sample
+        # ticks through it).  Every bundle embeds the pre-incident slice
+        # of the key series — "what changed before it broke" without a
+        # live node.
+        if history is None:
+            from cook_tpu.obs.tsdb import MetricsHistory
+
+            history = MetricsHistory()
+        self.history = history
+        self.incidents.add_collector("history", self.history.incident_slice)
+        # fleet observatory (cook_tpu/obs/fleet.py): the leader's wiring
+        # (components.py) attaches a started FleetObservatory; None =
+        # this node does not federate (GET /debug/fleet says so)
+        self.fleet = None
 
     def _starvation_view(self) -> dict:
         from cook_tpu.scheduler.monitor import starvation_stats
@@ -316,6 +334,8 @@ class CookApi:
         r.add_get("/debug/trace", self.get_debug_trace)
         r.add_get("/debug/incidents", self.get_debug_incidents)
         r.add_get("/debug/incidents/{incident_id}", self.get_debug_incident)
+        r.add_get("/debug/history", self.get_debug_history)
+        r.add_get("/debug/fleet", self.get_debug_fleet)
         r.add_get("/debug/profile", self.get_debug_profile)
         r.add_post("/debug/profile", self.post_debug_profile)
         r.add_get("/jobs/{uuid}/timeline", self.get_job_timeline)
@@ -648,6 +668,55 @@ class CookApi:
             return _err(404, f"incident {incident_id} not retained")
         return web.json_response(bundle, dumps=lambda d: json.dumps(
             d, default=str))
+
+    async def get_debug_history(self, request: web.Request) -> web.Response:
+        """Durable multi-resolution metrics history (cook_tpu/obs/tsdb.py):
+        `?metric=` selects series (exact series key, base name, or a
+        trailing-`*` prefix), `?since=` bounds the window (epoch seconds;
+        negative = relative, -600 = last ten minutes), `?step=` picks the
+        resolution (`raw` | `1m` | `10m` — rollup buckets carry
+        min/max/mean/last/count).  Without `metric`, serves the series
+        index (every tracked series with its point count) — the
+        discovery surface `cs history` tab-completes from."""
+        metric = request.query.get("metric", "")
+        step = request.query.get("step", "raw")
+        try:
+            since = float(request.query.get("since", "0") or 0)
+        except ValueError:
+            return _err(400, "since must be a number (epoch seconds, or "
+                             "negative for relative)")
+        from cook_tpu.obs.tsdb import STEPS
+
+        body = {
+            "enabled": True,
+            "sample_s": self.history.config.sample_s,
+            "steps": list(STEPS),
+        }
+        if not metric:
+            body["series"] = self.history.series_index()
+            return web.json_response(body)
+        try:
+            body.update(self.history.query(metric, since=since, step=step))
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(body)
+
+    async def get_debug_fleet(self, request: web.Request) -> web.Response:
+        """Merged fleet verdict (cook_tpu/obs/fleet.py): one row per
+        node (self + every polled peer) with poll-age staleness,
+        federation-level reasons (`peer-unreachable` / `peer-degraded`
+        with the peer's own reasons attached), and the worst replication
+        shard across the fleet.  `enabled: false` on nodes without a
+        fleet observatory (non-leaders, or no peers configured)."""
+        if self.fleet is None:
+            return web.json_response({
+                "enabled": False,
+                "nodes": [],
+                "reasons": [],
+                "detail": "no fleet observatory on this node (leader-only "
+                          "duty; Settings.peers / fleet_poll_s)",
+            })
+        return web.json_response(self.fleet.verdict())
 
     async def get_debug_profile(self, request: web.Request) -> web.Response:
         """Profile-capture status: the in-flight capture (if any), recent
@@ -2150,7 +2219,11 @@ class CookApi:
         self.replication_ack_meta[meta_key] = {
             "seq": seq, "durable": durable, "time": _time.monotonic(),
             "last_txn_id": last_txn_id, "shard": shard,
-            "follower": follower}
+            "follower": follower,
+            # the follower's own REST URL (control/replication.py sends
+            # it): the fleet observatory's peer registry — a standby
+            # that acks is a peer the leader can poll without config
+            "url": str(body.get("url", "") or "")}
         global_registry.counter(
             "replication.acks",
             "replication acks received, split durable vs memory-only").inc(

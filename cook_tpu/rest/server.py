@@ -74,13 +74,15 @@ class InprocessControlPlane:
 
     def __init__(self, *, data_dir: Optional[str] = None,
                  pools: tuple = ("default",), config=None, clock=None,
-                 journal_kw: Optional[dict] = None, shards: int = 1):
+                 journal_kw: Optional[dict] = None, shards: int = 1,
+                 history_sample_s: float = 0.5):
         import tempfile
         import time as _time
 
         from cook_tpu.models import persistence
         from cook_tpu.models.entities import Pool
         from cook_tpu.models.store import JobStore
+        from cook_tpu.obs.tsdb import HistoryConfig, MetricsHistory
         from cook_tpu.rest.api import ApiConfig, CookApi
         from cook_tpu.txn import TransactionLog
 
@@ -113,8 +115,14 @@ class InprocessControlPlane:
             self.txn = TransactionLog(self.store, journal=self.journal)
         for pool in pools:
             self.store.set_pool(Pool(name=pool))
+        # fast-sampled, memory-only metrics history: the loadtest's
+        # closing report scrapes /debug/history for the run's window
+        # (commit-ack p99 trend), so a 2-second smoke run needs more
+        # than one tick
+        self.history = MetricsHistory(
+            config=HistoryConfig(sample_s=history_sample_s))
         self.api = CookApi(self.store, None, config or ApiConfig(),
-                           txn=self.txn)
+                           txn=self.txn, history=self.history)
         self.server = ServerThread(self.api)
 
     @property
@@ -123,11 +131,13 @@ class InprocessControlPlane:
 
     def start(self) -> "InprocessControlPlane":
         self.server.start()
+        self.history.start()
         return self
 
     def stop(self) -> None:
         import shutil
 
+        self.history.stop()
         self.server.stop()
         for journal in self.journals:
             journal.close()

@@ -42,6 +42,7 @@ def cmd_run(args) -> int:
         speculate=args.speculate,
         resident=args.resident,
         fault_schedule=fault_schedule,
+        history_every=args.history_every,
         scheduler=SchedulerConfig(
             # chunk/backend default to the hardware-tuned config
             # (tuned_match.json) like the service; flags override
@@ -73,6 +74,11 @@ def cmd_run(args) -> int:
 
         with open(args.trace_out, "w") as f:
             json.dump(tracing.chrome_trace(), f)
+    if args.history_out and result.metrics_history:
+        # the run's retained metrics history (virtual-clock timestamps,
+        # same shape as GET /debug/history) for offline trend analysis
+        with open(args.history_out, "w") as f:
+            json.dump(result.metrics_history, f, indent=1)
     if args.incidents_out:
         # incident bundles the run captured (same schema as
         # GET /debug/incidents/{id}), one JSON file per bundle
@@ -259,6 +265,13 @@ def main(argv=None) -> int:
     r.add_argument("--faults", default="",
                    help="FaultSchedule JSON file armed for the run "
                         "(cook_tpu.faults; see docs/resilience.md)")
+    r.add_argument("--history-every", type=int, default=0,
+                   help="cycles between metrics-history sample ticks on "
+                        "the virtual clock (0 = off); pair with "
+                        "--history-out")
+    r.add_argument("--history-out", default="",
+                   help="write the run's multi-resolution metrics "
+                        "history dump (GET /debug/history schema) here")
     r.add_argument("--elastic-every", type=int, default=1,
                    help="cycles between capacity plans (with --elastic)")
     r.set_defaults(fn=cmd_run)

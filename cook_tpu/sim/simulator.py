@@ -116,6 +116,13 @@ class SimConfig:
     # must stay below device_fallback_cycles or a short degraded window
     # can recover unobserved
     health_every: int = 4
+    # cycles between metrics-history sample ticks (0 = off).  A long run
+    # retains the same multi-resolution series a live node's sampler
+    # would (obs/tsdb.MetricsHistory on the VIRTUAL clock), dumped in
+    # SimResult.metrics_history / `sim run --history-out` — so "what did
+    # the queue gauge look like before the fault fired" is answerable
+    # offline, same as GET /debug/history on a service node
+    history_every: int = 0
 
 
 @dataclass
@@ -145,6 +152,10 @@ class SimResult:
     # process overlap — the simulator is single-flight in practice) plus
     # the mean rebuild_fraction/padding_waste off the cycle records
     data_plane: dict = field(default_factory=dict)
+    # multi-resolution metrics history sampled on the virtual clock
+    # (with history_every > 0): {"raw": query-dump, "10m": query-dump} —
+    # the same shape GET /debug/history serves (docs/observability.md)
+    metrics_history: dict = field(default_factory=dict)
 
     def queued_wait_ms(self) -> list[int]:
         """Per-started-task queued wait (start - submit): the metric the
@@ -297,6 +308,16 @@ class Simulator:
 
         led_h2d0, led_d2h0 = _dp.LEDGER.byte_totals()
         cfg = self.config
+        history = None
+        if cfg.history_every:
+            # metrics history on the VIRTUAL clock: points stamp in
+            # simulated seconds, so the dump lines up with the trace
+            # timeline instead of the host wall clock
+            from cook_tpu.obs.tsdb import HistoryConfig, MetricsHistory
+
+            history = MetricsHistory(
+                config=HistoryConfig(sample_s=0),
+                clock=lambda: self.now_ms / 1000.0)
         submitted = 0
         phase_wall: dict[str, float] = {"rank": 0.0, "match": 0.0,
                                         "rebalance": 0.0, "elastic": 0.0}
@@ -379,6 +400,10 @@ class Simulator:
             if (cfg.health_every and cycle % cfg.health_every == 0
                     and self.scheduler.telemetry is not None):
                 self.scheduler.telemetry.health()
+            # 3d. metrics-history tick on the virtual clock (the long-run
+            # analog of the service's history sampler)
+            if history is not None and cycle % cfg.history_every == 0:
+                history.sample_once()
             # 4. advance virtual time
             self.now_ms += cfg.cycle_ms
             # stop when all work is done
@@ -438,6 +463,9 @@ class Simulator:
             capacity_ledger=self.store.encoded_capacity_ledger(),
             incidents=self.scheduler.incidents.dump(),
             data_plane=data_plane_summary,
+            metrics_history=(
+                {"raw": history.query("*"), "10m": history.query(
+                    "*", step="10m")} if history is not None else {}),
         )
 
     def _collect_rows(self) -> list[dict]:

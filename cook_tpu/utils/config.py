@@ -205,6 +205,22 @@ class Settings:
     incident_capacity: int = 32
     incident_cooldown_s: float = 30.0
     health_watch_interval_s: float = 15.0
+    # durable multi-resolution metrics history (cook_tpu/obs/tsdb.py):
+    # a background sampler polls the metrics registry every
+    # history_sample_s into raw -> 1m -> 10m rollup rings, persisted
+    # under data_dir/metrics/ and served at GET /debug/history.
+    # <= 0 disables the sampler (the endpoint still serves, empty).
+    history_sample_s: float = 10.0
+    # retention overrides ({"raw_points": .., "rollup_points": ..,
+    # "segment_lines": .., "max_segments": .., "key_series": [..],
+    # "incident_window_s": ..}); {} = HistoryConfig defaults
+    history_retention: dict = field(default_factory=dict)
+    # fleet observatory (cook_tpu/obs/fleet.py), a leader duty: poll
+    # every known peer (this list + every standby registered through
+    # replication acks) for health/staleness every fleet_poll_s and
+    # serve the merged verdict at GET /debug/fleet.  <= 0 disables.
+    peers: tuple = ()
+    fleet_poll_s: float = 5.0
     # automatic device-profile capture on device-latency-shaped
     # degradations (solve-latency-regression, device-degraded),
     # cooldown-rate-limited; POST /debug/profile works regardless.
@@ -296,6 +312,7 @@ def read_config(path: Optional[str] = None,
                 "fault_injection", "journal_fsync_policy", "load_shedding",
                 "incident_dir", "incident_capacity", "incident_cooldown_s",
                 "health_watch_interval_s", "auto_profile", "profile_dir",
+                "history_sample_s", "fleet_poll_s",
                 "queue_limit_per_pool",
                 "queue_limit_per_user", "submission_rate_per_minute"):
         if key in data:
@@ -312,6 +329,10 @@ def read_config(path: Optional[str] = None,
         settings.elastic = dict(data["elastic"])
     if "executor_token" in data:
         settings.executor_token = str(data["executor_token"])
+    if "peers" in data:
+        settings.peers = tuple(data["peers"])
+    if "history_retention" in data:
+        settings.history_retention = dict(data["history_retention"])
     if "pools" in data:
         settings.pools = data["pools"]
     if "clusters" in data:
@@ -347,6 +368,10 @@ def _validate(s: Settings) -> None:
                          "(expected (0, 1])")
     if s.backfill_weight < 0:
         raise ValueError(f"bad backfill_weight {s.backfill_weight}")
+    for url in s.peers:
+        if not str(url).startswith(("http://", "https://")):
+            raise ValueError(f"bad peer url {url!r} (http(s)://... "
+                             "required)")
     if s.journal_fsync_policy not in ("fail-stop", "degrade-async"):
         raise ValueError(
             f"bad journal_fsync_policy {s.journal_fsync_policy!r} "

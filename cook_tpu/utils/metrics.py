@@ -19,6 +19,14 @@ def _labels_key(labels: Optional[dict]) -> tuple:
     return tuple(sorted((labels or {}).items()))
 
 
+def prometheus_name(name: str) -> str:
+    """The exposition-time mapping from registry names to Prometheus
+    identifiers — THE definition; every consumer that needs to match
+    rendered names against registry names (obs/fleet.parse_headline,
+    tools/lint_metrics standalone copy) must agree with it."""
+    return "cook_" + name.replace(".", "_").replace("-", "_")
+
+
 class BoundCounter:
     """A counter pre-bound to one label set (the prometheus-client
     `labels()` child pattern): `inc()` skips the per-call label-dict
@@ -195,7 +203,7 @@ class Registry:
             metrics = sorted(self._metrics.items())
         lines = []
         for name, metric in metrics:
-            pname = "cook_" + name.replace(".", "_").replace("-", "_")
+            pname = prometheus_name(name)
             if metric.help:
                 lines.append(f"# HELP {pname} {_escape_help(metric.help)}")
             if isinstance(metric, Counter):

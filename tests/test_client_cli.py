@@ -144,6 +144,62 @@ def test_cli_timeline(server, cfg, capsys):
     assert [e["kind"] for e in body["events"]][0] == "submitted"
 
 
+def test_cli_history_sparkline_and_index(server, cfg, capsys):
+    # populate the health rollup gauge, then force two sample ticks so
+    # counters/histograms have a window to difference over
+    server.api.health_verdict()
+    server.api.history.sample_once()
+    server.api.history.sample_once()
+    # no metric -> the series index
+    assert cli(server, "history") == 0
+    index_out = capsys.readouterr().out
+    assert "obs.health" in index_out or "rest." in index_out
+    # a gauge family renders a sparkline line per series
+    assert cli(server, "history", "obs.health.degraded",
+               "--window", "3600") == 0
+    out = capsys.readouterr().out
+    assert "obs.health.degraded" in out and "last=" in out
+    # an unknown metric is a non-zero exit with a hint, not a traceback
+    assert cli(server, "history", "no.such.metric") == 1
+    assert "no points" in capsys.readouterr().err
+    # --json round-trips
+    assert cli(server, "history", "obs.health.degraded", "--json") == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["series"]
+
+
+def test_cli_fleet_disabled_and_rendered(server, cfg, capsys):
+    # no observatory wired -> the disabled stub, exit 0
+    assert cli(server, "fleet") == 0
+    assert "disabled" in capsys.readouterr().out
+    from cook_tpu.obs.fleet import FleetObservatory
+
+    server.api.fleet = FleetObservatory(
+        self_url=server.url, incidents=server.api.incidents,
+        self_verdict_fn=server.api.health_verdict)
+    try:
+        server.api.fleet.poll_once()
+        assert cli(server, "fleet") == 0
+        out = capsys.readouterr().out
+        assert "ok" in out and server.url in out
+        assert cli(server, "fleet", "--json") == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["enabled"] and parsed["nodes"]
+    finally:
+        server.api.fleet = None
+
+
+def test_sparkline_shapes():
+    from cook_tpu.client.cli import sparkline
+
+    assert sparkline([]) == ""
+    assert sparkline([3.0, 3.0, 3.0]) == "▁▁▁"
+    ramp = sparkline([0, 1, 2, 3, 4, 5, 6, 7])
+    assert ramp[0] == "▁" and ramp[-1] == "█"
+    # long series downsample to the target width
+    assert len(sparkline(list(range(1000)), width=40)) == 40
+
+
 def test_cli_timeline_unknown_uuid(server, cfg, capsys):
     assert cli(server, "timeline", "no-such-uuid") == 1
 

@@ -1,0 +1,323 @@
+"""cook_tpu/obs/fleet.py — fleet federation: peer polling, the
+peer-unreachable / peer-degraded reasons, federated incident capture
+with flap suppression, the /debug/fleet surface, and the live-style
+leader + follower drill the acceptance criteria pin (fault on the
+follower -> leader fleet verdict + federated incident referencing the
+peer's own bundle + embedded pre-incident history -> leader restart
+still serves the pre-restart history)."""
+import json
+import time
+
+import pytest
+import requests
+
+from cook_tpu import faults
+from cook_tpu.obs.fleet import (PEER_DEGRADED, PEER_UNREACHABLE,
+                                FleetObservatory, parse_headline)
+from cook_tpu.obs.incident import IncidentRecorder
+
+ADMIN = {"X-Cook-Requesting-User": "admin"}
+
+
+class FakePeers:
+    """Injectable transport: a dict of url -> {path: body | Exception}."""
+
+    def __init__(self, peers: dict):
+        self.peers = peers
+
+    def fetch(self, url: str, timeout_s: float):
+        for base, routes in self.peers.items():
+            if url.startswith(base):
+                path = url[len(base):]
+                body = routes.get(path, Exception(f"404 {path}"))
+                if isinstance(body, Exception):
+                    raise body
+                return body
+        raise OSError(f"connection refused: {url}")
+
+
+def healthy_routes(reasons=()):
+    return {
+        "/debug/health": {
+            "healthy": not reasons,
+            "status": "ok" if not reasons else "degraded",
+            "reasons": list(reasons),
+            "checks": {"contention": {"commit_ack": {"p99_ms": 1.0},
+                                      "journal": {},
+                                      "endpoints": {}}},
+        },
+        "/debug/replica": {"shards": {"0": {"staleness_ms": 40.0}}},
+        "/metrics": "cook_obs_health_degraded 0.0\n"
+                    "cook_rest_in_flight 2.0\n",
+        "/debug/incidents": {"incidents": [{"id": "inc-000007"}]},
+    }
+
+
+def make_fleet(peers: dict, **kw):
+    fake = FakePeers(peers)
+    kw.setdefault("incidents", IncidentRecorder())
+    fleet = FleetObservatory(self_url="http://leader",
+                             peers=tuple(peers),
+                             fetch_fn=fake.fetch, **kw)
+    return fleet, fake
+
+
+# --------------------------------------------------------------- polling
+
+
+def test_healthy_peer_row_carries_staleness_and_headline():
+    fleet, _ = make_fleet({"http://peer-a": healthy_routes()})
+    rows = fleet.poll_once()
+    row = rows["http://peer-a"]
+    assert row["ok"] and row["healthy"] and row["status"] == "ok"
+    assert row["staleness"] == {"0": 40.0}
+    assert row["headline"]["rest.in_flight"] == 2.0
+    assert "commit_ack" in row["contention"]
+    verdict = fleet.verdict()
+    assert verdict["healthy"] and verdict["reasons"] == []
+    assert verdict["worst_shard"] == {"node": "http://peer-a",
+                                      "shard": "0", "staleness_ms": 40.0}
+
+
+def test_dead_peer_becomes_unreachable_within_one_poll():
+    fleet, _ = make_fleet({"http://gone": {}})  # every fetch raises
+    fleet.poll_once()
+    verdict = fleet.verdict()
+    assert verdict["status"] == "degraded"
+    assert verdict["reasons"] == [PEER_UNREACHABLE]
+    [row] = [n for n in verdict["nodes"] if not n.get("self")]
+    assert not row["ok"] and "error" in row
+    assert row["poll_age_s"] >= 0.0
+
+
+def test_degraded_peer_attaches_its_own_reasons():
+    fleet, fake = make_fleet(
+        {"http://peer-a": healthy_routes(["fsync-stall"])})
+    fleet.poll_once()
+    verdict = fleet.verdict()
+    assert verdict["reasons"] == [PEER_DEGRADED]
+    [row] = [n for n in verdict["nodes"] if not n.get("self")]
+    assert row["reasons"] == ["fsync-stall"]
+
+
+def test_recovery_clears_the_reason_and_stamps_the_bundle():
+    fake_routes = healthy_routes(["fsync-stall"])
+    fleet, fake = make_fleet({"http://peer-a": fake_routes})
+    fleet.poll_once()
+    bundle = fleet._peer_state["http://peer-a"]["bundle"]
+    assert bundle is not None and bundle["recovered_time"] is None
+    fake.peers["http://peer-a"] = healthy_routes()
+    fleet.poll_once()
+    assert fleet.verdict()["healthy"]
+    assert bundle["recovered_time"] is not None
+
+
+def test_federated_incident_references_the_peer_bundle():
+    incidents = IncidentRecorder()
+    fleet, _ = make_fleet(
+        {"http://peer-a": healthy_routes(["quality-drift"])},
+        incidents=incidents)
+    fleet.poll_once()
+    [summary] = incidents.bundles()
+    assert summary["trigger"] == "fleet-peer"
+    bundle = incidents.get(summary["id"])
+    [degradation] = bundle["verdict"]["degradations"]
+    assert degradation["reason"] == PEER_DEGRADED
+    assert degradation["peer"] == "http://peer-a"
+    assert degradation["peer_reasons"] == ["quality-drift"]
+    assert degradation["peer_incident_id"] == "inc-000007"
+    json.dumps(bundle, default=str)  # bundle persists; must round-trip
+
+
+def test_flapping_peer_is_cooldown_suppressed_then_deferred():
+    incidents = IncidentRecorder()
+    routes = healthy_routes(["fsync-stall"])
+    fleet, fake = make_fleet({"http://peer-a": routes},
+                             incidents=incidents, cooldown_s=3600.0)
+    fleet.poll_once()                       # edge 1: captures
+    assert len(incidents.bundles()) == 1
+    for _ in range(3):                      # flap inside the cooldown
+        fake.peers["http://peer-a"] = healthy_routes()
+        fleet.poll_once()
+        fake.peers["http://peer-a"] = routes
+        fleet.poll_once()
+    assert len(incidents.bundles()) == 1    # suppressed, not flooded
+    state = fleet._peer_state["http://peer-a"]
+    assert state["pending"]                 # ... but deferred, not lost
+    state["last_capture"] = float("-inf")   # cooldown clears
+    fleet.poll_once()
+    assert len(incidents.bundles()) == 2
+
+
+def test_unreachable_peer_capture_skips_the_bundle_reference():
+    incidents = IncidentRecorder()
+    fleet, _ = make_fleet({"http://gone": {}}, incidents=incidents)
+    fleet.poll_once()
+    [summary] = incidents.bundles()
+    bundle = incidents.get(summary["id"])
+    [degradation] = bundle["verdict"]["degradations"]
+    assert degradation["reason"] == PEER_UNREACHABLE
+    assert degradation["peer_incident_id"] is None
+
+
+def test_peers_fn_registry_merges_and_excludes_self():
+    fleet, _ = make_fleet(
+        {"http://peer-a": healthy_routes()},
+        peers_fn=lambda: ["http://leader", "http://peer-a/",
+                          "http://peer-b"])
+    assert fleet.peer_list() == ["http://peer-a", "http://peer-b"]
+
+
+def test_crashed_peer_stays_unreachable_after_registry_prunes_it():
+    """Peers are sticky: the dynamic registry half is the replication
+    ack table, which liveness-prunes a crashed standby within seconds —
+    the dead node must KEEP its peer-unreachable row, not vanish and
+    flip the fleet verdict back to ok."""
+    registry = {"urls": ["http://standby"]}
+    fleet, fake = make_fleet({"http://standby": healthy_routes()},
+                             peers_fn=lambda: registry["urls"])
+    fleet.peers = ()  # registry-only registration, the no-config path
+    fleet.poll_once()
+    assert fleet.verdict()["healthy"]
+    # the standby crashes AND its acks age out of the registry
+    fake.peers.pop("http://standby")
+    registry["urls"] = []
+    fleet.poll_once()
+    verdict = fleet.verdict()
+    assert verdict["reasons"] == [PEER_UNREACHABLE]
+    assert "http://standby" in [n["url"] for n in verdict["nodes"]]
+    # explicit decommission is the way a peer actually leaves
+    fleet.forget_peer("http://standby")
+    fleet.poll_once()
+    assert fleet.verdict()["healthy"]
+    assert fleet.peer_list() == []
+
+
+def test_parse_headline_takes_worst_label_and_skips_histograms():
+    text = ("# HELP cook_rank_queue_len x\n"
+            "cook_rank_queue_len{pool=\"a\"} 3.0\n"
+            "cook_rank_queue_len{pool=\"b\"} 9.0\n"
+            "cook_obs_health_degraded 1.0\n"
+            "cook_job_latency_end_to_end_bucket{le=\"1\"} 4\n"
+            "garbage line\n")
+    out = parse_headline(text, ("rank.queue_len", "obs.health.degraded",
+                                "job.latency.end_to_end"))
+    assert out == {"rank.queue_len": 9.0, "obs.health.degraded": 1.0}
+
+
+# --------------------------------------------------------- live-style drill
+
+
+def test_drill_leader_follower_fault_fleet_incident_history(tmp_path):
+    """The acceptance drill: boot a leader + one follower control
+    plane, arm a fault on the follower -> the leader's /debug/fleet
+    shows the peer degraded (its own reasons attached) within one poll
+    interval, the leader's incident ring gains a federated entry
+    referencing the peer's bundle, the bundle embeds a non-empty
+    pre-incident history slice; restart the leader and /debug/history
+    still serves the pre-restart samples."""
+    from cook_tpu.obs.contention import ContentionParams
+    from cook_tpu.obs.tsdb import HistoryConfig, MetricsHistory
+    from cook_tpu.rest.api import ApiConfig
+    from cook_tpu.rest.server import InprocessControlPlane
+
+    follower_dir = tmp_path / "follower"
+    follower_dir.mkdir()
+    follower = InprocessControlPlane(
+        config=ApiConfig(contention=ContentionParams(fsync_stall_s=0.05)),
+        history_sample_s=0,
+        data_dir=str(follower_dir)).start()
+    leader_history_dir = str(tmp_path / "leader-metrics")
+    leader = InprocessControlPlane(history_sample_s=0).start()
+    leader.api.history = MetricsHistory(
+        dir=leader_history_dir, config=HistoryConfig(sample_s=0))
+    leader.api.incidents.add_collector(
+        "history", leader.api.history.incident_slice)
+    fleet = FleetObservatory(
+        self_url=leader.url, peers=(follower.url,), poll_s=0.2,
+        incidents=leader.api.incidents,
+        self_verdict_fn=leader.api.health_verdict)
+    leader.api.fleet = fleet
+    try:
+        # pre-incident history on the leader: the health rollup gauge is
+        # a key series, so sampling now gives the bundle its slice
+        leader.api.health_verdict()
+        leader.api.history.sample_once()
+        time.sleep(0.05)
+        leader.api.history.sample_once()
+
+        # baseline: the follower is a healthy peer
+        fleet.poll_once()
+        assert leader.api.fleet.verdict()["healthy"]
+
+        # arm the fault ON THE FOLLOWER's write path and trip it: a
+        # 100 ms fsync stall against a 50 ms bound degrades its health
+        faults.arm(faults.FaultSchedule([faults.FaultRule(
+            point=faults.JOURNAL_FSYNC, mode="delay", delay_s=0.1)]))
+        try:
+            r = requests.post(
+                f"{follower.url}/jobs",
+                json={"jobs": [{"command": "true", "mem": 64,
+                                "cpus": 0.5}]},
+                headers=ADMIN, timeout=30)
+            assert r.status_code == 201
+        finally:
+            faults.disarm()
+
+        # within ONE poll interval the leader sees the degradation
+        fleet.start()
+        deadline = time.monotonic() + 5.0
+        verdict = None
+        while time.monotonic() < deadline:
+            verdict = leader.api.fleet.verdict()
+            if PEER_DEGRADED in verdict["reasons"]:
+                break
+            time.sleep(0.05)
+        fleet.stop()
+        assert verdict is not None \
+            and PEER_DEGRADED in verdict["reasons"], verdict
+        [row] = [n for n in verdict["nodes"] if not n.get("self")]
+        assert "fsync-stall" in row["reasons"]
+        assert row["poll_age_s"] < 5.0
+
+        # the leader's incident ring gained a federated entry that
+        # references the PEER's own bundle (the follower captured one
+        # when its health was polled)
+        federated = [b for b in leader.api.incidents.bundles()
+                     if b["trigger"] == "fleet-peer"]
+        assert federated, leader.api.incidents.bundles()
+        bundle = leader.api.incidents.get(federated[-1]["id"])
+        [degradation] = bundle["verdict"]["degradations"]
+        assert degradation["peer"] == follower.url
+        assert "fsync-stall" in degradation["peer_reasons"]
+        peer_incident_id = degradation["peer_incident_id"]
+        assert peer_incident_id is not None
+        peer_index = requests.get(f"{follower.url}/debug/incidents",
+                                  headers=ADMIN, timeout=10).json()
+        assert peer_incident_id in [b["id"]
+                                    for b in peer_index["incidents"]]
+
+        # ... and embeds a non-empty pre-incident history slice
+        assert bundle["history"]["series"], bundle["history"]
+
+        # GET /debug/fleet serves the same verdict over HTTP
+        over_http = requests.get(f"{leader.url}/debug/fleet",
+                                 headers=ADMIN, timeout=10).json()
+        assert over_http["enabled"]
+        assert PEER_DEGRADED in over_http["reasons"]
+
+        # "restart" the leader: a fresh history over the same dir still
+        # serves the pre-restart samples
+        pre_restart = leader.api.history.query("obs.health.degraded")
+        assert pre_restart["series"]["obs.health.degraded"]
+        leader.api.history.stop()
+        reborn = MetricsHistory(dir=leader_history_dir,
+                                config=HistoryConfig(sample_s=0))
+        recovered = reborn.query("obs.health.degraded")
+        assert recovered["series"]["obs.health.degraded"] \
+            == pre_restart["series"]["obs.health.degraded"]
+        reborn.stop()
+    finally:
+        fleet.stop()
+        leader.stop()
+        follower.stop()

@@ -210,6 +210,111 @@ def test_tree_lint_checks_repo_doc_catalog(tmp_path):
     assert "undocumented.name" in result.errors[0]
 
 
+# -------------------------------------------------- reverse doc-drift rule
+
+
+CATALOG_DOC = """# Observability
+Prose backticks like `GET /debug/health` and `utils/config.py` must
+never be treated as catalog rows.
+
+## Metric catalog (selected)
+
+| name (registry) | type | labels | meaning |
+|---|---|---|---|
+| `covered.exact` | counter | — | a live metric |
+| `journal.appends` / `journal.bytes` | counter | — | two names, one row |
+| `family.*` | gauge | pool | a wildcard family |
+| `span.<name>` | histogram | tags | dynamic family, constant head |
+
+## Another section
+
+| `not.a.catalog.row` | whatever |
+"""
+
+
+def test_reverse_drift_flags_stale_catalog_row():
+    result = lint_source(
+        "global_registry.counter('covered.exact', 'h')\n"
+        "global_registry.counter('journal.appends', 'h')\n"
+        "global_registry.gauge('family.member', 'h')\n"
+        'global_registry.histogram(f"span.{n}", "h")\n')
+    lint_metrics.lint_reverse_doc_drift(result, CATALOG_DOC,
+                                        "docs/observability.md")
+    # `journal.bytes` shares a row with a registered sibling but is
+    # itself unregistered -> flagged; everything else is vouched for
+    # (exact, wildcard family, dynamic `span.` head), and the other
+    # sections' backticks are ignored entirely
+    assert [e for e in result.errors] \
+        == [e for e in result.errors if "journal.bytes" in e]
+    assert len(result.errors) == 1
+    assert "prune the row" in result.errors[0]
+
+
+def test_reverse_drift_wildcard_needs_at_least_one_member():
+    doc = ("## Metric catalog\n"
+           "| name | type |\n|---|---|\n"
+           "| `ghost.*` | gauge |\n")
+    result = lint_source("global_registry.gauge('other.name', 'h')\n")
+    lint_metrics.lint_reverse_doc_drift(result, doc, "docs/o.md")
+    assert not result.ok and "ghost.*" in result.errors[0]
+    result = lint_source("global_registry.gauge('ghost.member', 'h')\n")
+    lint_metrics.lint_reverse_doc_drift(result, doc, "docs/o.md")
+    assert result.ok
+
+
+def test_reverse_drift_placeholder_rows_are_checked_not_skipped():
+    """A `span.<name>`-style row normalizes to a `span.*` wildcard — it
+    must be CHECKED (and fail when the dynamic family disappears), not
+    silently skipped because `<` can't appear in a metric name."""
+    doc = ("## Metric catalog\n| n |\n|---|\n"
+           "| `span.<name>` | histogram |\n")
+    rows = lint_metrics.catalog_rows(doc)
+    assert rows == [(4, ["span.*"])]
+    vouched = lint_source('global_registry.histogram(f"span.{n}", "h")\n')
+    lint_metrics.lint_reverse_doc_drift(vouched, doc, "docs/o.md")
+    assert vouched.ok
+    orphaned = lint_source("global_registry.gauge('other', 'h')\n")
+    lint_metrics.lint_reverse_doc_drift(orphaned, doc, "docs/o.md")
+    assert not orphaned.ok and "span.*" in orphaned.errors[0]
+
+
+def test_reverse_drift_line_numbers_point_at_the_row():
+    lines = CATALOG_DOC.splitlines()
+    rows = lint_metrics.catalog_rows(CATALOG_DOC)
+    for lineno, tokens in rows:
+        for token in tokens:
+            base = token.rstrip("*").replace("<name>", "")
+            assert base.rstrip(".") in lines[lineno - 1]
+    # rows come only from the catalog section's table
+    all_tokens = [t for _, tokens in rows for t in tokens]
+    assert "not.a.catalog.row" not in all_tokens
+    assert "GET" not in all_tokens
+
+
+def test_constant_name_registration_is_resolved():
+    """A registration through a file-local string constant participates
+    in both drift directions (shard/replica.py's
+    `_STALENESS_GAUGE_NAME` idiom)."""
+    src = ('_NAME = "shard.via_constant"\n'
+           "global_registry.gauge(_NAME, 'h')\n")
+    result = lint_source(src)
+    assert [s.name for s in result.sites] == ["shard.via_constant"]
+    assert not result.sites[0].dynamic
+    doc = ("## Metric catalog\n| n |\n|---|\n"
+           "| `shard.via_constant` | gauge |\n")
+    lint_metrics.lint_reverse_doc_drift(result, doc, "docs/o.md")
+    assert result.ok
+    # a REBOUND name is ambiguous and must not vouch for anything
+    rebound = lint_source('X = "a.b"\nX = "c.d"\n'
+                          "global_registry.gauge(X, 'h')\n")
+    assert rebound.sites == []
+
+
+def test_repo_catalog_survives_reverse_check():
+    result = lint_metrics.lint_tree(REPO_ROOT)
+    assert result.ok, "\n".join(result.errors)
+
+
 def test_cli_exit_codes(tmp_path):
     clean = tmp_path / "clean"
     clean.mkdir()

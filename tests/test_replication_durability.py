@@ -61,6 +61,52 @@ def _wait(pred, timeout_s, what):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+def test_standby_self_registers_as_fleet_peer(tmp_path):
+    """The replication ack doubles as fleet-peer registration: a
+    standby that tails the leader lands its URL in the leader's ack
+    registry, the leader's fleet observatory (started with leadership)
+    discovers it with NO `peers` config, and one poll yields a healthy
+    fleet row for it (obs/fleet.py; docs/observability.md)."""
+    lease = LeaseServer().start()
+    p1 = p2 = None
+    try:
+        s1 = _settings(free_port(), str(tmp_path / "n1"), lease.url)
+        p1 = build_process(s1)
+        start_leader_duties(p1, block=False, on_loss=lambda: None)
+        assert p1.is_leader()
+        assert p1.fleet is not None and p1.api.fleet is p1.fleet
+
+        s2 = _settings(free_port(), str(tmp_path / "n2"), lease.url)
+        p2 = build_process(s2)
+        standby = threading.Thread(
+            target=start_leader_duties, args=(p2,),
+            kwargs={"block": False, "on_loss": lambda: None}, daemon=True)
+        standby.start()
+        standby_url = f"http://127.0.0.1:{s2.port}"
+        _wait(lambda: standby_url in p1.fleet.peer_list(), 15,
+              "standby url in the leader's fleet peer registry")
+
+        rows = p1.fleet.poll_once()
+        row = rows[standby_url]
+        assert row["ok"], row
+        verdict = p1.api.fleet.verdict()
+        assert standby_url in [n["url"] for n in verdict["nodes"]]
+        # the standby runs its own history sampler (every node role)
+        assert p2.history is not None
+        # served over the leader's REST surface too
+        r = requests.get(f"http://127.0.0.1:{s1.port}/debug/fleet",
+                         headers=ADMIN, timeout=10)
+        assert r.status_code == 200
+        body = r.json()
+        assert body["enabled"]
+        assert standby_url in [n["url"] for n in body["nodes"]]
+    finally:
+        for p in (p1, p2):
+            if p is not None:
+                shutdown(p)
+        lease.stop()
+
+
 # ------------------------------------------------------------------ sync-ack
 
 

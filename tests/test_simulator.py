@@ -36,6 +36,31 @@ def test_simulator_completes_all_jobs():
     assert len(started) == len(jobs)
 
 
+def test_simulator_retains_metrics_history_on_virtual_clock():
+    """history_every > 0: a long run retains the same multi-resolution
+    series a live node's sampler would, timestamped in VIRTUAL seconds
+    (obs/tsdb.py; `sim run --history-every N --history-out FILE`)."""
+    jobs, hosts = small_trace()
+    cfg = SimConfig(cycle_ms=15_000, max_cycles=500, history_every=2)
+    result = Simulator(jobs, hosts, cfg).run()
+    raw = result.metrics_history["raw"]["series"]
+    assert raw, "history_every set but no series retained"
+    queue_series = [k for k in raw if k.startswith("rank.queue_len")]
+    assert queue_series, sorted(raw)[:10]
+    points = raw[queue_series[0]]
+    # virtual-clock timestamps: monotone, bounded by the simulated span
+    times = [t for t, _ in points]
+    assert times == sorted(times)
+    assert times[-1] <= result.virtual_ms / 1000.0
+    # the 10m rollup rides along (one simulated cycle is 15 virtual
+    # seconds, so a multi-minute run folds into rollup buckets)
+    rolled = result.metrics_history["10m"]["series"][queue_series[0]]
+    assert sum(b["count"] for b in rolled) == len(points)
+    # off by default: no retained history, no cost
+    assert Simulator(*small_trace(), SimConfig(
+        cycle_ms=15_000, max_cycles=50)).run().metrics_history == {}
+
+
 def test_simulator_determinism():
     jobs, hosts = small_trace()
     r1 = Simulator(jobs, hosts, SimConfig(cycle_ms=15_000)).run()

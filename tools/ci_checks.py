@@ -7,7 +7,10 @@ gate — each with its own invocation and exit-code convention.  This
 wrapper runs them as one pipeline with one verdict:
 
   1. `tools/lint_metrics.py`   — metric/span registration lint + the
-     docs/observability.md catalog drift check;
+     docs/observability.md catalog drift check, BOTH directions: a
+     registered metric missing from the catalog fails, and a catalog
+     row whose family is no longer registered anywhere fails
+     (`family.*` wildcards honored);
   2. `python bench.py --smoke` — the tiny bench tier:
      match/dru/rebalance/elastic solves, the `match_xl` hierarchical
      two-level solve (coarse/fine/refine phases, the 100k x 10k tier's
@@ -45,7 +48,10 @@ wrapper runs them as one pipeline with one verdict:
   5. `tools/debug_smoke.py`    — boots a full-stack node and GETs every
      /debug/* endpoint (plus /jobs/{uuid}/timeline), asserting 200 +
      parseable JSON — catches schema-breaking regressions no
-     per-handler unit test sees.
+     per-handler unit test sees.  `/debug/history` must serve a
+     NON-EMPTY series index after the rig's forced sample ticks, and
+     `/debug/fleet` must render the merged verdict (self row) through
+     the rig's zero-peer fleet observatory.
 
     python tools/ci_checks.py [--root DIR] [--threshold 0.2]
                               [--skip-bench]

@@ -65,6 +65,19 @@ def build_rig():
     # fault_injection on so GET /debug/faults serves its (disarmed) state
     # instead of the production 403
     api = CookApi(store, scheduler, ApiConfig(fault_injection=True))
+    # force metrics-history ticks so /debug/history serves NON-EMPTY
+    # series (two ticks: counters/histograms need a previous tick to
+    # difference against)
+    api.history.sample_once()
+    api.history.sample_once()
+    # a zero-peer fleet observatory so /debug/fleet serves the real
+    # merged-verdict shape (self row included), not the disabled stub
+    from cook_tpu.obs.fleet import FleetObservatory
+
+    api.fleet = FleetObservatory(self_url="http://smoke.local",
+                                 incidents=api.incidents,
+                                 self_verdict_fn=api.health_verdict)
+    api.fleet.poll_once()
     # mint one incident so /debug/incidents/{id} has a real id to serve
     incident = api.incidents.capture(
         {"healthy": False, "reasons": ["debug-smoke"]}, trigger="smoke")
@@ -130,6 +143,22 @@ def main(argv=None) -> int:
                                      for s in ds.get("states", [])):
                             problem = ("device_state has no resident "
                                        "pool mirrors")
+                    elif path == "/debug/history":
+                        # the rig forced sample ticks, so the series
+                        # index must be NON-EMPTY — an empty history
+                        # after a forced tick is a broken sampler, not
+                        # a quiet system
+                        if not parsed.get("series"):
+                            problem = ("history series index empty "
+                                       "after forced sample ticks")
+                    elif path == "/debug/fleet":
+                        # the rig wired a fleet observatory: the merged
+                        # verdict must render (self row at minimum)
+                        if not parsed.get("enabled") \
+                                or not parsed.get("nodes"):
+                            problem = ("fleet verdict missing/empty "
+                                       "nodes despite a wired "
+                                       "observatory")
             if problem:
                 failures.append(f"{path}: {problem}")
                 print(f"debug_smoke: {path}: FAIL ({problem})")

@@ -27,7 +27,14 @@ the source tree instead and fails when:
     is a metric nobody can interpret mid-incident; the catalog is the
     contract, so it must grow WITH the code.  Only checked when the
     linted root carries docs/observability.md (arbitrary-directory
-    lints skip it).
+    lints skip it);
+  * **reverse doc drift** — the mirror direction: a metric-catalog
+    TABLE row (the "## Metric catalog" section only; prose backticks
+    elsewhere are not rows) whose family is no longer registered
+    anywhere in the code fails, honoring the same `family.*` wildcard
+    convention — a stale row sends the mid-incident reader hunting for
+    a metric that no longer exists, so the catalog must also SHRINK
+    with the code.
 
 Aliased registrations (`g = global_registry.gauge; g("name", ...)`) are
 resolved file-locally, so the monitor-gauge idiom stays covered.
@@ -58,7 +65,9 @@ _VALID_SPAN_FRAGMENT = re.compile(r"[a-z0-9_.]*$")
 
 
 def rendered_name(name: str) -> str:
-    """The exposition-time mapping from utils/metrics.py render_prometheus."""
+    """The exposition-time mapping — a standalone copy of
+    cook_tpu/utils/metrics.prometheus_name (this linter must run
+    against arbitrary trees without importing the package)."""
     return "cook_" + name.replace(".", "_").replace("-", "_")
 
 
@@ -100,14 +109,21 @@ def _is_global_registry(node: ast.expr) -> bool:
     return False
 
 
-def _name_arg(call: ast.Call) -> tuple[str, bool] | None:
+def _name_arg(call: ast.Call,
+              consts: dict[str, str] | None = None) -> tuple[str, bool] | None:
     """(name, dynamic) from the first positional arg; None when it isn't
-    a string-ish literal at all (a variable — nothing to check)."""
+    a string-ish literal at all (a variable — nothing to check).  A bare
+    name bound to a file-local string constant (`_NAME = "a.b"` ...
+    `gauge(_NAME, ...)`) resolves through `consts` — without this the
+    constant-name idiom hides a registration from BOTH doc-drift
+    directions."""
     if not call.args:
         return None
     arg = call.args[0]
     if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
         return arg.value, False
+    if isinstance(arg, ast.Name) and consts and arg.id in consts:
+        return consts[arg.id], False
     if isinstance(arg, ast.JoinedStr):
         fragments = [v.value for v in arg.values
                      if isinstance(v, ast.Constant) and isinstance(v.value, str)]
@@ -149,6 +165,27 @@ def _registry_aliases(tree: ast.AST) -> dict[str, str]:
     return aliases
 
 
+def _string_constants(tree: ast.AST) -> dict[str, str]:
+    """File-local names bound (once) to a string literal
+    (`_NAME = "shard.x"` -> {"_NAME": "shard.x"}).  Re-bound names are
+    dropped — an ambiguous binding must not vouch for a name."""
+    consts: dict[str, str] = {}
+    rebound: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        target = node.targets[0].id
+        if target in consts or target in rebound:
+            rebound.add(target)
+            consts.pop(target, None)
+            continue
+        if isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[target] = node.value.value
+    return consts
+
+
 def _is_span_call(func: ast.expr) -> bool:
     # span(...) / record_event(...) / tracing.span(...) /
     # <mod>.tracing.record_event(...)
@@ -170,6 +207,7 @@ def collect_sites(source: str, path: str) -> list[CallSite]:
     except SyntaxError:
         return sites
     aliases = _registry_aliases(tree)
+    consts = _string_constants(tree)
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -183,7 +221,7 @@ def collect_sites(source: str, path: str) -> list[CallSite]:
             metric_type = aliases[func.id]
         if metric_type is None:
             continue
-        parsed = _name_arg(node)
+        parsed = _name_arg(node, consts)
         if parsed is None:
             continue
         name, dynamic = parsed
@@ -321,6 +359,84 @@ def lint_doc_coverage(result: LintResult, doc_text: str,
             f"{doc_path} catalog (add a row, or a `family.*` wildcard)")
 
 
+# the reverse direction is scoped to the catalog TABLE (the section
+# below this heading): the rest of the doc backticks plenty of
+# non-metric tokens (paths, config keys) that must not be "checked"
+_CATALOG_HEADING = "## Metric catalog"
+# a catalog-row token: a metric name, optionally with an
+# `<angle-bracket>` placeholder segment (`span.<name>`,
+# `obs.device.mem_<kind>`) or a trailing `*` — either marks the
+# constant head as a wildcard prefix
+_ROW_NAME = re.compile(
+    r"`([a-zA-Z0-9_][a-zA-Z0-9_.\-]*)(<[a-zA-Z_]+>[a-zA-Z0-9_.\-]*|\*)?`")
+
+
+def catalog_rows(doc_text: str) -> list[tuple[int, list[str]]]:
+    """(line number, first-cell metric tokens) for every table row in
+    the metric-catalog section.  A row's first cell may carry several
+    names (`journal.appends` / `journal.bytes_written`); a placeholder
+    segment (`span.<name>`) normalizes to a `head.*` wildcard token so
+    the dynamic-family idiom is actually checked, not skipped."""
+    rows: list[tuple[int, list[str]]] = []
+    in_section = False
+    for lineno, line in enumerate(doc_text.splitlines(), start=1):
+        if line.startswith("## "):
+            in_section = line.startswith(_CATALOG_HEADING)
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        cells = line.split("|")
+        if len(cells) < 2:
+            continue
+        first = cells[1]
+        if set(first.strip()) <= {"-", " ", ":"}:
+            continue  # the header separator row
+        tokens = [head + ("*" if tail else "")
+                  for head, tail in _ROW_NAME.findall(first)]
+        if tokens:
+            rows.append((lineno, tokens))
+    return rows
+
+
+def lint_reverse_doc_drift(result: LintResult, doc_text: str,
+                           doc_path: str) -> None:
+    """The code->docs check's mirror: a metric-catalog row whose family
+    is no longer registered ANYWHERE in the linted tree fails — a stale
+    row sends the mid-incident reader hunting for a metric that no
+    longer exists.  A token is vouched for by a literal registration
+    (exact, or prefix-covered for wildcard rows — both `family.*` and
+    `span.<name>`-style placeholder rows normalize to wildcards in
+    catalog_rows) or by a dynamic registration whose constant fragment
+    overlaps it (the doc token and the f-string prefix share a
+    prefix)."""
+    literals = {s.name for s in result.sites if not s.dynamic}
+    fragments = [s.name for s in result.sites if s.dynamic and s.name]
+
+    def covered(token: str) -> bool:
+        if token.endswith("*"):
+            prefix = token[:-1]
+            return (any(name.startswith(prefix) for name in literals)
+                    or any(f.startswith(prefix) or prefix.startswith(f)
+                           for f in fragments))
+        if token in literals:
+            return True
+        # `span.<name>`-style rows parse to their constant head ("span.");
+        # match against dynamic sites' constant fragments either way round
+        return any(f.startswith(token) or token.startswith(f)
+                   for f in fragments)
+
+    flagged: set[str] = set()
+    for lineno, tokens in catalog_rows(doc_text):
+        for token in tokens:
+            if token in flagged or covered(token):
+                continue
+            flagged.add(token)
+            result.errors.append(
+                f"{doc_path}:{lineno}: catalog row names {token!r} but "
+                f"no registration in the code matches it — prune the "
+                f"row (or restore the metric)")
+
+
 def lint_tree(root: str) -> LintResult:
     root_path = pathlib.Path(root)
     sites: list[CallSite] = []
@@ -341,9 +457,11 @@ def lint_tree(root: str) -> LintResult:
     doc = root_path / DOC_CATALOG
     if doc.is_file():
         try:
-            lint_doc_coverage(result, doc.read_text(), str(doc))
+            doc_text = doc.read_text()
         except OSError:
-            pass
+            return result
+        lint_doc_coverage(result, doc_text, str(doc))
+        lint_reverse_doc_drift(result, doc_text, str(doc))
     return result
 
 

@@ -267,7 +267,60 @@ def run_loadtest(url: str, *, rps: float = 50.0, duration_s: float = 5.0,
     shard_summary = per_shard_summary(report.get("contention"))
     if shard_summary is not None:
         report["per_shard"] = shard_summary
+    # ... and the server's retained history for the run's window: the
+    # commit-ack p99 TREND (obs/tsdb.py sampled it while we drove load),
+    # so a mid-run regression is visible as a slope, not hidden inside
+    # one final percentile
+    try:
+        import requests
+
+        r = requests.get(
+            f"{url}/debug/history",
+            params={"metric": "job.latency.submit_commit_ack.p99",
+                    "since": -(wall_s + 5.0)},
+            headers={"X-Cook-Requesting-User": admin_user}, timeout=10)
+        if r.status_code == 200:
+            trend = commit_ack_trend(r.json(), wall_s)
+            if trend is not None:
+                report["commit_ack_trend"] = trend
+    except Exception as e:  # noqa: BLE001 — best-effort, same as above
+        log(f"loadtest: /debug/history scrape failed: {e}")
     return report
+
+
+def commit_ack_trend(history_body, duration_s: float,
+                     n_buckets: int = 5) -> "dict | None":
+    """Bucket the server-side commit-ack p99 series over the run's
+    window: [{offset_s, p99_ms, samples}] oldest-first, plus the
+    first->last delta.  The window is clamped to the run's duration
+    (the scrape's `since` carries slack, and a long-lived server
+    retains pre-run samples that must not read as this run's trend).
+    None when the server retained no points in the window (history
+    sampler off, or a run shorter than one sample tick)."""
+    points = []
+    for series_points in (history_body.get("series") or {}).values():
+        points.extend(series_points)
+    if not points:
+        return None
+    points.sort()
+    cutoff = points[-1][0] - duration_s
+    points = [p for p in points if p[0] >= cutoff]
+    t0, t1 = points[0][0], points[-1][0]
+    span = max(t1 - t0, 1e-9)
+    buckets: list[list[float]] = [[] for _ in range(n_buckets)]
+    for t, v in points:
+        idx = min(n_buckets - 1, int((t - t0) / span * n_buckets))
+        buckets[idx].append(v * 1000.0)  # histogram points are seconds
+    rows = [{"offset_s": round(i * span / n_buckets, 2),
+             "p99_ms": round(max(vals), 3), "samples": len(vals)}
+            for i, vals in enumerate(buckets) if vals]
+    return {
+        "buckets": rows,
+        "first_p99_ms": rows[0]["p99_ms"],
+        "last_p99_ms": rows[-1]["p99_ms"],
+        "delta_p99_ms": round(rows[-1]["p99_ms"] - rows[0]["p99_ms"], 3),
+        "window_s": round(span, 2),
+    }
 
 
 def per_shard_summary(contention) -> "dict | None":
@@ -367,6 +420,10 @@ def main(argv=None) -> int:
                                       "duration_s", "commit_ack", "errors")}
     if "per_shard" in report:
         summary["per_shard"] = report["per_shard"]
+    if "commit_ack_trend" in report:
+        # the trend next to the hottest-shard attribution: a mid-run
+        # regression reads as a slope here, not just a final percentile
+        summary["commit_ack_trend"] = report["commit_ack_trend"]
     print(json.dumps(summary))
     if args.out:
         with open(args.out, "w") as f:
