@@ -845,6 +845,77 @@ def bench_control_plane_sharded(*, rps=300.0, duration_s=8.0, seed=13,
     }
 
 
+def bench_control_plane_mp(*, rps=300.0, duration_s=8.0, seed=13,
+                           smoke=False, groups=4, workers=8,
+                           baseline=None) -> dict:
+    """Multi-process control-plane phase (cook_tpu/mp/): the SAME
+    seeded trace as `control_plane_sharded`, driven closed-loop through
+    the shard-aware FRONT END of a fleet of `groups` worker PROCESSES
+    (one shard-group each, one traffic pool per group).  Forwarding,
+    connection pooling, per-worker breakers, and any cross-group 2PC
+    are all inside the measured path.
+
+    `rps_speedup_vs_sharded` compares against the in-process sharded
+    phase's achieved RPS on the same trace (pass that phase dict as
+    `baseline` to reuse its numbers; otherwise a quick inline baseline
+    runs).  The record stamps `cores` = os.cpu_count(): worker
+    processes only beat the in-process plane when they actually get
+    cores — on a 1-core box the fleet pays forwarding overhead for no
+    parallelism and the honest speedup is <= 1x (the >= 2.5x target
+    needs >= `groups` cores; docs/observability.md).  The comparison is
+    RECORDED, not gate-enforced; the gate tracks this phase's
+    commit-ack p50 round over round."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import loadtest
+
+    if smoke:
+        rps, duration_s = 160.0, 3.0
+    kw = dict(rps=rps, duration_s=duration_s, mode="closed",
+              workers=workers, seed=seed, warmup=25)
+    mp_report = loadtest.run_mp(groups=groups, standbys=0, **kw)
+    if baseline is None:
+        base = loadtest.run_inprocess(shards=groups, **kw)
+        baseline = {"achieved_rps": base["achieved_rps"],
+                    "p50_ms": float(base["commit_ack"]["p50_ms"] or 0.0),
+                    "commit_ack_p99_ms":
+                        float(base["commit_ack"]["p99_ms"] or 0.0)}
+    ack = mp_report["commit_ack"]
+    sharded_rps = baseline.get("achieved_rps", 0.0)
+    speedup = (mp_report["achieved_rps"] / sharded_rps
+               if sharded_rps else 0.0)
+    cores = os.cpu_count() or 1
+    mp_stats = mp_report.get("mp", {})
+    log(f"control plane mp ({groups} worker processes, {workers} "
+        f"clients, {cores} cores): {mp_report['achieved_rps']:.0f} rps "
+        f"through the front end, commit-ack p50 {ack['p50_ms']:.2f} ms "
+        f"/ p99 {ack['p99_ms']:.2f} ms — {speedup:.2f}x vs the "
+        f"in-process sharded plane at {sharded_rps:.0f} rps"
+        + ("" if cores >= groups else
+           f" (only {cores} core(s): forwarding overhead with no "
+           f"process parallelism — expect >= 2.5x at >= {groups} "
+           f"cores)"))
+    return {
+        "p50_ms": float(ack["p50_ms"] or 0.0),
+        "commit_ack_p99_ms": float(ack["p99_ms"] or 0.0),
+        "submits": ack["count"],
+        "groups": groups,
+        "workers": workers,
+        "cores": cores,
+        "target_rps": rps,
+        "achieved_rps": mp_report["achieved_rps"],
+        "errors": mp_report["errors"],
+        "rps_speedup_vs_sharded": speedup,
+        "per_worker": mp_stats.get("per_worker", {}),
+        "twopc": mp_stats.get("twopc", {}),
+        "sharded_baseline": {
+            "p50_ms": baseline.get("p50_ms", 0.0),
+            "commit_ack_p99_ms": baseline.get("commit_ack_p99_ms", 0.0),
+            "achieved_rps": sharded_rps,
+        },
+    }
+
+
 def make_elastic_problem(jnp, p, j, p_real=None, seed=6):
     """Padded capacity-plan inputs at any size — ONE construction for
     the full and smoke tiers (ops/elastic.py solve shapes)."""
@@ -1079,6 +1150,8 @@ def device_main():
     resident_phases = bench_match_resident()
     control_plane = bench_control_plane()
     control_plane_sharded = bench_control_plane_sharded()
+    control_plane_mp = bench_control_plane_mp(
+        baseline=control_plane_sharded)
     pipeline_phases = bench_pipeline(jax, jnp, n_pools=8, hosts_per_pool=96,
                                      jobs_per_pool=1536)
     speculation_phases = bench_speculation()
@@ -1099,6 +1172,7 @@ def device_main():
         **resident_phases,
         "control_plane": control_plane,
         "control_plane_sharded": control_plane_sharded,
+        "control_plane_mp": control_plane_mp,
         **pipeline_phases,
         **speculation_phases,
     }, headline), out=_record_out_arg())
@@ -1137,6 +1211,7 @@ def cpu_main():
         # measured at full scale even on the CPU fallback
         "control_plane": bench_control_plane(),
         "control_plane_sharded": bench_control_plane_sharded(),
+        "control_plane_mp": bench_control_plane_mp(),
         # the speculation A/B runs through the trace simulator on
         # whatever backend is live — full scale here too
         **bench_speculation(),
@@ -1251,6 +1326,13 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     # (parallel journal-segment fsyncs) is gate-tracked every CI run
     phases["control_plane_sharded"] = bench_control_plane_sharded(
         smoke=True)
+
+    # multi-process fleet (cook_tpu/mp/): same trace through the
+    # shard-aware front end over worker processes; speedup vs the
+    # in-process sharded phase above is recorded with a `cores` stamp
+    # (a 1-core box honestly records <= 1x)
+    phases["control_plane_mp"] = bench_control_plane_mp(
+        smoke=True, baseline=phases["control_plane_sharded"])
 
     # prediction-assisted speculative cycles: the completion-heavy A/B
     # (hit fraction + cycle-start-to-first-launch p50), tiny tier

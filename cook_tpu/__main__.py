@@ -18,11 +18,25 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int)
     parser.add_argument("--no-leader", action="store_true",
                         help="serve REST only (hot standby)")
+    parser.add_argument("--mp", type=int, metavar="N",
+                        help="multi-process mode: N shard-group worker "
+                             "processes behind a shard-aware front end "
+                             "(cook_tpu.mp)")
+    parser.add_argument("--mp-standbys", type=int, default=1,
+                        help="warm standby workers for --mp failover")
+    parser.add_argument("--mp-shards", type=int, default=None,
+                        help="global shard count for --mp "
+                             "(default: one shard per group)")
+    parser.add_argument("--data-dir", default=None,
+                        help="journal root for --mp "
+                             "(default: a temp dir)")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if args.mp:
+        return _mp_main(args)
     overrides = {}
     if args.port:
         overrides["port"] = args.port
@@ -47,6 +61,33 @@ def main(argv=None) -> int:
                 time.sleep(3600)
     finally:
         shutdown(process)
+    return 0
+
+
+def _mp_main(args) -> int:
+    """`python -m cook_tpu --mp 4`: supervised worker fleet + front
+    end, blocking until interrupted."""
+    import time
+
+    from cook_tpu.mp.supervisor import MpRuntime
+
+    runtime = MpRuntime(n_groups=args.mp, n_shards=args.mp_shards,
+                        data_dir=args.data_dir,
+                        standbys=args.mp_standbys)
+    workers = runtime.supervisor.workers
+    print(f"cook-tpu mp front end at {runtime.url} "
+          f"({len(workers)} shard-group workers, "
+          f"{args.mp_standbys} standby)", file=sys.stderr)
+    for g, handle in sorted(workers.items()):
+        print(f"  group {g}: {handle.describe['url']} "
+              f"shards={handle.describe['shards']}", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        runtime.stop()
     return 0
 
 

@@ -48,7 +48,9 @@ def _clients(args) -> list[tuple[ClusterConfig, JobClient]]:
         if not clusters:
             raise SystemExit(f"no cluster named {args.cluster} in config")
     user = args.user or os.environ.get("USER", "anonymous")
-    return [(c, JobClient(c.url, user=user)) for c in clusters]
+    direct = bool(getattr(args, "route_map", False))
+    return [(c, JobClient(c.url, user=user, direct_reads=direct))
+            for c in clusters]
 
 
 def _fan_out_query(args, uuids: Sequence[str]):
@@ -574,6 +576,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config", help="path to cluster config json")
     p.add_argument("--cluster", help="restrict to one named cluster")
     p.add_argument("--user", help="requesting user")
+    p.add_argument("--route-map", action="store_true", dest="route_map",
+                   help="shard-aware direct reads: fetch the route map "
+                        "from GET /debug/shards and poll the owning "
+                        "worker directly (mp fleets; falls back to the "
+                        "front end on staleness or a moved segment)")
     sub = p.add_subparsers(dest="subcommand", required=True)
 
     sp = sub.add_parser("submit", help="submit a job")

@@ -22,6 +22,9 @@ class JobClientError(Exception):
         self.status = status
 
 
+ROUTE_MAP_TTL_S = 10.0
+
+
 class JobClient:
     def __init__(
         self,
@@ -31,17 +34,109 @@ class JobClient:
         session: Optional[requests.Session] = None,
         retries: int = 3,
         retry_backoff_s: float = 0.2,
+        direct_reads: bool = False,
+        max_staleness_ms: float = 5000.0,
     ):
         self.url = url.rstrip("/")
         self.user = user
         self.session = session or requests.Session()
         self.retries = retries
         self.retry_backoff_s = retry_backoff_s
+        # shard-aware direct reads (the mp runtime, cook_tpu/mp/): the
+        # client fetches the route map from the front end's
+        # GET /debug/shards, remembers which shard-group owns each uuid
+        # (learned from the X-Cook-Shard-Group response header), and
+        # sends read polls (query/wait) straight to the owning worker —
+        # skipping the forwarding hop.  Any direct miss (connection
+        # error, 404/421 from a moved segment, a staleness header past
+        # `max_staleness_ms`) falls back to the front end and drops the
+        # cached mapping.  Off by default: against a single-process
+        # server /debug/shards 404s once and direct routing stays off.
+        self.direct_reads = direct_reads
+        self.max_staleness_ms = max_staleness_ms
+        self._route_map: Optional[dict] = None
+        self._route_map_at = 0.0
+        self._uuid_group: dict[str, int] = {}
 
     # ------------------------------------------------------------- plumbing
 
     def _headers(self) -> dict:
         return {"X-Cook-Requesting-User": self.user}
+
+    # ------------------------------------------------------ direct routing
+
+    def _group_url(self, group: Optional[int]) -> Optional[str]:
+        """The live worker url for a shard-group, from a TTL-cached
+        route map; None turns the caller into a front-end request."""
+        if group is None or not self.direct_reads:
+            return None
+        now = time.monotonic()
+        if self._route_map is None \
+                or now - self._route_map_at > ROUTE_MAP_TTL_S:
+            try:
+                resp = self.session.get(
+                    f"{self.url}/debug/shards",
+                    headers=self._headers(), timeout=10)
+                if resp.status_code != 200:
+                    self.direct_reads = False  # not an mp front end
+                    return None
+                self._route_map = resp.json()
+                self._route_map_at = now
+            except requests.RequestException:
+                return None
+        for entry in self._route_map.get("groups", []):
+            if entry["group"] == group:
+                return entry["url"] if entry.get("alive") else None
+        return None
+
+    def _learn_owner(self, resp, uuids: Sequence[str]) -> None:
+        """Remember uuid -> shard-group from the response header the
+        front end (and workers via it) stamp on every reply."""
+        if not self.direct_reads:
+            return
+        header = resp.headers.get("X-Cook-Shard-Group", "")
+        if not header or "," in header:  # multi-group (2PC) reply
+            return
+        try:
+            group = int(header)
+        except ValueError:
+            return
+        for uuid in uuids:
+            self._uuid_group[uuid] = group
+
+    def _drop_owner(self, uuids: Sequence[str]) -> None:
+        self._route_map = None  # refetch: the fleet may have failed over
+        for uuid in uuids:
+            self._uuid_group.pop(uuid, None)
+
+    def _direct_get(self, path: str, uuids: Sequence[str],
+                    **kw) -> Optional[requests.Response]:
+        """One direct read against the owning worker; None means route
+        through the front end instead (and on a miss the mapping is
+        dropped so the next poll re-learns)."""
+        groups = {self._uuid_group.get(u) for u in uuids}
+        if len(groups) != 1 or None in groups:
+            return None
+        base = self._group_url(groups.pop())
+        if base is None:
+            return None
+        try:
+            resp = self.session.get(f"{base}{path}",
+                                    headers=self._headers(),
+                                    timeout=30, **kw)
+        except requests.RequestException:
+            self._drop_owner(uuids)
+            return None
+        if resp.status_code in (404, 421) or resp.status_code >= 500:
+            # stale map: the segment moved (421 Misdirected / adopted
+            # elsewhere) or the worker is mid-failover
+            self._drop_owner(uuids)
+            return None
+        staleness = resp.headers.get("X-Cook-Staleness-Ms")
+        if staleness is not None \
+                and float(staleness) > self.max_staleness_ms:
+            return None
+        return resp
 
     def _request(self, method: str, path: str, **kw) -> Any:
         last_exc: Optional[Exception] = None
@@ -85,11 +180,17 @@ class JobClient:
         if groups:
             body["groups"] = list(groups)
         resp = self._request("POST", "/jobs", json=body)
-        return resp.json()["jobs"]
+        uuids = resp.json()["jobs"]
+        self._learn_owner(resp, uuids)
+        return uuids
 
     def query(self, uuids: Sequence[str]) -> list[dict]:
-        resp = self._request("GET", "/jobs",
-                             params=[("uuid", u) for u in uuids])
+        params = [("uuid", u) for u in uuids]
+        direct = self._direct_get("/jobs", uuids, params=params)
+        if direct is not None and direct.status_code < 400:
+            return direct.json()
+        resp = self._request("GET", "/jobs", params=params)
+        self._learn_owner(resp, uuids)
         return resp.json()
 
     def query_views(self, uuids: Sequence[str]) -> list[JobView]:
@@ -100,7 +201,12 @@ class JobClient:
         return InstanceView(self.query_instance(task_id))
 
     def query_one(self, uuid: str) -> dict:
-        return self._request("GET", f"/jobs/{uuid}").json()
+        direct = self._direct_get(f"/jobs/{uuid}", [uuid])
+        if direct is not None and direct.status_code < 400:
+            return direct.json()
+        resp = self._request("GET", f"/jobs/{uuid}")
+        self._learn_owner(resp, [uuid])
+        return resp.json()
 
     def query_instance(self, task_id: str) -> dict:
         return self._request("GET", f"/instances/{task_id}").json()

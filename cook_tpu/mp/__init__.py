@@ -1,0 +1,32 @@
+"""Multi-process shard-group runtime (ISSUE 16).
+
+The sharded control plane (cook_tpu/shard/) keeps every shard's lock,
+journal segment, and replication feed in ONE process, so the GIL caps
+the measured throughput win.  This package places shard-GROUPS in
+separate worker processes behind a shard-aware front end:
+
+  * `topology`   — shard -> group assignment + the route map the front
+    end serves at GET /debug/shards;
+  * `worker`     — the per-group process: only its shards' stores,
+    journal segments, idempotency tables, and replication feeds, the
+    existing REST surface plus an internal RPC port
+    (`python -m cook_tpu.mp.worker`);
+  * `twopc`      — cross-group transactions as a two-phase ordered
+    apply over RPC, decision-journaled by the coordinator;
+  * `router`     — the forwarding front end (connection pooling,
+    per-worker circuit breakers, header passthrough, 2PC coordinator);
+  * `supervisor` — spawns/monitors the worker fleet, promotes a standby
+    to adopt a dead worker's journal segments, plus the `MpRuntime`
+    harness loadtest/bench/chaos drive.
+"""
+from cook_tpu.mp.topology import (GroupShardRouter, ShardGroupTopology,
+                                  build_route_map, read_route_map,
+                                  write_route_map)
+
+__all__ = [
+    "GroupShardRouter",
+    "ShardGroupTopology",
+    "build_route_map",
+    "read_route_map",
+    "write_route_map",
+]

@@ -1,0 +1,653 @@
+"""Shard-aware front end: one public endpoint over the worker fleet.
+
+Clients talk to the front end exactly as they would a single-process
+control plane; it maps each request's key to its owning shard-group
+(`topology.ShardGroupTopology` over the same stable hash the workers
+use) and forwards it there, preserving the request's idempotency key
+(X-Cook-Txn-Id) and propagating the worker's staleness / replication
+headers back out.  What lands where:
+
+  * pool-keyed writes (POST /jobs) — split by pool; one group means a
+    raw forward, several means a cross-group 2PC
+    (`twopc.TwoPCCoordinator`);
+  * uuid-keyed requests (kill, /jobs/{uuid}, /retry, ...) — owner
+    resolved via a TTL cache backed by a parallel /rpc/resolve scatter;
+  * fleet-wide reads (/queue, /running, /list, /usage, ...) —
+    scatter-gather with a structural merge;
+  * meta-keyed ops (/pools, /settings, config) — the group owning the
+    META shard;
+  * GET /debug/shards — the route map, for shard-aware clients
+    (client/jobclient.py --route-map) that want to skip the hop.
+
+Forwarding rides one shared aiohttp session (connection pooling) with a
+per-worker `CircuitBreaker`: transport failures open the breaker and
+requests for that group fail fast with 503 + Retry-After until the
+cooldown's half-open probe closes it — a dead worker degrades ONLY the
+keys it owns.  The supervisor rewrites the route map on failover; the
+front end re-reads it on mtime change, clears its resolve cache, and
+replays outstanding 2PC decisions against the promoted standby's urls.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from cook_tpu.faults.breaker import BreakerParams, CircuitBreaker
+from cook_tpu.mp.topology import (ShardGroupTopology, read_route_map,
+                                  topology_of)
+from cook_tpu.mp.twopc import DecisionLog, TwoPCCoordinator
+from cook_tpu.txn.transaction import new_txn_id
+from cook_tpu.utils.metrics import global_registry
+
+log = logging.getLogger(__name__)
+
+_FWD_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, float("inf"))
+
+# request headers forwarded to workers / response headers propagated
+# back to the client, by prefix
+_HEADER_PREFIX = "X-Cook-"
+_RESP_EXTRA = ("Retry-After",)
+
+RESOLVE_TTL_S = 30.0
+MAP_CHECK_INTERVAL_S = 0.25
+
+# scatter-gather read routes: ask every alive group, merge structurally
+# (/pools is here because each worker registers only its OWNED pools —
+# the union is the cluster's pool list)
+SCATTER_ROUTES = frozenset({
+    "/queue", "/running", "/list", "/unscheduled_jobs",
+    "/stats/instances", "/usage", "/pools",
+})
+
+
+def _merge(a, b):
+    """Structural merge for scatter-gather replies: dicts union
+    (recursing on collisions), lists concatenate, numbers sum, anything
+    else keeps the first answer."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = _merge(out[k], v) if k in out else v
+        return out
+    if isinstance(a, list) and isinstance(b, list):
+        return a + b
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a + b
+    return a
+
+
+class _Reservoir:
+    """Bounded latency sample for /debug/frontend percentiles."""
+
+    def __init__(self, cap: int = 2048):
+        self.cap = cap
+        self.samples: list[float] = []
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            self.count += 1
+            if len(self.samples) < self.cap:
+                self.samples.append(value)
+            else:
+                self.samples[self.count % self.cap] = value
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self.samples:
+                return 0.0
+            ordered = sorted(self.samples)
+            return ordered[min(len(ordered) - 1,
+                               int(q * len(ordered)))]
+
+
+class FrontEnd:
+    """ServerThread-compatible (build_app) forwarding app."""
+
+    def __init__(self, route_map_path: Optional[str] = None, *,
+                 route_map: Optional[dict] = None,
+                 decision_log_path: Optional[str] = None,
+                 default_pool: str = "default",
+                 rpc_timeout_s: float = 10.0,
+                 forward_timeout_s: float = 30.0,
+                 breaker_params: Optional[BreakerParams] = None):
+        if route_map is None and route_map_path is None:
+            raise ValueError("need route_map or route_map_path")
+        self.route_map_path = route_map_path
+        self._map = route_map or read_route_map(route_map_path)
+        if self._map is None:
+            raise ValueError(f"no route map at {route_map_path}")
+        self._map_mtime = (os.path.getmtime(route_map_path)
+                           if route_map_path
+                           and os.path.exists(route_map_path) else 0.0)
+        self._map_checked = 0.0
+        self._map_lock = threading.Lock()
+        self.topology = topology_of(self._map)
+        self.default_pool = default_pool
+        self.forward_timeout_s = forward_timeout_s
+        self._session = None  # created on the app's loop
+        params = breaker_params or BreakerParams(
+            window=20, min_samples=5, error_threshold=0.5, cooldown_s=2.0)
+        self.breakers = {g: CircuitBreaker(f"worker-{g}", params)
+                         for g in range(self.topology.n_groups)}
+        decisions = DecisionLog(
+            decision_log_path
+            or os.path.join("/tmp", f"cook-2pc-{os.getpid()}.jsonl"))
+        self.coordinator = TwoPCCoordinator(
+            self._post_json, decisions, rpc_timeout_s=rpc_timeout_s)
+        self._resolve_cache: dict[str, tuple[int, float]] = {}
+        self._latency = {g: _Reservoir()
+                         for g in range(self.topology.n_groups)}
+        self._twopc_stats = {"commits": 0, "vetoes": 0, "errors": 0}
+        self._forward_seconds = global_registry.histogram(
+            "mp.forward_seconds",
+            "front-end forward round-trip seconds per shard-group",
+            buckets=_FWD_BUCKETS)
+        self._forwarded = global_registry.counter(
+            "mp.forwarded",
+            "front-end forwarded requests per group and outcome "
+            "(ok/error/breaker_open)")
+        self._resolves = global_registry.counter(
+            "mp.resolve.lookups",
+            "uuid -> owning-group resolutions per source "
+            "(cache/scatter/miss)")
+
+    # --------------------------------------------------------- route map
+
+    def _maybe_reload_map(self) -> None:
+        if not self.route_map_path:
+            return
+        now = time.monotonic()
+        with self._map_lock:
+            if now - self._map_checked < MAP_CHECK_INTERVAL_S:
+                return
+            self._map_checked = now
+        try:
+            mtime = os.path.getmtime(self.route_map_path)
+        except OSError:
+            return
+        if mtime == self._map_mtime:
+            return
+        new_map = read_route_map(self.route_map_path)
+        if new_map is None:
+            return
+        with self._map_lock:
+            self._map = new_map
+            self._map_mtime = mtime
+            # entity ownership may have moved with the segments
+            self._resolve_cache.clear()
+        log.info("route map reloaded (map_seq=%s)",
+                 new_map.get("map_seq"))
+        # finish any decision whose participant moved to a new url
+        asyncio.get_running_loop().create_task(
+            self.coordinator.replay(self._rpc_urls()))
+
+    def _entry(self, group: int) -> dict:
+        return self._map["groups"][group]
+
+    def _rpc_urls(self) -> dict[int, str]:
+        return {e["group"]: e["rpc_url"] for e in self._map["groups"]
+                if e.get("rpc_url")}
+
+    def _alive_groups(self) -> list[int]:
+        return [e["group"] for e in self._map["groups"] if e["alive"]]
+
+    # --------------------------------------------------------- transport
+
+    async def _ensure_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=64,
+                                               limit_per_host=16))
+        return self._session
+
+    async def _post_json(self, url: str, body: dict,
+                         timeout_s: float) -> tuple[int, dict]:
+        """The 2PC transport (twopc.PostFn)."""
+        import aiohttp
+
+        session = await self._ensure_session()
+        async with session.post(
+                url, json=body,
+                timeout=aiohttp.ClientTimeout(total=timeout_s)) as resp:
+            try:
+                payload = await resp.json()
+            except Exception:  # noqa: BLE001 — non-JSON reply
+                payload = {"ok": False,
+                           "error": (await resp.text())[:200]}
+            return resp.status, payload
+
+    async def _forward(self, group: int, request: web.Request, *,
+                       path: Optional[str] = None,
+                       body: Optional[bytes] = None) -> web.Response:
+        """Forward `request` to `group`'s worker, preserving X-Cook-*
+        headers both ways and stamping X-Cook-Shard-Group."""
+        import aiohttp
+
+        breaker = self.breakers[group]
+        if not breaker.allows_work():
+            self._forwarded.inc(1, {"group": str(group),
+                                    "outcome": "breaker_open"})
+            return web.json_response(
+                {"error": f"shard-group {group} unavailable "
+                          f"(circuit open)"},
+                status=503, headers={"Retry-After": "2",
+                                     "X-Cook-Shard-Group": str(group)})
+        entry = self._entry(group)
+        if not entry["alive"] or not entry["url"]:
+            self._forwarded.inc(1, {"group": str(group),
+                                    "outcome": "error"})
+            return web.json_response(
+                {"error": f"shard-group {group} has no live worker"},
+                status=503, headers={"Retry-After": "2",
+                                     "X-Cook-Shard-Group": str(group)})
+        target = entry["url"] + (path if path is not None
+                                 else request.path_qs)
+        headers = {k: v for k, v in request.headers.items()
+                   if k.startswith(_HEADER_PREFIX)
+                   or k == "Content-Type"}
+        if body is None and request.can_read_body:
+            body = await request.read()
+        session = await self._ensure_session()
+        t0 = time.perf_counter()
+        try:
+            async with session.request(
+                    request.method, target, data=body, headers=headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.forward_timeout_s)) as resp:
+                payload = await resp.read()
+                elapsed = time.perf_counter() - t0
+                breaker.note_success()
+                self._latency[group].add(elapsed)
+                self._forward_seconds.observe(elapsed,
+                                              {"group": str(group)})
+                self._forwarded.inc(1, {"group": str(group),
+                                        "outcome": "ok"})
+                out_headers = {
+                    k: v for k, v in resp.headers.items()
+                    if k.startswith(_HEADER_PREFIX) or k in _RESP_EXTRA}
+                out_headers["X-Cook-Shard-Group"] = str(group)
+                return web.Response(
+                    body=payload, status=resp.status,
+                    content_type=resp.content_type,
+                    headers=out_headers)
+        except Exception as e:  # noqa: BLE001 — transport failure, not
+            # an app error: the worker is unreachable
+            breaker.note_failure()
+            self._forwarded.inc(1, {"group": str(group),
+                                    "outcome": "error"})
+            return web.json_response(
+                {"error": f"shard-group {group} unreachable: "
+                          f"{type(e).__name__}"},
+                status=502, headers={"X-Cook-Shard-Group": str(group)})
+
+    # -------------------------------------------------------- resolution
+
+    async def _resolve_uuids(self, uuids) -> dict[str, int]:
+        """uuid -> owning group, TTL cache over a parallel
+        /rpc/resolve scatter.  Unknown uuids are absent from the
+        result."""
+        now = time.monotonic()
+        owners: dict[str, int] = {}
+        missing: list[str] = []
+        for uuid in uuids:
+            cached = self._resolve_cache.get(uuid)
+            if cached is not None and now - cached[1] < RESOLVE_TTL_S:
+                owners[uuid] = cached[0]
+                self._resolves.inc(1, {"source": "cache"})
+            else:
+                missing.append(uuid)
+        if not missing:
+            return owners
+        session = await self._ensure_session()
+        import aiohttp
+
+        query = "&".join(f"uuid={u}" for u in missing)
+
+        async def ask(group: int) -> tuple[int, dict]:
+            rpc = self._entry(group).get("rpc_url", "")
+            if not rpc:
+                return group, {}
+            try:
+                async with session.get(
+                        f"{rpc}/rpc/resolve?{query}",
+                        timeout=aiohttp.ClientTimeout(total=3.0)) as r:
+                    reply = await r.json()
+                    return group, reply.get("owned", {})
+            except Exception:  # noqa: BLE001 — dead worker: its keys
+                # resolve nowhere until the standby adopts
+                return group, {}
+
+        replies = await asyncio.gather(
+            *(ask(g) for g in self._alive_groups()))
+        for group, owned in replies:
+            for uuid in owned:
+                owners[uuid] = group
+                self._resolve_cache[uuid] = (group, now)
+                self._resolves.inc(1, {"source": "scatter"})
+        for uuid in missing:
+            if uuid not in owners:
+                self._resolves.inc(1, {"source": "miss"})
+        return owners
+
+    # ---------------------------------------------------------- handlers
+
+    async def post_jobs(self, request: web.Request) -> web.Response:
+        body_bytes = await request.read()
+        try:
+            body = json.loads(body_bytes or b"{}")
+        except ValueError:
+            return web.json_response({"error": "request body must be "
+                                               "valid JSON"}, status=400)
+        specs = body.get("jobs", [])
+        group_specs = body.get("groups", [])
+        by_group: dict[int, list] = {}
+        for spec in specs:
+            pool = spec.get("pool") or self.default_pool
+            by_group.setdefault(
+                self.topology.group_for_pool(pool), []).append(spec)
+        if len(by_group) <= 1:
+            # one owner: raw forward, headers (txn id) and body intact
+            g = next(iter(by_group), self.topology.meta_group)
+            return await self._forward(g, request, body=body_bytes)
+        # cross-group: pin uuids here so the per-group payloads are
+        # stable under 2PC replay
+        from cook_tpu.models.entities import new_uuid
+
+        for spec in specs:
+            spec.setdefault("uuid", new_uuid())
+        txn_id = request.headers.get("X-Cook-Txn-Id") or new_txn_id()
+        user = request.headers.get("X-Cook-Requesting-User", "")
+        lowest = min(by_group)
+        per_group = {
+            g: {"jobs": gspecs,
+                # explicit group specs ride the lowest group (the
+                # single-process plan's convention); other participants
+                # materialize implicit groups from job references
+                "groups": group_specs if g == lowest else []}
+            for g, gspecs in sorted(by_group.items())}
+        outcome = await self.coordinator.run(
+            txn_id=txn_id, op="jobs/submit", user=user,
+            per_group=per_group, rpc_urls=self._rpc_urls())
+        if not outcome["ok"]:
+            self._twopc_stats["vetoes" if outcome["status"] < 500
+                              else "errors"] += 1
+            return web.json_response({"error": outcome["error"]},
+                                     status=outcome["status"])
+        self._twopc_stats["commits"] += 1
+        uuids: list[str] = []
+        for g in sorted(outcome["results"]):
+            uuids.extend(
+                outcome["results"][g].get("result", {}).get("jobs", []))
+        return web.json_response(
+            {"jobs": uuids}, status=201,
+            headers={"X-Cook-Txn-Id": txn_id,
+                     "X-Cook-Shard-Group":
+                         ",".join(str(g) for g in sorted(per_group))})
+
+    async def delete_jobs(self, request: web.Request) -> web.Response:
+        uuids = request.query.getall("job", []) \
+            + request.query.getall("uuid", [])
+        owners = await self._resolve_uuids(uuids)
+        unknown = [u for u in uuids if u not in owners]
+        if unknown:
+            return web.json_response(
+                {"error": f"unknown jobs: {unknown}"}, status=404)
+        groups = sorted(set(owners.values()))
+        if len(groups) <= 1:
+            g = groups[0] if groups else self.topology.meta_group
+            return await self._forward(g, request)
+        txn_id = request.headers.get("X-Cook-Txn-Id") or new_txn_id()
+        user = request.headers.get("X-Cook-Requesting-User", "")
+        per_group = {g: {"uuids": [u for u in uuids if owners[u] == g]}
+                     for g in groups}
+        outcome = await self.coordinator.run(
+            txn_id=txn_id, op="jobs/kill", user=user,
+            per_group=per_group, rpc_urls=self._rpc_urls())
+        if not outcome["ok"]:
+            self._twopc_stats["vetoes" if outcome["status"] < 500
+                              else "errors"] += 1
+            return web.json_response({"error": outcome["error"]},
+                                     status=outcome["status"])
+        self._twopc_stats["commits"] += 1
+        return web.Response(status=204,
+                            headers={"X-Cook-Txn-Id": txn_id})
+
+    async def by_uuid(self, request: web.Request) -> web.Response:
+        """Requests keyed by entity uuid (path segment, query params, or
+        JSON body `job` field): resolve the owner, forward there."""
+        uuids = [u for u in (request.match_info.get("uuid"),) if u]
+        for param in ("uuid", "job", "instance"):
+            uuids.extend(request.query.getall(param, []))
+        body = None
+        if not uuids and request.can_read_body:
+            body = await request.read()
+            try:
+                parsed = json.loads(body or b"{}")
+                for field in ("job", "uuid", "jobs"):
+                    value = parsed.get(field)
+                    if isinstance(value, str):
+                        uuids.append(value)
+                    elif isinstance(value, list):
+                        uuids.extend(value)
+            except ValueError:
+                pass
+        if not uuids:
+            return await self._forward(self.topology.meta_group,
+                                       request, body=body)
+        owners = await self._resolve_uuids(uuids)
+        if not owners:
+            return web.json_response(
+                {"error": f"unknown entity: {uuids}"}, status=404)
+        groups = sorted(set(owners.values()))
+        if len(groups) > 1:
+            return web.json_response(
+                {"error": "entities span shard-groups; issue one "
+                          "request per group"}, status=400)
+        return await self._forward(groups[0], request, body=body)
+
+    async def by_user(self, request: web.Request) -> web.Response:
+        """share/quota: keyed by pool when given, else by user (the
+        ShardRouter plan's convention)."""
+        body = None
+        pool = request.query.get("pool")
+        user = request.query.get("user")
+        if request.can_read_body:
+            body = await request.read()
+            try:
+                parsed = json.loads(body or b"{}")
+                pool = pool or parsed.get("pool")
+                user = user or parsed.get("user")
+            except ValueError:
+                pass
+        if pool:
+            g = self.topology.group_for_pool(pool)
+        elif user:
+            g = self.topology.group_for_user(user)
+        else:
+            g = self.topology.meta_group
+        return await self._forward(g, request, body=body)
+
+    async def scatter(self, request: web.Request) -> web.Response:
+        """Fleet-wide read: ask every alive group, merge structurally,
+        stamp the WORST staleness seen (a merged read is only as fresh
+        as its stalest contributor)."""
+        alive = self._alive_groups()
+        replies = await asyncio.gather(
+            *(self._forward(g, request) for g in alive))
+        merged = None
+        worst_staleness = -1.0
+        errors = []
+        for g, resp in zip(alive, replies):
+            if resp.status >= 400:
+                errors.append(g)
+                continue
+            try:
+                part = json.loads(resp.body or b"null")
+            except ValueError:
+                continue
+            merged = part if merged is None else _merge(merged, part)
+            staleness = resp.headers.get("X-Cook-Staleness-Ms")
+            if staleness is not None:
+                worst_staleness = max(worst_staleness, float(staleness))
+        if merged is None:
+            return web.json_response(
+                {"error": f"no shard-group answered "
+                          f"(failed: {errors})"}, status=502)
+        headers = {}
+        if worst_staleness >= 0:
+            headers["X-Cook-Staleness-Ms"] = str(worst_staleness)
+        if errors:
+            headers["X-Cook-Partial-Groups"] = \
+                ",".join(str(g) for g in errors)
+        return web.json_response(merged, headers=headers)
+
+    async def to_meta(self, request: web.Request) -> web.Response:
+        return await self._forward(self.topology.meta_group, request)
+
+    async def get_metrics(self, request: web.Request) -> web.Response:
+        # the front end's OWN registry (forward/2pc/breaker series);
+        # worker registries are scraped at the workers
+        return web.Response(text=global_registry.render_prometheus(),
+                            content_type="text/plain")
+
+    async def get_debug_shards(self, request: web.Request) \
+            -> web.Response:
+        with self._map_lock:
+            route_map = dict(self._map)
+        route_map["breakers"] = {
+            str(g): b.state.value for g, b in self.breakers.items()}
+        return web.json_response(route_map)
+
+    async def get_debug_frontend(self, request: web.Request) \
+            -> web.Response:
+        per_group = {}
+        for g, reservoir in self._latency.items():
+            per_group[str(g)] = {
+                "forwarded": reservoir.count,
+                "p50_ms": round(reservoir.quantile(0.5) * 1e3, 3),
+                "p99_ms": round(reservoir.quantile(0.99) * 1e3, 3),
+                "breaker": self.breakers[g].state.value,
+                "alive": self._entry(g)["alive"],
+            }
+        return web.json_response({
+            "map_seq": self._map.get("map_seq"),
+            "n_groups": self.topology.n_groups,
+            "n_shards": self.topology.n_shards,
+            "per_group": per_group,
+            "twopc": dict(self._twopc_stats),
+            "resolve_cache": len(self._resolve_cache),
+        })
+
+    async def get_debug_health(self, request: web.Request) \
+            -> web.Response:
+        alive = self._alive_groups()
+        replies = await asyncio.gather(
+            *(self._forward(g, request) for g in alive))
+        per_group, worst = {}, 200
+        for g, resp in zip(alive, replies):
+            try:
+                per_group[str(g)] = json.loads(resp.body or b"{}")
+            except ValueError:
+                per_group[str(g)] = {"error": resp.status}
+            worst = max(worst, resp.status)
+        dead = [e["group"] for e in self._map["groups"]
+                if not e["alive"]]
+        if dead:
+            worst = max(worst, 503)
+        return web.json_response(
+            {"groups": per_group, "dead_groups": dead},
+            status=worst if worst != 200 else 200)
+
+    async def post_pool_move(self, request: web.Request) \
+            -> web.Response:
+        body = await request.read()
+        try:
+            parsed = json.loads(body or b"{}")
+        except ValueError:
+            parsed = {}
+        dest = parsed.get("pool", "")
+        uuids = parsed.get("jobs") or \
+            ([parsed["job"]] if parsed.get("job") else [])
+        owners = await self._resolve_uuids(uuids)
+        groups = sorted(set(owners.values()))
+        if dest and groups and \
+                any(self.topology.group_for_pool(dest) != g
+                    for g in groups):
+            # moving a job between shard-groups means moving it between
+            # journal segments — out of scope for this runtime
+            # (ROADMAP: cross-group rebalancing)
+            return web.json_response(
+                {"error": "pool-move across shard-groups is not "
+                          "supported by the mp runtime"}, status=501)
+        g = groups[0] if groups else self.topology.meta_group
+        return await self._forward(g, request, body=body)
+
+    # -------------------------------------------------------------- app
+
+    @web.middleware
+    async def _map_middleware(self, request: web.Request, handler):
+        self._maybe_reload_map()
+        return await handler(request)
+
+    def build_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._map_middleware])
+        r = app.router
+        for path in ("/rawscheduler", "/jobs"):
+            r.add_post(path, self.post_jobs)
+            r.add_delete(path, self.delete_jobs)
+            r.add_get(path, self.by_uuid)
+        r.add_get("/jobs/{uuid}", self.by_uuid)
+        r.add_get("/jobs/{uuid}/timeline", self.by_uuid)
+        r.add_get("/instances/{uuid}", self.by_uuid)
+        r.add_get("/instances", self.by_uuid)
+        r.add_delete("/instances", self.by_uuid)
+        r.add_get("/group", self.by_uuid)
+        r.add_delete("/group", self.by_uuid)
+        r.add_get("/retry", self.by_uuid)
+        r.add_post("/retry", self.by_uuid)
+        r.add_put("/retry", self.by_uuid)
+        r.add_get("/progress/{uuid}", self.by_uuid)
+        r.add_post("/progress/{uuid}", self.by_uuid)
+        r.add_post("/heartbeat/{uuid}", self.by_uuid)
+        r.add_post("/pool-move", self.post_pool_move)
+        for path in ("/share", "/quota"):
+            r.add_get(path, self.by_user)
+            r.add_post(path, self.by_user)
+            r.add_delete(path, self.by_user)
+        for path in sorted(SCATTER_ROUTES):
+            r.add_get(path, self.scatter)
+        r.add_get("/metrics", self.get_metrics)
+        r.add_get("/debug/shards", self.get_debug_shards)
+        r.add_get("/debug/frontend", self.get_debug_frontend)
+        r.add_get("/debug/health", self.get_debug_health)
+        # everything else (pools/settings/info/config/debug) lives on
+        # the meta group
+        r.add_route("*", "/{tail:.*}", self.to_meta)
+
+        async def _on_startup(app):
+            await self._ensure_session()
+            await self.coordinator.replay(self._rpc_urls())
+
+        async def _on_cleanup(app):
+            if self._session is not None:
+                await self._session.close()
+                self._session = None
+            self.coordinator.decisions.close()
+
+        app.on_startup.append(_on_startup)
+        app.on_cleanup.append(_on_cleanup)
+        return app

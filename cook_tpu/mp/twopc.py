@@ -1,0 +1,262 @@
+"""Cross-group transactions: two-phase ordered apply over RPC.
+
+This generalizes `cook_tpu/shard/txn.py`'s in-process discipline to
+workers in separate processes:
+
+  * ascending order — participants are contacted in ascending GROUP
+    order for both phases, the cross-process analog of the ascending
+    shard-lock acquisition that makes concurrent cross-shard commits
+    deadlock-free;
+  * all-or-nothing veto — prepare runs the full single-process
+    validation on every participant (rest/api.py `parse_submission`
+    for submits, existence + ownership for kills); ANY veto aborts the
+    whole transaction and the client sees the same 4xx a one-process
+    submit would have produced;
+  * single journaled decision — the coordinator appends
+    {"decision": "commit"} (fsynced) BEFORE sending any commit, and
+    {"decision": "done"} after every participant acknowledged.  A
+    decision with no "done" is replayed on reconnect/restart; no
+    decision means presumed abort (participants GC their staged
+    prepare after a TTL);
+  * idempotent replay — the commit RPC CARRIES the payload, so a
+    participant that lost its staged prepare (crash between phases, a
+    standby that adopted the segments) re-validates and applies from
+    the payload, while one that already applied answers from its
+    per-shard idempotency table.  Replaying a decision any number of
+    times converges.
+
+The coordinator is async (it lives on the front end's event loop); the
+injectable `post` transport is how tests drive veto/abort/replay paths
+without sockets.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import threading
+from typing import Awaitable, Callable, Optional
+
+from cook_tpu.utils.metrics import global_registry
+
+log = logging.getLogger(__name__)
+
+# cross-group txns pay two RPC rounds + the participants' fsyncs: ms to
+# seconds under fsync stalls
+_TXN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0, float("inf"))
+
+# transport: async (url, body_dict, timeout_s) -> (status:int, body:dict)
+PostFn = Callable[[str, dict, float], Awaitable[tuple]]
+
+
+class DecisionLog:
+    """The coordinator's write-ahead decision journal (jsonl).
+
+    Two record kinds per txn_id: the COMMIT decision (op, user, and the
+    per-group payload split — everything replay needs to re-send
+    commits) and the DONE marker once every participant acknowledged.
+    Append is flush+fsync: the decision must be durable before the
+    first commit RPC leaves, or a coordinator crash could leave some
+    participants committed with no record to finish the rest.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "a", encoding="utf-8")
+
+    def append(self, record: dict) -> None:
+        line = json.dumps(record, separators=(",", ":"))
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def outstanding(self) -> dict[str, dict]:
+        """Committed-but-not-done decisions, replayed at coordinator
+        start (and after failovers): txn_id -> decision record.
+        Tolerates a torn tail — a half-written line is a decision that
+        never became durable, i.e. presumed abort."""
+        pending: dict[str, dict] = {}
+        if not os.path.exists(self.path):
+            return pending
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    break  # torn tail: nothing after it is durable
+                txn_id = record.get("txn_id")
+                if record.get("decision") == "commit":
+                    pending[txn_id] = record
+                elif record.get("decision") == "done":
+                    pending.pop(txn_id, None)
+        return pending
+
+
+class TwoPCCoordinator:
+    """Drives prepare/decide/commit across worker RPC endpoints."""
+
+    def __init__(self, post: PostFn, decisions: DecisionLog, *,
+                 rpc_timeout_s: float = 10.0,
+                 commit_attempts: int = 3,
+                 retry_backoff_s: float = 0.2):
+        self.post = post
+        self.decisions = decisions
+        self.rpc_timeout_s = rpc_timeout_s
+        self.commit_attempts = commit_attempts
+        self.retry_backoff_s = retry_backoff_s
+        self._prepares = global_registry.counter(
+            "mp.txn.prepares",
+            "cross-group 2PC prepare RPCs, per outcome (ok/veto/error)")
+        self._commits = global_registry.counter(
+            "mp.txn.commits",
+            "cross-group 2PC commit RPCs, per outcome (ok/failed)")
+        self._aborts = global_registry.counter(
+            "mp.txn.aborts", "cross-group 2PC aborts sent to participants")
+        self._txn_seconds = global_registry.histogram(
+            "mp.txn.seconds",
+            "cross-group transaction wall seconds (first prepare sent -> "
+            "last commit acked), per op", buckets=_TXN_BUCKETS)
+
+    async def _rpc(self, rpc_url: str, method: str,
+                   body: dict) -> tuple[int, dict]:
+        try:
+            status, payload = await self.post(
+                f"{rpc_url}/rpc/2pc/{method}", body, self.rpc_timeout_s)
+            if not isinstance(payload, dict):
+                payload = {"ok": False, "error": f"non-JSON {method} reply"}
+            return status, payload
+        except Exception as e:  # noqa: BLE001 — transport failure is a
+            # participant outcome, not a coordinator crash
+            return 0, {"ok": False, "transport_error": True,
+                       "error": f"{type(e).__name__}: {e}"}
+
+    async def run(self, *, txn_id: str, op: str, user: str,
+                  per_group: dict[int, dict],
+                  rpc_urls: dict[int, str]) -> dict:
+        """One cross-group transaction.  Returns
+        {"ok": True, "results": {group: commit-reply},
+         "pending_groups": [...]} on commit (pending_groups lists
+        participants whose commit RPC kept failing — the decision
+        stands and replay finishes them), or
+        {"ok": False, "status": http-ish, "error": str} on veto/error.
+        """
+        import time as _time
+
+        groups = sorted(per_group)
+        t0 = _time.perf_counter()
+        prepared: list[int] = []
+        for g in groups:  # ascending group order, both phases
+            status, reply = await self._rpc(rpc_urls[g], "prepare", {
+                "txn_id": txn_id, "op": op, "user": user,
+                "payload": per_group[g]})
+            if not reply.get("ok"):
+                outcome = ("error" if reply.get("transport_error")
+                           or status >= 500 else "veto")
+                self._prepares.inc(1, {"outcome": outcome})
+                await self._abort(txn_id, prepared, rpc_urls)
+                return {"ok": False,
+                        "status": int(reply.get("status")
+                                      or (502 if outcome == "error"
+                                          else 400)),
+                        "error": reply.get("error", "prepare failed"),
+                        "vetoed_by": g}
+            self._prepares.inc(1, {"outcome": "ok"})
+            prepared.append(g)
+        # the single decision: durable BEFORE any participant applies
+        decision = {"txn_id": txn_id, "op": op, "user": user,
+                    "decision": "commit",
+                    "groups": {str(g): per_group[g] for g in groups},
+                    "rpc_urls": {str(g): rpc_urls[g] for g in groups}}
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.decisions.append, decision)
+        results, pending = await self._commit_all(txn_id, op, user,
+                                                  per_group, rpc_urls)
+        if not pending:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.decisions.append,
+                {"txn_id": txn_id, "decision": "done"})
+        self._txn_seconds.observe(_time.perf_counter() - t0, {"op": op})
+        return {"ok": True, "results": results,
+                "pending_groups": sorted(pending)}
+
+    async def _commit_all(self, txn_id: str, op: str, user: str,
+                          per_group: dict[int, dict],
+                          rpc_urls: dict[int, str]):
+        results: dict[int, dict] = {}
+        pending: set[int] = set()
+        for g in sorted(per_group):
+            reply = None
+            for attempt in range(self.commit_attempts):
+                _status, reply = await self._rpc(rpc_urls[g], "commit", {
+                    "txn_id": txn_id, "op": op, "user": user,
+                    "payload": per_group[g]})
+                if reply.get("ok"):
+                    break
+                await asyncio.sleep(self.retry_backoff_s * (attempt + 1))
+            if reply.get("ok"):
+                self._commits.inc(1, {"outcome": "ok"})
+                results[g] = reply
+            else:
+                # the decision stands; this participant applies on
+                # replay (or after a standby adopts its segments)
+                self._commits.inc(1, {"outcome": "failed"})
+                log.warning("2pc %s: commit to group %d failed (%s); "
+                            "left for replay", txn_id, g,
+                            reply.get("error"))
+                pending.add(g)
+        return results, pending
+
+    async def _abort(self, txn_id: str, prepared: list[int],
+                     rpc_urls: dict[int, str]) -> None:
+        """Best-effort abort of already-prepared participants (reverse
+        order — unwinding the ascending acquisition).  Participants
+        also GC staged prepares by TTL, so a lost abort only delays
+        cleanup (presumed abort: no decision record means the txn never
+        happened)."""
+        for g in reversed(prepared):
+            self._aborts.inc()
+            await self._rpc(rpc_urls[g], "abort", {"txn_id": txn_id})
+
+    async def replay(self, rpc_urls: Optional[dict[int, str]]
+                     = None) -> dict:
+        """Finish outstanding decisions (coordinator restart, worker
+        reconnect, post-failover).  Commits are idempotent on the
+        participants, so replaying a decision that already applied is a
+        duplicate answer, not a re-apply.  `rpc_urls` overrides the
+        endpoints recorded in the decision (a promoted standby serves
+        the dead worker's groups at a NEW url)."""
+        outstanding = await asyncio.get_running_loop().run_in_executor(
+            None, self.decisions.outstanding)
+        finished, still_pending = 0, 0
+        for txn_id, record in outstanding.items():
+            per_group = {int(g): payload
+                         for g, payload in record["groups"].items()}
+            urls = {int(g): url
+                    for g, url in (record.get("rpc_urls") or {}).items()}
+            if rpc_urls:
+                urls.update(rpc_urls)
+            _results, pending = await self._commit_all(
+                txn_id, record["op"], record.get("user", ""),
+                per_group, urls)
+            if pending:
+                still_pending += 1
+            else:
+                finished += 1
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.decisions.append,
+                    {"txn_id": txn_id, "decision": "done"})
+        return {"outstanding": len(outstanding), "finished": finished,
+                "still_pending": still_pending}

@@ -45,6 +45,7 @@ from cook_tpu.models.entities import (
 )
 from cook_tpu.models.reasons import _REASONS, REASONS_BY_CODE
 from cook_tpu.models.store import JobStore, TransactionVetoed
+from cook_tpu.shard.router import MisroutedKey
 from cook_tpu.obs.contention import (
     ContentionObservatory,
     ContentionParams,
@@ -827,6 +828,14 @@ class CookApi:
             raise
         except TransactionVetoed as e:
             response = _err(400, str(e))
+        except MisroutedKey as e:
+            # multi-process runtime (cook_tpu/mp/): this worker does not
+            # own the key's shard — a stale front-end route map or a
+            # client reading an old shard map.  421 (not 4xx-the-key):
+            # the entity may well exist, just not HERE; the caller
+            # refreshes its map (GET /debug/shards) and retries.
+            response = _err(421, str(e))
+            response.headers["X-Cook-Owner-Shard"] = str(e.owner_shard)
         except json.JSONDecodeError as e:
             response = _err(400, f"malformed JSON body: {e}")
         self._apply_cors(request, response)
@@ -1046,50 +1055,10 @@ class CookApi:
         if not self.submission_limiter.try_spend(user, len(specs)):
             return _err(429, "job submission rate limit exceeded")
 
-        groups: dict[str, Group] = {}
-        for gs in group_specs:
-            group, err = self._parse_group(gs)
-            if err:
-                return _err(400, err)
-            groups[group.uuid] = group
-
-        jobs = []
-        pools_counted: dict[str, int] = {}
-        for spec in specs:
-            pool = self.plugins.pool_selector.select_pool(
-                spec, self.config.default_pool
-            )
-            pool_ent = self.store.pools.get(pool)
-            if pool_ent is None or not pool_ent.accepts_submissions:
-                return _err(400, f"pool {pool} does not accept submissions")
-            result = self.plugins.validate_submission(spec, user, pool)
-            if not result.accepted:
-                return _err(400, result.message or "rejected by plugin")
-            spec = self.plugins.modify_submission(spec, user, pool)
-            try:
-                job, err = self._parse_job(spec, user, pool, groups)
-            except (ValueError, TypeError) as e:
-                # non-numeric mem/cpus/disk/ports etc.: a client error,
-                # not a server fault
-                job, err = None, f"malformed job field: {e}"
-            if err:
-                return _err(400, err)
-            # JobAdjusters (plugins/definitions.clj JobAdjuster, e.g. the
-            # pool mover) may rewrite the parsed job; an adjusted pool
-            # must still exist and accept work, else revert ONLY the pool
-            # (other adjusters' changes survive)
-            adjusted = self.plugins.adjust(job)
-            if adjusted.pool != job.pool:
-                dest = self.store.pools.get(adjusted.pool)
-                if dest is None or not dest.accepts_submissions:
-                    adjusted = adjusted.with_(pool=job.pool)
-            job = adjusted
-            jobs.append(job)
-            pools_counted[job.pool] = pools_counted.get(job.pool, 0) + 1
-        for pool, count in pools_counted.items():
-            limit_err = self.queue_limits.check_submission(user, pool, count)
-            if limit_err:
-                return _err(400, limit_err)
+        jobs, groups, parse_err = self.parse_submission(specs, group_specs,
+                                                        user)
+        if parse_err:
+            return _err(400, parse_err)
         import time as _time
 
         t_commit = _time.perf_counter()
@@ -1120,6 +1089,63 @@ class CookApi:
             # standby durability bound was not met — say so
             body["replicated"] = False
         return web.json_response(body, status=201)
+
+    def parse_submission(
+            self, specs: list, group_specs: list, user: str,
+    ) -> tuple[list, dict, Optional[str]]:
+        """Parse + validate one submit batch into entity objects:
+        group parsing, pool selection/acceptance, submission plugins,
+        job parsing/adjustment, and the per-pool queue-limit check.
+        Returns (jobs, groups, error) with error None on success — the
+        shared seam under POST /jobs and the mp runtime's 2PC prepare
+        phase (cook_tpu/mp/worker.py), which must veto with EXACTLY the
+        conditions a single-process submit would 400 on.  Rate limiting
+        and idempotency stay with the caller (they are per-entry-point,
+        not per-validation)."""
+        groups: dict[str, Group] = {}
+        for gs in group_specs:
+            group, err = self._parse_group(gs)
+            if err:
+                return [], {}, err
+            groups[group.uuid] = group
+        jobs = []
+        pools_counted: dict[str, int] = {}
+        for spec in specs:
+            pool = self.plugins.pool_selector.select_pool(
+                spec, self.config.default_pool
+            )
+            pool_ent = self.store.pools.get(pool)
+            if pool_ent is None or not pool_ent.accepts_submissions:
+                return [], {}, f"pool {pool} does not accept submissions"
+            result = self.plugins.validate_submission(spec, user, pool)
+            if not result.accepted:
+                return [], {}, result.message or "rejected by plugin"
+            spec = self.plugins.modify_submission(spec, user, pool)
+            try:
+                job, err = self._parse_job(spec, user, pool, groups)
+            except (ValueError, TypeError) as e:
+                # non-numeric mem/cpus/disk/ports etc.: a client error,
+                # not a server fault
+                job, err = None, f"malformed job field: {e}"
+            if err:
+                return [], {}, err
+            # JobAdjusters (plugins/definitions.clj JobAdjuster, e.g. the
+            # pool mover) may rewrite the parsed job; an adjusted pool
+            # must still exist and accept work, else revert ONLY the pool
+            # (other adjusters' changes survive)
+            adjusted = self.plugins.adjust(job)
+            if adjusted.pool != job.pool:
+                dest = self.store.pools.get(adjusted.pool)
+                if dest is None or not dest.accepts_submissions:
+                    adjusted = adjusted.with_(pool=job.pool)
+            job = adjusted
+            jobs.append(job)
+            pools_counted[job.pool] = pools_counted.get(job.pool, 0) + 1
+        for pool, count in pools_counted.items():
+            limit_err = self.queue_limits.check_submission(user, pool, count)
+            if limit_err:
+                return [], {}, limit_err
+        return jobs, groups, None
 
     def _parse_job(self, spec: dict, user: str, pool: str,
                    groups: dict[str, Group]) -> tuple[Optional[Job], Optional[str]]:

@@ -413,6 +413,22 @@ class Scheduler:
     def _backfill_active(self) -> bool:
         return self.config.backfill_weight > 0 and self.predictor is not None
 
+    def _pool_store(self, pool: Pool):
+        """The store a per-pool cycle should read: a sharded (or mp
+        shard-group) store exposes `store_for_pool`, pinning the cycle
+        to the pool's own shard — one snapshot, no facade fan-out and
+        no cross-shard lock traffic mid-cycle.  Plain JobStores return
+        themselves."""
+        pinned = getattr(self.store, "store_for_pool", None)
+        if pinned is None:
+            return self.store
+        try:
+            return pinned(pool.name)
+        except Exception:  # noqa: BLE001 — a pool this process does
+            # not serve (MisroutedKey): fall back to the facade, which
+            # raises the precise error at the access site
+            return self.store
+
     def rank_cycle(self, pool: Pool) -> RankedQueue:
         # offensive-job filter: quarantine jobs no host in the pool could
         # ever hold (scheduler.clj:2198-2257)
@@ -433,7 +449,7 @@ class Scheduler:
             from cook_tpu.scheduler.ranking_columnar import rank_pool_columnar
 
             queue = rank_pool_columnar(
-                self.store, self.columnar, pool,
+                self._pool_store(pool), self.columnar, pool,
                 capacity_limits=((max_mem, max_cpus, max_gpus)
                                  if limits_active else None),
                 device_state=dru_state,
@@ -445,7 +461,7 @@ class Scheduler:
             filt = (offensive_job_filter(max_mem, max_cpus, max_gpus)
                     if limits_active else None)
             queue = rank_pool(
-                self.store, pool, offensive_job_filter=filt,
+                self._pool_store(pool), pool, offensive_job_filter=filt,
                 predictor=(self.predictor if self._backfill_active
                            else None),
                 backfill_weight=self.config.backfill_weight,
@@ -552,7 +568,7 @@ class Scheduler:
                                                   flight)
         if outcome is None:
             outcome = match_pool(
-                self.store,
+                self._pool_store(pool),
                 pool,
                 queue,
                 self.clusters,

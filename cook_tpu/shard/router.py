@@ -27,6 +27,28 @@ from typing import Optional, Sequence
 META_SHARD = 0
 
 
+class MisroutedKey(Exception):
+    """A key whose owning shard this process does not serve.
+
+    Raised by the multi-process runtime's group-scoped router
+    (cook_tpu/mp/topology.py) when a request reaches a worker that owns
+    only a subset of the global shard space — the symptom of a stale
+    front-end route map or a client bypassing the front end with an old
+    shard map.  The REST layer answers it with 421 Misdirected Request
+    plus the owning shard, so the caller can refresh its map and retry
+    instead of silently writing the key into the wrong journal segment.
+    """
+
+    def __init__(self, key: str, owner_shard: int,
+                 owned: Sequence[int] = ()):
+        self.key = key
+        self.owner_shard = owner_shard
+        self.owned = tuple(owned)
+        super().__init__(
+            f"{key} routes to shard {owner_shard}, which this process "
+            f"does not serve (serving shards {list(self.owned)})")
+
+
 def _stable_hash(key: str) -> int:
     return zlib.crc32(key.encode("utf-8")) & 0xFFFFFFFF
 

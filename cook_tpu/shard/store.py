@@ -106,6 +106,27 @@ class _MergedView:
         return out
 
 
+class _PinnedPoolStore:
+    """One pool's shard, seen as a full store (`store_for_pool`).
+
+    Everything delegates to the shard — a per-pool cycle's reads and
+    instance writes are pool-keyed, so they land on the right shard by
+    construction — EXCEPT `groups`, which stays the owning facade's
+    merged view: group entities ride the lowest shard of their
+    submission plan and may not live on this pool's shard."""
+
+    __slots__ = ("_shard", "_facade")
+
+    def __init__(self, shard: JobStore, facade: "ShardedStore"):
+        object.__setattr__(self, "_shard", shard)
+        object.__setattr__(self, "_facade", facade)
+
+    def __getattr__(self, name):
+        if name == "groups":
+            return self._facade.groups
+        return getattr(self._shard, name)
+
+
 class ShardedStore:
     """The partitioned control-plane store (see module docstring)."""
 
@@ -113,7 +134,11 @@ class ShardedStore:
                  clock: Callable[[], int] = None,
                  router: Optional[ShardRouter] = None,
                  shards: Optional[Sequence[JobStore]] = None):
-        if n_shards < 2:
+        if n_shards < 2 and router is None:
+            # a 1-shard facade is only meaningful with an explicit
+            # router: the mp runtime's workers (cook_tpu/mp/) wrap ONE
+            # global shard behind a group-scoped router so misrouted
+            # keys are detected instead of silently applied locally
             raise ValueError("ShardedStore needs >= 2 shards; use a plain "
                              "JobStore for 1")
         self.n_shards = n_shards
@@ -166,6 +191,16 @@ class ShardedStore:
 
     def shard_for_pool(self, pool: str) -> JobStore:
         return self.shards[self.router.shard_for_pool(pool)]
+
+    def store_for_pool(self, pool: str) -> "_PinnedPoolStore":
+        """The pool's owning shard, pinned for a per-pool match/rank
+        cycle (scheduler/core.py): snapshot reads and instance writes
+        touch exactly one shard lock instead of the merged facade, so
+        the cycle's encode cache / device-state mirror see one shard's
+        event stream.  `groups` stays the merged view — a group entity
+        rides the LOWEST shard of its submission plan, which may not be
+        the pool's shard (matcher group-placement constraints)."""
+        return _PinnedPoolStore(self.shard_for_pool(pool), self)
 
     def shard_of_job(self, job_uuid: str) -> Optional[JobStore]:
         for shard in self.shards:

@@ -42,6 +42,7 @@ def test_smoke_emits_structured_record(smoke_record):
     assert set(on_disk["phases"]) == {"match", "dru", "rebalance",
                                       "elastic_plan", "control_plane",
                                       "control_plane_sharded",
+                                      "control_plane_mp",
                                       "match_xl", "match_xl_coarse",
                                       "match_xl_fine", "match_xl_refine",
                                       "speculation", "match_resident",
@@ -74,6 +75,15 @@ def test_smoke_emits_structured_record(smoke_record):
     assert set(sharded["per_shard"]) == {"0", "1", "2", "3"}
     assert sharded["single_shard"]["achieved_rps"] > 0
     assert sharded["rps_speedup_vs_single"] > 0
+    # the multi-process phase (cook_tpu/mp/) records the worker count,
+    # the speedup vs the in-process sharded baseline, and the `cores`
+    # stamp that makes a 1-core record honest (recorded, not gated:
+    # the >=2.5x target needs real cores — see observability.md)
+    mp = record["phases"]["control_plane_mp"]
+    assert mp["errors"] == 0 and mp["submits"] > 0
+    assert mp["groups"] >= 2 and mp["cores"] >= 1
+    assert mp["rps_speedup_vs_sharded"] > 0
+    assert set(mp["per_worker"]) and mp["sharded_baseline"]["achieved_rps"] > 0
 
 
 def test_smoke_match_holds_packing_parity(smoke_record):

@@ -663,6 +663,105 @@ def scenario_wedged_shard() -> list:
         cp.stop()
 
 
+def scenario_killed_worker() -> list:
+    """SIGKILL one shard-group WORKER PROCESS mid-traffic -> only that
+    group's keys degrade (the other worker keeps acking submits at 201
+    throughout) -> the supervisor promotes a standby, which adopts the
+    dead worker's journal segments -> the killed group serves again and
+    EVERY acked submit — including ones acked moments before the kill —
+    reads back through the front end.  The multi-process analog of
+    wedged-shard: process death instead of a wedged fsync, standby
+    adoption instead of in-place recovery."""
+    import signal as _signal
+
+    from cook_tpu.mp.supervisor import MpRuntime
+
+    steps = []
+    n_groups = 2
+    victim = 0
+    runtime = MpRuntime(n_groups=n_groups, standbys=1, poll_s=0.3)
+    acked: dict[str, list] = {}
+    try:
+        pools = [p for p in runtime.pools if p != "default"]
+        url = runtime.url
+
+        def submit(pool: str, uuid: str, timeout: float = 10.0) -> int:
+            status, _ = _post(f"{url}/jobs", {"jobs": [{
+                "uuid": uuid, "command": "true", "mem": 64,
+                "cpus": 0.1, "pool": pool}]}, timeout=timeout)
+            if status == 201:
+                acked.setdefault(pool, []).append(uuid)
+            return status
+
+        # baseline: both groups acking
+        for i in range(4):
+            for pool in pools:
+                _check(submit(pool, f"kw-{pool}-{i:02d}") == 201,
+                       f"baseline submit to {pool} failed")
+        victim_pool, healthy_pool = pools[victim], pools[1 - victim]
+        baseline = sum(len(v) for v in acked.values())
+        steps.append(f"baseline: {baseline} submits acked across "
+                     f"{n_groups} worker processes")
+
+        runtime.supervisor.kill_worker(victim, _signal.SIGKILL)
+
+        # blast radius: the healthy group keeps acking while the
+        # victim's keys fail (fast 5xx via breaker/dead-map, or a
+        # transport error) until the standby adopts
+        degraded = False
+        for i in range(20):
+            _check(submit(healthy_pool, f"kw-live-{i:02d}",
+                          timeout=5.0) == 201,
+                   f"healthy group stopped acking after the kill "
+                   f"(submit {i})")
+            status = submit(victim_pool, f"kw-dead-{i:02d}",
+                            timeout=3.0)
+            if status != 201:
+                degraded = True
+            time.sleep(0.05)
+        _check(degraded, "killing a worker degraded nothing — the "
+                         "drill saw no blast radius at all")
+        steps.append(f"SIGKILL group {victim}: only pool "
+                     f"{victim_pool!r} degraded; {healthy_pool!r} "
+                     f"acked every submit throughout")
+
+        # supervisor: standby adopts the dead worker's segments
+        def adopted():
+            _, _, shards = _get(f"{url}/debug/shards")
+            groups = shards.get("groups", [])
+            return (shards.get("map_seq", 0) >= 3
+                    and all(e["alive"] for e in groups) and shards)
+        shards = _wait_until(adopted, timeout_s=60.0,
+                             what="standby adoption in the route map")
+        steps.append(f"standby adopted group {victim}'s journal "
+                     f"segments (map_seq {shards['map_seq']})")
+
+        # recovery: the victim pool acks again...
+        def victim_acks():
+            return submit(victim_pool, f"kw-post-{int(time.monotonic()*1e3)%100000}",
+                          timeout=5.0) == 201
+        _wait_until(victim_acks, timeout_s=30.0, interval_s=0.3,
+                    what="the adopted group to ack submits")
+        # ...and NO acked txn was lost: every 201 ever returned reads
+        # back through the front end, including pre-kill acks whose
+        # only durable copy was the dead worker's journal segment
+        missing = []
+        for pool, uuids in acked.items():
+            for uuid in uuids:
+                status, _, _ = _get(f"{url}/jobs/{uuid}")
+                if status != 200:
+                    missing.append(uuid)
+        _check(not missing,
+               f"acked submits lost across worker death: {missing}")
+        total = sum(len(v) for v in acked.values())
+        steps.append(f"recovery: adopted group acks; all {total} acked "
+                     f"submits (both groups) read back — no acked txn "
+                     f"lost")
+        return steps
+    finally:
+        runtime.stop()
+
+
 SCENARIOS = {
     "fsync-stall-sheds": scenario_fsync_stall_sheds,
     "launch-breaker": scenario_launch_breaker,
@@ -671,12 +770,14 @@ SCENARIOS = {
     "replication-lag": scenario_replication_lag,
     "failover-fsync": scenario_failover_fsync,
     "wedged-shard": scenario_wedged_shard,
+    "killed-worker": scenario_killed_worker,
 }
 
 # the fast set ci_checks runs on every build (the original trio plus
-# the sharded control plane's blast-radius drill)
+# the sharded control plane's blast-radius drill and the mp runtime's
+# worker-death drill)
 SMOKE = ("fsync-stall-sheds", "launch-breaker", "device-fallback",
-         "wedged-shard")
+         "wedged-shard", "killed-worker")
 
 
 def run_scenario(name: str) -> ScenarioResult:

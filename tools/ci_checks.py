@@ -30,7 +30,13 @@ wrapper runs them as one pipeline with one verdict:
      against a 4-shard partitioned plane (cook_tpu/shard/) at
      concurrency, with a concurrency-matched single-shard baseline
      recorded alongside (`single_shard` / `rps_speedup_vs_single`) so
-     the sharded-vs-single comparison is measured every run; the gate
+     the sharded-vs-single comparison is measured every run,
+     AND the `control_plane_mp` phase — the same trace through the
+     MULTI-PROCESS fleet (cook_tpu/mp/: shard-group worker processes
+     behind the forwarding front end, 2PC in the measured path), with
+     `rps_speedup_vs_sharded` against the in-process sharded phase and
+     a `cores` stamp recorded alongside (the speedup claim only means
+     anything with >= as many cores as workers); the gate
      enforces the sharded run's commit-ack p50 round over round (writes
      BENCH_rsmoke.json, rotating the previous record to
      BENCH_rsmoke_prev.json so step 3 has a pair to diff);
@@ -41,7 +47,10 @@ wrapper runs them as one pipeline with one verdict:
      accelerator record);
   4. `tools/chaos.py --smoke`  — the fast chaos set (fsync stall ->
      shed, launch failures -> breaker, device error -> CPU fallback,
-     wedged shard -> single-shard blast radius + mid-drill failover):
+     wedged shard -> single-shard blast radius + mid-drill failover,
+     killed worker -> SIGKILL one shard-group process mid-traffic:
+     only its keys degrade, a standby adopts its journal segments, no
+     acked txn lost):
      each scenario injects its fault, observes the /debug/health reason
      AND the automatic reaction, then asserts full recovery invariants
      (docs/resilience.md);

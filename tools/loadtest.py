@@ -376,6 +376,52 @@ def run_inprocess(shards: int = 1, **kw) -> dict:
         plane.stop()
 
 
+def run_mp(groups: int = 4, standbys: int = 1, *,
+           inprocess: bool = False, **kw) -> dict:
+    """Multi-process form: an `MpRuntime` fleet (one worker process per
+    shard-group behind the shard-aware front end) driven through the
+    front end's public port — forwarding, 2PC, and breaker costs are
+    all in the measured path.  Traffic rides one pool per GROUP
+    (`pools_for_distinct_groups`), so the per-worker breakdown in
+    report["mp"] covers every worker.  What bench.py's
+    `control_plane_mp` phase wraps; `inprocess=True` embeds the workers
+    (tier-1 tests — no subprocess boots)."""
+    import urllib.request as _url
+
+    from cook_tpu.mp.supervisor import MpRuntime
+    from cook_tpu.mp.topology import ShardGroupTopology
+
+    pools = ShardGroupTopology(groups, groups).pools_for_distinct_groups()
+    kw.setdefault("pools", pools)
+    runtime = MpRuntime(n_groups=groups, standbys=standbys,
+                        inprocess=inprocess,
+                        pools=("default", *pools))
+    try:
+        report = run_loadtest(runtime.url, **kw)
+        # per-worker accounting from the front end's own ledger:
+        # forwarded counts + forwarded-request percentiles per group
+        req = _url.Request(runtime.url + "/debug/frontend")
+        with _url.urlopen(req, timeout=10) as r:
+            front = json.loads(r.read())
+        wall = max(report["duration_s"], 1e-6)
+        per_worker = {}
+        for g, row in front.get("per_group", {}).items():
+            per_worker[g] = {
+                "forwarded": row["forwarded"],
+                "rps": round(row["forwarded"] / wall, 1),
+                "forward_p50_ms": row["p50_ms"],
+                "forward_p99_ms": row["p99_ms"],
+                "breaker": row["breaker"],
+            }
+        report["mp"] = {"groups": groups,
+                        "map_seq": front.get("map_seq"),
+                        "per_worker": per_worker,
+                        "twopc": front.get("twopc", {})}
+        return report
+    finally:
+        runtime.stop()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="sustained control-plane load harness")
@@ -400,6 +446,12 @@ def main(argv=None) -> int:
                              "control plane (one traffic pool per "
                              "shard; per-shard breakdown in the "
                              "summary)")
+    parser.add_argument("--mp", type=int, default=0, metavar="N",
+                        help="drive an N-worker multi-process fleet "
+                             "through its front end (one traffic pool "
+                             "per worker; per-worker RPS and "
+                             "forwarded-request p99 in the summary)")
+    parser.add_argument("--mp-standbys", type=int, default=1)
     parser.add_argument("--out", default="",
                         help="write the JSON report here too")
     args = parser.parse_args(argv)
@@ -409,7 +461,12 @@ def main(argv=None) -> int:
               workers=args.workers, mix=mix, n_users=args.users,
               seed=args.seed, pool=args.pool,
               log=lambda *a: print(*a, file=sys.stderr))
-    if args.smoke:
+    if args.mp:
+        if args.smoke:
+            kw.update(rps=min(args.rps, 40.0),
+                      duration_s=min(args.duration, 2.0))
+        report = run_mp(groups=args.mp, standbys=args.mp_standbys, **kw)
+    elif args.smoke:
         kw.update(rps=min(args.rps, 40.0), duration_s=min(args.duration, 2.0))
         report = run_inprocess(shards=args.shards, **kw)
     elif args.url:
@@ -424,6 +481,8 @@ def main(argv=None) -> int:
         # the trend next to the hottest-shard attribution: a mid-run
         # regression reads as a slope here, not just a final percentile
         summary["commit_ack_trend"] = report["commit_ack_trend"]
+    if "mp" in report:
+        summary["mp"] = report["mp"]
     print(json.dumps(summary))
     if args.out:
         with open(args.out, "w") as f:
