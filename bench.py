@@ -801,7 +801,9 @@ def bench_control_plane_sharded(*, rps=300.0, duration_s=8.0, seed=13,
     here as higher achieved RPS at equal-or-lower commit-ack p50
     (~1.04x on this in-process rig, where the GIL caps the win; the
     comparison is RECORDED, not gate-enforced — tools/bench_gate.py
-    gates the sharded run's p50 round over round like any phase)."""
+    gates the sharded run's p50 round over round like any phase.  The
+    mp phase's fleet-vs-sharded speedup, by contrast, DOES self-gate
+    once the recorded core count clears bench_gate.MP_GATE_MIN_CORES)."""
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tools"))
     import loadtest
@@ -862,9 +864,11 @@ def bench_control_plane_mp(*, rps=300.0, duration_s=8.0, seed=13,
     processes only beat the in-process plane when they actually get
     cores — on a 1-core box the fleet pays forwarding overhead for no
     parallelism and the honest speedup is <= 1x (the >= 2.5x target
-    needs >= `groups` cores; docs/observability.md).  The comparison is
-    RECORDED, not gate-enforced; the gate tracks this phase's
-    commit-ack p50 round over round."""
+    needs >= `groups` cores; docs/observability.md).  The comparison
+    SELF-GATES in tools/bench_gate.py when the recorded `cores` >= 4
+    (speedup must reach 2.5x); below that core floor it stays recorded,
+    not gated.  The gate also tracks this phase's commit-ack p50 round
+    over round, skipping pairs recorded on differing core counts."""
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tools"))
     import loadtest

@@ -334,6 +334,16 @@ def cmd_timeline(args) -> int:
             line = f"completed on {event.get('host', '?')}"
         elif kind == "re-queued":
             line = "re-queued (waiting again)"
+        elif kind == "2pc-commit-decision":
+            prepares = ", ".join(
+                f"g{g} {ms:.1f}ms" for g, ms in sorted(
+                    (event.get("prepare_ms") or {}).items()))
+            line = (f"2PC commit decision across groups "
+                    f"{event.get('groups')} (txn {event.get('txn_id')}"
+                    + (f"; prepare {prepares}" if prepares else "") + ")")
+        elif kind == "2pc-done":
+            line = (f"2PC done across groups {event.get('groups')} "
+                    f"(txn {event.get('txn_id')})")
         else:
             line = json.dumps(event)
         print(f"  +{offset:>8}  {line}")
@@ -470,6 +480,53 @@ def cmd_fleet(args) -> int:
         if status != "ok":
             rc = 1
     return rc
+
+
+def cmd_trace(args) -> int:
+    """Render one transaction's merged cross-process trace as a text
+    waterfall (GET /debug/trace?txn_id=; against the mp front end the
+    body federates the front-end, coordinator, and worker slices)."""
+    width = 40
+    for cluster, client in _clients(args):
+        body = client.trace(args.txn_id)
+        spans = body.get("spans") or []
+        if not spans:
+            continue
+        if args.json:
+            print(json.dumps({"cluster": cluster.name, **body}, indent=2))
+            return 0
+        starts = [s["t"] - s.get("duration_s", 0.0) for s in spans]
+        t0 = min(starts)
+        window = max(max(s["t"] for s in spans) - t0, 1e-9)
+        procs = [str(s.get("process") or
+                     (s.get("tags") or {}).get("process") or "?")
+                 for s in spans]
+        proc_w = max(len(p) for p in procs)
+        name_w = max(len(s["name"]) for s in spans)
+        print(f"{args.txn_id}: {len(spans)} spans, "
+              f"{len(set(procs))} process(es), "
+              f"{window * 1000:.1f}ms window (cluster {cluster.name})")
+        for proc, start, s in zip(procs, starts, spans):
+            dur = s.get("duration_s", 0.0)
+            lead = int((start - t0) / window * width)
+            if dur <= 0.0:  # record_event marker (veto, replication ack)
+                bar = " " * min(lead, width - 1) + "·"
+                stamp = "event"
+            else:
+                fill = max(1, round(dur / window * width))
+                bar = " " * min(lead, width - fill) + "█" * fill
+                stamp = f"{dur * 1000:.2f}ms"
+            mark = "  !error" if (s.get("tags") or {}).get("error") else ""
+            print(f"  {proc:<{proc_w}}  {s['name']:<{name_w}}  "
+                  f"|{bar:<{width}}|  {stamp}{mark}")
+        failed = body.get("groups_failed")
+        if failed:
+            print(f"  (groups unreachable during collection: {failed})")
+        return 0
+    print(f"{args.txn_id}: no spans retained on any cluster "
+          f"(span rings are finite — trace soon after the request)",
+          file=sys.stderr)
+    return 1
 
 
 def cmd_usage(args) -> int:
@@ -635,6 +692,14 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("uuid")
     q.add_argument("--json", action="store_true")
     q.set_defaults(fn=cmd_timeline)
+
+    q = sub.add_parser(
+        "trace",
+        help="render one transaction's merged cross-process trace "
+             "(GET /debug/trace?txn_id=) as a text waterfall")
+    q.add_argument("txn_id")
+    q.add_argument("--json", action="store_true")
+    q.set_defaults(fn=cmd_trace)
 
     q = sub.add_parser(
         "history",

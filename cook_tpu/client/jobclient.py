@@ -271,6 +271,14 @@ class JobClient:
         per node, peer staleness, federation reasons)."""
         return self._request("GET", "/debug/fleet").json()
 
+    def trace(self, txn_id: str) -> dict:
+        """GET /debug/trace?txn_id=: one transaction's merged
+        cross-process trace (raw span records; the mp front end
+        federates worker slices, a single node serves its own ring)."""
+        return self._request("GET", "/debug/trace",
+                             params={"txn_id": txn_id,
+                                     "format": "raw"}).json()
+
     def unscheduled_reasons(self, uuid: str) -> list[dict]:
         resp = self._request("GET", "/unscheduled_jobs",
                              params={"job": uuid})

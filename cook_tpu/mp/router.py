@@ -43,7 +43,10 @@ from cook_tpu.faults.breaker import BreakerParams, CircuitBreaker
 from cook_tpu.mp.topology import (ShardGroupTopology, read_route_map,
                                   topology_of)
 from cook_tpu.mp.twopc import DecisionLog, TwoPCCoordinator
+from cook_tpu.obs import distributed
+from cook_tpu.obs.incident import IncidentRecorder, add_default_collectors
 from cook_tpu.txn.transaction import new_txn_id
+from cook_tpu.utils import tracing
 from cook_tpu.utils.metrics import global_registry
 
 log = logging.getLogger(__name__)
@@ -144,12 +147,27 @@ class FrontEnd:
         decisions = DecisionLog(
             decision_log_path
             or os.path.join("/tmp", f"cook-2pc-{os.getpid()}.jsonl"))
+        self.decisions = decisions
         self.coordinator = TwoPCCoordinator(
             self._post_json, decisions, rpc_timeout_s=rpc_timeout_s)
         self._resolve_cache: dict[str, tuple[int, float]] = {}
         self._latency = {g: _Reservoir()
                          for g in range(self.topology.n_groups)}
         self._twopc_stats = {"commits": 0, "vetoes": 0, "errors": 0}
+        # per-(group, hop) forward-time split: queue / transport /
+        # apply / fsync / replication_ack (obs/distributed.py)
+        self.hops = distributed.HopAttribution()
+        # federated mp incidents: the supervisor's fleet observatory
+        # points at this recorder (MpRuntime wiring), so a worker's
+        # ok->degraded edge captures the decision-log tail, breaker
+        # states, and route map in ONE bundle alongside the standard
+        # trace/faults evidence
+        self.incidents = add_default_collectors(IncidentRecorder())
+        distributed.add_mp_collectors(
+            self.incidents, decision_log_path=decisions.path,
+            breakers_fn=lambda: {str(g): b.state.value
+                                 for g, b in self.breakers.items()},
+            route_map_fn=lambda: dict(self._map))
         self._forward_seconds = global_registry.histogram(
             "mp.forward_seconds",
             "front-end forward round-trip seconds per shard-group",
@@ -214,14 +232,17 @@ class FrontEnd:
                                                limit_per_host=16))
         return self._session
 
-    async def _post_json(self, url: str, body: dict,
-                         timeout_s: float) -> tuple[int, dict]:
-        """The 2PC transport (twopc.PostFn)."""
+    async def _post_json(self, url: str, body: dict, timeout_s: float,
+                         headers: Optional[dict] = None
+                         ) -> tuple[int, dict]:
+        """The 2PC transport (twopc.PostFn); `headers` carry the
+        coordinator's trace context (X-Cook-Txn-Id +
+        X-Cook-Parent-Span)."""
         import aiohttp
 
         session = await self._ensure_session()
         async with session.post(
-                url, json=body,
+                url, json=body, headers=headers,
                 timeout=aiohttp.ClientTimeout(total=timeout_s)) as resp:
             try:
                 payload = await resp.json()
@@ -259,10 +280,20 @@ class FrontEnd:
         headers = {k: v for k, v in request.headers.items()
                    if k.startswith(_HEADER_PREFIX)
                    or k == "Content-Type"}
+        # trace context on EVERY forward: a client-provided txn id is
+        # preserved (it is also the idempotency key); one is minted
+        # otherwise so the hop is traceable end to end.  The worker
+        # opens its server-side span under our forward span.
+        txn_id = headers.get(distributed.TXN_HEADER) or new_txn_id()
+        headers[distributed.TXN_HEADER] = txn_id
+        headers[distributed.PARENT_SPAN_HEADER] = "mp.forward"
         if body is None and request.can_read_body:
             body = await request.read()
         session = await self._ensure_session()
         t0 = time.perf_counter()
+        # front-end queue hop: arrival (stamped by _map_middleware) to
+        # forward start — resolve scatters, body reads, map reloads
+        queue_s = max(0.0, t0 - request.get("t_arrival", t0))
         try:
             async with session.request(
                     request.method, target, data=body, headers=headers,
@@ -276,10 +307,18 @@ class FrontEnd:
                                               {"group": str(group)})
                 self._forwarded.inc(1, {"group": str(group),
                                         "outcome": "ok"})
+                self.hops.attribute(
+                    group, total_s=elapsed, queue_s=queue_s,
+                    walls=distributed.parse_hop_walls(
+                        resp.headers.get(distributed.HOP_WALLS_HEADER)))
+                tracing.record_span(
+                    "mp.forward", elapsed, group=group, txn_id=txn_id,
+                    process=distributed.PROCESS_FRONTEND)
                 out_headers = {
                     k: v for k, v in resp.headers.items()
                     if k.startswith(_HEADER_PREFIX) or k in _RESP_EXTRA}
                 out_headers["X-Cook-Shard-Group"] = str(group)
+                out_headers.setdefault(distributed.TXN_HEADER, txn_id)
                 return web.Response(
                     body=payload, status=resp.status,
                     content_type=resp.content_type,
@@ -289,6 +328,10 @@ class FrontEnd:
             breaker.note_failure()
             self._forwarded.inc(1, {"group": str(group),
                                     "outcome": "error"})
+            tracing.record_span(
+                "mp.forward", time.perf_counter() - t0, group=group,
+                txn_id=txn_id, error=True,
+                process=distributed.PROCESS_FRONTEND)
             return web.json_response(
                 {"error": f"shard-group {group} unreachable: "
                           f"{type(e).__name__}"},
@@ -379,9 +422,16 @@ class FrontEnd:
                 # materialize implicit groups from job references
                 "groups": group_specs if g == lowest else []}
             for g, gspecs in sorted(by_group.items())}
+        t0 = time.perf_counter()
         outcome = await self.coordinator.run(
             txn_id=txn_id, op="jobs/submit", user=user,
             per_group=per_group, rpc_urls=self._rpc_urls())
+        # the front-end track of the 2PC waterfall (the coordinator's
+        # phase spans ride their own pid track)
+        tracing.record_span(
+            "mp.submit_2pc", time.perf_counter() - t0, txn_id=txn_id,
+            groups=len(per_group), process=distributed.PROCESS_FRONTEND,
+            **({} if outcome["ok"] else {"error": True}))
         if not outcome["ok"]:
             self._twopc_stats["vetoes" if outcome["status"] < 500
                               else "errors"] += 1
@@ -414,9 +464,14 @@ class FrontEnd:
         user = request.headers.get("X-Cook-Requesting-User", "")
         per_group = {g: {"uuids": [u for u in uuids if owners[u] == g]}
                      for g in groups}
+        t0 = time.perf_counter()
         outcome = await self.coordinator.run(
             txn_id=txn_id, op="jobs/kill", user=user,
             per_group=per_group, rpc_urls=self._rpc_urls())
+        tracing.record_span(
+            "mp.kill_2pc", time.perf_counter() - t0, txn_id=txn_id,
+            groups=len(per_group), process=distributed.PROCESS_FRONTEND,
+            **({} if outcome["ok"] else {"error": True}))
         if not outcome["ok"]:
             self._twopc_stats["vetoes" if outcome["status"] < 500
                               else "errors"] += 1
@@ -542,6 +597,10 @@ class FrontEnd:
                 "p99_ms": round(reservoir.quantile(0.99) * 1e3, 3),
                 "breaker": self.breakers[g].state.value,
                 "alive": self._entry(g)["alive"],
+                # forward time split by hop (queue / transport / apply /
+                # fsync / replication_ack), from the worker's
+                # X-Cook-Hop-Walls response headers
+                "hops": self.hops.snapshot(g),
             }
         return web.json_response({
             "map_seq": self._map.get("map_seq"),
@@ -551,6 +610,109 @@ class FrontEnd:
             "twopc": dict(self._twopc_stats),
             "resolve_cache": len(self._resolve_cache),
         })
+
+    async def get_debug_trace(self, request: web.Request) \
+            -> web.Response:
+        """Federated trace collection: scatter GET /debug/trace?txn_id=
+        to every live group, merge the slices with the front end's own
+        spans (dedup + per-process pid tracks), and emit ONE
+        Chrome-trace file (`?format=raw` for the merged ring entries).
+        A txn id is required — the whole-ring export lives on the
+        workers; this endpoint answers "show me THIS request's
+        cross-process critical path"."""
+        txn_id = request.query.get("txn_id")
+        if not txn_id:
+            return web.json_response(
+                {"error": "txn_id is required (per-transaction merged "
+                          "trace; whole-ring exports live on the "
+                          "workers' /debug/trace)"}, status=400)
+        fmt = request.query.get("format", "chrome")
+        if fmt not in ("chrome", "raw"):
+            return web.json_response(
+                {"error": f"unknown format {fmt!r} (chrome | raw)"},
+                status=400)
+        sources = [{"process": distributed.PROCESS_FRONTEND,
+                    "spans": tracing.spans_for_txn(txn_id)}]
+        alive = self._alive_groups()
+        worker_path = f"/debug/trace?txn_id={txn_id}&format=raw"
+        replies = await asyncio.gather(
+            *(self._forward(g, request, path=worker_path)
+              for g in alive))
+        failed: list[int] = []
+        for g, resp in zip(alive, replies):
+            if resp.status != 200:
+                failed.append(g)
+                continue
+            try:
+                payload = json.loads(resp.body or b"{}")
+            except ValueError:
+                failed.append(g)
+                continue
+            sources.append({
+                "process": (payload.get("process")
+                            or distributed.worker_process_label(g)),
+                "spans": payload.get("spans") or []})
+        merged = distributed.merge_process_traces(sources)
+        distributed.note_collection(
+            "empty" if not merged else
+            "partial" if failed else "merged")
+        if fmt == "raw":
+            return web.json_response(
+                {"txn_id": txn_id, "spans": merged,
+                 "groups_asked": alive, "groups_failed": failed})
+        return web.json_response(distributed.merged_chrome_trace(merged))
+
+    async def get_debug_incidents(self, request: web.Request) \
+            -> web.Response:
+        """The front end's OWN federated incident index (worker-local
+        bundles stay on the workers' /debug/incidents)."""
+        return web.json_response({
+            "incidents": self.incidents.bundles(),
+            "capacity": self.incidents.capacity,
+            "cooldown_s": self.incidents.cooldown_s,
+            "dir": self.incidents.dir,
+        })
+
+    async def get_debug_incident(self, request: web.Request) \
+            -> web.Response:
+        incident_id = request.match_info["incident_id"]
+        bundle = self.incidents.get(incident_id)
+        if bundle is None:
+            return web.json_response(
+                {"error": f"incident {incident_id} not retained"},
+                status=404)
+        return web.json_response(bundle)
+
+    async def get_job_timeline(self, request: web.Request) \
+            -> web.Response:
+        """/jobs/{uuid}/timeline with the cross-group hop stitched in:
+        the owning worker renders the job's lifecycle, and when the job
+        arrived via a cross-group 2PC the commit decision's prepare
+        walls / decision / done timestamps (decision log) are folded
+        into the event stream (obs/distributed.py
+        stitch_twopc_events)."""
+        uuid = request.match_info.get("uuid", "")
+        owners = await self._resolve_uuids([uuid])
+        if uuid not in owners:
+            return web.json_response(
+                {"error": f"unknown entity: ['{uuid}']"}, status=404)
+        resp = await self._forward(owners[uuid], request)
+        if resp.status != 200:
+            return resp
+        record, done_t = await asyncio.get_running_loop() \
+            .run_in_executor(
+                None, self.decisions.find_for_uuid, uuid)
+        if record is None:
+            return resp  # single-group job: the worker's view is whole
+        try:
+            timeline = json.loads(resp.body or b"{}")
+        except ValueError:
+            return resp
+        out_headers = {k: v for k, v in resp.headers.items()
+                       if k.startswith(_HEADER_PREFIX)}
+        return web.json_response(
+            distributed.stitch_twopc_events(timeline, record, done_t),
+            headers=out_headers)
 
     async def get_debug_health(self, request: web.Request) \
             -> web.Response:
@@ -600,6 +762,9 @@ class FrontEnd:
 
     @web.middleware
     async def _map_middleware(self, request: web.Request, handler):
+        # arrival stamp: everything between here and the forward's
+        # session.request is the "queue" hop of the per-hop split
+        request["t_arrival"] = time.perf_counter()
         self._maybe_reload_map()
         return await handler(request)
 
@@ -611,7 +776,7 @@ class FrontEnd:
             r.add_delete(path, self.delete_jobs)
             r.add_get(path, self.by_uuid)
         r.add_get("/jobs/{uuid}", self.by_uuid)
-        r.add_get("/jobs/{uuid}/timeline", self.by_uuid)
+        r.add_get("/jobs/{uuid}/timeline", self.get_job_timeline)
         r.add_get("/instances/{uuid}", self.by_uuid)
         r.add_get("/instances", self.by_uuid)
         r.add_delete("/instances", self.by_uuid)
@@ -634,6 +799,10 @@ class FrontEnd:
         r.add_get("/debug/shards", self.get_debug_shards)
         r.add_get("/debug/frontend", self.get_debug_frontend)
         r.add_get("/debug/health", self.get_debug_health)
+        r.add_get("/debug/trace", self.get_debug_trace)
+        r.add_get("/debug/incidents", self.get_debug_incidents)
+        r.add_get("/debug/incidents/{incident_id}",
+                  self.get_debug_incident)
         # everything else (pools/settings/info/config/debug) lives on
         # the meta group
         r.add_route("*", "/{tail:.*}", self.to_meta)

@@ -47,6 +47,9 @@ import time
 import urllib.request
 from typing import Callable, Optional
 
+from cook_tpu.obs import distributed
+from cook_tpu.txn.transaction import new_txn_id
+from cook_tpu.utils import tracing
 from cook_tpu.mp.topology import (ShardGroupTopology, build_route_map,
                                   write_route_map)
 from cook_tpu.utils.metrics import global_registry
@@ -178,10 +181,12 @@ class Supervisor:
         proc.kill()
         raise RuntimeError(f"worker {name} missed the ready deadline")
 
-    def _post(self, url: str, body: dict, timeout_s: float = 30.0):
+    def _post(self, url: str, body: dict, timeout_s: float = 30.0,
+              headers: Optional[dict] = None):
         req = urllib.request.Request(
             url, method="POST", data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             return r.status, json.loads(r.read() or b"{}")
 
@@ -191,6 +196,7 @@ class Supervisor:
         from cook_tpu.obs.fleet import FleetObservatory
         from cook_tpu.shard.journal import write_manifest
 
+        os.makedirs(self.data_dir, exist_ok=True)
         write_manifest(self.data_dir, self.topology.n_shards)
         for g in range(self.topology.n_groups):
             self.workers[g] = self.spawn_fn(
@@ -271,6 +277,7 @@ class Supervisor:
     def failover(self, group: int) -> None:
         """Promote a standby to adopt `group`'s journal segments (cold
         respawn when the spare pool is empty)."""
+        t_failover = time.perf_counter()
         old = self.workers[group]
         old_url = old.describe["url"]
         old.kill(signal.SIGKILL)  # ensure the corpse releases nothing
@@ -281,6 +288,12 @@ class Supervisor:
         # fast instead of timing out against the corpse
         self._write_map()
         shards = self.topology.shards_of_group(group)
+        # trace context: the adoption RPC carries a failover correlation
+        # id so the adopter's `mp.adopt` span lands in a stitched trace
+        # naming the adopting group (GET /debug/trace?txn_id=<this>)
+        failover_txn = f"failover-{group}-{new_txn_id()}"
+        adopt_headers = {distributed.TXN_HEADER: failover_txn,
+                         distributed.PARENT_SPAN_HEADER: "mp.failover"}
         promoted = None
         while self.standbys and promoted is None:
             standby = self.standbys.pop(0)
@@ -288,7 +301,8 @@ class Supervisor:
                 status, reply = self.post_fn(
                     standby.describe["rpc_url"] + "/rpc/adopt",
                     {"group": group, "shards": list(shards),
-                     "pools": list(self.pools)})
+                     "pools": list(self.pools)},
+                    headers=adopt_headers)
                 if status == 200 and reply.get("ok"):
                     standby.describe = {**standby.describe, **reply}
                     promoted = standby
@@ -311,6 +325,10 @@ class Supervisor:
             self.observatory.peers = tuple(
                 h.describe["url"] for h in self.workers.values())
         self._failovers.inc(1, {"group": str(group)})
+        tracing.record_span(
+            "mp.failover", time.perf_counter() - t_failover,
+            txn_id=failover_txn, group=group,
+            process=distributed.PROCESS_FRONTEND)
         # restore the spare pool in the background (a standby boot
         # imports jax: seconds on a small box)
         threading.Thread(target=self._replenish_standby,
@@ -374,6 +392,14 @@ class MpRuntime:
             self.supervisor.map_path,
             decision_log_path=os.path.join(data_dir, "mp",
                                            "2pc-decisions.jsonl"))
+        # federated incidents: a worker's ok->degraded edge seen by the
+        # supervisor's fleet poller captures through the FRONT END's
+        # recorder — whose collectors embed the 2PC decision-log tail,
+        # breaker states, and route map alongside each peer's newest
+        # bundle reference (obs/distributed.py add_mp_collectors)
+        if self.supervisor.observatory is not None:
+            self.supervisor.observatory.incidents = \
+                self.frontend.incidents
         self.server = ServerThread(self.frontend)
         self.server.start()
 

@@ -36,8 +36,11 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Awaitable, Callable, Optional
 
+from cook_tpu.obs import distributed
+from cook_tpu.utils import tracing
 from cook_tpu.utils.metrics import global_registry
 
 log = logging.getLogger(__name__)
@@ -47,8 +50,11 @@ log = logging.getLogger(__name__)
 _TXN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                 1.0, 2.5, 5.0, 10.0, float("inf"))
 
-# transport: async (url, body_dict, timeout_s) -> (status:int, body:dict)
-PostFn = Callable[[str, dict, float], Awaitable[tuple]]
+# transport: async (url, body_dict, timeout_s, headers) -> (status:int,
+# body:dict) — headers carry the trace context (X-Cook-Txn-Id +
+# X-Cook-Parent-Span) so participants open child spans under the
+# coordinator's phase span (obs/distributed.py header contract)
+PostFn = Callable[[str, dict, float, Optional[dict]], Awaitable[tuple]]
 
 
 class DecisionLog:
@@ -80,29 +86,54 @@ class DecisionLog:
             if not self._f.closed:
                 self._f.close()
 
-    def outstanding(self) -> dict[str, dict]:
-        """Committed-but-not-done decisions, replayed at coordinator
-        start (and after failovers): txn_id -> decision record.
-        Tolerates a torn tail — a half-written line is a decision that
-        never became durable, i.e. presumed abort."""
-        pending: dict[str, dict] = {}
+    def _scan(self):
+        """Durable records, oldest first (torn tail dropped — a
+        half-written line is a decision that never became durable,
+        i.e. presumed abort)."""
         if not os.path.exists(self.path):
-            return pending
+            return
         with open(self.path, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    record = json.loads(line)
+                    yield json.loads(line)
                 except ValueError:
-                    break  # torn tail: nothing after it is durable
-                txn_id = record.get("txn_id")
-                if record.get("decision") == "commit":
-                    pending[txn_id] = record
-                elif record.get("decision") == "done":
-                    pending.pop(txn_id, None)
+                    return  # torn tail: nothing after it is durable
+
+    def outstanding(self) -> dict[str, dict]:
+        """Committed-but-not-done decisions, replayed at coordinator
+        start (and after failovers): txn_id -> decision record."""
+        pending: dict[str, dict] = {}
+        for record in self._scan():
+            txn_id = record.get("txn_id")
+            if record.get("decision") == "commit":
+                pending[txn_id] = record
+            elif record.get("decision") == "done":
+                pending.pop(txn_id, None)
         return pending
+
+    def find_for_uuid(self, uuid: str) -> tuple[Optional[dict],
+                                                Optional[float]]:
+        """The newest commit decision whose per-group payload pins this
+        job uuid, plus its done-marker timestamp (None while commits
+        are still pending replay) — the timeline stitch's source."""
+        found: Optional[dict] = None
+        done_t: Optional[float] = None
+        for record in self._scan():
+            if record.get("decision") == "commit":
+                for payload in (record.get("groups") or {}).values():
+                    jobs = (payload or {}).get("jobs") or []
+                    uuids = (payload or {}).get("uuids") or []
+                    if uuid in uuids or any(
+                            j.get("uuid") == uuid for j in jobs):
+                        found, done_t = record, None
+                        break
+            elif (found is not None and record.get("decision") == "done"
+                    and record.get("txn_id") == found.get("txn_id")):
+                done_t = record.get("t")
+        return found, done_t
 
 
 class TwoPCCoordinator:
@@ -130,18 +161,31 @@ class TwoPCCoordinator:
             "cross-group transaction wall seconds (first prepare sent -> "
             "last commit acked), per op", buckets=_TXN_BUCKETS)
 
-    async def _rpc(self, rpc_url: str, method: str,
-                   body: dict) -> tuple[int, dict]:
+    async def _rpc(self, rpc_url: str, method: str, body: dict, *,
+                   group: Optional[int] = None) -> tuple[int, dict]:
+        # trace context on every 2PC RPC: the participant opens its
+        # child span under the coordinator's phase span
+        headers = {distributed.PARENT_SPAN_HEADER: f"twopc.{method}"}
+        if body.get("txn_id"):
+            headers[distributed.TXN_HEADER] = body["txn_id"]
+        t0 = time.perf_counter()
         try:
             status, payload = await self.post(
-                f"{rpc_url}/rpc/2pc/{method}", body, self.rpc_timeout_s)
+                f"{rpc_url}/rpc/2pc/{method}", body, self.rpc_timeout_s,
+                headers)
             if not isinstance(payload, dict):
                 payload = {"ok": False, "error": f"non-JSON {method} reply"}
-            return status, payload
         except Exception as e:  # noqa: BLE001 — transport failure is a
             # participant outcome, not a coordinator crash
-            return 0, {"ok": False, "transport_error": True,
-                       "error": f"{type(e).__name__}: {e}"}
+            status, payload = 0, {"ok": False, "transport_error": True,
+                                  "error": f"{type(e).__name__}: {e}"}
+        tracing.record_span(
+            f"twopc.{method}", time.perf_counter() - t0,
+            txn_id=body.get("txn_id"),
+            process=distributed.PROCESS_COORDINATOR,
+            **({} if group is None else {"group": group}),
+            **({} if payload.get("ok") else {"error": True}))
+        return status, payload
 
     async def run(self, *, txn_id: str, op: str, user: str,
                   per_group: dict[int, dict],
@@ -153,15 +197,16 @@ class TwoPCCoordinator:
         stands and replay finishes them), or
         {"ok": False, "status": http-ish, "error": str} on veto/error.
         """
-        import time as _time
-
         groups = sorted(per_group)
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         prepared: list[int] = []
+        prepare_s: dict[str, float] = {}
         for g in groups:  # ascending group order, both phases
+            tp0 = time.perf_counter()
             status, reply = await self._rpc(rpc_urls[g], "prepare", {
                 "txn_id": txn_id, "op": op, "user": user,
-                "payload": per_group[g]})
+                "payload": per_group[g]}, group=g)
+            prepare_s[str(g)] = time.perf_counter() - tp0
             if not reply.get("ok"):
                 outcome = ("error" if reply.get("transport_error")
                            or status >= 500 else "veto")
@@ -175,20 +220,35 @@ class TwoPCCoordinator:
                         "vetoed_by": g}
             self._prepares.inc(1, {"outcome": "ok"})
             prepared.append(g)
-        # the single decision: durable BEFORE any participant applies
+        # the single decision: durable BEFORE any participant applies.
+        # `t` + per-group prepare walls ride in the record so the
+        # timeline stitch can place the cross-group hop without a
+        # second fsync.
         decision = {"txn_id": txn_id, "op": op, "user": user,
-                    "decision": "commit",
+                    "decision": "commit", "t": time.time(),
+                    "prepare_s": prepare_s,
                     "groups": {str(g): per_group[g] for g in groups},
                     "rpc_urls": {str(g): rpc_urls[g] for g in groups}}
+        td0 = time.perf_counter()
         await asyncio.get_running_loop().run_in_executor(
             None, self.decisions.append, decision)
+        # the fsynced decision write is its own span on the
+        # coordinator's pid track — it IS the commit point
+        tracing.record_span(
+            "twopc.decision_write", time.perf_counter() - td0,
+            txn_id=txn_id, op=op,
+            process=distributed.PROCESS_COORDINATOR)
         results, pending = await self._commit_all(txn_id, op, user,
                                                   per_group, rpc_urls)
         if not pending:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.decisions.append,
-                {"txn_id": txn_id, "decision": "done"})
-        self._txn_seconds.observe(_time.perf_counter() - t0, {"op": op})
+                {"txn_id": txn_id, "decision": "done", "t": time.time()})
+        wall = time.perf_counter() - t0
+        self._txn_seconds.observe(wall, {"op": op})
+        tracing.record_span(
+            "twopc.txn", wall, txn_id=txn_id, op=op,
+            process=distributed.PROCESS_COORDINATOR)
         return {"ok": True, "results": results,
                 "pending_groups": sorted(pending)}
 
@@ -202,7 +262,7 @@ class TwoPCCoordinator:
             for attempt in range(self.commit_attempts):
                 _status, reply = await self._rpc(rpc_urls[g], "commit", {
                     "txn_id": txn_id, "op": op, "user": user,
-                    "payload": per_group[g]})
+                    "payload": per_group[g]}, group=g)
                 if reply.get("ok"):
                     break
                 await asyncio.sleep(self.retry_backoff_s * (attempt + 1))
@@ -228,7 +288,8 @@ class TwoPCCoordinator:
         happened)."""
         for g in reversed(prepared):
             self._aborts.inc()
-            await self._rpc(rpc_urls[g], "abort", {"txn_id": txn_id})
+            await self._rpc(rpc_urls[g], "abort", {"txn_id": txn_id},
+                            group=g)
 
     async def replay(self, rpc_urls: Optional[dict[int, str]]
                      = None) -> dict:
@@ -257,6 +318,7 @@ class TwoPCCoordinator:
                 finished += 1
                 await asyncio.get_running_loop().run_in_executor(
                     None, self.decisions.append,
-                    {"txn_id": txn_id, "decision": "done"})
+                    {"txn_id": txn_id, "decision": "done",
+                     "t": time.time()})
         return {"outstanding": len(outstanding), "finished": finished,
                 "still_pending": still_pending}

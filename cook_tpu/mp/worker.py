@@ -31,11 +31,14 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Optional
 
 from aiohttp import web
 
 from cook_tpu.mp.topology import GroupShardRouter
+from cook_tpu.obs import distributed
+from cook_tpu.utils import tracing
 from cook_tpu.utils.metrics import global_registry
 
 log = logging.getLogger(__name__)
@@ -60,30 +63,54 @@ class TwoPCParticipant:
     drop the staged prepare.
     """
 
-    def __init__(self, store, txn, api):
+    def __init__(self, store, txn, api, group: Optional[int] = None):
         self.store = store
         self.txn = txn
         self.api = api
+        self.group = group
         self._lock = threading.Lock()
         self._pending: dict[str, dict] = {}  # txn_id -> staged payload
 
+    def _span_tags(self) -> dict:
+        """Tags that route a participant span to this worker's pid
+        track in the merged trace (obs/distributed.py)."""
+        if self.group is None:
+            return {}
+        return {"group": self.group,
+                "process": distributed.worker_process_label(self.group)}
+
     # ------------------------------------------------------------ phases
 
-    def prepare(self, txn_id: str, op: str, user: str,
+    def prepare(self, txn_id: str, op: str, user: str, payload: dict,
+                *, parent: Optional[str] = None) -> dict:
+        with tracing.correlate(txn_id), tracing.span(
+                "mp.participant.prepare", parent=parent, op=op,
+                **self._span_tags()):
+            staged, err = self._validate(op, user, payload)
+            if err is not None:
+                # name the vetoing group in the ring: the stitched
+                # trace for an aborted txn must say WHO said no
+                tracing.record_event("twopc.veto", op=op,
+                                     status=err.get("status"),
+                                     **self._span_tags())
+                return {"ok": False, **err}
+            import time as _time
+
+            with self._lock:
+                self._gc(_time.monotonic())
+                self._pending[txn_id] = {"op": op, "staged": staged,
+                                         "at": _time.monotonic()}
+            return {"ok": True, "uuids": staged.get("uuids", [])}
+
+    def commit(self, txn_id: str, op: str, user: str, payload: dict,
+               *, parent: Optional[str] = None) -> dict:
+        with tracing.correlate(txn_id), tracing.span(
+                "mp.participant.commit", parent=parent, op=op,
+                **self._span_tags()):
+            return self._commit(txn_id, op, user, payload)
+
+    def _commit(self, txn_id: str, op: str, user: str,
                 payload: dict) -> dict:
-        staged, err = self._validate(op, user, payload)
-        if err is not None:
-            return {"ok": False, **err}
-        import time as _time
-
-        with self._lock:
-            self._gc(_time.monotonic())
-            self._pending[txn_id] = {"op": op, "staged": staged,
-                                     "at": _time.monotonic()}
-        return {"ok": True, "uuids": staged.get("uuids", [])}
-
-    def commit(self, txn_id: str, op: str, user: str,
-               payload: dict) -> dict:
         cached = self.store.txn_results.get(txn_id)
         if cached is not None:
             return {"ok": True, "duplicate": True,
@@ -114,10 +141,14 @@ class TwoPCParticipant:
                 "shard_seqs": {str(s): q for s, q in
                                (outcome.shard_seqs or {}).items()}}
 
-    def abort(self, txn_id: str) -> dict:
-        with self._lock:
-            dropped = self._pending.pop(txn_id, None) is not None
-        return {"ok": True, "dropped": dropped}
+    def abort(self, txn_id: str, *,
+              parent: Optional[str] = None) -> dict:
+        with tracing.correlate(txn_id), tracing.span(
+                "mp.participant.abort", parent=parent,
+                **self._span_tags()):
+            with self._lock:
+                dropped = self._pending.pop(txn_id, None) is not None
+            return {"ok": True, "dropped": dropped}
 
     # -------------------------------------------------------- validation
 
@@ -214,13 +245,18 @@ class _RpcSurface:
                     {"ok": False, "error": "standby"}, status=503)
             body = await request.json()
             participant = self.worker.participant
+            # the coordinator's trace context: the participant's span
+            # parents under the X-Cook-Parent-Span phase span
+            parent = request.headers.get(distributed.PARENT_SPAN_HEADER)
             t0 = _time.perf_counter()
             if method == "abort":
-                call = (lambda: participant.abort(body["txn_id"]))
+                call = (lambda: participant.abort(body["txn_id"],
+                                                  parent=parent))
             else:
                 call = (lambda: getattr(participant, method)(
                     body["txn_id"], body.get("op", ""),
-                    body.get("user", ""), body.get("payload") or {}))
+                    body.get("user", ""), body.get("payload") or {},
+                    parent=parent))
             # commits end in fsync — keep them off the event loop
             reply = await asyncio.get_running_loop().run_in_executor(
                 None, call)
@@ -240,12 +276,22 @@ class _RpcSurface:
                 {"ok": False,
                  "error": f"already serving group {self.worker.group}"},
                 status=409)
+        group = int(body["group"])
+        parent = request.headers.get(distributed.PARENT_SPAN_HEADER)
+        corr = request.headers.get(distributed.TXN_HEADER)
+
+        def run_adopt():
+            # the adopting group names itself in the failover trace
+            with tracing.correlate(corr), tracing.span(
+                    "mp.adopt", parent=parent, group=group,
+                    process=distributed.worker_process_label(group)):
+                return self.worker.adopt(
+                    group, [int(s) for s in body["shards"]],
+                    tuple(body.get("pools") or ("default",)))
+
         try:
             describe = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: self.worker.adopt(
-                    int(body["group"]),
-                    [int(s) for s in body["shards"]],
-                    tuple(body.get("pools") or ("default",))))
+                None, run_adopt)
         except Exception as e:  # noqa: BLE001 — adoption failure must
             # reach the supervisor as a reply, not a hung socket
             log.exception("adoption failed")
@@ -275,7 +321,11 @@ class ShardGroupWorker:
         self.shards: tuple = tuple(sorted(shards))
         self.pools = tuple(pools)
         self.config = config
-        self.clock = clock
+        # wall-clock ms by default (rest/server.py uses the same): job
+        # timestamps must share a domain with the 2PC decision log's
+        # wall stamps or the front end's stitched timeline events
+        # render decades away from the worker's own
+        self.clock = clock or (lambda: int(time.time() * 1000))
         self.journal_kw = dict(journal_kw or {})
         self.history_sample_s = history_sample_s
         self.port = port or free_port()
@@ -338,8 +388,7 @@ class ShardGroupWorker:
                 store_factory=shard_journal._shard_factory(gi, clock))
             locals_.append(recovered
                            or shard_journal._shard_factory(gi, clock)())
-        self.store = ShardedStore(len(self.shards),
-                                  clock=clock or (lambda: 0),
+        self.store = ShardedStore(len(self.shards), clock=clock,
                                   router=router, shards=locals_)
         for gi, shard in zip(self.shards, self.store.shards):
             directory = shard_journal.shard_dir(self.data_dir, gi)
@@ -367,8 +416,12 @@ class ShardGroupWorker:
             config=HistoryConfig(sample_s=self.history_sample_s))
         self.api = CookApi(self.store, None, self.config or ApiConfig(),
                            txn=self.txn, history=self.history)
+        # REST-side spans/walls route to this worker's merged-trace pid
+        # track and X-Cook-Hop-Walls header (obs/distributed.py)
+        self.api.process_label = distributed.worker_process_label(
+            self.group)
         self.participant = TwoPCParticipant(self.store, self.txn,
-                                            self.api)
+                                            self.api, group=self.group)
         self.rest_server = ServerThread(self.api, port=self.port)
 
     def adopt(self, group: int, shards, pools: tuple) -> dict:

@@ -195,6 +195,10 @@ class CookApi:
         # store's watcher thread via call_soon_threadsafe
         self._repl_waiters: set = set()
         self._repl_loop = None
+        # merged-trace process identity (obs/distributed.py): the mp
+        # worker stamps "worker-gN" here so this node's REST-side spans
+        # route to its pid track; None on single-process servers
+        self.process_label = None
         # control-plane contention observatory (cook_tpu/obs/contention):
         # per-route REST telemetry (fed by the outermost middleware),
         # store-lock / journal / replication / commit-ack attribution —
@@ -630,7 +634,10 @@ class CookApi:
         as a Chrome-trace/Perfetto-loadable event file — host threads and
         pools become tracks, every ring tag (txn_id included) rides in
         the event args; `?format=raw` returns the ring entries verbatim.
-        `?limit=` bounds how many (newest) spans export."""
+        `?limit=` bounds how many (newest) spans export; `?txn_id=`
+        slices the ring by correlation id first — the per-process half
+        of the mp front end's federated trace merge
+        (docs/observability.md, cross-process tracing)."""
         from cook_tpu.utils import tracing
 
         try:
@@ -638,12 +645,18 @@ class CookApi:
                 "limit", str(tracing.ring_capacity()))))
         except ValueError:
             return _err(400, "limit must be an integer")
+        txn_id = request.query.get("txn_id")
+        if txn_id:
+            spans = tracing.spans_for_txn(txn_id, limit=limit)
+        else:
+            spans = tracing.recent_spans(limit=limit)
         fmt = request.query.get("format", "chrome")
         if fmt == "chrome":
-            return web.json_response(tracing.chrome_trace(limit=limit))
+            return web.json_response(tracing.chrome_trace(spans=spans))
         if fmt == "raw":
             return web.json_response(
-                {"spans": tracing.recent_spans(limit=limit)})
+                {"spans": spans, "process": self.process_label,
+                 "txn_id": txn_id})
         return _err(400, f"unknown format {fmt!r} (chrome | raw)")
 
     async def get_debug_incidents(self, request: web.Request
@@ -786,6 +799,20 @@ class CookApi:
         try:
             response = await handler(request)
             status = response.status
+            # server-side phase walls for the mp front end's per-hop
+            # attribution (obs/distributed.py): "server" is this
+            # response's total service wall (transport = the front
+            # end's round-trip minus it); commits add apply / fsync /
+            # replication_ack via request["phase_walls"] (_commit)
+            from cook_tpu.obs import distributed
+
+            walls = dict(request.get("phase_walls") or {})
+            walls["server"] = _time.perf_counter() - t0
+            try:
+                response.headers[distributed.HOP_WALLS_HEADER] = \
+                    distributed.encode_hop_walls(walls)
+            except RuntimeError:
+                pass  # prepared/streamed response: headers are sealed
             return response
         except web.HTTPException as e:
             status = e.status
@@ -1010,18 +1037,41 @@ class CookApi:
         type).  Clients may pass X-Cook-Txn-Id: a retried request with
         the same id is answered from the transaction table, not
         re-applied — on this leader or a promoted standby."""
+        import time as _time
+
+        from cook_tpu.obs import distributed
+        from cook_tpu.utils import tracing
+
         txn_id = request.headers.get("X-Cook-Txn-Id") or None
         if txn_id and txn_suffix:
             txn_id = f"{txn_id}:{txn_suffix}"
+        t0 = _time.perf_counter()
         outcome = await self._run_commit(op, payload, txn_id)
         outcome.replicated = True
+        walls = dict(outcome.phase_walls or {})
         if self.config.replication_sync_ack and not outcome.duplicate:
+            t_repl = _time.perf_counter()
             outcome.replicated = await self._await_replication_outcome(
                 outcome)
+            walls["replication_ack"] = _time.perf_counter() - t_repl
             if not outcome.replicated:
                 global_registry.counter(
                     "replication_ack_timeouts",
                     "sync-ack replication bounds missed").inc()
+        if walls:
+            # picked up by _endpoint_middleware into X-Cook-Hop-Walls
+            outcome.phase_walls = walls
+            request["phase_walls"] = walls
+        # the server-side commit span: parented under the front end's
+        # forward span when the request carried X-Cook-Parent-Span
+        # (async-safe completed-span recorder — handlers interleave)
+        span_tags = {"op": op}
+        if self.process_label:
+            span_tags["process"] = self.process_label
+        tracing.record_span(
+            "rest.commit", _time.perf_counter() - t0,
+            parent=request.headers.get(distributed.PARENT_SPAN_HEADER),
+            txn_id=outcome.txn_id, **span_tags)
         return outcome
 
     @staticmethod

@@ -140,6 +140,7 @@ class ShardedTransactionLog:
                 result = self._apply_multi(txn, plan)
                 seqs = {i: store.note_txn(txn.txn_id, txn.op, result)
                         for i, store in zip(shards, stores)}
+        t_sync = time.perf_counter()
         if self.policy.sync_journal:
             for i in shards:
                 journal = self.journals[i]
@@ -151,7 +152,10 @@ class ShardedTransactionLog:
             self._note_commit(i, wall)
         return TxnOutcome(txn_id=txn.txn_id, op=txn.op,
                           seq=max(seqs.values()), result=result,
-                          shard_seqs=seqs)
+                          shard_seqs=seqs,
+                          phase_walls={
+                              "apply": t_sync - t0,
+                              "fsync": time.perf_counter() - t_sync})
 
     def _apply_multi(self, txn: Transaction, plan: RoutePlan) -> Any:
         """Apply one cross-shard transaction; caller holds every touched

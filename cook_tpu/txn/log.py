@@ -87,6 +87,7 @@ class TransactionLog:
         while True:
             attempts += 1
             try:
+                t_apply = _time.perf_counter()
                 with store._lock:
                     cached = store.txn_results.get(txn.txn_id)
                     if cached is not None:
@@ -111,8 +112,13 @@ class TransactionLog:
                             "attempt %d/%d", txn.op, txn.txn_id, attempts,
                             self.policy.max_attempts)
                 time.sleep(self.policy.retry_backoff_s)
+        t_sync = _time.perf_counter()
         if self.journal is not None and self.policy.sync_journal:
             self.journal.sync()
+        # phase walls feed the mp per-hop attribution: lock+apply vs
+        # the group fsync (obs/distributed.py HOPS)
+        phase_walls = {"apply": t_sync - t_apply,
+                       "fsync": _time.perf_counter() - t_sync}
         # commit wall per op (apply under the store lock + group fsync;
         # idempotent replays answered from the txn table are excluded —
         # they pay neither), the txn-side half of the commit-ack latency
@@ -123,4 +129,5 @@ class TransactionLog:
             buckets=_COMMIT_BUCKETS).observe(
             _time.perf_counter() - t_commit, {"op": txn.op})
         return TxnOutcome(txn_id=txn.txn_id, op=txn.op, seq=seq,
-                          result=result, attempts=attempts)
+                          result=result, attempts=attempts,
+                          phase_walls=phase_walls)

@@ -54,3 +54,8 @@ class TxnOutcome:
     # comparable within one shard's history, so sync-ack replication
     # awaits each entry separately.  None on unsharded commits.
     shard_seqs: Optional[dict[int, int]] = None
+    # per-phase wall seconds ({"apply": ..., "fsync": ...}; rest/api.py
+    # adds "replication_ack") — the server-side half of the mp front
+    # end's per-hop attribution, returned in the X-Cook-Hop-Walls
+    # response header (obs/distributed.py).  None on duplicate answers.
+    phase_walls: Optional[dict[str, float]] = None

@@ -29,8 +29,11 @@ _active: dict[int, list[str]] = {}
 _correlation = threading.local()
 
 # tags that carry per-request identity: kept in the trace ring, stripped
-# from metric labels (label cardinality must stay bounded)
-_RING_ONLY_TAGS = ("txn_id", "error")
+# from metric labels (label cardinality must stay bounded).  "process" is
+# the cross-process track tag (obs/distributed.py): it identifies which
+# fleet member recorded the span, which the merged-trace export needs but
+# a metric label does not (the registry is already per-process).
+_RING_ONLY_TAGS = ("txn_id", "error", "process")
 
 
 def set_correlation(txn_id: Optional[str]) -> Optional[str]:
@@ -56,12 +59,17 @@ def correlate(txn_id: Optional[str]):
 
 
 @contextmanager
-def span(name: str, **tags):
-    """with span("match_cycle", pool="default"): ..."""
+def span(name: str, parent: Optional[str] = None, **tags):
+    """with span("match_cycle", pool="default"): ...
+
+    `parent` overrides the thread-local stack-derived parent — the
+    cross-process case, where the causal parent arrived in an
+    `X-Cook-Parent-Span` header rather than on this thread's stack."""
     tid = threading.get_ident()
     with _lock:
         stack = _active.setdefault(tid, [])
-        parent = stack[-1] if stack else None
+        if parent is None:
+            parent = stack[-1] if stack else None
         stack.append(name)
     corr = current_correlation()
     if corr is not None and "txn_id" not in tags:
@@ -102,6 +110,47 @@ def span(name: str, **tags):
             f"span.{name}", "wall seconds of the traced section").observe(
             duration, labels=metric_tags or None
         )
+
+
+def record_span(name: str, duration_s: float, *,
+                parent: Optional[str] = None,
+                t: Optional[float] = None, **tags) -> None:
+    """Append an already-completed span to the ring and observe its
+    histogram, WITHOUT touching the per-thread `_active` stack.
+
+    This is the async-safe recorder: the front end's aiohttp handlers
+    interleave many requests on one event-loop thread, so the LIFO
+    stack discipline `span()` relies on would mis-pair parents there.
+    Callers measure the wall themselves and record the finished span
+    with an explicit parent."""
+    corr = current_correlation()
+    if corr is not None and "txn_id" not in tags:
+        tags["txn_id"] = corr
+    with _lock:
+        _trace_ring.append({
+            "name": name,
+            "parent": parent,
+            "duration_s": duration_s,
+            "tags": tags,
+            "t": t if t is not None else time.time(),
+            "tid": threading.get_ident(),
+            "thread": threading.current_thread().name,
+        })
+    metric_tags = {k: v for k, v in tags.items()
+                   if k not in _RING_ONLY_TAGS}
+    global_registry.histogram(
+        f"span.{name}", "wall seconds of the traced section").observe(
+        duration_s, labels=metric_tags or None
+    )
+
+
+def spans_for_txn(txn_id: str, limit: Optional[int] = None) -> list[dict]:
+    """Slice the ring by correlation id (the `txn_id` tag) — the
+    per-process half of the federated `GET /debug/trace?txn_id=`."""
+    with _lock:
+        entries = [e for e in _trace_ring
+                   if (e.get("tags") or {}).get("txn_id") == txn_id]
+    return entries[-limit:] if limit else entries
 
 
 def record_event(name: str, **tags) -> None:

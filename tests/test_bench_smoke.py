@@ -145,7 +145,11 @@ def test_next_phase_record_path_skips_driver_rounds(tmp_path):
 def make_record(path, mode="smoke", platform="cpu", **phases):
     payload = {
         "schema": "cook-bench/v1", "mode": mode, "platform": platform,
-        "phases": {name: {"p50_ms": p50} for name, p50 in phases.items()},
+        # a dict rides through as the phase's full info (mp phases carry
+        # cores + speedup columns); a bare number is just the p50
+        "phases": {name: (dict(info) if isinstance(info, dict)
+                          else {"p50_ms": info})
+                   for name, info in phases.items()},
     }
     path.write_text(json.dumps(payload))
     return str(path)
@@ -285,3 +289,58 @@ class TestBenchGate:
         old = make_record(tmp_path / "a.json", match=10.0)
         new = make_record(tmp_path / "b.json", match=50.0)
         assert bench_gate.main([old, new]) == 1  # real regression still fails
+
+
+def mp_phase(p50=5.0, cores=1, speedup=1.0):
+    return {"p50_ms": p50, "cores": cores,
+            "rps_speedup_vs_sharded": speedup}
+
+
+class TestMpSpeedupGate:
+    """bench.py's control_plane_mp fleet-vs-sharded speedup self-gates
+    when the recorded run had the cores to meet the 2.5x target
+    (bench_gate.MP_GATE_MIN_CORES); below the floor it stays recorded,
+    not gated — worker processes cannot beat the in-process plane
+    without process parallelism."""
+
+    def test_below_core_floor_is_informational(self, tmp_path, capsys):
+        rec = make_record(tmp_path / "a.json",
+                          control_plane_mp=mp_phase(cores=1, speedup=0.8))
+        assert bench_gate.main([rec]) == 0
+        out = capsys.readouterr().out
+        assert "recorded, not gated" in out and "PASS" in out
+
+    def test_enough_cores_meeting_target_passes(self, tmp_path, capsys):
+        rec = make_record(tmp_path / "a.json",
+                          control_plane_mp=mp_phase(cores=8, speedup=3.1))
+        assert bench_gate.main([rec]) == 0
+        assert "ok (target 2.5x)" in capsys.readouterr().out
+
+    def test_enough_cores_below_target_fails(self, tmp_path, capsys):
+        rec = make_record(tmp_path / "a.json",
+                          control_plane_mp=mp_phase(cores=4, speedup=1.4))
+        assert bench_gate.main([rec]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "mp speedup" in out
+
+    def test_self_gate_also_runs_on_paired_records(self, tmp_path, capsys):
+        # a family with a comparison pair must not skip the self-gate
+        old = make_record(tmp_path / "a.json",
+                          control_plane_mp=mp_phase(cores=8, speedup=3.0))
+        new = make_record(tmp_path / "b.json",
+                          control_plane_mp=mp_phase(cores=8, speedup=1.2))
+        assert bench_gate.main([old, new]) == 1
+        assert "mp speedup" in capsys.readouterr().out
+
+    def test_differing_cores_pair_skips_timing(self, tmp_path, capsys):
+        # 1-core p50 vs 8-core p50 is a hardware diff, not a regression;
+        # the new record's own speedup still gates (and passes here)
+        old = make_record(tmp_path / "a.json",
+                          control_plane_mp=mp_phase(p50=5.0, cores=1,
+                                                    speedup=0.9))
+        new = make_record(tmp_path / "b.json",
+                          control_plane_mp=mp_phase(p50=50.0, cores=8,
+                                                    speedup=3.0))
+        assert bench_gate.main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "differing core counts" in out and "PASS" in out

@@ -22,7 +22,9 @@ from cook_tpu.mp import (GroupShardRouter, ShardGroupTopology,
                          build_route_map, read_route_map, write_route_map)
 from cook_tpu.mp.twopc import DecisionLog, TwoPCCoordinator
 from cook_tpu.mp.worker import ShardGroupWorker
+from cook_tpu.obs import distributed
 from cook_tpu.shard.router import MisroutedKey, ShardRouter
+from cook_tpu.utils import tracing
 
 HDRS = {"X-Cook-Requesting-User": "alice"}
 
@@ -181,17 +183,20 @@ class _Fleet:
         self.fail_commits_to = set(fail_commits_to)
         self.log_path = str(tmp_path / "2pc-decisions.jsonl")
 
-    async def post(self, url, body, timeout_s):
+    async def post(self, url, body, timeout_s, headers=None):
         base, _, method = url.partition("/rpc/2pc/")
         group = int(base.rsplit("/", 1)[-1])
         if method == "commit" and group in self.fail_commits_to:
             raise ConnectionError("injected commit outage")
         participant = self.workers[group].participant
+        # the coordinator's trace context rides the headers, exactly as
+        # _RpcSurface would hand it to the participant
+        parent = (headers or {}).get(distributed.PARENT_SPAN_HEADER)
         if method == "abort":
-            return 200, participant.abort(body["txn_id"])
+            return 200, participant.abort(body["txn_id"], parent=parent)
         return 200, getattr(participant, method)(
             body["txn_id"], body["op"], body["user"],
-            body.get("payload") or {})
+            body.get("payload") or {}, parent=parent)
 
     def coordinator(self, **kw):
         kw.setdefault("retry_backoff_s", 0.0)
@@ -271,6 +276,73 @@ def test_twopc_decision_survives_commit_outage_and_replays(tmp_path):
         assert "j1" in fleet.workers[1].store.jobs
         # replay converges: running it again finds nothing outstanding
         assert asyncio.run(fresh.replay())["outstanding"] == 0
+    finally:
+        fleet.stop()
+
+
+def test_twopc_veto_trace_names_vetoing_group(fleet):
+    """A vetoed cross-group txn leaves a stitched trace naming WHO
+    said no: the coordinator's failed prepare span carries the group,
+    and the participant lands a twopc.veto marker on its own track."""
+    coord = fleet.coordinator()
+    per_group = fleet.submit_payloads("-vt")
+    per_group[1]["jobs"][0]["command"] = ""  # group 1 must veto
+    result = asyncio.run(coord.run(
+        txn_id="t-veto-trace", op="jobs/submit", user="alice",
+        per_group=per_group, rpc_urls=fleet.rpc_urls))
+    assert not result["ok"] and result["vetoed_by"] == 1
+    spans = tracing.spans_for_txn("t-veto-trace")
+    by_name = {}
+    for entry in spans:
+        by_name.setdefault(entry["name"], []).append(entry)
+    assert any(e["tags"].get("process") == "worker-g1"
+               for e in by_name["twopc.veto"])
+    assert any(e["tags"].get("group") == 1 and e["tags"].get("error")
+               for e in by_name["twopc.prepare"])
+    # participants opened their phase spans under the coordinator's
+    # X-Cook-Parent-Span, from BOTH groups' tracks
+    prepares = by_name["mp.participant.prepare"]
+    assert {e["parent"] for e in prepares} == {"twopc.prepare"}
+    assert {"worker-g0", "worker-g1"} <= {
+        e["tags"].get("process") for e in prepares}
+    # group 0 prepared fine and was unwound: its abort is in the trace
+    assert any(e["tags"].get("process") == "worker-g0"
+               for e in by_name["mp.participant.abort"])
+    # presumed abort: no decision write ever happened
+    assert "twopc.decision_write" not in by_name
+
+
+def test_twopc_replay_trace_names_replayed_group(tmp_path):
+    """A torn decision (commit outage after the fsynced decision write)
+    replays to convergence, and the stitched trace names the group the
+    replay finished: the failed + successful commit RPCs and the
+    participant's apply all carry the same txn id."""
+    fleet = _Fleet(tmp_path, fail_commits_to={1})
+    try:
+        coord = fleet.coordinator(commit_attempts=1)
+        result = asyncio.run(coord.run(
+            txn_id="t-replay-trace", op="jobs/submit", user="alice",
+            per_group=fleet.submit_payloads("-rt"),
+            rpc_urls=fleet.rpc_urls))
+        assert result["ok"] and result["pending_groups"] == [1]
+        fleet.fail_commits_to.clear()
+        asyncio.run(fleet.coordinator().replay())
+        spans = tracing.spans_for_txn("t-replay-trace")
+        commits = [e for e in spans if e["name"] == "twopc.commit"]
+        assert any(e["tags"].get("group") == 1 and e["tags"].get("error")
+                   for e in commits), "the outage never hit the ring"
+        assert any(e["tags"].get("group") == 1
+                   and not e["tags"].get("error")
+                   for e in commits), "no successful replayed commit"
+        applied = [e for e in spans
+                   if e["name"] == "mp.participant.commit"
+                   and e["tags"].get("process") == "worker-g1"]
+        assert applied and applied[-1]["parent"] == "twopc.commit"
+        # exactly one fsynced decision write, on the coordinator lane
+        decisions = [e for e in spans
+                     if e["name"] == "twopc.decision_write"]
+        assert len(decisions) == 1
+        assert decisions[0]["tags"]["process"] == "coordinator"
     finally:
         fleet.stop()
 
@@ -374,6 +446,143 @@ def test_frontend_debug_surfaces(runtime):
     assert "twopc" in frontend
 
 
+def test_frontend_merged_trace_for_cross_group_submit(runtime):
+    """The ISSUE's acceptance artifact: ONE merged Chrome trace for a
+    cross-group submit, with front-end (pid 0), coordinator-decision
+    (pid 1), and both participants' (pid >= 2) tracks under one
+    txn id."""
+    pool_a, pool_b = runtime.pools[1], runtime.pools[2]
+    txn_id = "txn-merged-trace"
+    resp = requests.post(
+        f"{runtime.url}/jobs",
+        headers={**HDRS, "X-Cook-Txn-Id": txn_id},
+        json={"jobs": [job_spec("tr-a", pool_a),
+                       job_spec("tr-b", pool_b)]})
+    assert resp.status_code == 201
+    raw = requests.get(f"{runtime.url}/debug/trace", headers=HDRS,
+                       params={"txn_id": txn_id, "format": "raw"}).json()
+    assert raw["txn_id"] == txn_id and raw["groups_failed"] == []
+    procs = {e["process"] for e in raw["spans"]}
+    assert "frontend" in procs and "coordinator" in procs
+    assert len({p for p in procs if p.startswith("worker-g")}) >= 2
+    names = {e["name"] for e in raw["spans"]}
+    assert {"mp.submit_2pc", "twopc.prepare", "twopc.decision_write",
+            "twopc.commit", "mp.participant.prepare",
+            "mp.participant.commit"} <= names
+    # chrome rendering: one pid track per process, contract pids
+    chrome = requests.get(f"{runtime.url}/debug/trace", headers=HDRS,
+                          params={"txn_id": txn_id}).json()
+    events = chrome["traceEvents"]
+    pids = {e["args"]["name"]: e["pid"] for e in events
+            if e["name"] == "process_name"}
+    assert pids["frontend"] == 0 and pids["coordinator"] == 1
+    worker_pids = [p for label, p in pids.items()
+                   if label.startswith("worker-g")]
+    assert len(worker_pids) >= 2 and all(p >= 2 for p in worker_pids)
+    decision = [e for e in events if e["name"] == "twopc.decision_write"]
+    assert decision and decision[0]["pid"] == 1  # the commit point
+    # bad requests fail crisply
+    assert requests.get(f"{runtime.url}/debug/trace",
+                        headers=HDRS).status_code == 400
+    assert requests.get(f"{runtime.url}/debug/trace", headers=HDRS,
+                        params={"txn_id": "x", "format": "svg"}
+                        ).status_code == 400
+
+
+def test_frontend_reports_nonzero_hop_splits(runtime):
+    """/debug/frontend splits forward time by hop from the worker's
+    X-Cook-Hop-Walls response header + the front end's own stamps."""
+    pool = runtime.pools[1]
+    for i in range(3):
+        resp = requests.post(f"{runtime.url}/jobs", headers=HDRS,
+                             json={"jobs": [job_spec(f"hop-{i}", pool)]})
+        assert resp.status_code == 201
+        assert "server" in resp.headers.get("X-Cook-Hop-Walls", ""), \
+            "worker phase walls never propagated back out"
+    g = str(runtime.supervisor.topology.group_for_pool(pool))
+    frontend = requests.get(f"{runtime.url}/debug/frontend",
+                            headers=HDRS).json()
+    hops = frontend["per_group"][g]["hops"]
+    for hop in ("queue", "transport", "apply", "fsync"):
+        assert hops[hop]["count"] > 0, f"no {hop} samples"
+        assert hops[hop]["p99_ms"] > 0.0, f"{hop} split is zero"
+
+
+def test_frontend_timeline_stitches_twopc_decision(runtime):
+    """/jobs/{uuid}/timeline through the front end folds the 2PC commit
+    decision + done markers into a cross-group job's event stream."""
+    pool_a, pool_b = runtime.pools[1], runtime.pools[2]
+    resp = requests.post(f"{runtime.url}/jobs", headers=HDRS, json={
+        "jobs": [job_spec("tl-a", pool_a), job_spec("tl-b", pool_b)]})
+    assert resp.status_code == 201
+    timeline = requests.get(f"{runtime.url}/jobs/tl-a/timeline",
+                            headers=HDRS).json()
+    kinds = [e["kind"] for e in timeline["events"]]
+    assert "2pc-commit-decision" in kinds and "2pc-done" in kinds
+    decision = next(e for e in timeline["events"]
+                    if e["kind"] == "2pc-commit-decision")
+    assert len(decision["groups"]) == 2
+    assert set(decision["prepare_ms"]) == \
+        {str(g) for g in decision["groups"]}
+    twopc = timeline["twopc"]
+    assert twopc["txn_id"] == decision["txn_id"]
+    assert twopc["done_t"] >= twopc["decided_t"]
+    # shared clock domain: the worker stamps jobs with wall-clock ms
+    # (ShardGroupWorker's default clock), so the decision-log event
+    # lands within seconds of the submit stamp — not decades away
+    # (the decision write precedes the commit apply that stamps the
+    # job, so the delta may be slightly negative)
+    assert abs(decision["t_ms"] - timeline["submit_time_ms"]) < 60_000
+    # a single-group job's timeline passes through unstitched
+    requests.post(f"{runtime.url}/jobs", headers=HDRS,
+                  json={"jobs": [job_spec("tl-solo", pool_a)]})
+    solo = requests.get(f"{runtime.url}/jobs/tl-solo/timeline",
+                        headers=HDRS).json()
+    assert "twopc" not in solo
+    # unknown uuid: 404, same contract as the worker's own surface
+    assert requests.get(f"{runtime.url}/jobs/no-such/timeline",
+                        headers=HDRS).status_code == 404
+
+
+def test_cli_renders_twopc_timeline_and_trace_waterfall(
+        runtime, tmp_path, capsys):
+    """`cs timeline` names the 2PC hop and `cs trace` renders the
+    merged cross-process waterfall when pointed at the mp front end."""
+    from cook_tpu.client.cli import main as cli_main
+
+    cfg = tmp_path / "cs.json"
+    cfg.write_text(json.dumps(
+        {"clusters": [{"name": "mp", "url": runtime.url}]}))
+    txn_id = "cli-mp-trace"
+    resp = requests.post(
+        f"{runtime.url}/jobs",
+        headers={**HDRS, "X-Cook-Txn-Id": txn_id},
+        json={"jobs": [job_spec("cli-a", runtime.pools[1]),
+                       job_spec("cli-b", runtime.pools[2])]})
+    assert resp.status_code == 201
+    assert cli_main(["--config", str(cfg), "--user", "alice",
+                     "timeline", "cli-a"]) == 0
+    out = capsys.readouterr().out
+    assert "2PC commit decision across groups" in out
+    assert "2PC done across groups" in out
+    assert cli_main(["--config", str(cfg), "--user", "alice",
+                     "trace", txn_id]) == 0
+    out = capsys.readouterr().out
+    for process in ("frontend", "coordinator", "worker-g"):
+        assert process in out, f"{process} track missing from waterfall"
+    assert "mp.submit_2pc" in out and "twopc.decision_write" in out
+    assert "█" in out  # bars, not just labels
+    # --json round-trips the merged raw body
+    assert cli_main(["--config", str(cfg), "--user", "alice",
+                     "trace", txn_id, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["spans"] and parsed["cluster"] == "mp"
+    # an unknown txn id exits non-zero with a retention hint
+    assert cli_main(["--config", str(cfg), "--user", "alice",
+                     "trace", "never-seen"]) == 1
+    assert "no spans" in capsys.readouterr().err
+
+
 def test_supervisor_failover_promotes_standby_and_keeps_acks(tmp_path):
     from cook_tpu.mp.supervisor import MpRuntime
 
@@ -416,5 +625,37 @@ def test_supervisor_failover_promotes_standby_and_keeps_acks(tmp_path):
         resp = requests.post(f"{runtime.url}/jobs", headers=HDRS,
                              json={"jobs": [job_spec("fo-new", pool0)]})
         assert resp.status_code == 201
+        # federated incident: the fleet poller saw the victim's
+        # ok->degraded edge and captured through the FRONT END's
+        # recorder, embedding the mp evidence collectors
+        fed = [b for b in runtime.frontend.incidents.bundles()
+               if b["trigger"] == "fleet-peer"]
+        assert fed, "no federated incident for the killed worker"
+        bundle = runtime.frontend.incidents.get(fed[-1]["id"])
+        assert bundle["verdict"]["federated"]
+        assert bundle["verdict"]["peer"].rstrip("/") == \
+            old_url.rstrip("/")
+        assert "records" in bundle["decision_log"]
+        assert set(bundle["breakers"]) == {"0", "1"}
+        assert bundle["route_map"]["groups"]
+        # ...and the front end's /debug/incidents serves the same index
+        served = requests.get(f"{runtime.url}/debug/incidents",
+                              headers=HDRS).json()
+        assert fed[-1]["id"] in {b["id"] for b in served["incidents"]}
+        # the adoption is traceable: the supervisor stamped the adopt
+        # RPC with a failover correlation id, and the adopter opened
+        # mp.adopt on its OWN group's track under mp.failover
+        adopts = [e for e in tracing.recent_spans(4096)
+                  if e["name"] == "mp.adopt"
+                  and e["tags"].get("group") == victim]
+        assert adopts, "no mp.adopt span for the failover"
+        adopt = adopts[-1]
+        assert adopt["parent"] == "mp.failover"
+        assert adopt["tags"]["process"] == f"worker-g{victim}"
+        failover_txn = adopt["tags"]["txn_id"]
+        assert failover_txn.startswith(f"failover-{victim}-")
+        stitched = tracing.spans_for_txn(failover_txn)
+        assert {"mp.adopt", "mp.failover"} <= \
+            {e["name"] for e in stitched}
     finally:
         runtime.stop()

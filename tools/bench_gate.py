@@ -56,6 +56,16 @@ SCHEMA = "cook-bench/v1"
 # is the regression the phase exists to catch, not an informational diff
 BYTE_GATED_PREFIXES = ("match_resident",)
 
+# the control_plane_mp phase records `cores` and
+# `rps_speedup_vs_sharded`: worker PROCESSES only beat the in-process
+# sharded plane when they actually get cores, so the >= 2.5x target
+# SELF-GATES (newest record, no pair needed) only when the run had the
+# cores to meet it; below the floor the comparison stays recorded, not
+# gated (bench.py bench_control_plane_mp)
+MP_PHASE_PREFIX = "control_plane_mp"
+MP_GATE_MIN_CORES = 4
+MP_SPEEDUP_TARGET = 2.5
+
 
 def load_record(path: str) -> dict | None:
     """Parse one bench artifact; returns a normalized record or None for
@@ -87,8 +97,13 @@ def load_record(path: str) -> dict | None:
                    # the ledger simply diff nothing); warm_cycles feeds
                    # bench_history's warm/cold residency split
                    **{col: int(info[col]) for col in
-                      ("h2d_bytes", "d2h_bytes", "warm_cycles")
-                      if col in info}}
+                      ("h2d_bytes", "d2h_bytes", "warm_cycles", "cores")
+                      if col in info},
+                   # the mp phase's recorded fleet-vs-sharded speedup
+                   # (self-gated when cores allow; see gate_mp_speedup)
+                   **({"rps_speedup_vs_sharded":
+                       float(info["rps_speedup_vs_sharded"])}
+                      if "rps_speedup_vs_sharded" in info else {})}
             for name, info in phases.items()
             if isinstance(info, dict) and "p50_ms" in info
         },
@@ -169,6 +184,42 @@ def diff_bytes(old: dict, new: dict, bytes_threshold,
                 regressions.append(f"{phase} ({col})")
 
 
+def gate_mp_speedup(record: dict, messages: list[str],
+                    regressions: list[str]) -> bool:
+    """Self-gate the newest record's control_plane_mp phase(s): when the
+    run had >= MP_GATE_MIN_CORES cores, a fleet-vs-sharded speedup below
+    MP_SPEEDUP_TARGET regresses; on fewer cores worker processes cannot
+    win (forwarding overhead, no parallelism), so the speedup stays
+    recorded-not-gated.  Returns True when any phase was evaluated."""
+    evaluated = False
+    for phase in sorted(record["phases"]):
+        if not phase.startswith(MP_PHASE_PREFIX):
+            continue
+        info = record["phases"][phase]
+        cores = info.get("cores")
+        speedup = info.get("rps_speedup_vs_sharded")
+        if cores is None or speedup is None:
+            continue
+        evaluated = True
+        if cores < MP_GATE_MIN_CORES:
+            messages.append(
+                f"bench_gate:   {phase}: {speedup:.2f}x vs sharded on "
+                f"{cores} core(s) — recorded, not gated (the "
+                f"{MP_SPEEDUP_TARGET}x target needs >= "
+                f"{MP_GATE_MIN_CORES} cores)")
+        elif speedup < MP_SPEEDUP_TARGET:
+            messages.append(
+                f"bench_gate:   {phase}: {speedup:.2f}x vs sharded on "
+                f"{cores} cores REGRESSION (target >= "
+                f"{MP_SPEEDUP_TARGET}x at >= {MP_GATE_MIN_CORES} cores)")
+            regressions.append(f"{phase} (mp speedup)")
+        else:
+            messages.append(
+                f"bench_gate:   {phase}: {speedup:.2f}x vs sharded on "
+                f"{cores} cores ok (target {MP_SPEEDUP_TARGET}x)")
+    return evaluated
+
+
 def gate(records: list[dict], threshold: float,
          min_delta_ms: float = 2.0, bytes_threshold: float = None,
          bytes_only: bool = False) -> tuple[int, list[str]]:
@@ -186,6 +237,25 @@ def gate(records: list[dict], threshold: float,
     compared = False
     for (mode, platform), family in sorted(families.items()):
         if len(family) < 2:
+            # a singleton family still self-gates its mp speedup: the
+            # target is within ONE record (fleet vs its own inline
+            # sharded baseline), no pair needed
+            if bytes_only:
+                continue
+            regressions: list[str] = []
+            mp_msgs: list[str] = []
+            if not gate_mp_speedup(family[-1], mp_msgs, regressions):
+                continue
+            compared = True
+            messages.append(
+                f"bench_gate: {family[-1]['path']} (mode={mode}, "
+                f"platform={platform}): mp speedup self-gate")
+            messages.extend(mp_msgs)
+            if regressions:
+                regressed_families += 1
+                messages.append(
+                    f"bench_gate: FAIL — {len(regressions)} phase(s) "
+                    f"regressed: {', '.join(regressions)}")
             continue
         compared = True
         old, new = family[-2], family[-1]
@@ -220,6 +290,10 @@ def gate(records: list[dict], threshold: float,
                 f"hardware before gating (or pass --bytes-only)")
             regressed_families += 1
             continue
+        # the newest record's mp speedup target is gated here too — the
+        # self-gate needs no pair, but a family WITH a pair must not
+        # skip it
+        gate_mp_speedup(new, messages, regressions)
         for phase in sorted(set(old["phases"]) & set(new["phases"])):
             oinfo, ninfo = old["phases"][phase], new["phases"][phase]
             if (oinfo.get("backend") and ninfo.get("backend")
@@ -229,6 +303,16 @@ def gate(records: list[dict], threshold: float,
                     f"different backends ({oinfo['backend']} vs "
                     f"{ninfo['backend']})")
                 regressions.append(f"{phase} (cross-backend)")
+                continue
+            if (oinfo.get("cores") and ninfo.get("cores")
+                    and oinfo["cores"] != ninfo["cores"]):
+                # p50 on 1 core vs 8 cores is a hardware diff, not a
+                # regression signal — skip the timing pair, keep the
+                # phase visible
+                messages.append(
+                    f"bench_gate:   {phase}: timing comparison skipped "
+                    f"— records taken on differing core counts "
+                    f"({oinfo['cores']} vs {ninfo['cores']})")
                 continue
             before, after = oinfo["p50_ms"], ninfo["p50_ms"]
             if before <= 0:

@@ -736,6 +736,37 @@ def scenario_killed_worker() -> list:
         steps.append(f"standby adopted group {victim}'s journal "
                      f"segments (map_seq {shards['map_seq']})")
 
+        # diagnosis: the fleet poller saw the victim's ok->degraded edge
+        # and captured a FEDERATED incident through the front end's
+        # recorder — one bundle embedding the 2PC decision-log tail, the
+        # breaker states, and the route map (obs/distributed.py)
+        def federated_bundle():
+            status, _, index = _get(f"{url}/debug/incidents")
+            if status != 200:
+                return None
+            fed = [b for b in index.get("incidents", [])
+                   if b.get("trigger") == "fleet-peer"]
+            return fed[-1] if fed else None
+        summary = _wait_until(federated_bundle, timeout_s=30.0,
+                              interval_s=0.3,
+                              what="a fleet-peer incident bundle at "
+                                   "the front end")
+        status, _, bundle = _get(f"{url}/debug/incidents/{summary['id']}")
+        _check(status == 200,
+               f"federated bundle {summary['id']} not served by id")
+        for evidence in ("decision_log", "breakers", "route_map"):
+            _check(isinstance(bundle.get(evidence), dict)
+                   and "error" not in bundle[evidence],
+                   f"federated bundle missing {evidence} evidence: "
+                   f"{bundle.get(evidence)}")
+        _check(bundle["decision_log"].get("records") is not None,
+               "decision_log evidence carries no records field")
+        _check(bundle["route_map"].get("groups"),
+               "route_map evidence carries no groups")
+        steps.append(f"diagnosis: federated incident {summary['id']} "
+                     f"(trigger fleet-peer) embeds decision-log tail, "
+                     f"breaker states, and the route map")
+
         # recovery: the victim pool acks again...
         def victim_acks():
             return submit(victim_pool, f"kw-post-{int(time.monotonic()*1e3)%100000}",

@@ -12,6 +12,14 @@ generated OpenAPI doc, GETs every `/debug` path (plus the per-job
 timeline), and asserts every answer is the expected status with a
 parseable JSON body.
 
+A second rig boots the multi-process analog in-process — `MpRuntime`
+(supervisor + shard-group workers + front end) — drives single- and
+cross-group submits through the front end, and walks ITS debug
+surface: /debug/shards, /debug/frontend (per-hop latency splits must
+be non-zero), the federated /debug/trace?txn_id= (the merged Chrome
+trace must carry front-end + coordinator + both participants'
+tracks), and the federated incident routes.
+
     python tools/debug_smoke.py
 
 Wired into `tools/ci_checks.py` as the `debug_smoke` step (subprocess:
@@ -103,6 +111,137 @@ def smoke_paths(api, incident_id: str) -> list[str]:
     return paths + ["/jobs/smoke-0/timeline"]
 
 
+def _http(url: str, body: dict | None = None,
+          headers: dict | None = None):
+    """One request, JSON in/out: (status, parsed_or_None, n_bytes)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method="POST" if data else "GET",
+        headers={**ADMIN, "Content-Type": "application/json",
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            status, raw = r.status, r.read()
+    except urllib.error.HTTPError as e:
+        status, raw = e.code, e.read()
+    try:
+        return status, json.loads(raw), len(raw)
+    except ValueError:
+        return status, None, len(raw)
+
+
+def mp_smoke() -> list[str]:
+    """Boot an in-process MpRuntime, push traffic through the front
+    end, then walk its debug surface — the cross-process tracing /
+    incident routes that no single-node rig exercises."""
+    from cook_tpu.mp.supervisor import MpRuntime
+
+    failures: list[str] = []
+
+    def check(path: str, ok: bool, problem: str, n_bytes: int) -> None:
+        if ok:
+            print(f"debug_smoke[mp]: {path}: 200 OK ({n_bytes} bytes)")
+        else:
+            failures.append(f"mp {path}: {problem}")
+            print(f"debug_smoke[mp]: {path}: FAIL ({problem})")
+
+    def spec(uuid: str, pool: str) -> dict:
+        return {"uuid": uuid, "command": "true", "pool": pool,
+                "mem": 64, "cpus": 1}
+
+    runtime = MpRuntime(n_groups=2, standbys=0, inprocess=True,
+                        poll_s=30.0)
+    try:
+        pool_a, pool_b = runtime.pools[1], runtime.pools[2]
+        # single-group forwards: the hop reservoirs need samples before
+        # /debug/frontend can report non-zero splits
+        for i in range(3):
+            status, _, _ = _http(f"{runtime.url}/jobs", body={
+                "jobs": [spec(f"mp-hop-{i}", pool_a)]})
+            if status != 201:
+                failures.append(f"mp submit mp-hop-{i}: status {status}")
+        # cross-group submit under a known txn id: the 2PC spans this
+        # mints are what /debug/trace must stitch into one trace
+        txn_id = "smoke-mp-trace"
+        status, _, _ = _http(
+            f"{runtime.url}/jobs",
+            body={"jobs": [spec("mp-tr-a", pool_a),
+                           spec("mp-tr-b", pool_b)]},
+            headers={"X-Cook-Txn-Id": txn_id})
+        if status != 201:
+            failures.append(f"mp cross-group submit: status {status}")
+        # mint one incident through the FRONT END's recorder so the
+        # federated routes have a bundle (mp collectors embed the 2PC
+        # decision-log tail, breaker states, and the route map)
+        incident = runtime.frontend.incidents.capture(
+            {"healthy": False, "reasons": ["debug-smoke"]},
+            trigger="smoke")
+
+        status, shards, n = _http(f"{runtime.url}/debug/shards")
+        check("/debug/shards",
+              status == 200 and isinstance(shards, dict)
+              and shards.get("groups"),
+              f"status {status} / no groups in route map", n)
+
+        status, fe, n = _http(f"{runtime.url}/debug/frontend")
+        g = str(runtime.supervisor.topology.group_for_pool(pool_a))
+        hops = ((fe or {}).get("per_group", {}).get(g) or {}).get(
+            "hops", {})
+        flat = (status == 200) and [
+            hop for hop in ("queue", "transport", "apply", "fsync")
+            if not (hops.get(hop, {}).get("count", 0) > 0
+                    and hops.get(hop, {}).get("p99_ms", 0.0) > 0.0)]
+        check("/debug/frontend",
+              status == 200 and flat == [],
+              f"status {status} / zero hop splits {flat}", n)
+
+        status, raw, n = _http(
+            f"{runtime.url}/debug/trace?txn_id={txn_id}&format=raw")
+        procs = {s.get("process") for s in (raw or {}).get("spans", [])}
+        workers = {p for p in procs if str(p).startswith("worker-g")}
+        check("/debug/trace?format=raw",
+              status == 200 and raw.get("groups_failed") == []
+              and "frontend" in procs and "coordinator" in procs
+              and len(workers) >= 2,
+              f"status {status} / merged processes {sorted(map(str, procs))}",
+              n)
+
+        status, chrome, n = _http(
+            f"{runtime.url}/debug/trace?txn_id={txn_id}")
+        pids = {e["args"]["name"]: e["pid"]
+                for e in (chrome or {}).get("traceEvents", [])
+                if e.get("name") == "process_name"}
+        check("/debug/trace",
+              status == 200 and pids.get("frontend") == 0
+              and pids.get("coordinator") == 1
+              and sum(1 for name, pid in pids.items()
+                      if name.startswith("worker-g") and pid >= 2) >= 2,
+              f"status {status} / pid tracks {pids}", n)
+
+        status, _, n = _http(f"{runtime.url}/debug/trace")
+        check("/debug/trace (no txn_id)", status == 400,
+              f"expected 400, got {status}", n)
+
+        status, index, n = _http(f"{runtime.url}/debug/incidents")
+        ids = {b.get("id") for b in (index or {}).get("incidents", [])}
+        check("/debug/incidents",
+              status == 200 and incident["id"] in ids,
+              f"status {status} / bundle index {sorted(map(str, ids))}",
+              n)
+
+        status, bundle, n = _http(
+            f"{runtime.url}/debug/incidents/{incident['id']}")
+        missing = (status == 200) and [
+            k for k in ("decision_log", "breakers", "route_map")
+            if not isinstance((bundle or {}).get(k), dict)]
+        check(f"/debug/incidents/{incident['id']}",
+              status == 200 and missing == [],
+              f"status {status} / missing evidence {missing}", n)
+    finally:
+        runtime.stop()
+    return failures
+
+
 def main(argv=None) -> int:
     from cook_tpu.rest.server import ServerThread
 
@@ -167,6 +306,7 @@ def main(argv=None) -> int:
                       f"({len(body)} bytes)")
     finally:
         server.stop()
+    failures += mp_smoke()
     if failures:
         print(f"debug_smoke: FAILED: {len(failures)} endpoint(s)")
         return 1
