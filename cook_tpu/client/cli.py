@@ -327,6 +327,15 @@ def cmd_timeline(args) -> int:
         elif kind == "preempted":
             line = (f"PREEMPTED on {event.get('host', '?')} "
                     f"({event.get('reason', '?')})")
+            ledger = event.get("preemption")
+            if ledger:
+                detail = [f"by user {ledger.get('preemptor_user', '?')}"]
+                if ledger.get("dru_at_decision") is not None:
+                    detail.append(f"dru {ledger['dru_at_decision']:.3f}")
+                if ledger.get("runtime_lost_s") is not None:
+                    detail.append(
+                        f"runtime lost {ledger['runtime_lost_s']:.1f}s")
+                line += "  [" + ", ".join(detail) + "]"
         elif kind == "instance-failed":
             line = (f"instance failed on {event.get('host', '?')} "
                     f"({event.get('reason', '?')})")
@@ -479,6 +488,57 @@ def cmd_fleet(args) -> int:
                   f"({worst_shard['staleness_ms']:.0f}ms behind)")
         if status != "ok":
             rc = 1
+    return rc
+
+
+def cmd_fairness(args) -> int:
+    """Render the fairness observatory (GET /debug/fairness): per-pool
+    Jain index, per-user DRU trajectories, preemption rollups and the
+    recent ledger tail."""
+    rc = 0
+    for cluster, client in _clients(args):
+        body = client.fairness(pool=args.pool, ledger=args.ledger)
+        if args.json:
+            print(json.dumps({"cluster": cluster.name, **body}, indent=2))
+            continue
+        pools = body.get("pools", {})
+        if not pools:
+            print(f"{cluster.name}: no fairness samples yet "
+                  "(has a rank cycle run?)")
+            continue
+        for pool, view in sorted(pools.items()):
+            jain = view.get("jain_index")
+            rollups = view.get("rollups", {})
+            wasted = rollups.get("wasted_s", {})
+            frag = view.get("fragmentation", {})
+            print(f"{cluster.name}/{pool}: jain {jain:.3f}  "
+                  f"preemptions {rollups.get('preemptions', 0)} "
+                  f"({rollups.get('tasks_preempted', 0)} tasks)  "
+                  f"wasted {wasted.get('fairness', 0.0):.1f}s fairness / "
+                  f"{wasted.get('mea_culpa', 0.0):.1f}s mea-culpa  "
+                  f"fragmentation {frag.get('fragmentation', 0.0):.2f}")
+            users = view.get("trajectories", {})
+            for user in sorted(users,
+                               key=lambda u: users[u].get("dru", 0.0),
+                               reverse=True):
+                point = users[user]
+                usage = point.get("usage", {})
+                line = (f"   {user:16s} dru {point.get('dru', 0.0):7.3f}  "
+                        f"mem {usage.get('mem', 0.0):8.0f}  "
+                        f"cpus {usage.get('cpus', 0.0):5.1f}  "
+                        f"queued {point.get('queued', 0)}")
+                if point.get("queue_dru") is not None:
+                    line += f"  queue-dru {point['queue_dru']:.3f}"
+                print(line)
+            for entry in view.get("ledger", [])[-args.ledger:]:
+                victims = entry.get("victims", [])
+                vusers = sorted({v.get("user", "?") for v in victims})
+                print(f"   ledger t={entry.get('t_ms', 0)}ms "
+                      f"{entry.get('preemptor_user', '?')} preempted "
+                      f"{len(victims)} task(s) of {', '.join(vusers)} "
+                      f"on {entry.get('hostname', '?')} "
+                      f"(dru {entry.get('min_preempted_dru', 0.0):.3f}, "
+                      f"wasted {entry.get('wasted_s', 0.0):.1f}s)")
     return rc
 
 
@@ -719,6 +779,16 @@ def build_parser() -> argparse.ArgumentParser:
              " one row per node with peer health/staleness")
     q.add_argument("--json", action="store_true")
     q.set_defaults(fn=cmd_fleet)
+
+    q = sub.add_parser(
+        "fairness",
+        help="render the fairness observatory (GET /debug/fairness): "
+             "per-user DRU trajectories, preemption ledger, Jain index")
+    q.add_argument("--pool", default=None, help="narrow to one pool")
+    q.add_argument("--ledger", type=int, default=10,
+                   help="recent preemption-ledger entries to show")
+    q.add_argument("--json", action="store_true")
+    q.set_defaults(fn=cmd_fairness)
 
     q = sub.add_parser("config", help="show or edit the federation config")
     q.add_argument("--add-cluster", nargs=2, metavar=("NAME", "URL"))

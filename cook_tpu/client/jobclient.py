@@ -271,6 +271,17 @@ class JobClient:
         per node, peer staleness, federation reasons)."""
         return self._request("GET", "/debug/fleet").json()
 
+    def fairness(self, pool: Optional[str] = None,
+                 ledger: int = 50) -> dict:
+        """GET /debug/fairness: per-(pool, user) DRU trajectories, the
+        preemption ledger (preemptor/victim users, wasted-work seconds),
+        per-pool rollups + Jain index + fragmentation.  Against the mp
+        front end the body merges every shard group's pools."""
+        params: dict = {"ledger": ledger}
+        if pool:
+            params["pool"] = pool
+        return self._request("GET", "/debug/fairness", params=params).json()
+
     def trace(self, txn_id: str) -> dict:
         """GET /debug/trace?txn_id=: one transaction's merged
         cross-process trace (raw span records; the mp front end

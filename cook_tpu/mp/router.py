@@ -68,6 +68,9 @@ MAP_CHECK_INTERVAL_S = 0.25
 SCATTER_ROUTES = frozenset({
     "/queue", "/running", "/list", "/unscheduled_jobs",
     "/stats/instances", "/usage", "/pools",
+    # pool-keyed fairness bodies: pools are group-owned and disjoint, so
+    # the dict-union merge composes them without summing anything
+    "/debug/fairness",
 })
 
 

@@ -99,6 +99,10 @@ _EXPORTS = {
     "job_timeline": ("cook_tpu.obs.incident", "job_timeline"),
     "MetricsHistory": ("cook_tpu.obs.tsdb", "MetricsHistory"),
     "HistoryConfig": ("cook_tpu.obs.tsdb", "HistoryConfig"),
+    "FairnessObservatory": ("cook_tpu.obs.fairness", "FairnessObservatory"),
+    "FairnessConfig": ("cook_tpu.obs.fairness", "FairnessConfig"),
+    "FAIRNESS_DRIFT": ("cook_tpu.obs.fairness", "FAIRNESS_DRIFT"),
+    "jain_index": ("cook_tpu.obs.fairness", "jain_index"),
     "FleetObservatory": ("cook_tpu.obs.fleet", "FleetObservatory"),
     "PEER_UNREACHABLE": ("cook_tpu.obs.fleet", "PEER_UNREACHABLE"),
     "PEER_DEGRADED": ("cook_tpu.obs.fleet", "PEER_DEGRADED"),

@@ -265,7 +265,7 @@ def add_default_collectors(recorder: IncidentRecorder, *,
 _MATCHED = "matched"
 
 
-def job_timeline(store, recorder, job) -> dict:
+def job_timeline(store, recorder, job, fairness=None) -> dict:
     """One job's causally-ordered lifecycle: submit, per-cycle rank/skip
     decisions (consecutive same-reason cycles compressed into one event
     with a count), launches, instance terminations (preemptions called
@@ -273,6 +273,10 @@ def job_timeline(store, recorder, job) -> dict:
 
     `store` is the JobStore, `recorder` the FlightRecorder (None
     tolerated: the timeline then carries only store-derived events).
+    `fairness` is the FairnessObservatory (None tolerated): when its
+    preemption ledger knows a killed instance, the bare `preempted`
+    event gains the ledger's detail — preemptor user/job, the victim's
+    DRU at decision time, and the runtime destroyed.
     Times are store-clock milliseconds throughout (virtual in the
     simulator), the same clock `submit_time_ms` uses."""
     from cook_tpu.models.reasons import REASONS_BY_CODE
@@ -368,6 +372,10 @@ def job_timeline(store, recorder, job) -> dict:
         if reason is not None:
             terminal["reason"] = reason.name
             terminal["mea_culpa"] = reason.mea_culpa
+        if preempted and fairness is not None:
+            detail = fairness.victim_detail(inst.task_id)
+            if detail is not None:
+                terminal["preemption"] = detail
         events.append(terminal)
         # the job re-queued after this attempt died — true for every
         # failed non-final attempt (a later attempt exists), and for a

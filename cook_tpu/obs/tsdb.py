@@ -73,6 +73,7 @@ DEFAULT_KEY_SERIES = (
     "job.latency.submit_commit_ack.",
     "match.matched",
     "rank.queue_len",
+    "fairness.",
 )
 
 

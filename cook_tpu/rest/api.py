@@ -264,6 +264,18 @@ class CookApi:
         # (components.py) attaches a started FleetObservatory; None =
         # this node does not federate (GET /debug/fleet says so)
         self.fleet = None
+        # fairness observatory (cook_tpu/obs/fairness.py): adopt the
+        # scheduler's (rank/rebalance cycles feed it) or stand up a
+        # local one on scheduler-less nodes (mp shard-group workers) so
+        # /debug/fairness scatter-merges fleet-wide and the incident
+        # bundle carries fairness evidence either way
+        self.fairness = getattr(scheduler, "fairness", None)
+        if self.fairness is None:
+            from cook_tpu.obs.fairness import FairnessObservatory
+
+            self.fairness = FairnessObservatory(clock=store.clock)
+            self.fairness.recover(store)
+            self.incidents.add_collector("fairness", self.fairness.collector)
 
     def _starvation_view(self) -> dict:
         from cook_tpu.scheduler.monitor import starvation_stats
@@ -341,6 +353,7 @@ class CookApi:
         r.add_get("/debug/incidents/{incident_id}", self.get_debug_incident)
         r.add_get("/debug/history", self.get_debug_history)
         r.add_get("/debug/fleet", self.get_debug_fleet)
+        r.add_get("/debug/fairness", self.get_debug_fairness)
         r.add_get("/debug/profile", self.get_debug_profile)
         r.add_post("/debug/profile", self.post_debug_profile)
         r.add_get("/jobs/{uuid}/timeline", self.get_job_timeline)
@@ -426,8 +439,13 @@ class CookApi:
             # flap on every probe
             verdict = telemetry.health(observe=False)
         degradations, checks = self.contention.evaluate()
+        # fairness drift (obs/fairness.py): a sustained Jain-index drop
+        # joins the merged verdict the same way the contention half does
+        fair_degradations = self.fairness.health_degradations()
+        degradations = degradations + fair_degradations
         verdict["degradations"] = verdict["degradations"] + degradations
         verdict["checks"]["contention"] = checks
+        verdict["checks"]["fairness"] = self.fairness.health_checks()
         verdict["reasons"] = sorted(
             set(verdict["reasons"]) | {d["reason"] for d in degradations})
         if degradations:
@@ -732,6 +750,22 @@ class CookApi:
             })
         return web.json_response(self.fleet.verdict())
 
+    async def get_debug_fairness(self, request: web.Request) -> web.Response:
+        """Fairness observatory (cook_tpu/obs/fairness.py): per-(pool,
+        user) DRU trajectories (share, quota, usage, DRU score, queued
+        depth), the preemption ledger (preemptor/victim users, DRU at
+        decision, wasted-work seconds), per-pool rollups + Jain
+        fairness index + fragmentation stat.  `?pool=` narrows to one
+        pool; `?ledger=` bounds the ledger tail (default 50).  Body is
+        pool-keyed so the mp front end scatter-merges shard groups."""
+        pool = request.query.get("pool")
+        try:
+            ledger_limit = int(request.query.get("ledger", "50"))
+        except ValueError:
+            return _err(400, "ledger must be an integer")
+        return web.json_response(
+            self.fairness.snapshot(pool=pool, ledger_limit=ledger_limit))
+
     async def get_debug_profile(self, request: web.Request) -> web.Response:
         """Profile-capture status: the in-flight capture (if any), recent
         captures with their log dirs, and the auto-capture cooldown."""
@@ -779,7 +813,7 @@ class CookApi:
         if job is None:
             return _err(404, "unknown job")
         return web.json_response(job_timeline(self.store, self._recorder(),
-                                              job))
+                                              job, fairness=self.fairness))
 
     @web.middleware
     async def _endpoint_middleware(self, request: web.Request, handler):

@@ -289,6 +289,15 @@ class Scheduler:
                 "cycles", lambda: self.recorder.records_json(limit=50))
         if self.telemetry is not None:
             self.telemetry.health_observer = self.incidents.observe
+        # fairness observatory (obs/fairness.py): per-user DRU
+        # trajectories fed from rank_cycle, the preemption ledger fed
+        # from rebalance_cycle, wasted-work rollups recovered from the
+        # store's terminal instances after failover
+        from cook_tpu.obs.fairness import FairnessObservatory
+
+        self.fairness = FairnessObservatory(clock=store.clock)
+        self.fairness.recover(store)
+        self.incidents.add_collector("fairness", self.fairness.collector)
         self._last_rank_s: dict[str, float] = {}
         # elastic capacity plane: capacity deltas commit through the txn
         # pipeline (components.py wires the journal-backed log in; a bare
@@ -365,6 +374,7 @@ class Scheduler:
             inst = self.store.instances.get(event.data["task_id"])
             if job is not None and inst is not None:
                 self.plugins.on_completion(job, inst)
+                self._note_wasted_work(job, inst)
         if event.kind != "job/state" or event.data.get("state") != "completed":
             return
         job_uuid = event.data["uuid"]
@@ -375,6 +385,26 @@ class Scheduler:
                 self.store.update_instance_state(
                     inst.task_id, InstanceStatus.FAILED, "killed-by-user"
                 )
+
+    def _note_wasted_work(self, job, inst) -> None:
+        """Mea-culpa wasted-work accounting for NON-rebalancer kills
+        (e.g. the backing cluster preempted the container, reason
+        `container-preempted`).  Rebalancer preemptions are accounted at
+        decision time by rebalance_cycle -> fairness.record_decisions,
+        and their instance/status event lands here too — skip them or
+        the wasted seconds double-count."""
+        from cook_tpu.models.reasons import REASONS_BY_CODE
+
+        if inst.status != InstanceStatus.FAILED or inst.reason_code is None:
+            return
+        reason = REASONS_BY_CODE.get(inst.reason_code)
+        if (reason is None or not reason.mea_culpa
+                or reason.name == "preempted-by-rebalancer"):
+            return
+        end_ms = inst.end_time_ms or self.store.clock()
+        wasted_s = max(0.0, (end_ms - inst.start_time_ms) / 1000.0)
+        self.fairness.note_kill(job.pool, job.user, inst.task_id,
+                                wasted_s, reason=reason.name)
 
     # -------------------------------------------------------------- cycles
 
@@ -476,6 +506,10 @@ class Scheduler:
         global_registry.gauge(
             "rank.queue_len", "ranked queue length per pool").set(
             len(queue.jobs), {"pool": pool.name})
+        # fairness trajectory sample: the rank cycle is the one moment
+        # the per-user fair-share picture (queue DRU + running usage) is
+        # coherent in one place
+        self.fairness.observe_rank(pool.name, queue, self._pool_store(pool))
         # stash the duration so the NEXT match cycle's flight record can
         # claim its rank phase even when ranking is driven separately
         # (components.py rank trigger, the simulator's explicit rank step)
@@ -899,15 +933,52 @@ class Scheduler:
             reclaimer=(self.elastic.reclaim_for
                        if self.elastic is not None else None),
         )
+        # fairness ledger: per-victim wasted-work seconds must be read
+        # BEFORE _transact_preemption flips the instances terminal (the
+        # runtime destroyed is clock() - start at the kill)
+        now_ms = self.store.clock()
+        ledger_entries = []
+        for d in decisions:
+            if not d.task_ids:
+                continue
+            victims = []
+            for v in d.victims:
+                inst = self.store.instances.get(v["task_id"])
+                wasted_s = 0.0
+                # start_time_ms is always clock-stamped at create; 0 is
+                # a REAL start under the simulator's virtual clock
+                if inst is not None and not inst.status.terminal:
+                    wasted_s = max(
+                        0.0, (now_ms - inst.start_time_ms) / 1000.0)
+                victims.append(dict(v, wasted_s=round(wasted_s, 3)))
+            ledger_entries.append({
+                "t_ms": now_ms,
+                "preemptor_job": d.job.uuid,
+                "preemptor_user": d.job.user,
+                "hostname": d.hostname,
+                "min_preempted_dru": d.min_preempted_dru,
+                "victims": victims,
+                "wasted_s": round(sum(v["wasted_s"] for v in victims), 3),
+                "freed": {"mem": sum(v["mem"] for v in victims),
+                          "cpus": sum(v["cpus"] for v in victims),
+                          "gpus": sum(v["gpus"] for v in victims)},
+            })
+        fairness_rollup = self.fairness.record_decisions(
+            pool.name, ledger_entries)
         if self.recorder is not None:
+            by_job = {e["preemptor_job"]: e for e in ledger_entries}
             self.recorder.annotate_preemptions(
                 pool.name,
                 [PreemptionRecord(
                     job_uuid=d.job.uuid, hostname=d.hostname,
                     task_ids=list(d.task_ids),
-                    min_preempted_dru=d.min_preempted_dru)
+                    min_preempted_dru=d.min_preempted_dru,
+                    preemptor_user=d.job.user,
+                    victims=by_job.get(d.job.uuid, {}).get("victims", []),
+                    wasted_s=by_job.get(d.job.uuid, {}).get("wasted_s", 0.0))
                  for d in decisions if d.task_ids],
                 _time.perf_counter() - t0,
+                fairness=fairness_rollup if ledger_entries else None,
             )
         for decision in decisions:
             self._transact_preemption(decision)

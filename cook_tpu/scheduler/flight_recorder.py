@@ -78,6 +78,10 @@ class PreemptionRecord:
     hostname: str
     task_ids: list[str]           # victims
     min_preempted_dru: float      # the DRU score that justified the kill
+    preemptor_user: str = ""      # the beneficiary's user
+    # per-victim fairness detail: [{task_id, user, dru, wasted_s, ...}]
+    victims: list[dict] = field(default_factory=list)
+    wasted_s: float = 0.0         # victim runtime destroyed, seconds
 
     def to_json(self) -> dict:
         return {
@@ -85,6 +89,9 @@ class PreemptionRecord:
             "hostname": self.hostname,
             "task_ids": list(self.task_ids),
             "dru": self.min_preempted_dru,
+            "preemptor_user": self.preemptor_user,
+            "victims": [dict(v) for v in self.victims],
+            "wasted_s": self.wasted_s,
         }
 
 
@@ -176,6 +183,9 @@ class CycleRecord:
     matched: list[dict] = field(default_factory=list)
     skipped: list[dict] = field(default_factory=list)
     preemptions: list[PreemptionRecord] = field(default_factory=list)
+    # fairness rollup for the cycle's rebalance pass (obs/fairness.py):
+    # {preemptions, tasks_preempted, wasted_s, jain_index}
+    fairness: dict = field(default_factory=dict)
 
     def to_json(self) -> dict:
         return {
@@ -222,6 +232,7 @@ class CycleRecord:
             "matched": list(self.matched),
             "skipped": list(self.skipped),
             "preemptions": [p.to_json() for p in self.preemptions],
+            "fairness": dict(self.fairness),
         }
 
 
@@ -567,7 +578,8 @@ class FlightRecorder:
 
     def annotate_preemptions(self, pool: str,
                              preemptions: list[PreemptionRecord],
-                             duration_s: float) -> None:
+                             duration_s: float,
+                             fairness: Optional[dict] = None) -> None:
         """Attach a rebalance pass to the pool's most recent cycle record
         (the preemption search runs as a phase of the same scheduling
         cycle); falls back to a standalone record when no match cycle has
@@ -588,6 +600,8 @@ class FlightRecorder:
             target.host_s += duration_s
             target.total_s += duration_s
             target.preemptions.extend(preemptions)
+            if fairness:
+                target.fairness.update(fairness)
 
     # ------------------------------------------------------------------ reads
 

@@ -60,6 +60,10 @@ class Decision:
     hostname: str
     task_ids: list[str]           # victims (empty = spare-only)
     min_preempted_dru: float
+    # per-victim detail for the fairness ledger, captured at decision
+    # time (the cycle state mutates as later decisions apply):
+    # [{task_id, user, dru, mem, cpus, gpus}]
+    victims: list[dict] = field(default_factory=list)
 
 
 @dataclass
@@ -319,13 +323,35 @@ class RebalanceCycle:
             return None
         mask = np.asarray(decision.preempt_mask)
         task_ids = [self.row_ids[i] for i in np.where(mask)[0]]
+        victims = self._victim_details(task_ids)
         self._apply(job, host, task_ids, np.asarray(decision.freed))
         return Decision(
             job=job,
             hostname=self.hostnames[host],
             task_ids=task_ids,
             min_preempted_dru=float(decision.score),
+            victims=victims,
         )
+
+    def _victim_details(self, task_ids: list[str]) -> list[dict]:
+        """Per-victim (user, DRU-at-decision, resources) for the fairness
+        ledger.  Must run BEFORE _apply: applying the decision deletes
+        the victims' entries from the per-user task lists."""
+        out = []
+        for tid in task_ids:
+            user, _ = self.task_info[tid]
+            ut = self.users[user]
+            k = ut.ids.index(tid)
+            mem, cpus, gpus, _disk = ut.res[k]
+            out.append({
+                "task_id": tid,
+                "user": user,
+                "dru": round(float(ut.dru[k]), 6),
+                "mem": float(mem),
+                "cpus": float(cpus),
+                "gpus": float(gpus),
+            })
+        return out
 
     def _compute_decision_fast(self, job: Job) -> Optional[Decision]:
         """Decision against the cycle-start sort (RebalancerParams
@@ -360,12 +386,14 @@ class RebalanceCycle:
         mask_sorted = np.asarray(decision.preempt_mask)
         rows = self._perm_np[np.where(mask_sorted)[0]]
         task_ids = [self.row_ids[i] for i in rows]
+        victims = self._victim_details(task_ids)
         self._apply(job, host, task_ids, np.asarray(decision.freed))
         return Decision(
             job=job,
             hostname=self.hostnames[host],
             task_ids=task_ids,
             min_preempted_dru=float(decision.score),
+            victims=victims,
         )
 
     def _apply(self, job: Job, host: int, task_ids: list[str],

@@ -199,6 +199,63 @@ def completion_heavy_trace(
     return out_jobs, out_hosts
 
 
+def preemption_heavy_trace(
+    *,
+    hog_jobs: int = 8,
+    late_jobs: int = 6,
+    hosts: int = 4,
+    host_mem: float = 1000.0,
+    host_cpus: float = 4.0,
+    runtime_ms: int = 600_000,
+    late_arrival_ms: int = 60_000,
+    n_late_users: int = 3,
+    seed: int = 0,
+):
+    """The fairness observatory's acceptance scenario: one over-share
+    user floods the pool at t=0 with long-running hosts-filling jobs
+    (each consumes half a host), then `n_late_users` under-share users
+    arrive at `late_arrival_ms` with nothing free.  With the rebalancer
+    on (`SimConfig.rebalance_every` + a share set for the default user
+    so DRU is finite) the late arrivals can only start by preempting the
+    hog — so vs the standard trace the run shows a depressed Jain index
+    while the hog monopolizes, nonzero `fairness.wasted_work_seconds`,
+    and a populated preemption ledger (asserted A/B in
+    tests/test_fairness.py).  Returns (jobs, hosts) TraceJob/TraceHost
+    lists for sim.simulator.Simulator."""
+    import numpy as np
+
+    from cook_tpu.sim.simulator import TraceHost, TraceJob
+
+    rng = np.random.default_rng(seed)
+    jobs = [
+        TraceJob(
+            uuid=f"hog-{i:05d}",
+            user="hog",
+            submit_time_ms=0,
+            runtime_ms=runtime_ms,
+            mem=host_mem / 2.0,
+            cpus=host_cpus / 2.0,
+        )
+        for i in range(hog_jobs)
+    ] + [
+        TraceJob(
+            uuid=f"late-{i:05d}",
+            user=f"late{int(rng.integers(n_late_users))}",
+            submit_time_ms=late_arrival_ms,
+            runtime_ms=runtime_ms // 4,
+            mem=host_mem / 2.0,
+            cpus=host_cpus / 2.0,
+        )
+        for i in range(late_jobs)
+    ]
+    out_hosts = [
+        TraceHost(node_id=f"h{i:03d}", hostname=f"h{i:03d}",
+                  mem=host_mem, cpus=host_cpus)
+        for i in range(hosts)
+    ]
+    return jobs, out_hosts
+
+
 @dataclass(frozen=True)
 class TrafficOp:
     """One control-plane request in a rest_traffic_trace schedule."""

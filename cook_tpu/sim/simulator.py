@@ -156,6 +156,11 @@ class SimResult:
     # (with history_every > 0): {"raw": query-dump, "10m": query-dump} —
     # the same shape GET /debug/history serves (docs/observability.md)
     metrics_history: dict = field(default_factory=dict)
+    # fairness observatory snapshot at end of run (GET /debug/fairness
+    # schema): per-user DRU trajectories, preemption ledger + rollups,
+    # Jain index — so a trace replay reports the same fairness numbers
+    # production does
+    fairness: dict = field(default_factory=dict)
 
     def queued_wait_ms(self) -> list[int]:
         """Per-started-task queued wait (start - submit): the metric the
@@ -466,6 +471,7 @@ class Simulator:
             metrics_history=(
                 {"raw": history.query("*"), "10m": history.query(
                     "*", step="10m")} if history is not None else {}),
+            fairness=self.scheduler.fairness.snapshot(),
         )
 
     def _collect_rows(self) -> list[dict]:

@@ -1,0 +1,420 @@
+"""Fairness observatory (cook_tpu/obs/fairness.py): the seeded
+rebalance drill end to end (victim kill -> ledger -> rollups -> tsdb ->
+timeline -> cycle record), Jain-drop drift detection landing fairness
+evidence in an incident bundle, ledger/label bounds, failover recovery
+replay, the preemption-heavy loadgen A/B, and the mp scatter-merge
+shape."""
+from types import SimpleNamespace
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.models.entities import (
+    DEFAULT_USER,
+    InstanceStatus,
+    Pool,
+    Quota,
+    Resources,
+    Share,
+)
+from cook_tpu.models.persistence import attach_journal, recover
+from cook_tpu.models.store import JobStore
+from cook_tpu.obs.fairness import (
+    FAIRNESS_DRIFT,
+    FairnessConfig,
+    FairnessObservatory,
+    jain_index,
+)
+from cook_tpu.obs.incident import job_timeline
+from cook_tpu.obs.tsdb import MetricsHistory
+from cook_tpu.scheduler.core import Scheduler
+from cook_tpu.utils.metrics import global_registry
+from tests.conftest import FakeClock, make_job
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def _ledger_entry(i: int, pool_freed_mem: float = 100.0) -> dict:
+    return {
+        "t_ms": 1000 + i,
+        "preemptor_job": f"job-{i}",
+        "preemptor_user": "starved",
+        "hostname": f"h{i % 4}",
+        "min_preempted_dru": 2.0,
+        "victims": [{"task_id": f"t-{i}", "user": "hog", "dru": 2.0,
+                     "wasted_s": 1.5, "mem": pool_freed_mem, "cpus": 1.0,
+                     "gpus": 0.0}],
+        "freed": {"mem": pool_freed_mem, "cpus": 1.0, "gpus": 0.0},
+    }
+
+
+class _RankStore:
+    """Minimal store surface observe_rank needs: usage + share + quota."""
+
+    def __init__(self, dru_by_user: dict):
+        self.dru_by_user = dru_by_user
+
+    def user_usage(self, pool):
+        return {u: Resources(mem=d * 100.0, cpus=0.0)
+                for u, d in self.dru_by_user.items()}
+
+    def get_share(self, user, pool):
+        return Resources(mem=100.0, cpus=float("inf"), gpus=float("inf"))
+
+    def get_quota(self, user, pool):
+        return Quota(user=user, pool=pool,
+                     resources=Resources(mem=float("inf"),
+                                         cpus=float("inf")),
+                     count=2**31)
+
+
+def _rank(obs: FairnessObservatory, pool: str, dru_by_user: dict) -> None:
+    queue = SimpleNamespace(jobs=[], dru={})
+    obs.observe_rank(pool, queue, _RankStore(dru_by_user))
+
+
+def _preemption_rig():
+    """The debug_smoke recipe: finite default share, a hog filling both
+    hosts, then a starved user's job that no longer fits — rebalance
+    must transact a victim kill."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m",
+        [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=4000, cpus=8)
+         for i in range(2)],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    pool = store.pools["default"]
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=500, cpus=4)))
+    hogs = [make_job(user="hog", mem=1600, cpus=2) for _ in range(4)]
+    store.submit_jobs(hogs)
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    clock.advance(30_000)  # victims accrue runtime -> wasted_s > 0
+    store.submit_jobs([make_job(user="starved", mem=1000, cpus=1)])
+    scheduler.rank_cycle(pool)
+    decisions = scheduler.rebalance_cycle(pool)
+    return clock, store, scheduler, pool, decisions
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_jain_index_math():
+    assert jain_index([]) == 1.0
+    assert jain_index([0.0, 0.0]) == 1.0          # all-zero: vacuously fair
+    assert jain_index([2.0, 2.0, 2.0]) == 1.0
+    # one dominant user -> 1/n limit
+    skewed = jain_index([100.0, 0.001, 0.001, 0.001])
+    assert 0.25 <= skewed < 0.3
+    # scale invariance
+    assert abs(jain_index([1, 2, 3]) - jain_index([10, 20, 30])) < 1e-12
+
+
+# ------------------------------------------------------- the seeded drill
+
+
+def test_rebalance_drill_lands_ledger_rollups_and_tsdb():
+    clock, store, scheduler, pool, decisions = _preemption_rig()
+    assert any(d.task_ids for d in decisions), "drill must preempt"
+
+    snap = scheduler.fairness.snapshot()
+    body = snap["pools"]["default"]
+
+    # ledger: preemptor/victim users, DRU at decision, nonzero wasted work
+    assert body["ledger"], "transacted preemption must land in the ledger"
+    entry = body["ledger"][-1]
+    assert entry["preemptor_user"] == "starved"
+    assert entry["kind"] == "fairness"
+    assert entry["victims"]
+    for victim in entry["victims"]:
+        assert victim["user"] == "hog"
+        assert victim["dru"] > 1.0          # hog was far over share
+        assert victim["wasted_s"] == 30.0   # clock advanced 30s post-match
+    assert entry["wasted_s"] >= 30.0
+    assert entry["freed"]["mem"] > 0
+
+    # rollups + fragmentation
+    rollups = body["rollups"]
+    assert rollups["preemptions"] >= 1
+    assert rollups["tasks_preempted"] >= 1
+    assert rollups["wasted_s"]["fairness"] >= 30.0
+    assert rollups["by_user"]["starved"]["preemptions_initiated"] >= 1
+    assert rollups["by_user"]["hog"]["victim_tasks"] >= 1
+    frag = body["fragmentation"]
+    assert 0.0 <= frag["fragmentation"] <= 1.0
+    assert frag["decisions"] >= 1
+
+    # trajectories sampled at rank time: the hog reads over share
+    assert body["trajectories"]["hog"]["dru"] > 1.0
+    assert body["trajectories"]["starved"]["queued"] >= 1
+    assert 0.0 < body["jain_index"] <= 1.0
+
+    # the victim instance really died with the rebalancer reason
+    tid = entry["victims"][0]["task_id"]
+    inst = store.instances[tid]
+    assert inst.status == InstanceStatus.FAILED
+    assert inst.status.terminal
+
+    # victim_detail joins the ledger for the timeline
+    detail = scheduler.fairness.victim_detail(tid)
+    assert detail is not None
+    assert detail["preemptor_user"] == "starved"
+    assert detail["runtime_lost_s"] == 30.0
+
+    # fairness.* gauges land in the metrics history (prefix-matched key
+    # series, so `cs history fairness.user.dru` can sparkline the drift)
+    history = MetricsHistory()  # global registry
+    history.sample_once()
+    series = history.query("fairness.user.dru")["series"]
+    assert any("pool=default" in k and "user=hog" in k for k in series)
+    jain_series = history.query("fairness.jain_index")["series"]
+    assert any("pool=default" in k for k in jain_series)
+
+
+def test_drill_enriches_timeline_and_cycle_record():
+    clock, store, scheduler, pool, decisions = _preemption_rig()
+    tid = next(tid for d in decisions for tid in d.task_ids)
+    victim_job = store.jobs[store.instances[tid].job_uuid]
+
+    timeline = job_timeline(store, scheduler.recorder, victim_job,
+                            fairness=scheduler.fairness)
+    preemptions = [e["preemption"] for e in timeline["events"]
+                   if "preemption" in e]
+    assert preemptions, "preempted terminal event must carry ledger detail"
+    assert preemptions[0]["preemptor_user"] == "starved"
+    assert preemptions[0]["runtime_lost_s"] == 30.0
+    assert preemptions[0]["dru_at_decision"] > 1.0
+
+    # the rebalance pass's cycle record carries the fairness rollup
+    records = scheduler.recorder.records_json(limit=50)
+    fair = [r["fairness"] for r in records if r.get("fairness")]
+    assert fair and fair[-1]["tasks_preempted"] >= 1
+    assert fair[-1]["wasted_s"] >= 30.0
+
+
+def test_non_rebalancer_mea_culpa_kill_lands_in_mea_culpa_bucket():
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster(
+        "m", [MockHost(node_id="h0", hostname="h0", mem=4000, cpus=8)],
+        clock=clock)
+    scheduler = Scheduler(store, [cluster])
+    pool = store.pools["default"]
+    job = make_job(user="unlucky")
+    store.submit_jobs([job])
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    [tid] = [i.task_id for i in store.job_instances(job.uuid)]
+    clock.advance(12_000)
+    # the backing cluster killed the node out from under the task: a
+    # mea-culpa failure that is NOT a rebalancer preemption
+    store.update_instance_state(tid, InstanceStatus.FAILED, "node-removed")
+
+    rollups = scheduler.fairness.snapshot()["pools"]["default"]["rollups"]
+    assert rollups["wasted_s"]["mea_culpa"] == 12.0
+    assert rollups["wasted_s"]["fairness"] == 0.0
+    # no ledger entry — there is no preemptor to attribute
+    assert scheduler.fairness.snapshot()["pools"]["default"]["ledger"] == []
+
+
+# ----------------------------------------------------------------- drift
+
+
+def test_sustained_jain_drop_raises_drift_and_incident_evidence(store):
+    from cook_tpu.rest.api import ApiConfig, CookApi
+
+    api = CookApi(store, None, ApiConfig())
+    api.incidents.cooldown_s = 0.0
+    pool = "driftpool"
+
+    even = {"a": 1.0, "b": 1.0, "c": 1.0, "d": 1.0}
+    skew = {"a": 4.0, "b": 0.1, "c": 0.1, "d": 0.1}
+    for _ in range(20):
+        _rank(api.fairness, pool, even)
+    verdict = api.health_verdict()
+    assert FAIRNESS_DRIFT not in verdict["reasons"]
+
+    for _ in range(8):                     # fill the recent window low
+        _rank(api.fairness, pool, skew)
+    verdict = api.health_verdict()
+    assert FAIRNESS_DRIFT in verdict["reasons"]
+    assert not verdict["healthy"]
+    [deg] = [d for d in verdict["degradations"]
+             if d["reason"] == FAIRNESS_DRIFT]
+    assert deg["pool"] == pool
+    assert deg["recent"] < deg["baseline"]
+    assert verdict["checks"]["fairness"][pool]["jain_index"] < 0.5
+
+    # the ok->degraded edge captured a bundle with fairness evidence
+    bundles = api.incidents.bundles()
+    assert bundles
+    bundle = api.incidents.get(bundles[-1]["id"])
+    assert FAIRNESS_DRIFT in bundle["reasons"]
+    assert bundle["fairness"]["pools"][pool]["jain_index"] < 0.5
+    assert "trajectories" in bundle["fairness"]["pools"][pool]
+
+    # recovery: even usage again clears the reason (and the gauge edge)
+    for _ in range(8):
+        _rank(api.fairness, pool, even)
+    verdict = api.health_verdict()
+    assert FAIRNESS_DRIFT not in verdict["reasons"]
+    assert api.fairness._drift_active is False
+
+
+# ---------------------------------------------------------------- bounds
+
+
+def test_ledger_ring_holds_capacity_newest_win():
+    obs = FairnessObservatory(FairnessConfig(ledger_capacity=8))
+    for i in range(20):
+        obs.record_decisions("default", [_ledger_entry(i)])
+    body = obs.snapshot(ledger_limit=100)["pools"]["default"]
+    assert len(body["ledger"]) == 8
+    assert [e["t_ms"] for e in body["ledger"]] == list(range(1012, 1020))
+    # rollups keep counting past the ring: totals are not ring-bounded
+    assert body["rollups"]["preemptions"] == 20
+    assert body["rollups"]["tasks_preempted"] == 20
+
+
+def test_trajectory_labels_age_out_and_truncate():
+    obs = FairnessObservatory(FairnessConfig(max_users_per_pool=2))
+    pool = "ageout-pool"
+    dru_gauge = global_registry.gauge(
+        "fairness.user.dru",
+        "per-user running dominant-resource usage over share")
+
+    _rank(obs, pool, {"a": 3.0, "b": 2.0})
+    assert dru_gauge.value({"pool": pool, "user": "b"}) == 2.0
+
+    # b departs: its gauge labels must be retracted, not left stale
+    _rank(obs, pool, {"a": 3.0})
+    assert dru_gauge.value({"pool": pool, "user": "b"}) == 0.0
+    assert obs._exported_users[pool] == {"a"}
+
+    # over-cap population keeps the top users by DRU, counts the rest
+    _rank(obs, pool, {"a": 3.0, "b": 2.0, "c": 1.0, "d": 0.5})
+    body = obs.snapshot()["pools"][pool]
+    assert set(body["trajectories"]) == {"a", "b"}
+    assert body["trajectories_truncated"] == 2
+    assert dru_gauge.value({"pool": pool, "user": "c"}) == 0.0
+
+
+def test_rollup_user_overflow_collapses_to_other():
+    obs = FairnessObservatory(FairnessConfig(max_rollup_users=3))
+    for i in range(6):
+        entry = _ledger_entry(i)
+        entry["victims"][0]["user"] = f"victim{i}"
+        obs.record_decisions("default", [entry])
+    by_user = obs.snapshot()["pools"]["default"]["rollups"]["by_user"]
+    assert len(by_user) <= 4                    # cap + the "(other)" slot
+    assert "(other)" in by_user
+    assert by_user["(other)"]["victim_tasks"] >= 1
+
+
+# --------------------------------------------------------------- recovery
+
+
+def test_rollups_survive_failover_recovery_replay(tmp_path, clock):
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    writer = attach_journal(store, str(tmp_path / "journal.jsonl"))
+    j1 = make_job(user="victim")
+    j2 = make_job(user="unlucky")
+    store.submit_jobs([j1, j2])
+    store.create_instance(j1.uuid, "t1", hostname="h1", compute_cluster="c")
+    store.update_instance_state("t1", InstanceStatus.RUNNING)
+    store.create_instance(j2.uuid, "t2", hostname="h2", compute_cluster="c")
+    store.update_instance_state("t2", InstanceStatus.RUNNING)
+    clock.advance(45_000)
+    store.update_instance_state("t1", InstanceStatus.FAILED, 1002)
+    clock.advance(15_000)
+    store.update_instance_state("t2", InstanceStatus.FAILED, "node-removed")
+    writer.close()
+
+    restored = recover(str(tmp_path), clock=clock)
+    obs = FairnessObservatory()
+    assert obs.recover(restored) == 2
+    rollups = obs.snapshot()["pools"]["default"]["rollups"]
+    # rebalancer preemption -> fairness bucket; node loss -> mea-culpa
+    assert rollups["tasks_preempted"] == 1
+    assert rollups["wasted_s"]["fairness"] == 45.0
+    assert rollups["wasted_s"]["mea_culpa"] == 60.0
+    assert rollups["by_user"]["victim"]["victim_tasks"] == 1
+    assert rollups["by_user"]["unlucky"]["victim_wasted_s"] == 60.0
+
+
+# ---------------------------------------------------------------- loadgen
+
+
+def test_preemption_heavy_trace_ab_vs_standard():
+    """A/B: the preemption-heavy trace is distinguishable from a
+    standard completion-heavy run by BOTH the Jain index (depressed
+    while the hog monopolizes next to under-share late users) and the
+    wasted-work accounting (nonzero fairness bucket + populated
+    ledger); the standard run shows neither."""
+    from cook_tpu.sim.loadgen import (completion_heavy_trace,
+                                      preemption_heavy_trace)
+    from cook_tpu.sim.simulator import SimConfig, Simulator
+
+    def _run(jobs, hosts):
+        sim = Simulator(jobs, hosts,
+                        SimConfig(cycle_ms=30_000, rebalance_every=1,
+                                  max_cycles=60))
+        sim.store.set_share(Share(user=DEFAULT_USER, pool="default",
+                                  resources=Resources(mem=500.0, cpus=2.0)))
+        sim.store.dynamic_config["rebalancer"] = {
+            "safe_dru_threshold": 0.0, "min_dru_diff": 0.01,
+            "max_preemption": 10}
+        result = sim.run()
+        jain_samples = list(
+            sim.scheduler.fairness._baselines["default"]._samples)
+        return result, jain_samples
+
+    heavy, heavy_jain = _run(*preemption_heavy_trace(
+        hog_jobs=8, late_jobs=3, hosts=4, runtime_ms=240_000,
+        late_arrival_ms=30_000, n_late_users=3))
+    std, std_jain = _run(*completion_heavy_trace(
+        jobs=8, hosts=4, runtime_ms=60_000, n_users=1))
+
+    heavy_body = heavy.fairness["pools"]["default"]
+    std_body = std.fairness["pools"]["default"]
+
+    # wasted work distinguishes the traces
+    assert heavy_body["rollups"]["tasks_preempted"] >= 1
+    assert heavy_body["rollups"]["wasted_s"]["fairness"] > 0.0
+    assert heavy_body["ledger"]
+    assert std_body["rollups"]["tasks_preempted"] == 0
+    assert std_body["rollups"]["wasted_s"]["fairness"] == 0.0
+
+    # so does the Jain index: the heavy run dips while hog + under-share
+    # late users run side by side; the single-user standard run never
+    # leaves perfect fairness
+    assert min(heavy_jain) < 0.97
+    assert min(std_jain) > 0.999
+
+
+# --------------------------------------------------------------- mp merge
+
+
+def test_mp_scatter_merge_composes_disjoint_pool_bodies():
+    from cook_tpu.mp.router import _merge
+
+    a = FairnessObservatory()
+    b = FairnessObservatory()
+    a.record_decisions("pool_a", [_ledger_entry(0)])
+    _rank(a, "pool_a", {"hog": 2.0, "starved": 0.5})
+    b.record_decisions("pool_b", [_ledger_entry(1)])
+
+    merged = _merge(a.snapshot(), b.snapshot())
+    assert merged["enabled"] is True           # bool, not summed to 2
+    assert set(merged["pools"]) == {"pool_a", "pool_b"}
+    # group-owned pools are disjoint: per-pool numbers arrive untouched
+    pa = merged["pools"]["pool_a"]
+    assert pa["jain_index"] == a.snapshot()["pools"]["pool_a"]["jain_index"]
+    assert pa["rollups"]["preemptions"] == 1
+    assert merged["pools"]["pool_b"]["rollups"]["preemptions"] == 1
+    assert len(pa["ledger"]) == 1

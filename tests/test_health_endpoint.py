@@ -70,7 +70,7 @@ def test_healthy_by_default(server, fresh_telemetry):
     # checks plus the merged control-plane contention checks
     assert set(health["checks"]) == {"compile", "quality", "solve_latency",
                                      "device_fallback", "device_memory",
-                                     "contention"}
+                                     "contention", "fairness"}
     assert set(health["checks"]["contention"]) == {
         "store_lock", "journal", "replication", "commit_ack", "starvation"}
 

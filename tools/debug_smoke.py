@@ -52,6 +52,8 @@ def build_rig():
     from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
     from cook_tpu.scheduler.matcher import MatchConfig
 
+    from cook_tpu.models.entities import DEFAULT_USER, Share
+
     store = JobStore()
     store.set_pool(Pool(name="default"))
     cluster = MockCluster(
@@ -70,6 +72,23 @@ def build_rig():
     pool = store.pools["default"]
     scheduler.rank_cycle(pool)
     scheduler.match_cycle(pool)
+    # force a rebalance so /debug/fairness serves a POPULATED ledger:
+    # a finite share makes DRU meaningful, a hog user fills the hosts,
+    # then a starved user's job that no longer fits drives the
+    # preemption search to a transacted victim kill
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=500, cpus=4)))
+    store.submit_jobs([
+        Job(uuid=f"smoke-hog-{i}", user="hog", pool="default",
+            command="true", resources=Resources(mem=1600, cpus=2))
+        for i in range(4)])
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    store.submit_jobs([
+        Job(uuid="smoke-starved", user="starved", pool="default",
+            command="true", resources=Resources(mem=1000, cpus=1))])
+    scheduler.rank_cycle(pool)
+    scheduler.rebalance_cycle(pool)
     # fault_injection on so GET /debug/faults serves its (disarmed) state
     # instead of the production 403
     api = CookApi(store, scheduler, ApiConfig(fault_injection=True))
@@ -222,6 +241,35 @@ def mp_smoke() -> list[str]:
         check("/debug/trace (no txn_id)", status == 400,
               f"expected 400, got {status}", n)
 
+        # fairness scatter-merge: feed each shard group's worker
+        # observatory one ledger entry for a pool it OWNS (the same
+        # public call the scheduler makes), then the front end's
+        # /debug/fairness must merge both groups' pool-keyed bodies
+        for pool in (pool_a, pool_b):
+            g_idx = runtime.supervisor.topology.group_for_pool(pool)
+            worker = runtime.supervisor.workers[g_idx].worker
+            worker.api.fairness.record_decisions(pool, [{
+                "t_ms": 0,
+                "preemptor_job": f"job-{pool}",
+                "preemptor_user": "alice",
+                "hostname": "h0",
+                "min_preempted_dru": 1.5,
+                "victims": [{"task_id": f"t-{pool}", "user": "bob",
+                             "dru": 2.0, "wasted_s": 12.5,
+                             "mem": 100.0, "cpus": 1.0, "gpus": 0.0}],
+                "freed": {"mem": 100.0, "cpus": 1.0, "gpus": 0.0},
+                "wasted_s": 12.5,
+            }])
+        status, fairness, n = _http(f"{runtime.url}/debug/fairness")
+        merged_pools = (fairness or {}).get("pools", {})
+        missing_pools = (status == 200) and [
+            pool for pool in (pool_a, pool_b)
+            if not (merged_pools.get(pool) or {}).get("ledger")]
+        check("/debug/fairness",
+              status == 200 and missing_pools == [],
+              f"status {status} / pools missing ledger entries "
+              f"{missing_pools}", n)
+
         status, index, n = _http(f"{runtime.url}/debug/incidents")
         ids = {b.get("id") for b in (index or {}).get("incidents", [])}
         check("/debug/incidents",
@@ -290,6 +338,23 @@ def main(argv=None) -> int:
                         if not parsed.get("series"):
                             problem = ("history series index empty "
                                        "after forced sample ticks")
+                    elif path == "/debug/fairness":
+                        # the rig forced a rebalance: the ledger,
+                        # rollups, and trajectories must all be
+                        # populated — an empty body after a transacted
+                        # preemption is a broken feed, not a fair pool
+                        view = (parsed.get("pools") or {}).get(
+                            "default") or {}
+                        rollups = view.get("rollups") or {}
+                        if not view.get("ledger"):
+                            problem = ("fairness ledger empty after a "
+                                       "forced rebalance")
+                        elif rollups.get("tasks_preempted", 0) < 1:
+                            problem = ("fairness rollups show no "
+                                       "preempted tasks")
+                        elif not view.get("trajectories"):
+                            problem = ("fairness trajectories empty "
+                                       "after rank cycles")
                     elif path == "/debug/fleet":
                         # the rig wired a fleet observatory: the merged
                         # verdict must render (self row at minimum)
