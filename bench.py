@@ -645,6 +645,58 @@ def bench_speculation(*, smoke=False) -> dict:
     }
 
 
+def bench_gang(*, smoke=False) -> dict:
+    """`gang` phase: topology-aware gang scheduling (scheduler/gang.py +
+    the matcher's all-or-nothing chokepoint) on the seeded gang/topology
+    trace (sim/loadgen.gang_topology_trace).  Gated p50 is the gang
+    admission latency — submit to all-members-running, in VIRTUAL ms, so
+    the figure is deterministic and a regression means the placement
+    logic got worse, not the machine slower.  `placed_fraction`
+    (gangs fully placed / gangs) and `assembled_share` / `block_spread`
+    ride in the record; the acceptance bar is every gang placed whole
+    with block spread 1.0."""
+    from cook_tpu.scheduler.core import SchedulerConfig
+    from cook_tpu.scheduler.matcher import MatchConfig
+    from cook_tpu.sim.loadgen import gang_topology_trace
+    from cook_tpu.sim.simulator import SimConfig, Simulator
+
+    if smoke:
+        n_blocks, block_hosts, gang_sizes = 2, 4, (4, 4, 2)
+    else:
+        n_blocks, block_hosts, gang_sizes = 4, 8, (8, 8, 4, 4, 2, 2)
+
+    jobs, hosts = gang_topology_trace(
+        n_blocks=n_blocks, block_hosts=block_hosts, gang_sizes=gang_sizes)
+    config = SimConfig(
+        cycle_ms=30_000, max_cycles=200,
+        scheduler=SchedulerConfig(
+            device_telemetry=False,
+            match=MatchConfig(gang_enabled=True,
+                              topology_block_hosts=block_hosts,
+                              topology_weight=0.5)),
+    )
+    result = Simulator(jobs, hosts, config).run()
+    stats = result.gang_stats(jobs, hosts, nodes_per_block=block_hosts)
+    placed = sum(1 for g in stats["per_gang"]
+                 if g["placed_members"] == g["size"])
+    placed_fraction = placed / stats["gangs"] if stats["gangs"] else 0.0
+    log(f"gang {stats['gangs']} gangs on {n_blocks}x{block_hosts} hosts: "
+        f"admission p50 {stats['wait_ms_p50']:.0f} virtual-ms, placed "
+        f"fraction {placed_fraction:.2f}, assembled "
+        f"{stats['assembled']}/{stats['gangs']}, block spread "
+        f"{stats['mean_block_spread']:.2f}")
+    return {
+        "gang": {
+            "p50_ms": stats["wait_ms_p50"],
+            "placed_fraction": placed_fraction,
+            "assembled_share": stats["assembled_share"],
+            "block_spread": stats["mean_block_spread"],
+            "gangs": stats["gangs"],
+            "hosts": n_blocks * block_hosts,
+        },
+    }
+
+
 def encode_family_mark():
     """Node-encode + job-feasibility H2D totals — the exact families the
     device-resident mirror (scheduler/device_state.py) keeps on device;
@@ -1159,6 +1211,7 @@ def device_main():
     pipeline_phases = bench_pipeline(jax, jnp, n_pools=8, hosts_per_pool=96,
                                      jobs_per_pool=1536)
     speculation_phases = bench_speculation()
+    gang_phases = bench_gang()
     log(f"full-cycle estimate (rank+match+rebalance): "
         f"{dru_p50 + match_p50 + reb_p50:.1f} ms")
     extra = f", dru_ms={dru_p50:.1f}, rebalance_ms={reb_p50:.1f}"
@@ -1179,6 +1232,7 @@ def device_main():
         "control_plane_mp": control_plane_mp,
         **pipeline_phases,
         **speculation_phases,
+        **gang_phases,
     }, headline), out=_record_out_arg())
     print(json.dumps(headline), flush=True)
 
@@ -1219,6 +1273,8 @@ def cpu_main():
         # the speculation A/B runs through the trace simulator on
         # whatever backend is live — full scale here too
         **bench_speculation(),
+        # gang admission latency is virtual-time: backend-independent
+        **bench_gang(),
     }, headline), out=_record_out_arg())
     print(json.dumps(headline), flush=True)
 
@@ -1341,6 +1397,10 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     # prediction-assisted speculative cycles: the completion-heavy A/B
     # (hit fraction + cycle-start-to-first-launch p50), tiny tier
     phases.update(bench_speculation(smoke=True))
+
+    # topology-aware gang scheduling: admission latency (virtual ms,
+    # deterministic) + placed fraction on the seeded gang/topology trace
+    phases.update(bench_gang(smoke=True))
     return phases
 
 

@@ -88,7 +88,12 @@ def cmd_submit(args) -> int:
         spec["pool"] = args.pool
     if args.env:
         spec["env"] = dict(kv.split("=", 1) for kv in args.env)
-    uuids = client.submit([spec] * args.copies)
+    if args.gang_size:
+        # one gang of k copies, all-or-nothing on one topology block
+        uuids = client.submit([spec] * args.gang_size,
+                              gang_size=args.gang_size)
+    else:
+        uuids = client.submit([spec] * args.copies)
     for uuid in uuids:
         print(uuid)
     return 0
@@ -711,6 +716,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--pool")
     sp.add_argument("--env", action="append", metavar="K=V")
     sp.add_argument("--copies", type=int, default=1)
+    sp.add_argument("--gang-size", type=int, default=0, dest="gang_size",
+                    help="submit K copies as ONE all-or-nothing gang "
+                         "(all K place inside one topology block or "
+                         "none do; overrides --copies)")
     sp.set_defaults(fn=cmd_submit)
 
     for name, fn, help_ in [

@@ -167,14 +167,30 @@ class JobClient:
     # ------------------------------------------------------------------ api
 
     def submit(self, jobs: Sequence[dict], *, groups: Sequence[dict] = (),
-               pool: Optional[str] = None) -> list[str]:
-        """Submit jobs; fills in uuids when absent; returns the uuids."""
+               pool: Optional[str] = None,
+               gang_size: int = 0) -> list[str]:
+        """Submit jobs; fills in uuids when absent; returns the uuids.
+
+        `gang_size` >= 2 marks the batch ONE all-or-nothing gang: the
+        batch must hold exactly `gang_size` jobs; each gets
+        `gang_size=k` and a shared fresh group (the server promotes it
+        to unique-host placement, and the scheduler places all k
+        members inside one topology block or none at all)."""
+        if gang_size:
+            if gang_size < 2 or len(jobs) != gang_size:
+                raise ValueError(
+                    f"gang_size {gang_size} needs a batch of exactly "
+                    f"that many jobs (got {len(jobs)})")
+            gang_group = str(uuid_mod.uuid4())
         payload = []
         for job in jobs:
             job = dict(job)
             job.setdefault("uuid", str(uuid_mod.uuid4()))
             if pool is not None:
                 job.setdefault("pool", pool)
+            if gang_size:
+                job.setdefault("gang_size", gang_size)
+                job.setdefault("group", gang_group)
             payload.append(job)
         body: dict = {"jobs": payload}
         if groups:

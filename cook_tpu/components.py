@@ -303,6 +303,9 @@ def build_process(
         headroom=float(elastic_conf.get("headroom", 0.1)),
         rank_half_life=int(elastic_conf.get("rank_half_life", 64)),
         reclaim_window=int(elastic_conf.get("reclaim_window", 100)),
+        count_block_headroom=bool(
+            elastic_conf.get("count_block_headroom", True)),
+        gang_block_hosts=int(elastic_conf.get("gang_block_hosts", 0)),
     )
     incident_dir = settings.incident_dir
     if not incident_dir and settings.data_dir:
@@ -359,6 +362,7 @@ def build_process(
         replica_reads=settings.replica_reads,
         replica_staleness_ceiling_ms=settings.replica_staleness_ceiling_ms,
         replica_refuse_after_s=settings.replica_refuse_after_s,
+        max_gang_size=int(settings.api.get("max_gang_size", 64)),
     ), plugins=plugins, txn=txn, history=history)
     # close the overload loop (docs/resilience.md reaction (d)): the
     # contention observatory's shed signal also drives the scheduler's

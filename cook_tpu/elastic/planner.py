@@ -67,6 +67,13 @@ class ElasticParams:
     reclaim_window: int = 100
     # ring capacity of /debug/elastic
     recorder_capacity: int = 256
+    # block-shaped headroom: a waiting gang of k is unmet demand unless
+    # some single topology block has k member-sized hosts free — scalar
+    # spare can look sufficient while every block is fragmented, and a
+    # loaned-out pool would never call its capacity home for the gang
+    count_block_headroom: bool = True
+    # topology block width for that check (0 = choose_nodes_per_block)
+    gang_block_hosts: int = 0
 
 
 class CapacityPlanner:
@@ -321,6 +328,18 @@ class CapacityPlanner:
             need["gpus"] -= res.gpus
         unmet = {dim: max(v, 0.0) for dim, v in need.items()}
         starved = {dim for dim, v in unmet.items() if v >= MIN_MOVE[dim]}
+        reclaim_kind = "reclaim-on-demand"
+        if not starved and self.params.count_block_headroom:
+            # scalar spare covers the queue, but does any single block
+            # hold a waiting gang?  If not, the loan still starves us —
+            # block-shaped headroom is the capacity that matters to gangs
+            short = self._gang_block_shortfall(pending, host_spare)
+            if short is not None:
+                starved = {
+                    dim for dim in short["dims"]
+                    if any(outstanding[b].get(dim, 0.0) >= MIN_MOVE[dim]
+                           for b in outstanding)}
+                reclaim_kind = "reclaim-block-headroom"
         if not starved:
             return None
         t0 = time.perf_counter()
@@ -346,10 +365,10 @@ class CapacityPlanner:
         refreshed = self._pool_spare(pool)
         self._reclaim_hist.observe(time.perf_counter() - t0,
                                    {"pool": pool})
-        self._plan_counter.inc(labels={"kind": "reclaim-on-demand"})
+        self._plan_counter.inc(labels={"kind": reclaim_kind})
         self.recorder.add(PlanRecord(
             plan_id=self.recorder.next_id(),
-            kind="reclaim-on-demand",
+            kind=reclaim_kind,
             t_ms=self.store.clock(),
             wall_time=time.time(),
             pools=[pool] + sorted(outstanding),
@@ -358,6 +377,41 @@ class CapacityPlanner:
             txn_id=txn_id,
         ))
         return refreshed
+
+    def _gang_block_shortfall(self, pending: Sequence[Job],
+                              host_spare: dict) -> Optional[dict]:
+        """First waiting gang no topology block can hold: {group,
+        gang_size, best_block, dims} or None.  Blocks are contiguous
+        runs of the sorted host list, matching the planner's reading of
+        the fleet (scheduler/gang.py)."""
+        from cook_tpu.ops.hierarchical import choose_nodes_per_block
+        from cook_tpu.scheduler.gang import waiting_gangs
+
+        gangs = waiting_gangs(list(pending)[: self.params.reclaim_window])
+        if not gangs or not host_spare:
+            return None
+        hostnames = sorted(host_spare)
+        npb = (self.params.gang_block_hosts
+               or choose_nodes_per_block(len(hostnames)))
+        for group, jobs_g in gangs:
+            k = max(j.gang_size for j in jobs_g)
+            mem = max(j.resources.mem for j in jobs_g)
+            cpus = max(j.resources.cpus for j in jobs_g)
+            gpus = max(j.resources.gpus for j in jobs_g)
+            best = 0
+            for b in range(0, len(hostnames), npb):
+                free = 0
+                for h in hostnames[b:b + npb]:
+                    r = host_spare[h]
+                    if r.mem >= mem and r.cpus >= cpus and r.gpus >= gpus:
+                        free += 1
+                best = max(best, free)
+            if best < k:
+                dims = {d for d, v in (("mem", mem), ("cpus", cpus),
+                                       ("gpus", gpus)) if v > 0}
+                return {"group": group, "gang_size": k,
+                        "best_block": best, "dims": dims}
+        return None
 
     def _pool_spare(self, pool: str) -> dict:
         from cook_tpu.cluster.base import scan_pool_offers

@@ -190,6 +190,11 @@ class Job:
     labels: tuple = ()
     constraints: tuple = ()  # tuple[JobConstraint]
     group_uuid: Optional[str] = None
+    # gang scheduling (ROADMAP item 3): k > 0 marks this job one member
+    # of a k-host gang — all members share `group_uuid` and must place
+    # together inside ONE topology block or not at all (the matcher's
+    # all-or-nothing rule; scheduler/gang.py).  0 = not a gang member.
+    gang_size: int = 0
     container: Optional[Container] = None
     application: Optional[Application] = None
     checkpoint: Optional[Checkpoint] = None
@@ -297,6 +302,7 @@ def job_display(job: Job) -> dict[str, Any]:
         "disk_type": job.resources.disk_type,
         "ports": job.resources.ports,
         "labels": dict(job.labels),
+        "gang_size": job.gang_size,
         "env": dict(job.user_provided_env),
         "instances": list(job.instance_ids),
         "application": (

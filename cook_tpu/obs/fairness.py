@@ -26,11 +26,14 @@ module closes that gap with three instruments:
     preemptions — deliberate, fair-share-driven) vs `mea-culpa`
     (other scheduler-fault kills, e.g. container-preempted, reported
     through `note_kill()`).  The per-pool **fragmentation** stat is
-    the ROADMAP item-3 baseline: each rebalancer decision frees
-    capacity on exactly one host (contiguous by construction), so
-    `contiguous_share` is the largest single-decision freed chunk over
-    the total freed in the ledger window and `fragmentation` is its
-    complement — topology-aware victim selection must push it down.
+    block-aware: each ledger entry carries the topology block of the
+    host it freed (stamped by the scheduler from the same block
+    decomposition the hierarchical matcher solves), `contiguous_share`
+    is the largest single BLOCK's freed total over everything freed in
+    the ledger window, and `fragmentation` is its complement — freeing
+    three hosts in one block beats freeing three across the fleet,
+    because only the former admits a gang.  Topology-aware victim
+    selection (scheduler/gang.py) pushes it down.
 
   * **Jain fairness index** + drift detection — each rank cycle folds
     per-user running DRU into Jain's index `(Σx)²/(n·Σx²)` and feeds a
@@ -326,7 +329,8 @@ class FairnessObservatory:
             frag = self._fragmentation(pool)
             global_registry.gauge(
                 "fairness.fragmentation",
-                "1 - largest contiguous freed chunk over total freed").set(
+                "1 - largest within-one-topology-block freed capacity "
+                "over total freed (ledger window)").set(
                     frag["fragmentation"], {"pool": pool})
         return {
             "preemptions": len(entries),
@@ -371,11 +375,18 @@ class FairnessObservatory:
         return None
 
     def _fragmentation(self, pool: str) -> dict:
-        """Contiguous-capacity share of freed memory over the ledger
-        window.  Caller holds no lock (reads the deque snapshot-style;
-        appends are the only mutation and deques are safe to iterate
-        under the GIL via list())."""
-        best = 0.0
+        """Block-aware contiguous-capacity share of freed memory over the
+        ledger window: decisions carry the topology block their host
+        belongs to (stamped by Scheduler.rebalance_cycle), freed memory
+        accumulates per block, and `contiguous_share` is the LARGEST
+        single block's freed total over everything freed — capacity
+        returned scattered across blocks scores fragmented even when each
+        individual kill freed a big host, because no gang can use it
+        whole.  Entries without a block stamp (older ledgers, recovery)
+        fall back to per-decision chunks.  Caller holds no lock (reads
+        the deque snapshot-style; appends are the only mutation and
+        deques are safe to iterate under the GIL via list())."""
+        per_block: dict = {}
         total = 0.0
         n = 0
         for entry in list(self._ledger):
@@ -383,12 +394,17 @@ class FairnessObservatory:
                 continue
             freed = entry.get("freed", {}).get("mem", 0.0)
             total += freed
-            best = max(best, freed)
             n += 1
+            block = entry.get("block")
+            key = (("block", block) if isinstance(block, int) and block >= 0
+                   else ("entry", n))
+            per_block[key] = per_block.get(key, 0.0) + freed
+        best = max(per_block.values(), default=0.0)
         share = best / total if total > 0 else 1.0
         return {"contiguous_share": round(share, 4),
                 "fragmentation": round(1.0 - share, 4),
-                "decisions": n}
+                "decisions": n,
+                "blocks": sum(1 for k in per_block if k[0] == "block")}
 
     # ----------------------------------------------------------- recovery
 

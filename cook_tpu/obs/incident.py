@@ -297,17 +297,25 @@ def job_timeline(store, recorder, job, fairness=None) -> dict:
         if not run:
             return
         first, last = run[0], run[-1]
+        # the most recent cycle's detail is the live picture (a gang's
+        # best-block shortfall shrinks as churn drains)
+        detail = last.get("detail") or first.get("detail", "")
+        summary = (f"{len(run)} cycle"
+                   f"{'s' if len(run) != 1 else ''} skipped: "
+                   f"{first['code']}")
+        if first["code"] == "gang-incomplete" and detail:
+            # surface WHY the gang is holding: "7 cycles skipped:
+            # gang-incomplete, best block had 3/8 hosts free"
+            summary += f", {detail}"
         event = {
             "t_ms": first.get("t_ms", 0),
             "kind": "waiting",
             "code": first["code"],
-            "detail": first.get("detail", ""),
+            "detail": detail,
             "cycles": len(run),
             "first_cycle": first["cycle"],
             "last_cycle": last["cycle"],
-            "summary": (f"{len(run)} cycle"
-                        f"{'s' if len(run) != 1 else ''} skipped: "
-                        f"{first['code']}"),
+            "summary": summary,
         }
         for key in ("rank", "dru"):
             if last.get(key) is not None:

@@ -1,0 +1,242 @@
+"""Gang-placement kernels: all-or-nothing group-sum enforcement on the
+topology-block decomposition.
+
+A gang is k jobs (`Job.gang_size=k`, one shared group) that must land on
+k distinct hosts INSIDE ONE topology block — the contiguous node ranges
+the hierarchical matcher (ops/hierarchical.py) solves per block, which
+double as co-location domains (a block is "good interconnect" in the
+TPU-pod reading of the fleet).  The matcher solves placement as usual
+with gang members as ordinary rows; these kernels then act as the
+group-sum constraint: a gang keeps its assignments iff
+
+  * every member row placed (placed count == gang_need),
+  * all placed rows fall in one block (block min == block max), and
+  * members sit on k DISTINCT hosts (the group's UNIQUE placement —
+    enforced here so the device path agrees with
+    `validate_group_assignments` instead of racing it).
+
+Anything else strips the WHOLE gang back to -1 (`gang-incomplete`), and
+`release_assignments` returns the stripped demand to availability so the
+hierarchical refine rounds (or the next cycle) can retry the gang
+elsewhere.  The filter is O(J) scatter/gather — negligible next to the
+solve — and compiles per (rows, gang-slots) bucket like every other
+kernel here.
+
+`np_gang_filter` is the bit-identical numpy twin: the host-side
+enforcement chokepoint (`finalize_pool_match`) runs it on every match
+path (serial / batched / pipelined / speculative), so a gang can never
+partially place no matter which solve produced the assignment; parity
+tests pin the two implementations together.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_NO_BLOCK = 2**30  # sentinel block index for unplaced rows
+
+
+@functools.partial(jax.jit, static_argnames=("num_gangs", "num_nodes",
+                                             "nodes_per_block"))
+def gang_filter(assignment: jnp.ndarray, gang_id: jnp.ndarray,
+                gang_need: jnp.ndarray, *, num_gangs: int, num_nodes: int,
+                nodes_per_block: int):
+    """Strip partially-placed / block-split / host-sharing gangs from an
+    assignment.
+
+    assignment [J] int32 node index in [0, num_nodes) or -1; gang_id [J]
+    int32 gang slot in [0, num_gangs) or -1 for non-gang rows; gang_need
+    [J] int32 = k on gang rows (0 otherwise).  nodes_per_block=0 treats
+    the whole pool as one block (all-or-nothing + distinct-host only —
+    the flat matchers' mode).  Returns (new_assignment [J] int32,
+    stripped [J] bool).
+    """
+    placed = assignment >= 0
+    if nodes_per_block > 0:
+        blk = jnp.where(placed, assignment // nodes_per_block, _NO_BLOCK)
+    else:
+        blk = jnp.where(placed, 0, _NO_BLOCK)
+    # non-gang rows accumulate into a sentinel slot that is never checked
+    gid = jnp.where(gang_id >= 0, gang_id, num_gangs)
+    count = jnp.zeros(num_gangs + 1, jnp.int32).at[gid].add(
+        placed.astype(jnp.int32))
+    need = jnp.zeros(num_gangs + 1, jnp.int32).at[gid].max(gang_need)
+    bmin = jnp.full(num_gangs + 1, _NO_BLOCK, jnp.int32).at[gid].min(
+        blk.astype(jnp.int32))
+    bmax = jnp.full(num_gangs + 1, -1, jnp.int32).at[gid].max(
+        jnp.where(placed, blk, -1).astype(jnp.int32))
+    # distinct-host count per gang: occupancy scatter over a small
+    # [gangs+1, num_nodes] bool grid (gang slots are bucketed, so this
+    # stays a few MB at the largest pools and compiles once per shape)
+    node = jnp.clip(jnp.where(placed, assignment, 0), 0, num_nodes - 1)
+    occupancy = jnp.zeros((num_gangs + 1, num_nodes),
+                          jnp.bool_).at[gid, node].max(placed)
+    distinct = occupancy.sum(axis=1).astype(jnp.int32)
+    complete = (count == need) & (bmin == bmax) & (distinct == need)
+    keep = (gang_id < 0) | complete[gid]
+    new_assignment = jnp.where(keep, assignment, -1).astype(jnp.int32)
+    stripped = placed & ~keep
+    return new_assignment, stripped
+
+
+@jax.jit
+def release_assignments(avail: jnp.ndarray, demands: jnp.ndarray,
+                        assignment: jnp.ndarray,
+                        mask: jnp.ndarray) -> jnp.ndarray:
+    """Return masked rows' demand to availability (the inverse of the
+    solve's scatter-subtract): avail [N, R], demands [J, R], assignment
+    [J] node indices (only rows with mask True are read), mask [J] bool.
+    """
+    n = avail.shape[0]
+    idx = jnp.where(mask, assignment, n - 1)
+    delta = jnp.where(mask[:, None], demands, 0.0)
+    return avail.at[idx].add(delta)
+
+
+@functools.partial(jax.jit, static_argnames=("nodes_per_block",))
+def block_free_hosts(avail: jnp.ndarray, node_valid: jnp.ndarray,
+                     member_demand: jnp.ndarray, *,
+                     nodes_per_block: int) -> jnp.ndarray:
+    """Per-block count of valid hosts that can hold one gang member:
+    avail [N, R] (N a multiple of nodes_per_block), member_demand [R].
+    The coarse gang-routing gate (a gang of k only routes to blocks with
+    >= k such hosts) and the `gang-incomplete` detail's "best block had
+    x/k hosts free" numerator."""
+    n = avail.shape[0]
+    fits = jnp.all(avail >= member_demand[None, :], axis=-1) & node_valid
+    return fits.reshape(n // nodes_per_block,
+                        nodes_per_block).sum(axis=-1).astype(jnp.int32)
+
+
+# ------------------------------------------------------------ numpy twins
+
+
+def np_gang_filter(assignment: np.ndarray, gang_id: np.ndarray,
+                   gang_need: np.ndarray,
+                   nodes_per_block: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side twin of `gang_filter` (same semantics, numpy arrays).
+
+    Used by finalize_pool_match as the single enforcement chokepoint and
+    by the parity tests that pin the device kernel to it.  Returns
+    (new_assignment, stripped)."""
+    assignment = np.asarray(assignment, dtype=np.int32).copy()
+    gang_id = np.asarray(gang_id)
+    gang_need = np.asarray(gang_need)
+    placed = assignment >= 0
+    stripped = np.zeros(assignment.shape[0], dtype=bool)
+    for g in np.unique(gang_id[gang_id >= 0]):
+        rows = gang_id == g
+        need = int(gang_need[rows].max(initial=0))
+        hit = rows & placed
+        blocks = (assignment[hit] // nodes_per_block
+                  if nodes_per_block > 0
+                  else np.zeros(int(hit.sum()), dtype=np.int64))
+        distinct = int(np.unique(assignment[hit]).size)
+        complete = (int(hit.sum()) == need and need > 0
+                    and distinct == need
+                    and (blocks.size == 0 or blocks.min() == blocks.max()))
+        if not complete:
+            stripped |= hit
+            assignment[rows] = -1
+    return assignment, stripped
+
+
+def np_gang_repair(assignment: np.ndarray, gang_id: np.ndarray,
+                   gang_need: np.ndarray, demands: np.ndarray,
+                   avail: np.ndarray, feasible: Optional[np.ndarray],
+                   nodes_per_block: int) -> np.ndarray:
+    """Greedy host-side completion pass for gangs the solver left partial,
+    co-located, or block-split.
+
+    The flat binpack kernels know nothing about gangs: best-fit happily
+    stacks all k members on one host, UNIQUE validation then strips the
+    duplicates, and the all-or-nothing filter would hold the gang back
+    forever.  This pass gives each broken gang one whole-gang retry: free
+    its partial placement, then walk the blocks (whole pool when
+    nodes_per_block<=0) and take the first block where every member fits
+    on a DISTINCT feasible host under remaining capacity.  Non-gang rows
+    are never moved; capacity accounting includes everything already
+    placed this cycle.  Returns the repaired assignment (rows of gangs
+    that still cannot place whole stay/become -1 for `np_gang_filter` to
+    finalize)."""
+    assignment = np.asarray(assignment, dtype=np.int32).copy()
+    gang_id = np.asarray(gang_id)
+    gang_need = np.asarray(gang_need)
+    demands = np.asarray(demands, dtype=np.float64)
+    n = avail.shape[0]
+    remaining = np.asarray(avail, dtype=np.float64).copy()
+    placed = assignment >= 0
+    np.subtract.at(remaining, assignment[placed], demands[placed])
+    npb = nodes_per_block if nodes_per_block > 0 else n
+    for g in np.unique(gang_id[gang_id >= 0]):
+        rows = np.flatnonzero(gang_id == g)
+        need = int(gang_need[rows].max(initial=0))
+        if need <= 0 or len(rows) < need:
+            continue
+        hit = rows[assignment[rows] >= 0]
+        if hit.size == need:
+            hosts = assignment[hit]
+            blocks = hosts // npb
+            if (np.unique(hosts).size == need
+                    and blocks.min() == blocks.max()):
+                continue  # already whole: one block, distinct hosts
+        # free the broken placement, then retry the gang whole
+        np.add.at(remaining, assignment[hit], demands[hit])
+        assignment[rows] = -1
+        order = rows[np.argsort(-demands[rows].sum(axis=1), kind="stable")]
+        n_blocks = (n + npb - 1) // npb
+        chosen = None
+        for b in range(n_blocks):
+            lo, hi = b * npb, min((b + 1) * npb, n)
+            if hi - lo < need:
+                continue
+            rem = remaining[lo:hi].copy()
+            used: set = set()
+            trial: dict = {}
+            for ji in order:
+                pick = -1
+                for node in range(lo, hi):
+                    if node in used:
+                        continue
+                    if feasible is not None and not feasible[ji, node]:
+                        continue
+                    if np.all(rem[node - lo] >= demands[ji]):
+                        pick = node
+                        break
+                if pick < 0:
+                    break
+                used.add(pick)
+                rem[pick - lo] -= demands[ji]
+                trial[int(ji)] = pick
+            if len(trial) == len(order):
+                chosen = trial
+                break
+        if chosen is not None:
+            for ji, node in chosen.items():
+                assignment[ji] = node
+                remaining[node] -= demands[ji]
+    return assignment
+
+
+def np_block_free_hosts(avail: np.ndarray, node_valid: np.ndarray,
+                        member_demand: np.ndarray,
+                        nodes_per_block: int) -> np.ndarray:
+    """Numpy twin of `block_free_hosts` (ragged tail tolerated: the last
+    block may be short when N is not a block multiple host-side)."""
+    fits = np.all(avail >= member_demand[None, :], axis=-1) & node_valid
+    n = fits.shape[0]
+    nb = max(1, (n + nodes_per_block - 1) // nodes_per_block) \
+        if nodes_per_block > 0 else 1
+    out = np.zeros(nb, dtype=np.int32)
+    if nodes_per_block <= 0:
+        out[0] = int(fits.sum())
+        return out
+    for b in range(nb):
+        out[b] = int(fits[b * nodes_per_block:(b + 1) * nodes_per_block]
+                     .sum())
+    return out

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 
 from cook_tpu.obs import data_plane
 from cook_tpu.ops.common import BIG, bucket_size, fetch_result
+from cook_tpu.ops.gang import gang_filter, release_assignments
 from cook_tpu.ops.match import (
     MatchProblem,
     MatchResult,
@@ -158,15 +159,27 @@ def block_aggregates(avail, totals, node_valid, npb: int):
     block_max = jnp.where(nv[..., None], av, -1.0).max(axis=1)
     block_tot = jnp.where(nv[..., None], tot, 0.0).sum(axis=1)
     block_valid = nv.any(axis=1)
-    return block_sum, block_max, block_tot, block_valid
+    block_count = nv.sum(axis=1).astype(jnp.int32)
+    return block_sum, block_max, block_tot, block_valid, block_count
 
 
 def _coarse_xla(demands, active, block_sum, block_max, block_tot,
-                block_valid, block_any, params: HierParams):
+                block_valid, block_any, params: HierParams,
+                gate_demands=None, need_row=None, block_count=None):
     """Coarse jobs x blocks assignment on the aggregated problem via the
     shared chunked kernel; `block_any` optionally gates each (job, block)
-    on the original constraint mask having any feasible node there."""
-    feas = jnp.all(block_max[None, :, :] >= demands[:, None, :], axis=-1)
+    on the original constraint mask having any feasible node there.
+
+    Gang rows route with their gang's AGGREGATE demand (the leader row
+    carries the sum; members are inactive here) but gate on what the
+    block must hold member-wise: `gate_demands` is the per-row max member
+    demand (block_max must fit it) and `need_row` the member count, gated
+    against `block_count` (valid hosts per block) — a gang of k only
+    routes to blocks with >= k candidate hosts."""
+    gate = demands if gate_demands is None else gate_demands
+    feas = jnp.all(block_max[None, :, :] >= gate[:, None, :], axis=-1)
+    if need_row is not None and block_count is not None:
+        feas = feas & (block_count[None, :] >= need_row[:, None])
     if block_any is not None:
         feas = feas & block_any
     problem = MatchProblem(
@@ -411,6 +424,8 @@ def hierarchical_match(
     mesh=None,
     observatory=None,
     pool: str = "",
+    gang_id: Optional[np.ndarray] = None,
+    gang_need: Optional[np.ndarray] = None,
 ) -> tuple[MatchResult, dict]:
     """Solve one giant pool's match problem coarse-then-fine.
 
@@ -419,6 +434,17 @@ def hierarchical_match(
     the phase walls (coarse_s/fine_s/refine_s), block geometry, per-block
     jobs/placed counts, and spill/refine accounting — the matcher copies
     it into the CycleRecord's hierarchical fields.
+
+    `gang_id`/`gang_need` (host [J] int arrays; -1/0 on non-gang rows)
+    turn on gang placement: each gang routes coarse as ONE row (the
+    leader carries the summed demand, gated on per-member fit and >= k
+    candidate hosts in the block), members inherit the leader's block,
+    and after every fine pass the `ops/gang.gang_filter` kernel strips
+    any gang that did not fully land inside one block — the stripped
+    demand is released back into the live availability so refine rounds
+    (and the next cycle) retry the gang whole.  A gang therefore never
+    partially places on this path; `stats["gangs"]` carries the
+    considered/placed/stripped accounting.
 
     `observatory` (obs.CompileObservatory) receives one
     `match_coarse`/`match_fine` solve report per pass, keyed by the
@@ -487,6 +513,55 @@ def hierarchical_match(
     block_stats: list[dict] = []
     avail_now = avail
 
+    # ---- gang precompute (one-time per solve): the leader row of each
+    # gang carries the gang's aggregate coarse demand; members ride the
+    # leader's block.  Device filter arrays are bucketed so the filter
+    # compiles once per (rows, gang-slots) shape like everything else.
+    gang_rows_np = is_leader_np = leader_row_np = None
+    gang_id_dev = gang_need_dev = None
+    demands_coarse = problem.demands
+    gate_demands = need_row = None
+    n_gangs = gang_slots = 0
+    gangs_stripped_rows = 0
+    has_gangs = False
+    if gang_id is not None and gang_need is not None:
+        gang_id_np = np.full(j, -1, dtype=np.int32)
+        gang_id_np[:orig_j] = np.asarray(gang_id, dtype=np.int32)
+        gang_need_np = np.zeros(j, dtype=np.int32)
+        gang_need_np[:orig_j] = np.asarray(gang_need, dtype=np.int32)
+        has_gangs = bool((gang_id_np >= 0).any())
+    if has_gangs:
+        gang_rows_np = gang_id_np >= 0
+        leader_row_np = np.arange(j, dtype=np.int32)
+        is_leader_np = np.zeros(j, dtype=bool)
+        for g in np.unique(gang_id_np[gang_rows_np]):
+            rows = np.flatnonzero(gang_id_np == g)
+            leader_row_np[rows] = rows[0]
+            is_leader_np[rows[0]] = True
+        n_gangs = int(is_leader_np.sum())
+        gang_slots = bucket_size(n_gangs)
+        lr = data_plane.h2d(leader_row_np,
+                            family=data_plane.FAM_HIER_COARSE)
+        gmask = data_plane.h2d(gang_rows_np,
+                               family=data_plane.FAM_HIER_COARSE)
+        gang_id_dev = data_plane.h2d(gang_id_np,
+                                     family=data_plane.FAM_HIER_FINE)
+        gang_need_dev = data_plane.h2d(gang_need_np,
+                                       family=data_plane.FAM_HIER_FINE)
+        contrib = jnp.where(gmask[:, None], problem.demands, 0.0)
+        agg = jnp.zeros_like(problem.demands).at[lr].add(contrib)
+        # members route as one aggregate row; gates stay member-sized
+        demands_coarse = jnp.where(gmask[:, None], agg, problem.demands)
+        gmax = jnp.zeros_like(problem.demands).at[lr].max(contrib)
+        gate_demands = jnp.where(gmask[:, None], gmax, problem.demands)
+        need_row = data_plane.h2d(
+            np.where(gang_rows_np, gang_need_np, 1).astype(np.int32),
+            family=data_plane.FAM_HIER_COARSE)
+        # gang gating needs the masked coarse path (the fused pallas
+        # scorer has no per-row host-count gate); quality unaffected —
+        # xla is the exact backend
+        coarse_backend = "xla"
+
     def coarse_pass(active_mask: np.ndarray) -> np.ndarray:
         """One coarse jobs x blocks assignment against the CURRENT block
         availabilities (refine rounds re-enter here with only the
@@ -497,8 +572,8 @@ def hierarchical_match(
             "match_coarse", (j, b_pad),
             valid_cells=int(active_mask.sum()) * b_real,
             padded_cells=j * b_pad)
-        block_sum, block_max, block_tot, block_valid = block_aggregates(
-            avail_now, totals, node_valid, npb)
+        block_sum, block_max, block_tot, block_valid, block_count = \
+            block_aggregates(avail_now, totals, node_valid, npb)
         if block_pad_axis:
             block_sum = jnp.pad(block_sum, ((0, block_pad_axis), (0, 0)))
             block_max = jnp.pad(block_max, ((0, block_pad_axis), (0, 0)),
@@ -506,12 +581,17 @@ def hierarchical_match(
             block_tot = jnp.pad(block_tot, ((0, block_pad_axis), (0, 0)),
                                 constant_values=1.0)
             block_valid = jnp.pad(block_valid, (0, block_pad_axis))
+            block_count = jnp.pad(block_count, (0, block_pad_axis))
+        if has_gangs:
+            # gang members ride their leader's row through the coarse
+            # solve — only the leader (aggregate demand) routes
+            active_mask = active_mask & ~(gang_rows_np & ~is_leader_np)
         active = data_plane.h2d(active_mask,
                                 family=data_plane.FAM_HIER_COARSE)
         if coarse_backend == "pallas":
             interpret = jax.default_backend() != "tpu"
             assignment = _coarse_pallas(
-                problem.demands, active, block_sum, block_max, block_tot,
+                demands_coarse, active, block_sum, block_max, block_tot,
                 block_valid,
                 chunk=_chunk_for(params.coarse_chunk, j),
                 rounds=params.coarse_rounds, passes=params.coarse_passes,
@@ -524,13 +604,23 @@ def hierarchical_match(
                     block_any = jnp.pad(block_any,
                                         ((0, 0), (0, block_pad_axis)))
             assignment = _coarse_xla(
-                problem.demands, active, block_sum, block_max, block_tot,
-                block_valid, block_any, params)
+                demands_coarse, active, block_sum, block_max, block_tot,
+                block_valid, block_any, params,
+                gate_demands=gate_demands if has_gangs else None,
+                need_row=need_row if has_gangs else None,
+                block_count=block_count if has_gangs else None)
         if observatory is not None:
             observatory.observe_solve("match_coarse", (j, b_pad),
                                       coarse_backend)
         with data_plane.family(data_plane.FAM_HIER_COARSE):
-            return np.asarray(fetch_result(assignment))
+            res = np.asarray(fetch_result(assignment))
+        if has_gangs:
+            # members inherit the leader's block (or its miss): the
+            # scatter then seats the whole gang in one block's slots
+            members = gang_rows_np & ~is_leader_np
+            res = res.copy()
+            res[members] = res[leader_row_np[members]]
+        return res
 
     def fine_pass(job_idx: np.ndarray):
         """Scattered fine batch solve; returns (assignment [b_real, s]
@@ -567,6 +657,31 @@ def hierarchical_match(
         out[job_idx[sel]] = global_idx[sel].astype(np.int32)
         return int(sel.sum())
 
+    def enforce_gangs() -> int:
+        """Group-sum constraint: run the device `gang_filter` over the
+        merged global assignment, stripping any gang that did not fully
+        land inside one block, and release the stripped demand back into
+        the live availability so refine rounds retry the gang whole.
+        Returns the number of rows stripped (0 when gangs are absent —
+        the gang-free path never touches the device)."""
+        nonlocal avail_now, gangs_stripped_rows
+        if not has_gangs:
+            return 0
+        asg_dev = data_plane.h2d(out, family=data_plane.FAM_HIER_FINE)
+        new_asg, stripped = gang_filter(
+            asg_dev, gang_id_dev, gang_need_dev,
+            num_gangs=gang_slots, num_nodes=n_pad, nodes_per_block=npb)
+        with data_plane.family(data_plane.FAM_HIER_FINE):
+            stripped_np = np.asarray(fetch_result(stripped))
+        count = int(stripped_np.sum())
+        if count:
+            avail_now = release_assignments(avail_now, problem.demands,
+                                            asg_dev, stripped)
+            with data_plane.family(data_plane.FAM_HIER_FINE):
+                out[:] = np.asarray(fetch_result(new_asg))
+            gangs_stripped_rows += count
+        return count
+
     # ---- round 0: coarse -> scatter -> fine
     t0 = time.perf_counter()
     coarse = coarse_pass(job_valid_np)
@@ -577,6 +692,7 @@ def hierarchical_match(
     fine_assign, avail_now = fine_pass(job_idx)
     fine_s += time.perf_counter() - t0
     merge(job_idx, fine_assign)
+    enforce_gangs()
     for bi in range(b_real):
         block_stats.append({
             "jobs": int((job_idx[bi] >= 0).sum()),
@@ -585,8 +701,9 @@ def hierarchical_match(
         })
 
     # ---- bounded refinement: re-offer every leftover (coarse-unrouted,
-    # slot-spilled, or fine-unplaced) to under-filled blocks against the
-    # UPDATED availabilities — identical shapes, so no new programs
+    # slot-spilled, fine-unplaced, or gang-stripped) to under-filled
+    # blocks against the UPDATED availabilities — identical shapes, so
+    # no new programs
     rounds_run = 0
     for _ in range(max(0, params.refine_rounds)):
         leftover = job_valid_np & (out < 0)
@@ -598,9 +715,12 @@ def hierarchical_match(
         job_idx, _ = scatter_to_blocks(coarse, leftover, b_real, slots)
         fine_assign, avail_now = fine_pass(job_idx)
         placed = merge(job_idx, fine_assign)
-        refine_placed += placed
+        stripped = enforce_gangs()
+        refine_placed += max(0, placed - stripped)
         refine_s += time.perf_counter() - t0
-        if placed == 0:
+        if placed - stripped <= 0:
+            # net-zero progress: a strip returned exactly what the round
+            # consumed, so the next round would replay the same solve
             break
 
     stats = {
@@ -622,6 +742,12 @@ def hierarchical_match(
         "block_stats": block_stats,
         "total_s": time.perf_counter() - t_start,
     }
+    if has_gangs:
+        stats["gangs"] = {
+            "considered": n_gangs,
+            "placed": int((is_leader_np & (out >= 0)).sum()),
+            "stripped_rows": gangs_stripped_rows,
+        }
     _note_metrics(pool, stats["backend"], stats)
     return MatchResult(assignment=jnp.asarray(out[:orig_j]),
                        new_avail=avail_now[:n]), stats

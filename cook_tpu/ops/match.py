@@ -61,6 +61,14 @@ class MatchProblem(NamedTuple):
     totals: jnp.ndarray      # [N, 2] (mem, cpus) capacity — fitness denominators
     node_valid: jnp.ndarray  # [N] bool
     feasible: Optional[jnp.ndarray] = None  # [J, N] bool constraint mask
+    # [N] additive score term — the topology distance bonus (matcher
+    # `topology_weight`): nodes in already-warm topology blocks score
+    # higher, so placements co-locate even for non-gang jobs.  None (the
+    # default) keeps the pre-gang XLA programs byte-identical; the
+    # pallas candidate backend ignores it (its fused best-node kernel
+    # ranks by raw fitness — co-location there rides on the
+    # hierarchical block routing instead).
+    node_bonus: Optional[jnp.ndarray] = None
 
 
 class MatchResult(NamedTuple):
@@ -90,7 +98,8 @@ def vmap_safe_backend(backend: str) -> str:
     return "xla" if backend == "pallas" else backend
 
 
-def _job_step(avail, totals, node_valid, demand, job_ok, feas_row):
+def _job_step(avail, totals, node_valid, demand, job_ok, feas_row,
+              node_bonus=None):
     """Place one job: feasibility mask + binpacking-fitness argmax."""
     fits = jnp.all(avail >= demand[None, :], axis=-1)
     feasible = fits & node_valid & feas_row & job_ok
@@ -98,6 +107,8 @@ def _job_step(avail, totals, node_valid, demand, job_ok, feas_row):
     denom = jnp.maximum(totals, 1e-30)
     fit = binpack_fitness(used[:, 0], used[:, 1], demand[0], demand[1],
                           denom[:, 0], denom[:, 1])
+    if node_bonus is not None:
+        fit = fit + node_bonus
     score = jnp.where(feasible, fit, -BIG)
     best = jnp.argmax(score)
     placed = score[best] > -BIG
@@ -122,7 +133,8 @@ def greedy_match(problem: MatchProblem) -> MatchResult:
     def step(avail, inputs):
         demand, ok, feas_row = inputs
         new_avail, choice = _job_step(
-            avail, problem.totals, problem.node_valid, demand, ok, feas_row
+            avail, problem.totals, problem.node_valid, demand, ok, feas_row,
+            node_bonus=problem.node_bonus,
         )
         return new_avail, choice
 
@@ -333,6 +345,8 @@ def chunked_match(
                                   demand_matrix[:, 0:1],
                                   demand_matrix[:, 1:2],
                                   denom[None, :, 0], denom[None, :, 1])
+            if problem.node_bonus is not None:
+                fit = fit + problem.node_bonus[None, :]
             score = jnp.where(feasible, fit, -BIG)
             if use_approx:
                 return jax.lax.approx_max_k(score, kc, recall_target=0.95)

@@ -112,11 +112,14 @@ def pool_sharded_match(mesh: Mesh, problems: MatchProblem, *,
     spec = P("pool")
     # a mask-less batch (feasible=None, e.g. the hierarchical fine solve
     # at XL sizes where a [J, N] mask would be GBs) has no leaf there —
-    # the spec pytree must match the data pytree's structure
+    # the spec pytree must match the data pytree's structure; likewise
+    # node_bonus only appears when topology scoring stamped one
     feas_spec = spec if problems.feasible is not None else None
+    bonus_spec = spec if problems.node_bonus is not None else None
     shmapped = shard_map(
         mapped, mesh=mesh,
-        in_specs=(MatchProblem(spec, spec, spec, spec, spec, feas_spec),),
+        in_specs=(MatchProblem(spec, spec, spec, spec, spec, feas_spec,
+                               bonus_spec),),
         out_specs=MatchResult(spec, spec),
     )
     return shmapped(problems)

@@ -16,6 +16,7 @@ honored for configured admins (rest/impersonation.clj).
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import re
 import statistics
@@ -66,6 +67,9 @@ class ApiConfig:
     max_job_cpus: float = 512.0
     max_job_gpus: float = 64.0
     max_retries_limit: int = 200
+    # largest accepted gang (gang_size=k all-or-nothing placement,
+    # scheduler/gang.py) — bounded by what one topology block can hold
+    max_gang_size: int = 64
     admins: tuple = ("admin",)
     version: str = "cook-tpu-0.1.0"
     submission_rate_per_minute: float = 0.0  # 0 = unlimited
@@ -1225,6 +1229,23 @@ class CookApi:
             job = adjusted
             jobs.append(job)
             pools_counted[job.pool] = pools_counted.get(job.pool, 0) + 1
+        # gang batches must be complete (store._validate_gangs re-checks
+        # under the txn lock; this mirrors it so the mp 2PC prepare phase
+        # vetoes with the same message a single-process 400 carries)
+        gangs: dict[str, list[Job]] = {}
+        for job in jobs:
+            if job.gang_size > 0 and job.group_uuid:
+                gangs.setdefault(job.group_uuid, []).append(job)
+        for guuid, members in gangs.items():
+            k = members[0].gang_size
+            if any(j.gang_size != k for j in members):
+                return [], {}, f"group {guuid}: members disagree on gang_size"
+            if any(j.pool != members[0].pool for j in members):
+                return [], {}, f"group {guuid}: gang members span pools"
+            if len(members) != k:
+                return [], {}, (
+                    f"group {guuid}: gang_size {k} but {len(members)} "
+                    "member(s) in the batch (gangs submit atomically)")
         for pool, count in pools_counted.items():
             limit_err = self.queue_limits.check_submission(user, pool, count)
             if limit_err:
@@ -1276,11 +1297,27 @@ class CookApi:
                               operator=ConstraintOperator.EQUALS,
                               pattern=c[2])
             )
+        gang_size = int(spec.get("gang_size", 0))
+        if gang_size < 0 or gang_size == 1 \
+                or gang_size > self.config.max_gang_size:
+            return None, (f"gang_size {gang_size} out of range "
+                          f"(0 or [2, {self.config.max_gang_size}])")
         group_uuid = spec.get("group")
+        if gang_size and not group_uuid:
+            return None, "gang_size requires a group"
         if group_uuid and group_uuid not in groups \
                 and group_uuid not in self.store.groups:
             # implicit group creation (reference: make-default-host-placement)
             groups[group_uuid] = Group(uuid=group_uuid)
+        if gang_size and group_uuid in groups:
+            # gang members need k DISTINCT hosts: an implicit (or
+            # placement-less) gang group is promoted to unique-host so
+            # validate_group_assignments enforces distinctness
+            g = groups[group_uuid]
+            if g.host_placement.type == GroupPlacementType.ALL:
+                groups[group_uuid] = dataclasses.replace(
+                    g, host_placement=HostPlacement(
+                        type=GroupPlacementType.UNIQUE))
         container = None
         cspec = spec.get("container")
         if cspec:
@@ -1325,6 +1362,7 @@ class CookApi:
             labels=tuple(sorted(spec.get("labels", {}).items())),
             constraints=tuple(constraints),
             group_uuid=group_uuid,
+            gang_size=gang_size,
             container=container,
             application=application,
             checkpoint=checkpoint,

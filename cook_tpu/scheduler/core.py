@@ -626,11 +626,15 @@ class Scheduler:
         # cache spare resources for the rebalancer (view-incubating-offers,
         # scheduler.clj:1537): offers minus what this cycle just placed
         matched_uuids = {j.uuid for j, _ in outcome.matched}
-        # launched jobs release their host reservations
+        # launched jobs release their host reservations; a placed gang
+        # releases its group-wide gang:<group> reservations
+        matched_tags = matched_uuids | {
+            "gang:" + j.group_uuid
+            for j, _ in outcome.matched if j.group_uuid}
         if self.host_reservations:
             self.host_reservations = {
-                host: uuid for host, uuid in self.host_reservations.items()
-                if uuid not in matched_uuids
+                host: tag for host, tag in self.host_reservations.items()
+                if tag not in matched_tags
             }
         queue.jobs = [j for j in queue.jobs if j.uuid not in matched_uuids]
         self._cache_spare(pool)
@@ -863,11 +867,14 @@ class Scheduler:
             matched_uuids = {j.uuid for j, _ in outcome.matched}
             queue = self.pool_queues[pool.name]
             queue.jobs = [j for j in queue.jobs if j.uuid not in matched_uuids]
+            matched_tags = matched_uuids | {
+                "gang:" + j.group_uuid
+                for j, _ in outcome.matched if j.group_uuid}
             if self.host_reservations:
                 self.host_reservations = {
-                    host: uuid
-                    for host, uuid in self.host_reservations.items()
-                    if uuid not in matched_uuids
+                    host: tag
+                    for host, tag in self.host_reservations.items()
+                    if tag not in matched_tags
                 }
             self._cache_spare(pool)
             flight = flights[pool.name]
@@ -913,6 +920,14 @@ class Scheduler:
                 "max_preemption", base.max_preemption)),
             fast_cycle=bool(overrides.get(
                 "fast_cycle", base.fast_cycle)),
+            gang_enabled=bool(overrides.get(
+                "gang_enabled", base.gang_enabled)),
+            gang_max_admissions=int(overrides.get(
+                "gang_max_admissions", base.gang_max_admissions)),
+            gang_drain_max_wait_ms=float(overrides.get(
+                "gang_drain_max_wait_ms", base.gang_drain_max_wait_ms)),
+            gang_drain_wasted_factor=float(overrides.get(
+                "gang_drain_wasted_factor", base.gang_drain_wasted_factor)),
         )
 
     def rebalance_cycle(self, pool: Pool) -> list[Decision]:
@@ -937,6 +952,7 @@ class Scheduler:
         # BEFORE _transact_preemption flips the instances terminal (the
         # runtime destroyed is clock() - start at the kill)
         now_ms = self.store.clock()
+        block_of = self._host_block_map(pool, spare)
         ledger_entries = []
         for d in decisions:
             if not d.task_ids:
@@ -956,6 +972,10 @@ class Scheduler:
                 "preemptor_job": d.job.uuid,
                 "preemptor_user": d.job.user,
                 "hostname": d.hostname,
+                # topology block of the freed host: the fairness
+                # observatory's block-aware fragmentation groups freed
+                # capacity by block (obs/fairness.py _fragmentation)
+                "block": block_of.get(d.hostname, -1),
                 "min_preempted_dru": d.min_preempted_dru,
                 "victims": victims,
                 "wasted_s": round(sum(v["wasted_s"] for v in victims), 3),
@@ -992,7 +1012,120 @@ class Scheduler:
             "rebalance.preempted",
             "tasks preempted by the rebalancer per pool").inc(
             n_preempted, {"pool": pool.name})
+        self._gang_admission_cycle(pool, queue, spare)
         return decisions
+
+    def _host_block_map(self, pool: Pool, spare: dict) -> dict[str, int]:
+        """hostname -> topology block index, on the planner's reading of
+        the fleet (sorted hosts chunked by the match config's block
+        width) — shared by the fairness ledger stamps and gang
+        admission."""
+        from cook_tpu.scheduler.matcher import topology_block_width
+
+        hostnames = sorted(
+            set(spare)
+            | {i.hostname for i in self.store.running_instances(pool.name)
+               if i.hostname})
+        npb = topology_block_width(self.config.match,
+                                   max(len(hostnames), 1))
+        if npb <= 0:
+            npb = max(len(hostnames), 1)
+        return {h: i // npb for i, h in enumerate(hostnames)}
+
+    def _gang_admission_cycle(self, pool: Pool, queue, spare) -> list:
+        """Topology-aware gang admission (scheduler/gang.py): whole-gang
+        drain-vs-kill decisions riding the rebalance cycle.  Preempt-less
+        admissions only reserve hosts (the block drains into the
+        reservation); preempt admissions transact contiguous in-block
+        victim sets like any rebalancer kill."""
+        from cook_tpu.scheduler.gang import (
+            GANG_RESERVATION_PREFIX,
+            gang_reservation_tag,
+            plan_gang_admissions,
+        )
+        from cook_tpu.scheduler.matcher import topology_block_width
+
+        params = self._rebalancer_params()
+        if not (params.gang_enabled and self.config.match.gang_enabled):
+            return []
+        waiting_groups = {
+            gang_reservation_tag(j.group_uuid) for j in queue.jobs
+            if j.gang_size >= 2 and j.group_uuid}
+        # stale gang reservations (gang canceled / placed via another
+        # pool) must not squat on hosts
+        self.host_reservations = {
+            host: tag for host, tag in self.host_reservations.items()
+            if not tag.startswith(GANG_RESERVATION_PREFIX)
+            or tag in waiting_groups}
+        if not waiting_groups:
+            return []
+        admissions = plan_gang_admissions(
+            self.store, pool, queue.jobs, spare,
+            nodes_per_block=topology_block_width(
+                self.config.match, max(len(spare), 1)),
+            predictor=self.predictor,
+            params=params,
+            now_ms=self.store.clock(),
+            reserved=set(self.host_reservations),
+        )
+        now_ms = self.store.clock()
+        gang_entries = []
+        for adm in admissions:
+            tag = gang_reservation_tag(adm.group_uuid)
+            for host in adm.hosts:
+                self.host_reservations[host] = tag
+            victims = []
+            for task_id in adm.victims:
+                inst = self.store.instances.get(task_id)
+                if inst is None or inst.status.terminal:
+                    continue
+                job = self.store.jobs.get(inst.job_uuid)
+                victims.append({
+                    "task_id": task_id,
+                    "user": job.user if job is not None else "",
+                    "dru": 0.0,
+                    "mem": job.resources.mem if job is not None else 0.0,
+                    "cpus": job.resources.cpus if job is not None else 0.0,
+                    "gpus": job.resources.gpus if job is not None else 0.0,
+                    "wasted_s": round(max(
+                        0.0, (now_ms - inst.start_time_ms) / 1000.0), 3),
+                })
+                self.store.update_instance_state(
+                    task_id, InstanceStatus.FAILED,
+                    "preempted-by-rebalancer")
+                cluster = self.cluster_by_name(inst.compute_cluster)
+                if cluster is not None:
+                    cluster.safe_kill_task(task_id)
+            if victims:
+                # gang kills join the fairness ledger like any rebalancer
+                # decision — block-stamped, so the block-aware
+                # fragmentation stat sees the contiguous freed capacity
+                gang_entries.append({
+                    "t_ms": now_ms,
+                    "preemptor_job": adm.leader_uuid,
+                    "preemptor_user": "",
+                    "hostname": ",".join(adm.hosts),
+                    "block": adm.block,
+                    "min_preempted_dru": 0.0,
+                    "victims": victims,
+                    "wasted_s": round(
+                        sum(v["wasted_s"] for v in victims), 3),
+                    "freed": {
+                        "mem": sum(v["mem"] for v in victims),
+                        "cpus": sum(v["cpus"] for v in victims),
+                        "gpus": sum(v["gpus"] for v in victims)},
+                })
+            global_registry.counter(
+                "gang.admissions",
+                "gang admission decisions by the rebalance cycle per "
+                "pool and mode (drain = preempt-less)").inc(
+                1, {"pool": pool.name, "mode": adm.mode})
+        if gang_entries:
+            self.fairness.record_decisions(pool.name, gang_entries)
+        self.metrics[f"rebalance.{pool.name}.gang_admissions"] = len(
+            admissions)
+        self.last_gang_admissions = [a.to_json() for a in admissions]
+        return admissions
 
     def elastic_cycle(self):
         """One capacity-plane planning interval (cook_tpu/elastic/):

@@ -50,6 +50,7 @@ SOLVE_FAILED = "solve-failed"
 NOT_CONSIDERED = "not-considered"
 EXCEEDS_POOL_CAPACITY = "exceeds-pool-capacity"
 CLUSTER_CIRCUIT_OPEN = "cluster-circuit-open"
+GANG_INCOMPLETE = "gang-incomplete"
 
 REASON_TEXT = {
     NO_OFFERS: "no offers",
@@ -67,6 +68,10 @@ REASON_TEXT = {
         "the pool's clusters are circuit-open (launch/kill RPCs failing);"
         " jobs wait for the breaker's half-open probe instead of burning"
         " mea-culpa retries",
+    GANG_INCOMPLETE:
+        "the job's gang could not place whole (all members on distinct"
+        " hosts inside one topology block); the matcher's all-or-nothing"
+        " rule holds the whole gang back",
 }
 
 
@@ -150,6 +155,15 @@ class CycleRecord:
     hier_spilled: int = 0
     hier_refine_placed: int = 0
     block_stats: list[dict] = field(default_factory=list)
+    # gang scheduling (scheduler/gang.py + ops/gang.py): per-cycle gang
+    # accounting — gangs in the considerable window, gangs fully placed,
+    # gangs blocked, and the blocking-reason split ({reason: count},
+    # e.g. "no-block-capacity" / "members-missing") — so /debug/cycles
+    # answers "why did the gang wait" without replaying the solve
+    gangs_considered: int = 0
+    gangs_placed: int = 0
+    gangs_blocked: int = 0
+    gang_block_reasons: dict = field(default_factory=dict)
     # per-pool capacity snapshot at cycle start ({hosts, mem, cpus,
     # spare_*}) + the elastic plan id in force — so a capacity delta
     # (cook_tpu/elastic/) correlates with match outcomes record-to-record
@@ -214,6 +228,10 @@ class CycleRecord:
             "hier_spilled": self.hier_spilled,
             "hier_refine_placed": self.hier_refine_placed,
             "block_stats": list(self.block_stats),
+            "gangs_considered": self.gangs_considered,
+            "gangs_placed": self.gangs_placed,
+            "gangs_blocked": self.gangs_blocked,
+            "gang_block_reasons": dict(self.gang_block_reasons),
             "pool_capacity": dict(self.pool_capacity),
             "elastic_plan": self.elastic_plan,
             "h2d_bytes": self.h2d_bytes,
@@ -338,6 +356,17 @@ class CycleBuilder:
         self.record.device_state = {
             k: v for k, v in stats.items() if not k.startswith("_")}
 
+    def note_gang(self, *, considered: int, placed: int, blocked: int,
+                  reasons: Optional[dict] = None) -> None:
+        """Record the cycle's gang outcome (matcher finalize chokepoint):
+        gangs considered/fully-placed/blocked plus the blocking-reason
+        split ({reason: count})."""
+        rec = self.record
+        rec.gangs_considered = considered
+        rec.gangs_placed = placed
+        rec.gangs_blocked = blocked
+        rec.gang_block_reasons = dict(reasons or {})
+
     def note_match(self, job_uuid: str, hostname: str, task_id: str) -> None:
         self.record.matched.append(
             {"job": job_uuid, "host": hostname, "task_id": task_id})
@@ -422,6 +451,9 @@ class NullCycle:
         pass
 
     def note_device_state(self, *a) -> None:
+        pass
+
+    def note_gang(self, *a, **kw) -> None:
         pass
 
 

@@ -44,6 +44,11 @@ from cook_tpu.ops.common import (
     fetch_result,
     pad_to,
 )
+from cook_tpu.ops.gang import (
+    np_block_free_hosts,
+    np_gang_filter,
+    np_gang_repair,
+)
 from cook_tpu.ops.match import (
     MatchProblem,
     backend_flags,
@@ -150,6 +155,25 @@ class MatchConfig:
     # whose packing efficiency drifts under it demotes to f32
     quantized: bool = False
     quantization_parity_floor: float = 0.98
+    # gang scheduling (ops/gang.py + scheduler/gang.py): jobs submitted
+    # with gang_size=k place all-or-nothing — k distinct hosts inside
+    # ONE topology block on the hierarchical path (the fine pass's
+    # group-sum filter), whole-pool all-or-nothing on the flat paths
+    # (which have no block structure; np_gang_filter in
+    # finalize_pool_match is the single enforcement chokepoint either
+    # way).  Disabling treats gang members as independent jobs.
+    gang_enabled: bool = True
+    # topology distance term: additive per-node score bonus
+    # (MatchProblem.node_bonus) proportional to the node's block
+    # utilization, so placements co-locate into already-warm topology
+    # blocks even for non-gang jobs — keeping whole blocks free for
+    # gangs.  0 disables (the pre-gang XLA programs stay byte-identical);
+    # binpack fitness is ~[0, 1], so weights ~0.1-0.5 bias without
+    # drowning the packing signal.
+    topology_weight: float = 0.0
+    # block width (hosts) for the distance term; 0 = the hierarchical
+    # decomposition's tuned bucket (ops/hierarchical.NODE_BLOCK_BUCKETS)
+    topology_block_hosts: int = 0
 
     def __post_init__(self):
         backend_flags(self.backend)  # raises on unknown names
@@ -240,6 +264,64 @@ def job_mem_with_overhead(job: Job, config: "MatchConfig") -> float:
     if job.checkpoint is not None and job.checkpoint.mode:
         mem += config.checkpoint_memory_overhead_mb
     return mem
+
+
+def gang_context(
+    considerable: Sequence[Job], config: "MatchConfig",
+) -> tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+    """(gang_id [J] int32, gang_need [J] int32) for this cycle's
+    considerable window, or (None, None) when no gang rows are present
+    (the gang-free fast path — no extra arrays, no extra XLA programs).
+    gang_id is a dense per-cycle index over the distinct gang groups;
+    members outside the window simply don't appear, so an under-
+    represented gang (quota cap, queue cap) strips at the chokepoint
+    with a members-missing detail instead of partially placing."""
+    if not config.gang_enabled:
+        return None, None
+    ids: dict[str, int] = {}
+    gang_id = np.full(len(considerable), -1, dtype=np.int32)
+    gang_need = np.zeros(len(considerable), dtype=np.int32)
+    for ji, job in enumerate(considerable):
+        if job.gang_size >= 2 and job.group_uuid:
+            gang_id[ji] = ids.setdefault(job.group_uuid, len(ids))
+            gang_need[ji] = job.gang_size
+    if not ids:
+        return None, None
+    return gang_id, gang_need
+
+
+def topology_block_width(config: "MatchConfig", n_nodes: int) -> int:
+    """Block width (hosts) the topology distance term uses: the explicit
+    override, else the hierarchical decomposition's tuned bucket — so
+    the distance term and the gang block rule agree on what "one block"
+    means when both are active."""
+    if config.topology_block_hosts:
+        return config.topology_block_hosts
+    from cook_tpu.ops.hierarchical import choose_nodes_per_block
+
+    return choose_nodes_per_block(max(n_nodes, 1))
+
+
+def topology_bonus(nodes: EncodedNodes,
+                   config: "MatchConfig") -> Optional[np.ndarray]:
+    """Per-node additive score bonus [N] float32 (None when disabled):
+    topology_weight x the node's block mem utilization.  Warmer blocks
+    attract placements, so scalar jobs pack into partially-used blocks
+    and whole blocks stay free for gangs — the node-topology distance
+    term of the cost tensor (fitness is within-node utilization; this
+    adds the across-block dimension)."""
+    if config.topology_weight <= 0 or nodes.n == 0:
+        return None
+    npb = topology_block_width(config, nodes.n)
+    avail_mem = np.array([o.mem for o in nodes.offers], dtype=np.float32)
+    total_mem = np.array([max(o.total_mem or o.mem, 1e-9)
+                          for o in nodes.offers], dtype=np.float32)
+    util = np.clip(1.0 - avail_mem / total_mem, 0.0, 1.0)
+    bonus = np.empty(nodes.n, dtype=np.float32)
+    for start in range(0, nodes.n, npb):
+        seg = slice(start, min(start + npb, nodes.n))
+        bonus[seg] = util[seg].mean()
+    return (config.topology_weight * bonus).astype(np.float32)
 
 
 def encode_problem_arrays(
@@ -406,7 +488,9 @@ class HierarchicalPending:
             self.prepared.problem,
             params=hier_params_from_config(self.config),
             mesh=mesh, observatory=observatory,
-            pool=self.prepared.pool.name)
+            pool=self.prepared.pool.name,
+            gang_id=self.prepared.gang_id,
+            gang_need=self.prepared.gang_need)
         self.prepared.hier_stats = stats
         return np.asarray(
             result.assignment[: len(self.prepared.considerable)])
@@ -532,6 +616,39 @@ def _note_fallback_metrics(pool_name: str, reason: str) -> None:
             "match cycles solved on the CPU reference because the pool's "
             "device solve is degraded, per pool/reason")
     _fallback_counter.inc(1, {"pool": pool_name, "reason": reason})
+
+
+_gang_metrics = None
+
+
+def _note_gang_metrics(pool_name: str, considered: int, placed: int,
+                       reasons: dict) -> None:
+    """Per-cycle gang placement counters (the `gang.*` metric family):
+    considered/placed per pool, blocked per pool+reason so dashboards can
+    split members-missing from no-block-capacity from transact-failed."""
+    global _gang_metrics
+    if _gang_metrics is None:
+        _gang_metrics = {
+            "considered": global_registry.counter(
+                "gang.considered",
+                "gangs seen by a pool's match cycle, per pool"),
+            "placed": global_registry.counter(
+                "gang.placed",
+                "gangs whose every member placed and transacted whole "
+                "(one topology block, distinct hosts), per pool"),
+            "blocked": global_registry.counter(
+                "gang.blocked",
+                "gangs held back whole (gang-incomplete), per pool and "
+                "blocking reason"),
+        }
+    if considered:
+        _gang_metrics["considered"].inc(considered, {"pool": pool_name})
+    if placed:
+        _gang_metrics["placed"].inc(placed, {"pool": pool_name})
+    for reason, n in (reasons or {}).items():
+        if n:
+            _gang_metrics["blocked"].inc(n, {"pool": pool_name,
+                                             "reason": reason})
 
 
 def enter_device_fallback(state: PoolMatchState, config: MatchConfig,
@@ -850,6 +967,12 @@ class PreparedPool:
     # HierarchicalPending.fetch and folded into the CycleRecord by
     # record_solve_outcome
     hier_stats: Optional[dict] = None
+    # gang rows of the considerable window (gang_context): dense per-
+    # cycle gang index / member count, None when the cycle has no gangs.
+    # The hierarchical solve consumes them for block routing; the
+    # finalize chokepoint enforces all-or-nothing on EVERY path with them
+    gang_id: Optional[np.ndarray] = None
+    gang_need: Optional[np.ndarray] = None
     # clusters withheld from this cycle because their circuit breaker is
     # open (cook_tpu/faults/breaker.py): offer-less pools report
     # `cluster-circuit-open` instead of a misleading `no-offers`
@@ -922,6 +1045,8 @@ def prepare_pool_problem(
     considerable = prepared.considerable
     record_considered(flight, queue, considerable,
                       len(prepared.cluster_offers))
+    prepared.gang_id, prepared.gang_need = gang_context(considerable,
+                                                        config)
     if not considerable or not prepared.cluster_offers:
         return prepared
 
@@ -1002,6 +1127,10 @@ def prepare_pool_problem(
         has_reservation = reserved_for != ""
         for ji, job in enumerate(considerable):
             allowed = ~has_reservation | (reserved_for == job.uuid)
+            if job.group_uuid:
+                # gang admission reserves hosts under a group-wide tag any
+                # member may claim (scheduler/gang.py)
+                allowed |= reserved_for == ("gang:" + job.group_uuid)
             feasible[ji] &= allowed
             # the saved pre-closure rows must honor reservations too, or
             # the balanced top-up could steal a reserved host
@@ -1023,6 +1152,16 @@ def prepare_pool_problem(
                                                chunk=config.chunk,
                                                config=config,
                                                quantized=quantized)
+    bonus = topology_bonus(nodes, config)
+    if bonus is not None:
+        # the topology distance term rides every build path (classic,
+        # quantized, device-resident) as a post-assembly field: [N]
+        # floats are negligible next to the [J, N] mask, so residency
+        # doesn't mirror them
+        pad_n = int(prepared.problem.avail.shape[0])
+        prepared.problem = prepared.problem._replace(
+            node_bonus=data_plane.h2d(pad_to(bonus, pad_n),
+                                      family=data_plane.FAM_NODE_ENCODE))
     return prepared
 
 
@@ -1064,6 +1203,11 @@ def finalize_pool_match(
             code = flight_codes.CONSTRAINTS_FILTERED
         for job in considerable:
             flight.note_skip(job.uuid, code)
+        if prepared.gang_id is not None:
+            n_gangs = int(np.unique(
+                prepared.gang_id[prepared.gang_id >= 0]).size)
+            flight.note_gang(considered=n_gangs, placed=0, blocked=n_gangs,
+                            reasons={code: n_gangs})
         _apply_backoff(config, state, outcome.head_matched)
         return outcome
     nodes = prepared.nodes
@@ -1104,6 +1248,85 @@ def finalize_pool_match(
                 np.float32)[:nodes.n],
         )
 
+    # gang all-or-nothing chokepoint (ops/gang.np_gang_filter): EVERY
+    # solve path — serial, batched, pipelined, speculative, CPU-fallback,
+    # hierarchical — funnels its assignment through here, so a gang can
+    # never partially place no matter which kernel produced it.  The
+    # hierarchical path already filtered on-device (and retried through
+    # refine); this host twin re-checks after group validation/topup may
+    # have stripped members.  Flat solves carry no block structure, so
+    # they enforce whole-pool all-or-nothing + distinct hosts
+    # (nodes_per_block=0); the one-block rule binds where topology
+    # exists.
+    gang_details: dict[int, str] = {}
+    if prepared.gang_id is not None:
+        gid, gneed = prepared.gang_id, prepared.gang_need
+        npb_eff = int((prepared.hier_stats or {}).get("nodes_per_block", 0))
+        if npb_eff == 0 and config.topology_block_hosts:
+            # flat solve but the operator declared the topology: the
+            # explicit block width binds the one-block rule here too
+            npb_eff = int(config.topology_block_hosts)
+        demands_np, avail_np, _tot = encode_problem_arrays(
+            considerable, nodes.offers, config)
+        # repair before judging: the flat kernels best-fit gang members
+        # onto one host (UNIQUE validation just stripped the duplicates);
+        # give each broken gang one whole-gang retry on distinct feasible
+        # hosts inside a single block before all-or-nothing decides
+        assignment = np_gang_repair(assignment, gid, gneed, demands_np,
+                                    avail_np, feasible, npb_eff)
+        assignment, _ = np_gang_filter(assignment, gid, gneed, npb_eff)
+        # capacity left after the strip — what the repair pass actually
+        # saw, so skip details report the real blocker, and the scalar
+        # top-up below reuses hosts a stripped gang freed
+        remaining_np = avail_np.copy()
+        placed_rows = np.flatnonzero(assignment >= 0)
+        np.subtract.at(remaining_np, assignment[placed_rows],
+                       demands_np[placed_rows])
+        block_reasons: dict[str, int] = {}
+        placed_gangs = 0
+        gang_ids = np.unique(gid[gid >= 0])
+        for g in gang_ids:
+            rows = np.flatnonzero(gid == g)
+            if bool((assignment[rows] >= 0).all()):
+                placed_gangs += 1
+                continue
+            k = int(gneed[rows].max())
+            if len(rows) < k:
+                gang_details[int(g)] = (
+                    f"only {len(rows)}/{k} members in this cycle's "
+                    "considerable window")
+                reason = "members-missing"
+            else:
+                member_demand = demands_np[rows].max(axis=0)
+                free = np_block_free_hosts(
+                    remaining_np, feasible[rows].all(axis=0),
+                    member_demand, npb_eff if npb_eff > 0 else nodes.n)
+                best = int(free.max(initial=0))
+                gang_details[int(g)] = (
+                    f"best block had {min(best, k)}/{k} hosts free")
+                reason = "no-block-capacity"
+            block_reasons[reason] = block_reasons.get(reason, 0) + 1
+        # scalar top-up: a stripped gang hands its hosts straight back
+        # to waiting UNGROUPED rows (greedy first-fit in schedule
+        # order) instead of idling them for a cycle — grouped jobs sit
+        # out, their placement rules already ran upstream
+        for ji in np.flatnonzero(assignment < 0):
+            ji = int(ji)
+            if gid[ji] >= 0 or considerable[ji].group_uuid:
+                continue
+            fits = feasible[ji] & (
+                remaining_np >= demands_np[ji]).all(axis=1)
+            cands = np.flatnonzero(fits)
+            if cands.size:
+                node = int(cands[0])
+                assignment[ji] = node
+                remaining_np[node] -= demands_np[ji]
+        # emitted AFTER the transact loop: a gang that solves whole can
+        # still abort during transact, and the cycle record must say so
+        gang_note = (int(gang_ids.size), placed_gangs, block_reasons)
+    else:
+        gang_note = None
+
     # transact + launch (scheduler.clj:790-1048)
     launches_per_cluster: dict[str, list[TaskSpec]] = {}
     cluster_by_name = {}
@@ -1113,10 +1336,75 @@ def finalize_pool_match(
     # ports handed out this cycle, per node (the mask guaranteed counts
     # against the offer; concrete picks must not collide intra-cycle)
     ports_used: dict[int, set] = {}
+
+    # gang-atomic transact: a gang's specs and launch bookkeeping defer
+    # into gang_txn until the LAST member transacts; a member failing any
+    # transact step (launch cap, ports, veto) rolls already-transacted
+    # siblings back (mea-culpa launch-failed, budget and ports refunded)
+    # so the all-or-nothing property survives the host-side launch
+    # pipeline, not just the solve
+    gang_txn: dict[int, dict] = {}
+    failed_gangs: set[int] = set()
+
+    def gang_of(ji: int) -> int:
+        return (int(prepared.gang_id[ji])
+                if prepared.gang_id is not None else -1)
+
+    def abort_gang(g: int, cause: str) -> None:
+        failed_gangs.add(g)
+        txn = gang_txn.pop(g, None)
+        if txn is None:
+            return
+        for task_id in txn["task_ids"]:
+            try:
+                store.update_instance_state(
+                    task_id, InstanceStatus.FAILED, "launch-failed")
+            except Exception:  # noqa: BLE001 — one stuck rollback must
+                # not strand the rest of the gang's members
+                log.exception("gang rollback transition for %s did not "
+                              "apply", task_id)
+        for cname, cnt in txn["budget"].items():
+            if cname in cluster_budget:
+                cluster_budget[cname] += cnt
+        for node_i, tports in txn["ports"]:
+            ports_used.get(node_i, set()).difference_update(tports)
+        detail = f"gang member failed to transact ({cause})"
+        for member, _offer, _tid in txn["jobs"]:
+            outcome.unmatched.append(member)
+            flight.note_skip(member.uuid, flight_codes.GANG_INCOMPLETE,
+                             detail)
+            if record_placement_failure is not None:
+                record_placement_failure(
+                    member,
+                    flight_codes.REASON_TEXT[flight_codes.GANG_INCOMPLETE]
+                    + f" ({detail})")
+
     for ji, job in enumerate(considerable):
         node_idx = int(assignment[ji])
+        g = gang_of(ji)
+        if g >= 0 and g in failed_gangs:
+            # a sibling already failed this cycle's transact: hold this
+            # member back too (all-or-nothing)
+            outcome.unmatched.append(job)
+            flight.note_skip(job.uuid, flight_codes.GANG_INCOMPLETE,
+                             gang_details.get(g, ""))
+            if record_placement_failure is not None:
+                record_placement_failure(
+                    job,
+                    flight_codes.REASON_TEXT[flight_codes.GANG_INCOMPLETE])
+            continue
         if node_idx < 0:
             outcome.unmatched.append(job)
+            if g >= 0:
+                detail = gang_details.get(g, "")
+                flight.note_skip(job.uuid, flight_codes.GANG_INCOMPLETE,
+                                 detail)
+                if record_placement_failure is not None:
+                    text = flight_codes.REASON_TEXT[
+                        flight_codes.GANG_INCOMPLETE]
+                    record_placement_failure(
+                        job, text + (f" ({detail})" if detail else ""))
+                continue
             code = _failure_reason(job, nodes, feasible[ji])
             flight.note_skip(job.uuid, code)
             if record_placement_failure is not None:
@@ -1148,6 +1436,8 @@ def finalize_pool_match(
             if record_placement_failure is not None:
                 record_placement_failure(
                     job, flight_codes.REASON_TEXT[flight_codes.LAUNCH_CAP])
+            if g >= 0:
+                abort_gang(g, flight_codes.LAUNCH_CAP)
             continue
         task_ports = assign_ports(offer, ports_used.setdefault(node_idx, set()),
                                   job.resources.ports)
@@ -1159,6 +1449,8 @@ def finalize_pool_match(
                 record_placement_failure(
                     job,
                     flight_codes.REASON_TEXT[flight_codes.PORTS_EXHAUSTED])
+            if g >= 0:
+                abort_gang(g, flight_codes.PORTS_EXHAUSTED)
             continue
         ports_used[node_idx].update(task_ports)
         cluster_budget[cluster.name] = budget - 1
@@ -1174,6 +1466,8 @@ def finalize_pool_match(
         except TransactionVetoed:
             # job completed/launched concurrently; drop the match
             flight.note_skip(job.uuid, flight_codes.LAUNCH_VETOED)
+            if g >= 0:
+                abort_gang(g, flight_codes.LAUNCH_VETOED)
             continue
         # checkpoint context rides in the task env uniformly for every
         # backend (mode/period for the tooling, preserve paths for the
@@ -1212,11 +1506,49 @@ def finalize_pool_match(
             checkpoint_preserve_paths=(tuple(job.checkpoint.preserve_paths)
                                        if job.checkpoint else ()),
         )
-        launches_per_cluster.setdefault(cluster.name, []).append(spec)
         cluster_by_name[cluster.name] = cluster
+        if g >= 0:
+            # defer the member: its spec only joins the launch batch once
+            # every sibling has transacted too
+            txn = gang_txn.setdefault(
+                g, {"specs": [], "jobs": [], "task_ids": [],
+                    "budget": {}, "ports": []})
+            txn["specs"].append((cluster.name, spec))
+            txn["jobs"].append((job, offer, task_id))
+            txn["task_ids"].append(task_id)
+            txn["budget"][cluster.name] = (
+                txn["budget"].get(cluster.name, 0) + 1)
+            txn["ports"].append((node_idx, set(task_ports)))
+            continue
+        launches_per_cluster.setdefault(cluster.name, []).append(spec)
         outcome.matched.append((job, offer))
         outcome.launched_task_ids.append(task_id)
         flight.note_match(job.uuid, offer.hostname, task_id)
+
+    # flush gangs whose every member transacted — their specs join the
+    # launch batches only now, so a late member's transact failure could
+    # not have left siblings half-launched.  (Launch-RPC failures AFTER
+    # this point are not rolled back gang-wide: those members re-queue
+    # mea-culpa through fail_launched_specs like any other job.)
+    for g in sorted(gang_txn):
+        txn = gang_txn[g]
+        for (cname, spec), (job, offer, task_id) in zip(txn["specs"],
+                                                        txn["jobs"]):
+            launches_per_cluster.setdefault(cname, []).append(spec)
+            outcome.matched.append((job, offer))
+            outcome.launched_task_ids.append(task_id)
+            flight.note_match(job.uuid, offer.hostname, task_id)
+
+    if gang_note is not None:
+        considered_n, placed_gangs, block_reasons = gang_note
+        if failed_gangs:
+            placed_gangs -= len(failed_gangs)
+            block_reasons["transact-failed"] = len(failed_gangs)
+        flight.note_gang(considered=considered_n, placed=placed_gangs,
+                         blocked=considered_n - placed_gangs,
+                         reasons=block_reasons)
+        _note_gang_metrics(pool.name, considered_n, placed_gangs,
+                           block_reasons)
 
     if launch_failure_cb is None:
         # the synchronous default may write the builder (same thread);
@@ -1610,8 +1942,20 @@ def match_pools_batched(
             max_j = max(p.problem.demands.shape[0] for p in solvable)
             max_n = max(p.problem.avail.shape[0] for p in solvable)
 
+            # the stack below needs one pytree structure across pools: if
+            # ANY pool carries a topology node_bonus, every lane gets one
+            # (zeros = no preference, decision-identical to absent)
+            any_bonus = any(p.problem.node_bonus is not None
+                            for p in solvable)
+
             def pad_problem(problem: MatchProblem) -> MatchProblem:
                 j, n = problem.demands.shape[0], problem.avail.shape[0]
+                bonus = None
+                if any_bonus:
+                    raw = (problem.node_bonus
+                           if problem.node_bonus is not None
+                           else jnp.zeros(n, problem.avail.dtype))
+                    bonus = jnp.pad(raw, (0, max_n - n))
                 return MatchProblem(
                     demands=jnp.pad(problem.demands,
                                     ((0, max_j - j), (0, 0))),
@@ -1621,6 +1965,7 @@ def match_pools_batched(
                     node_valid=jnp.pad(problem.node_valid, (0, max_n - n)),
                     feasible=jnp.pad(problem.feasible,
                                      ((0, max_j - j), (0, max_n - n))),
+                    node_bonus=bonus,
                 )
 
             padded_problems = [pad_problem(p.problem) for p in solvable]
@@ -1640,6 +1985,9 @@ def match_pools_batched(
                         max_j, max_n,
                         n_res=int(solvable[0].problem.demands.shape[-1]),
                         dtype=solvable[0].problem.demands.dtype)
+                    if any_bonus:
+                        pad_p = pad_p._replace(node_bonus=jnp.zeros(
+                            max_n, solvable[0].problem.avail.dtype))
                     padded_problems.extend([pad_p] * n_pad)
             stacked = jax.tree.map(
                 lambda *leaves: jnp.stack(leaves), *padded_problems,

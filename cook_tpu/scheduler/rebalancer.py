@@ -52,6 +52,19 @@ class RebalancerParams:
     # frozen within-host prefix ORDER and launches consuming spare
     # instead of joining the preemptable rows
     fast_cycle: bool = False
+    # ---- gang admission (scheduler/gang.py) ----
+    # topology-aware whole-gang admission from the rebalance cycle:
+    # drain-vs-kill per block, reservations tagged gang:<group>
+    gang_enabled: bool = True
+    # gangs admitted (drain or preempt) per rebalance cycle
+    gang_max_admissions: int = 4
+    # preempt-less admission: wait for a block's natural drain only when
+    # the predictor expects it free within this budget...
+    gang_drain_max_wait_ms: float = 300_000.0
+    # ...AND the wait is under factor x the wasted-work seconds the kill
+    # alternative would destroy (1.0 = break even: a second of waiting
+    # is worth a second of someone else's destroyed runtime)
+    gang_drain_wasted_factor: float = 1.0
 
 
 @dataclass

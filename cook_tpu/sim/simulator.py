@@ -22,6 +22,9 @@ from typing import Optional, Sequence
 from cook_tpu.cluster.mock import MockCluster, MockHost
 from cook_tpu.models.entities import (
     DruMode,
+    Group,
+    GroupPlacementType,
+    HostPlacement,
     Job,
     Pool,
     Resources,
@@ -44,6 +47,11 @@ class TraceJob:
     gpus: float = 0.0
     priority: int = 50
     pool: str = "default"
+    # gang scheduling: non-empty marks this job one member of the named
+    # gang — every trace job sharing the tag submits as ONE atomic batch
+    # under a UNIQUE-placement group with gang_size = member count, so
+    # the matcher's all-or-nothing block rule applies (scheduler/gang.py)
+    gang: str = ""
 
     @classmethod
     def from_dict(cls, d: dict) -> "TraceJob":
@@ -57,6 +65,7 @@ class TraceJob:
             gpus=float(d.get("gpus", 0.0)),
             priority=int(d.get("priority", 50)),
             pool=d.get("pool", "default"),
+            gang=str(d.get("gang", "")),
         )
 
 
@@ -189,6 +198,79 @@ class SimResult:
     def cycle_records_json(self) -> str:
         return json.dumps({"cycles": self.cycle_records}, indent=1)
 
+    def gang_stats(self, jobs: Sequence["TraceJob"],
+                   hosts: Sequence["TraceHost"] = (),
+                   *, nodes_per_block: int = 0) -> dict:
+        """Gang A/B summary off the run trace (the numbers the gang
+        scheduling acceptance compares against naive flat placement):
+
+        - a gang is *assembled* when all k members were RUNNING at the
+          same virtual instant (the point of gang scheduling — trickled
+          members whose runs never overlap did distributed-job work
+          for nothing);
+        - ``wait_ms`` is assembly time minus submit; unassembled gangs
+          score the full simulated span (they waited out the run);
+        - ``block_spread`` is how many topology blocks the gang's
+          members landed on (1 = contiguous, the fragmentation the
+          block rule exists to prevent).  Blocks are `nodes_per_block`
+          chunks of the sorted hostname list — the matcher's
+          decomposition."""
+        by_gang: dict[str, list] = {}
+        for tj in jobs:
+            if getattr(tj, "gang", ""):
+                by_gang.setdefault(tj.gang, []).append(tj)
+        by_gang = {g: ms for g, ms in by_gang.items() if len(ms) >= 2}
+        if not by_gang:
+            return {"gangs": 0, "assembled": 0, "assembled_share": 0.0,
+                    "wait_ms_p50": 0.0, "mean_block_spread": 0.0,
+                    "per_gang": []}
+        names = sorted(h.hostname for h in hosts)
+        npb = nodes_per_block if nodes_per_block > 0 else max(len(names), 1)
+        block_of = {h: i // npb for i, h in enumerate(names)}
+        runs: dict[str, list[dict]] = {}
+        for r in self.rows:
+            if r["start_ms"] is not None:
+                runs.setdefault(r["job_uuid"], []).append(r)
+        per_gang = []
+        for g, members in sorted(by_gang.items()):
+            submit = min(m.submit_time_ms for m in members)
+            last = [max(runs[m.uuid], key=lambda r: r["start_ms"])
+                    for m in members if m.uuid in runs]
+            spread = len({block_of.get(r["host"], -1) for r in last}) \
+                if last else 0
+            assembled_at = None
+            if len(last) == len(members):
+                start = max(r["start_ms"] for r in last)
+                end = min(r["end_ms"] if r["end_ms"] is not None
+                          else self.virtual_ms for r in last)
+                if start < end:
+                    assembled_at = start
+            per_gang.append({
+                "gang": g,
+                "size": len(members),
+                "placed_members": len(last),
+                "block_spread": spread,
+                "assembled": assembled_at is not None,
+                "wait_ms": (assembled_at - submit)
+                if assembled_at is not None else None,
+            })
+        waits = sorted(
+            d["wait_ms"] if d["wait_ms"] is not None else self.virtual_ms
+            for d in per_gang
+        )
+        spreads = [d["block_spread"] for d in per_gang
+                   if d["placed_members"]]
+        assembled = sum(1 for d in per_gang if d["assembled"])
+        return {
+            "gangs": len(per_gang),
+            "assembled": assembled,
+            "assembled_share": assembled / len(per_gang),
+            "wait_ms_p50": float(waits[len(waits) // 2]),
+            "mean_block_spread": (sum(spreads) / len(spreads)
+                                  if spreads else 0.0),
+            "per_gang": per_gang,
+        }
+
     def utilization(self, hosts: Sequence[TraceHost]) -> float:
         """Fraction of total cpu-ms capacity actually used by completed
         work over the simulated span."""
@@ -218,6 +300,24 @@ class SimResult:
 class Simulator:
     def __init__(self, jobs: Sequence[TraceJob], hosts: Sequence[TraceHost],
                  config: Optional[SimConfig] = None):
+        # gang members must land in ONE store submit batch (the store's
+        # txn-level gang validation): align every member to the gang's
+        # latest submit time so the due-jobs sweep picks them up together
+        self._gang_size: dict[str, int] = {}
+        gang_due: dict[str, int] = {}
+        for j in jobs:
+            if j.gang:
+                self._gang_size[j.gang] = self._gang_size.get(j.gang, 0) + 1
+                gang_due[j.gang] = max(gang_due.get(j.gang, 0),
+                                       j.submit_time_ms)
+        if self._gang_size:
+            import dataclasses as _dc
+
+            jobs = [
+                _dc.replace(j, submit_time_ms=gang_due[j.gang])
+                if j.gang and self._gang_size[j.gang] >= 2 else j
+                for j in jobs
+            ]
         self.trace_jobs = sorted(jobs, key=lambda j: (j.submit_time_ms, j.uuid))
         self.trace_hosts = list(hosts)
         self.config = config or SimConfig()
@@ -333,14 +433,30 @@ class Simulator:
             cycle += 1
             # 1. flush completions at current virtual time
             self.cluster.advance_to(self.now_ms)
-            # 2. submit due jobs
+            # 2. submit due jobs — one batch per cycle so gang members
+            # (aligned to a shared submit time in __init__) arrive in a
+            # single atomic store transaction with their UNIQUE group
+            due: list[TraceJob] = []
             while (
                 submitted < len(self.trace_jobs)
                 and self.trace_jobs[submitted].submit_time_ms <= self.now_ms
             ):
-                tj = self.trace_jobs[submitted]
-                self.store.submit_jobs([
-                    Job(
+                due.append(self.trace_jobs[submitted])
+                submitted += 1
+            if due:
+                groups: dict[str, Group] = {}
+                batch = []
+                for tj in due:
+                    k = self._gang_size.get(tj.gang, 0) if tj.gang else 0
+                    if k >= 2 and tj.gang not in self.store.groups \
+                            and tj.gang not in groups:
+                        groups[tj.gang] = Group(
+                            uuid=tj.gang,
+                            name=f"gang-{tj.gang}",
+                            host_placement=HostPlacement(
+                                type=GroupPlacementType.UNIQUE),
+                        )
+                    batch.append(Job(
                         uuid=tj.uuid,
                         user=tj.user,
                         pool=tj.pool,
@@ -350,9 +466,10 @@ class Simulator:
                         expected_runtime_ms=tj.runtime_ms,
                         command="sim",
                         max_retries=5,
-                    )
-                ])
-                submitted += 1
+                        group_uuid=tj.gang if k >= 2 else None,
+                        gang_size=k if k >= 2 else 0,
+                    ))
+                self.store.submit_jobs(batch, list(groups.values()))
             # 3. rank -> match (-> rebalance) per pool; spans make the
             # run exportable as a chrome trace (sim run --trace-out)
             t_cycle = time.perf_counter()

@@ -187,6 +187,9 @@ class Settings:
     # "rank_half_life": ..., "reclaim_window": ...})
     elastic_interval_s: float = 0.0
     elastic: dict = field(default_factory=dict)
+    # REST-layer knobs beyond the dedicated top-level keys
+    # ({"max_gang_size": ...}; docs/configuration.md "Gang scheduling")
+    api: dict = field(default_factory=dict)
     # resilience plane (docs/resilience.md):
     # POST /debug/faults arm/disarm — NEVER enable outside a chaos drill
     fault_injection: bool = False
@@ -275,6 +278,11 @@ def _match_config(d: dict) -> MatchConfig:
         quantized=bool(d.get("quantized", False)),
         quantization_parity_floor=float(
             d.get("quantization_parity_floor", 0.98)),
+        # topology-aware gang scheduling (scheduler/gang.py;
+        # docs/configuration.md "Gang scheduling")
+        gang_enabled=bool(d.get("gang_enabled", True)),
+        topology_weight=float(d.get("topology_weight", 0.0)),
+        topology_block_hosts=int(d.get("topology_block_hosts", 0)),
     )
 
 
@@ -327,6 +335,8 @@ def read_config(path: Optional[str] = None,
         settings.plugins = dict(data["plugins"])
     if "elastic" in data:
         settings.elastic = dict(data["elastic"])
+    if "api" in data:
+        settings.api = dict(data["api"])
     if "executor_token" in data:
         settings.executor_token = str(data["executor_token"])
     if "peers" in data:
@@ -344,6 +354,12 @@ def read_config(path: Optional[str] = None,
             min_dru_diff=float(rb.get("min_dru_diff", 0.5)),
             max_preemption=int(rb.get("max_preemption", 100)),
             fast_cycle=bool(rb.get("fast_cycle", False)),
+            gang_enabled=bool(rb.get("gang_enabled", True)),
+            gang_max_admissions=int(rb.get("gang_max_admissions", 4)),
+            gang_drain_max_wait_ms=float(
+                rb.get("gang_drain_max_wait_ms", 300_000.0)),
+            gang_drain_wasted_factor=float(
+                rb.get("gang_drain_wasted_factor", 1.0)),
         )
     # always route through _match_config so the tuned hardware defaults
     # apply even when the operator config has no `match` section — a bare

@@ -46,7 +46,7 @@ def test_smoke_emits_structured_record(smoke_record):
                                       "match_xl", "match_xl_coarse",
                                       "match_xl_fine", "match_xl_refine",
                                       "speculation", "match_resident",
-                                      "match_resident_cold"}
+                                      "match_resident_cold", "gang"}
     # every record and every phase carries the resolved JAX backend —
     # the label bench_gate uses to refuse cross-backend comparisons
     assert on_disk["backend"] == "cpu"
@@ -132,6 +132,19 @@ def test_smoke_speculation_tier(smoke_record):
     assert spec["hit_fraction"] >= 0.2
     assert spec["p50_ms"] < spec["baseline_p50_ms"]
     assert spec["cycles"] > 0
+
+
+def test_smoke_gang_tier(smoke_record):
+    """The gang phase: on the seeded gang/topology trace every gang
+    must fully place, assembly must be total (the one-block rule holds),
+    and the gated p50 is the deterministic virtual-ms admission wait."""
+    record, _, _ = smoke_record
+    gang = record["phases"]["gang"]
+    assert gang["placed_fraction"] == 1.0
+    assert gang["assembled_share"] == 1.0
+    assert gang["block_spread"] == 1.0
+    assert gang["gangs"] > 0
+    assert gang["p50_ms"] > 0
 
 
 def test_next_phase_record_path_skips_driver_rounds(tmp_path):

@@ -271,3 +271,49 @@ def test_cli_why(server, cfg, capsys):
     out = capsys.readouterr().out
     assert "waiting" in out
     assert "-" in out  # at least one reason line
+
+
+def test_client_submit_gang_places_atomically(server, client):
+    uuids = client.submit(
+        [{"command": "gangwork", "mem": 64, "expected_runtime": 5_000}] * 2,
+        gang_size=2)
+    assert len(uuids) == 2
+    jobs = client.query(uuids)
+    assert all(j["gang_size"] == 2 for j in jobs)
+    groups = {j["groups"][0] for j in jobs}
+    assert len(groups) == 1, "gang members must share one group"
+    pool = server.store.pools["default"]
+    server.scheduler.rank_cycle(pool)
+    server.scheduler.match_cycle(pool)
+    jobs = client.query(uuids)
+    hosts = {i["hostname"] for j in jobs for i in j["instances"]}
+    assert all(j["status"] == "running" for j in jobs)
+    assert len(hosts) == 2, "gang members must land on distinct hosts"
+    server.clock.advance(10_000)
+    server.cluster.advance_to(server.clock.now_ms)
+
+
+def test_client_gang_size_batch_mismatch(client):
+    with pytest.raises(ValueError):
+        client.submit([{"command": "x"}], gang_size=3)
+    # server-side: gang_size without a group is rejected
+    with pytest.raises(JobClientError):
+        client.submit([{"command": "x", "gang_size": 2},
+                       {"command": "x", "gang_size": 2}])
+
+
+def test_cli_submit_gang_timeline_renders_wait(server, cfg, capsys):
+    # a 3-gang on a 2-host fleet can never assemble: the timeline must
+    # attribute the wait to gang-incomplete with the best-block detail
+    assert cli(server, "submit", "--gang-size", "3", "--mem", "64",
+               "gangwait") == 0
+    uuids = capsys.readouterr().out.split()
+    assert len(uuids) == 3
+    pool = server.store.pools["default"]
+    for _ in range(3):
+        server.scheduler.rank_cycle(pool)
+        server.scheduler.match_cycle(pool)
+    assert cli(server, "timeline", uuids[0]) == 0
+    out = capsys.readouterr().out
+    assert "gang-incomplete" in out
+    assert "hosts free" in out

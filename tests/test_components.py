@@ -158,6 +158,33 @@ class TestConfig:
         assert s.match_config_for_pool("bxx").max_jobs_considered == 5
         assert s.rebalancer.max_preemption == 9
 
+    def test_gang_knobs_roundtrip(self, tmp_path):
+        # every documented gang knob must survive the JSON loader — a
+        # key the parser drops silently runs the service on defaults
+        p = tmp_path / "g.json"
+        p.write_text(json.dumps({
+            "match": {"gang_enabled": False, "topology_weight": 0.5,
+                      "topology_block_hosts": 2},
+            "rebalancer": {"gang_enabled": False,
+                           "gang_max_admissions": 7,
+                           "gang_drain_max_wait_ms": 1000.0,
+                           "gang_drain_wasted_factor": 2.5},
+            "elastic": {"count_block_headroom": False,
+                        "gang_block_hosts": 8},
+            "api": {"max_gang_size": 16},
+        }))
+        s = read_config(str(p))
+        assert s.match.gang_enabled is False
+        assert s.match.topology_weight == 0.5
+        assert s.match.topology_block_hosts == 2
+        assert s.rebalancer.gang_enabled is False
+        assert s.rebalancer.gang_max_admissions == 7
+        assert s.rebalancer.gang_drain_max_wait_ms == 1000.0
+        assert s.rebalancer.gang_drain_wasted_factor == 2.5
+        assert s.elastic == {"count_block_headroom": False,
+                             "gang_block_hosts": 8}
+        assert s.api == {"max_gang_size": 16}
+
     def test_validation(self, tmp_path):
         p = tmp_path / "bad.json"
         p.write_text(json.dumps({"port": -1}))

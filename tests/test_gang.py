@@ -1,0 +1,485 @@
+"""Topology-aware gang scheduling (ops/gang.py + scheduler/gang.py):
+the all-or-nothing property across every match path, numpy/device kernel
+parity, store submit-batch invariants, drain-vs-kill admission, the
+block-aware fragmentation stat, and elastic block-shaped headroom."""
+import numpy as np
+import pytest
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.elastic import CapacityPlanner, ElasticParams
+from cook_tpu.models.entities import (
+    ConstraintOperator,
+    Group,
+    GroupPlacementType,
+    HostPlacement,
+    InstanceStatus,
+    JobConstraint,
+    Pool,
+    Resources,
+)
+from cook_tpu.models.store import JobStore, TransactionVetoed
+from cook_tpu.obs.fairness import FairnessObservatory
+from cook_tpu.ops.gang import (
+    block_free_hosts,
+    gang_filter,
+    np_block_free_hosts,
+    np_gang_filter,
+    np_gang_repair,
+)
+from cook_tpu.scheduler.core import Scheduler, SchedulerConfig
+from cook_tpu.scheduler.gang import (
+    GangAdmission,
+    gang_reservation_tag,
+    plan_gang_admissions,
+    waiting_gangs,
+)
+from cook_tpu.scheduler.matcher import MatchConfig
+from cook_tpu.scheduler.rebalancer import RebalancerParams
+
+from conftest import FakeClock, make_job
+
+BLOCK_HOSTS = 4
+
+
+def _hosts(n, mem=1000.0, cpus=8.0):
+    """Hosts h0..h{n-1} each advertising a `slot` attribute so tests can
+    pin filler jobs deterministically (EQUALS constraint)."""
+    return [MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=mem, cpus=cpus,
+                     attributes=(("slot", f"h{i}"),)) for i in range(n)]
+
+
+def _pinned(host, mem=800.0, user="filler", **kw):
+    return make_job(
+        user=user, mem=mem, priority=100,
+        constraints=(JobConstraint("slot", ConstraintOperator.EQUALS,
+                                   host),),
+        **kw)
+
+
+def _gang_jobs(group, k, mem=500.0, user="ganguser", **kw):
+    return [make_job(user=user, mem=mem, gang_size=k, group_uuid=group,
+                     **kw) for _ in range(k)]
+
+
+def _gang_group(group):
+    return Group(uuid=group, name=f"gang-{group}",
+                 host_placement=HostPlacement(
+                     type=GroupPlacementType.UNIQUE))
+
+
+def _placed_hosts(store, group):
+    """Hostnames of live instances across the group's member jobs."""
+    out = []
+    for uuid in store.groups[group].job_uuids:
+        for inst in store.job_instances(uuid):
+            if not inst.status.terminal:
+                out.append(inst.hostname)
+    return out
+
+
+def _block(hostname):
+    return int(hostname[1:]) // BLOCK_HOSTS
+
+
+# -------------------------------------- the all-or-nothing property test
+
+
+PATHS = ("serial", "batched", "pipelined", "hierarchical")
+
+
+def _path_config(path):
+    kw = dict(gang_enabled=True, topology_block_hosts=BLOCK_HOSTS)
+    if path == "batched":
+        kw["chunk"] = 4
+    elif path == "hierarchical":
+        kw["hierarchical_threshold"] = 1
+        kw["hierarchical_nodes_per_block"] = BLOCK_HOSTS
+    return SchedulerConfig(match=MatchConfig(**kw))
+
+
+def _cycle(scheduler, store, path):
+    pool = store.pools["default"]
+    scheduler.rank_cycle(pool)
+    if path == "pipelined":
+        return scheduler.match_cycle_pipelined()["default"]
+    return scheduler.match_cycle(pool)
+
+
+@pytest.mark.parametrize("path", PATHS)
+def test_gang_never_partially_places_across_paths(path):
+    """THE acceptance property: whichever solve produced the assignment
+    (serial / chunked / pipelined / hierarchical), a gang places with
+    ALL k members on distinct hosts inside one topology block — or not
+    at all.  The rig leaves 3 scattered free hosts (2 in block 0, 1 in
+    block 1): a 3-gang must wait while a 2-gang lands whole."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster("m", _hosts(8), clock=clock)
+    scheduler = Scheduler(store, [cluster], _path_config(path))
+
+    # fillers pin busy hosts: free = {h0, h2} in block 0, {h7} in block 1
+    busy = ("h1", "h3", "h4", "h5", "h6")
+    store.submit_jobs([_pinned(h, expected_runtime_ms=60_000)
+                       for h in busy])
+    outcome = _cycle(scheduler, store, path)
+    assert len(outcome.matched) == len(busy)
+
+    store.submit_jobs(_gang_jobs("gang-a", 3), [_gang_group("gang-a")])
+    store.submit_jobs(_gang_jobs("gang-b", 2), [_gang_group("gang-b")])
+    _cycle(scheduler, store, path)
+
+    # gang-b fits whole in block 0; gang-a has no 3-free block anywhere —
+    # a naive solver would scatter it over h0/h2/h7 (partial after the
+    # UNIQUE/block strip), so zero placements IS the property
+    placed_b = _placed_hosts(store, "gang-b")
+    assert sorted(placed_b) == ["h0", "h2"]
+    assert len({_block(h) for h in placed_b}) == 1
+    assert _placed_hosts(store, "gang-a") == []
+
+    # fillers drain -> block 1 frees whole -> gang-a lands atomically
+    clock.advance(70_000)
+    cluster.advance_to(clock())
+    _cycle(scheduler, store, path)
+    placed_a = _placed_hosts(store, "gang-a")
+    assert len(placed_a) == 3
+    assert len(set(placed_a)) == 3
+    assert len({_block(h) for h in placed_a}) == 1
+
+
+# ------------------------------------------------- numpy/device parity
+
+
+def test_gang_filter_matches_numpy_twin_fuzz():
+    rng = np.random.default_rng(7)
+    for _ in range(25):
+        J, N, G = 12, 8, 3
+        gang_id = rng.integers(-1, G, size=J).astype(np.int32)
+        gang_need = np.zeros(J, dtype=np.int32)
+        for g in range(G):
+            rows = gang_id == g
+            if rows.any():
+                gang_need[rows] = rng.integers(2, 5)
+        assignment = rng.integers(-1, N, size=J).astype(np.int32)
+        for npb in (0, 4):
+            want_a, want_s = np_gang_filter(assignment, gang_id,
+                                            gang_need, npb)
+            got_a, got_s = gang_filter(assignment, gang_id, gang_need,
+                                       num_gangs=G, num_nodes=N,
+                                       nodes_per_block=npb)
+            np.testing.assert_array_equal(np.asarray(got_a), want_a)
+            np.testing.assert_array_equal(np.asarray(got_s), want_s)
+
+
+def test_block_free_hosts_matches_numpy_twin():
+    rng = np.random.default_rng(11)
+    avail = rng.uniform(0, 1000, size=(8, 2)).astype(np.float32)
+    node_valid = rng.random(8) > 0.3
+    demand = np.array([400.0, 2.0], dtype=np.float32)
+    want = np_block_free_hosts(avail, node_valid, demand, 4)
+    got = np.asarray(block_free_hosts(avail, node_valid, demand,
+                                      nodes_per_block=4))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_np_gang_repair_spreads_stacked_gang():
+    """Flat best-fit stacks all members on one host; repair must retry
+    the gang whole on distinct hosts of one block."""
+    gang_id = np.array([0, 0, 0, -1], dtype=np.int32)
+    gang_need = np.array([3, 3, 3, 0], dtype=np.int32)
+    assignment = np.array([0, 0, 0, 5], dtype=np.int32)  # stacked
+    demands = np.full((4, 2), 100.0)
+    avail = np.full((8, 2), 1000.0)
+    out = np_gang_repair(assignment, gang_id, gang_need, demands, avail,
+                         None, 4)
+    hosts = out[:3]
+    assert (hosts >= 0).all()
+    assert np.unique(hosts).size == 3
+    assert np.unique(hosts // 4).size == 1
+    assert out[3] == 5  # non-gang rows never move
+
+
+def test_np_gang_repair_rehomes_block_split_gang():
+    gang_id = np.array([0, 0], dtype=np.int32)
+    gang_need = np.array([2, 2], dtype=np.int32)
+    assignment = np.array([0, 4], dtype=np.int32)  # blocks 0 and 1
+    demands = np.full((2, 2), 100.0)
+    avail = np.full((8, 2), 1000.0)
+    out = np_gang_repair(assignment, gang_id, gang_need, demands, avail,
+                         None, 4)
+    assert (out >= 0).all()
+    assert np.unique(out // 4).size == 1
+
+
+def test_np_gang_repair_leaves_impossible_gang_unplaced():
+    gang_id = np.array([0, 0, 0], dtype=np.int32)
+    gang_need = np.array([3, 3, 3], dtype=np.int32)
+    assignment = np.array([0, 1, -1], dtype=np.int32)
+    demands = np.full((3, 2), 100.0)
+    avail = np.zeros((8, 2))
+    avail[0] = avail[1] = 1000.0  # only two hosts have capacity
+    out = np_gang_repair(assignment, gang_id, gang_need, demands, avail,
+                         None, 4)
+    assert (out == -1).all()
+
+
+# ----------------------------------------------- store batch invariants
+
+
+def _veto(store, jobs, groups=(), match=""):
+    with pytest.raises(TransactionVetoed, match=match):
+        store.submit_jobs(jobs, groups)
+
+
+def test_store_gang_submit_invariants(store):
+    store.set_pool(Pool(name="other"))
+    _veto(store, [make_job(gang_size=1)], match="gang_size 1")
+    _veto(store, [make_job(gang_size=2)], match="requires a group")
+    g = _gang_group("g-bad")
+    _veto(store, [make_job(gang_size=2, group_uuid="g-bad"),
+                  make_job(gang_size=3, group_uuid="g-bad")], [g],
+          match="disagree")
+    _veto(store, [make_job(gang_size=2, group_uuid="g-bad"),
+                  make_job(gang_size=2, group_uuid="g-bad",
+                           pool="other")], [g], match="span pools")
+    _veto(store, [make_job(gang_size=3, group_uuid="g-bad"),
+                  make_job(gang_size=3, group_uuid="g-bad")], [g],
+          match="submit atomically")
+    # a whole gang in one batch lands, and its group cannot be extended
+    ok = _gang_jobs("g-ok", 2)
+    store.submit_jobs(ok, [_gang_group("g-ok")])
+    assert set(store.groups["g-ok"].job_uuids) == {j.uuid for j in ok}
+    _veto(store, _gang_jobs("g-ok", 2), match="extended")
+
+
+# --------------------------------------------- drain-vs-kill admission
+
+
+class _FixedPredictor:
+    def __init__(self, runtime_ms):
+        self.runtime_ms = runtime_ms
+
+    def predict_runtime_ms(self, user, command):
+        return self.runtime_ms
+
+
+def _admission_rig(clock, elapsed_ms):
+    """One 4-host block: h0/h1 free, h2/h3 each running one task that
+    started `elapsed_ms` ago."""
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    running = [make_job(user="occupant", mem=900.0) for _ in range(2)]
+    store.submit_jobs(running)
+    clock.advance(-elapsed_ms)
+    for i, job in enumerate(running):
+        store.create_instance(job.uuid, f"t{i}", hostname=f"h{i + 2}",
+                              compute_cluster="m")
+        store.update_instance_state(f"t{i}", InstanceStatus.RUNNING)
+    clock.advance(elapsed_ms)
+    gang = _gang_jobs("g-adm", 4, mem=500.0)
+    store.submit_jobs(gang, [_gang_group("g-adm")])
+    spare = {"h0": Resources(mem=1000, cpus=8),
+             "h1": Resources(mem=1000, cpus=8),
+             "h2": Resources(mem=100, cpus=8),
+             "h3": Resources(mem=100, cpus=8)}
+    return store, gang, spare
+
+
+def _plan(store, gang, spare, predictor, **params):
+    return plan_gang_admissions(
+        store, store.pools["default"], gang, spare,
+        nodes_per_block=4, predictor=predictor,
+        params=RebalancerParams(**params), now_ms=store.clock())
+
+
+def test_admission_prefers_drain_when_predicted_cheap(clock):
+    """Preempt-less admission: victims ran 600 s (killing wastes 1200 s)
+    and the predictor expects them done in 30 s — the planner reserves
+    the block and kills nobody."""
+    store, gang, spare = _admission_rig(clock, elapsed_ms=600_000)
+    [adm] = _plan(store, gang, spare, _FixedPredictor(630_000.0))
+    assert adm.mode == "drain"
+    assert adm.victims == []
+    assert adm.hosts == ["h0", "h1", "h2", "h3"]
+    assert adm.predicted_wait_ms == pytest.approx(30_000.0)
+
+
+def test_admission_preempts_when_drain_over_budget(clock):
+    """Fresh victims (5 s elapsed, nothing to waste) predicted to run
+    ~995 s more: drain blows the wait ceiling, so kill wins."""
+    store, gang, spare = _admission_rig(clock, elapsed_ms=5_000)
+    [adm] = _plan(store, gang, spare, _FixedPredictor(1_000_000.0))
+    assert adm.mode == "preempt"
+    assert sorted(adm.victims) == ["t0", "t1"]
+    assert adm.victim_wasted_s == pytest.approx(10.0)
+
+
+def test_admission_drain_needs_wasted_work_to_beat(clock):
+    """The wasted-factor leg: same 30 s predicted drain, but the victims
+    just started — killing wastes ~10 s, under the 30 s wait, so the
+    break-even factor tips the decision to preempt."""
+    store, gang, spare = _admission_rig(clock, elapsed_ms=5_000)
+    [adm] = _plan(store, gang, spare, _FixedPredictor(35_000.0))
+    assert adm.mode == "preempt"
+
+
+def test_waiting_gangs_skips_partial_complements(clock):
+    members = _gang_jobs("g-part", 3)[:2]  # two of three present
+    assert waiting_gangs(members) == []
+    whole = _gang_jobs("g-whole", 2)
+    gangs = waiting_gangs(whole + members)
+    assert [g for g, _ in gangs] == ["g-whole"]
+
+
+def test_admissions_capped_per_cycle(clock):
+    store, gang, spare = _admission_rig(clock, elapsed_ms=5_000)
+    second = _gang_jobs("g-two", 4, mem=500.0)
+    store.submit_jobs(second, [_gang_group("g-two")])
+    adms = _plan(store, gang + second, spare,
+                 _FixedPredictor(1_000_000.0), gang_max_admissions=1)
+    assert len(adms) == 1 and adms[0].group_uuid == "g-adm"
+
+
+# ------------------------------------- scheduler-level admission cycle
+
+
+def _fleet_rig(**config_kw):
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    cluster = MockCluster("m", _hosts(4), clock=clock)
+    scheduler = Scheduler(
+        store, [cluster],
+        SchedulerConfig(match=MatchConfig(
+            gang_enabled=True, topology_block_hosts=BLOCK_HOSTS),
+            **config_kw))
+    pool = store.pools["default"]
+    # occupants fill the whole block (same user as the gang, so the DRU
+    # rebalancer stays quiet and only gang admission can act)
+    store.submit_jobs([
+        _pinned(f"h{i}", mem=900.0, user="ganguser",
+                expected_runtime_ms=60_000) for i in range(4)])
+    scheduler.rank_cycle(pool)
+    assert len(scheduler.match_cycle(pool).matched) == 4
+    clock.advance(30_000)
+    store.submit_jobs(_gang_jobs("g-core", 4, mem=900.0),
+                      [_gang_group("g-core")])
+    scheduler.rank_cycle(pool)
+    return clock, store, cluster, scheduler, pool
+
+
+def test_core_admission_preempts_reserves_and_places(clock):
+    """No predictor -> drain ETA unknown -> kill path: the cycle kills
+    the block's occupants, reserves the hosts gang:<group>, and the next
+    match places the gang whole — releasing the reservations."""
+    clock, store, cluster, scheduler, pool = _fleet_rig()
+    scheduler.rebalance_cycle(pool)
+    [adm] = scheduler.last_gang_admissions
+    assert adm["mode"] == "preempt"
+    tag = gang_reservation_tag("g-core")
+    assert set(scheduler.host_reservations.values()) == {tag}
+    assert len(scheduler.host_reservations) == 4
+    # victims transacted like rebalancer kills (fairness-ledger visible)
+    roll = scheduler.fairness.snapshot()["pools"]["default"]["rollups"]
+    assert roll["tasks_preempted"] == 4
+    assert roll["wasted_s"]["fairness"] == pytest.approx(120.0)
+
+    scheduler.rank_cycle(pool)
+    outcome = scheduler.match_cycle(pool)
+    placed = _placed_hosts(store, "g-core")
+    assert len(placed) == 4 and len(set(placed)) == 4
+    assert len(outcome.matched) == 4
+    assert scheduler.host_reservations == {}
+
+
+def test_core_admission_drains_without_killing(clock):
+    """With the runtime predictor warm (occupants predicted done in
+    ~30 s, killing would waste 120 s) admission goes preempt-less: hosts
+    reserved, nobody dies, and the gang lands after natural drain."""
+    clock, store, cluster, scheduler, pool = _fleet_rig(
+        backfill_weight=0.01)
+    for _ in range(3):
+        scheduler.predictor.observe("ganguser", "true", 60_000.0)
+    scheduler.rebalance_cycle(pool)
+    [adm] = scheduler.last_gang_admissions
+    assert adm["mode"] == "drain"
+    assert adm["victims"] == []
+    assert adm["predicted_wait_ms"] == pytest.approx(30_000.0)
+    assert len(scheduler.host_reservations) == 4
+    # nobody was preempted: all four occupants still running
+    assert len(store.running_instances("default")) == 4
+
+    clock.advance(40_000)
+    cluster.advance_to(clock())
+    scheduler.rank_cycle(pool)
+    scheduler.match_cycle(pool)
+    placed = _placed_hosts(store, "g-core")
+    assert len(placed) == 4 and len(set(placed)) == 4
+    assert scheduler.host_reservations == {}
+
+
+def test_core_prunes_stale_gang_reservations(clock):
+    clock, store, cluster, scheduler, pool = _fleet_rig()
+    scheduler.rebalance_cycle(pool)
+    assert len(scheduler.host_reservations) == 4
+    # the gang leaves the queue (canceled): its reservations must not
+    # squat on the block
+    store.kill_jobs(store.groups["g-core"].job_uuids)
+    scheduler.rank_cycle(pool)
+    scheduler.rebalance_cycle(pool)
+    assert scheduler.host_reservations == {}
+
+
+# ------------------------------------------- block-aware fragmentation
+
+
+def _frag_entry(i, block):
+    return {"t_ms": 1000 + i, "preemptor_job": f"j{i}",
+            "preemptor_user": "starved", "hostname": f"h{i}",
+            "block": block, "min_preempted_dru": 2.0,
+            "victims": [{"task_id": f"t{i}", "user": "hog", "dru": 2.0,
+                         "wasted_s": 1.0, "mem": 100.0, "cpus": 1.0,
+                         "gpus": 0.0}],
+            "freed": {"mem": 100.0, "cpus": 1.0, "gpus": 0.0}}
+
+
+def test_fragmentation_is_block_aware():
+    contiguous = FairnessObservatory()
+    contiguous.record_decisions(
+        "default", [_frag_entry(i, block=0) for i in range(3)])
+    frag = contiguous._fragmentation("default")
+    assert frag["contiguous_share"] == 1.0
+    assert frag["fragmentation"] == 0.0
+    assert frag["blocks"] == 1
+
+    scattered = FairnessObservatory()
+    scattered.record_decisions(
+        "default", [_frag_entry(i, block=i) for i in range(3)])
+    frag = scattered._fragmentation("default")
+    # same freed memory, three blocks: no gang can use it whole
+    assert frag["contiguous_share"] == pytest.approx(1 / 3, abs=1e-3)
+    assert frag["fragmentation"] > 0.6
+    assert frag["blocks"] == 3
+
+
+# --------------------------------------------- elastic block headroom
+
+
+def test_elastic_block_shortfall_detects_fragmented_spare(store):
+    planner = CapacityPlanner(store, [], txn=lambda *a, **k: None,
+                              params=ElasticParams(gang_block_hosts=4))
+    pending = _gang_jobs("g-el", 3, mem=500.0)
+    fit = Resources(mem=1000, cpus=8)
+    tight = Resources(mem=100, cpus=8)
+    # 4 member-sized hosts fleet-wide, but 2 per block: scalar spare
+    # says fine, the gang of 3 says starved
+    spare = {"h0": fit, "h1": fit, "h2": tight, "h3": tight,
+             "h4": fit, "h5": fit, "h6": tight, "h7": tight}
+    short = planner._gang_block_shortfall(pending, spare)
+    assert short is not None
+    assert short["gang_size"] == 3
+    assert short["best_block"] == 2
+    assert "mem" in short["dims"]
+    # widen one block to 3 free hosts: no shortfall
+    spare["h2"] = fit
+    assert planner._gang_block_shortfall(pending, spare) is None

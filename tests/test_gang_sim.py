@@ -1,0 +1,116 @@
+"""Gang scheduling A/B through the trace simulator (ISSUE 19
+acceptance): on the gang/topology trace, turning the gang machinery on
+must improve BOTH gang assembly wait and placement fragmentation vs
+naive flat placement — and never partially place a gang."""
+from __future__ import annotations
+
+import pytest
+
+from cook_tpu.scheduler.core import SchedulerConfig
+from cook_tpu.scheduler.matcher import MatchConfig
+from cook_tpu.sim.loadgen import gang_topology_trace
+from cook_tpu.sim.simulator import SimConfig, Simulator
+
+BLOCK_HOSTS = 4
+
+
+def _run(jobs, hosts, *, gang_enabled: bool):
+    match = MatchConfig(
+        gang_enabled=gang_enabled,
+        topology_block_hosts=BLOCK_HOSTS,
+        topology_weight=0.5 if gang_enabled else 0.0,
+    )
+    cfg = SimConfig(
+        cycle_ms=30_000,
+        max_cycles=60,
+        scheduler=SchedulerConfig(match=match),
+    )
+    return Simulator(jobs, hosts, cfg).run()
+
+
+@pytest.fixture(scope="module")
+def ab():
+    jobs, hosts = gang_topology_trace(block_hosts=BLOCK_HOSTS)
+    naive_run = _run(jobs, hosts, gang_enabled=False)
+    gang_run = _run(jobs, hosts, gang_enabled=True)
+    return {
+        "jobs": jobs,
+        "hosts": hosts,
+        "naive_run": naive_run,
+        "gang_run": gang_run,
+        "naive": naive_run.gang_stats(jobs, hosts,
+                                      nodes_per_block=BLOCK_HOSTS),
+        "gang": gang_run.gang_stats(jobs, hosts,
+                                    nodes_per_block=BLOCK_HOSTS),
+    }
+
+
+def test_every_gang_completes_both_modes(ab):
+    for mode in ("naive", "gang"):
+        for g in ab[mode]["per_gang"]:
+            assert g["placed_members"] == g["size"], (mode, g)
+
+
+def test_gang_mode_assembles_more_gangs(ab):
+    assert ab["gang"]["assembled"] == ab["gang"]["gangs"]
+    assert ab["gang"]["assembled"] > ab["naive"]["assembled"]
+
+
+def test_gang_wait_improves(ab):
+    assert ab["gang"]["wait_ms_p50"] < ab["naive"]["wait_ms_p50"]
+
+
+def test_fragmentation_improves(ab):
+    # the one-block rule: every assembled gang is contiguous
+    assert ab["gang"]["mean_block_spread"] == 1.0
+    assert ab["gang"]["mean_block_spread"] \
+        < ab["naive"]["mean_block_spread"]
+
+
+def test_gang_mode_never_partially_places(ab):
+    """Cycle-granular all-or-nothing: any cycle that launches members
+    of a gang launches the ENTIRE gang."""
+    sizes = {}
+    for tj in ab["jobs"]:
+        if tj.gang:
+            sizes[tj.gang] = sizes.get(tj.gang, 0) + 1
+    launched_by_cycle = {}
+    for rec in ab["gang_run"].cycle_records:
+        members = [m["job"] for m in rec.get("matched", [])
+                   if m["job"].startswith("gang")]
+        if members:
+            launched_by_cycle[rec["cycle"]] = members
+    assert launched_by_cycle, "gangs never launched"
+    for cycle, members in launched_by_cycle.items():
+        per_gang = {}
+        for m in members:
+            gang = "gang-" + m.split("-")[0][len("gang"):]
+            per_gang.setdefault(gang, []).append(m)
+        for gang, ms in per_gang.items():
+            assert len(ms) == sizes[gang], (cycle, gang, ms)
+
+
+def test_gang_cycle_records_track_skips(ab):
+    recs = [r for r in ab["gang_run"].cycle_records
+            if r.get("gangs_considered")]
+    assert recs, "no gang cycle records"
+    blocked = [r for r in recs if r.get("gangs_blocked")]
+    assert blocked, "trace never made a gang wait"
+    reasons = set()
+    for r in blocked:
+        reasons.update(r.get("gang_block_reasons", {}))
+    assert "no-block-capacity" in reasons
+    # the skip detail renders the best-block shortfall for operators
+    details = [s["detail"] for r in blocked
+               for s in r.get("skipped", [])
+               if s.get("code") == "gang-incomplete"]
+    assert any("hosts free" in d for d in details)
+    # naive run has gang handling off: no gang record fields populated
+    assert not any(r.get("gangs_considered")
+                   for r in ab["naive_run"].cycle_records)
+
+
+def test_scalar_churn_not_starved_by_gang_mode(ab):
+    """The scalar top-up: stripped gangs hand hosts back, so gang mode
+    does not stretch the run for the non-gang workload."""
+    assert ab["gang_run"].virtual_ms <= ab["naive_run"].virtual_ms

@@ -18,6 +18,11 @@ wrapper runs them as one pipeline with one verdict:
      the `speculation` phase (prediction-assisted speculative-cycle
      A/B on the completion-heavy trace: cycle-start-to-first-launch
      p50 + fraction of cycles served from speculation),
+     the `gang` phase (topology-aware gang scheduling on the seeded
+     gang/topology trace: gated p50 is the gang admission latency —
+     submit to all-members-running, in VIRTUAL ms so the figure is
+     deterministic — with the placed fraction, assembled share, and
+     mean block spread recorded alongside),
      the `match_resident` tier (device-resident match state: one cold
      rebuild + three warm delta cycles; the warm phase's p50 AND its
      h2d_bytes column are gate-enforced — warm-cycle byte growth is a
