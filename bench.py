@@ -371,6 +371,124 @@ def bench_match_xl(jax, jnp, platform, *, smoke=False, repeats=3) -> dict:
     return out
 
 
+def bench_match_xxl(jax, jnp, platform, *, smoke=False, repeats=1) -> dict:
+    """`match_xxl` tier: the SUPERBLOCK mega-matcher — 1M jobs x 100k
+    nodes through the two-level DCN x ICI decomposition
+    (ops/hierarchical.py superblock layer): one super-coarse
+    jobs x superblocks solve routes every job to a DCN domain, then
+    per-superblock coarse problems solve as ONE batched kernel, then the
+    unchanged fine/refine machinery.  The flat solve at this scale is
+    not tractable on any backend; the single-level match_xl coarse pass
+    alone would be a 1M x 2048-block problem.  CPU fallback is allowed
+    and stamped (`backend` + `cores` columns) — logical byte columns are
+    backend-stable, so bench_gate diffs them across machines.  Per-level
+    walls (super_coarse/coarse/fine/refine) ride as their own phases."""
+    from cook_tpu.ops import cpu_reference as ref
+    from cook_tpu.ops.hierarchical import HierParams, hierarchical_match
+    from cook_tpu.ops.match import MatchProblem
+
+    if smoke:
+        J, N = 8192, 1024
+        j_real, n_real = 8000, 1000
+        # 64-node blocks, 256-node superblocks -> 4 blocks/superblock,
+        # 4 superblocks: every level genuinely engaged at smoke size
+        params = HierParams(nodes_per_block=64, superblock_nodes=256,
+                            chunk=256, kc=32)
+    else:
+        J, N = 1_048_576, 102_400
+        j_real, n_real = 1_000_000, 100_000
+        tuned = load_tuned()
+        # 512-node blocks x 16-block superblocks = 8192-node DCN
+        # domains -> 13 superblocks over 100k nodes; the coarse level
+        # sees [16, slots, 16] batched problems instead of one
+        # 1M x 256-block monolith
+        params = HierParams(nodes_per_block=tuned["hier_nodes_per_block"],
+                            superblock_nodes=(
+                                16 * tuned["hier_nodes_per_block"]),
+                            chunk=min(tuned["chunk"], 8192),
+                            rounds=tuned["rounds"], passes=tuned["passes"],
+                            kc=tuned["kc"])
+    demands, avail, totals = make_problem(J, N, seed=4)
+    job_valid = np.zeros(J, dtype=bool)
+    job_valid[:j_real] = True
+    node_valid = np.zeros(N, dtype=bool)
+    node_valid[:n_real] = True
+    mark = byte_mark()
+    problem = MatchProblem(
+        demands=jnp.asarray(demands), job_valid=jnp.asarray(job_valid),
+        avail=jnp.asarray(avail), totals=jnp.asarray(totals),
+        node_valid=jnp.asarray(node_valid), feasible=None,
+    )
+    note_problem_bytes(problem)
+    mesh = None
+    if len(jax.devices()) > 1:
+        from cook_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+
+    runs = []
+
+    def solve():
+        result, stats = hierarchical_match(problem, params=params,
+                                           mesh=mesh)
+        runs.append(stats)
+        return np.asarray(result.assignment)
+
+    t0 = time.perf_counter()
+    assignment = solve()
+    log(f"match_xxl compile+first run: "
+        f"{(time.perf_counter() - t0) * 1000:.0f} ms (superblocks "
+        f"{runs[-1]['superblocks']} x {runs[-1]['superblock_blocks']} "
+        f"blocks, super {runs[-1]['super_shape']}, coarse "
+        f"{runs[-1]['coarse_shape']}, fine {runs[-1]['fine_shape']})")
+    xxl_bytes = byte_stamp(mark)
+    p50, times = time_fn(solve, repeats=repeats)
+    timed = runs[-repeats:]
+
+    def phase_p50(key):
+        return float(np.percentile([s[key] * 1000 for s in timed], 50))
+
+    eff = None
+    if smoke:
+        # hierarchical parity vs the flat CPU reference on the
+        # superblock path — the >= 0.95 acceptance bar, checked every
+        # CI run at smoke size (the full size has no tractable flat
+        # reference; tests/test_superblocks.py pins the bar too)
+        cpu_assign, kind = cpu_greedy(demands[:j_real], avail[:n_real],
+                                      totals[:n_real])
+        q_cpu = ref.packing_quality(demands[:j_real], cpu_assign)
+        q_dev = ref.packing_quality(demands[:j_real], assignment[:j_real])
+        eff = (q_dev["cpus_placed"] / q_cpu["cpus_placed"]
+               if q_cpu["cpus_placed"] else 1.0)
+        log(f"match_xxl {j_real} x {n_real} [{platform}]: p50 {p50:.1f} ms"
+            f"; cpu[{kind}] placed {q_cpu['num_placed']} vs device "
+            f"{q_dev['num_placed']}; packing efficiency {eff:.4f}")
+    else:
+        log(f"match_xxl {j_real} x {n_real} [{platform}]: p50 {p50:.1f} ms"
+            f" (all {[f'{t:.0f}' for t in times]})")
+    stats = timed[-1]
+    # backend + cores stamped on EVERY phase row: a CPU-fallback number
+    # must never read as a TPU number in bench_history
+    stamp = {"backend": platform, "cores": os.cpu_count()}
+    out = {
+        "match_xxl": {"p50_ms": p50, "jobs": j_real, "nodes": n_real,
+                      "superblocks": stats["superblocks"],
+                      "superblock_nodes": stats["superblock_nodes"],
+                      "blocks": stats["blocks"],
+                      "nodes_per_block": stats["nodes_per_block"],
+                      "spilled": stats["spilled"],
+                      "superblock_spilled": stats["superblock_spilled"],
+                      **xxl_bytes, **stamp,
+                      **({"packing_eff": eff} if eff is not None else {})},
+        "match_xxl_super_coarse": {"p50_ms": phase_p50("super_coarse_s"),
+                                   **stamp},
+        "match_xxl_coarse": {"p50_ms": phase_p50("coarse_s"), **stamp},
+        "match_xxl_fine": {"p50_ms": phase_p50("fine_s"), **stamp},
+        "match_xxl_refine": {"p50_ms": phase_p50("refine_s"), **stamp},
+    }
+    return out
+
+
 def bench_dru(jax, jnp):
     from cook_tpu.ops.common import fetch_result
     from cook_tpu.ops.dru import dru_rank
@@ -794,6 +912,182 @@ def bench_match_resident(*, smoke=False) -> dict:
     }
 
 
+def _family_h2d(family) -> int:
+    dp = _data_plane()
+    return dp.LEDGER.family_totals().get(family, {}).get("h2d_bytes", 0)
+
+
+def bench_rebalance_resident(*, smoke=False) -> dict:
+    """`rebalance_resident` tier: the rebalancer's cycle-start victim
+    tensors through the keyed-row resident mirror
+    (scheduler/device_state.ResidentRows) — one cold cycle (full
+    rebuild), two unchanged warm cycles, one delta cycle (a task
+    finishes).  `encode_h2d_bytes` is the FAM_REBALANCE ledger column
+    the >= 90% warm-reduction bar is judged on; bench_gate gates the
+    rebalance_resident* byte columns like match_resident's."""
+    from cook_tpu.models.entities import (DEFAULT_USER, Pool, Resources,
+                                          Share)
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.obs import data_plane
+    from cook_tpu.scheduler.device_state import ResidentRows
+    from cook_tpu.scheduler.rebalancer import (RebalancerParams,
+                                               rebalance_pool)
+
+    if smoke:
+        n_hosts, tasks_per_host = 8, 4
+    else:
+        n_hosts, tasks_per_host = 64, 16
+    store = JobStore(clock=lambda: 1_000_000)
+    store.set_pool(Pool(name="default"))
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=400, cpus=4, gpus=1)))
+    from cook_tpu.models.entities import Job
+
+    for h in range(n_hosts):
+        for k in range(tasks_per_host):
+            job = Job(uuid=f"reb-{h}-{k}", user=f"hog{k % 4}",
+                      pool="default", priority=50,
+                      resources=Resources(mem=300.0 + 10 * h, cpus=3.0),
+                      command="true")
+            store.submit_jobs([job])
+            store.create_instance(job.uuid, f"t-{h}-{k}",
+                                  hostname=f"h{h}", node_id=f"h{h}",
+                                  compute_cluster="bench")
+    spare = {f"h{h}": Resources(mem=50.0, cpus=1.0)
+             for h in range(n_hosts)}
+    params = RebalancerParams(safe_dru_threshold=0.0, min_dru_diff=0.01,
+                              max_preemption=8, resident=True)
+    mirror = ResidentRows("rebalance:bench",
+                          family=data_plane.FAM_REBALANCE)
+    pool = store.pools["default"]
+
+    def cycle():
+        mark = byte_mark()
+        fam0 = _family_h2d(data_plane.FAM_REBALANCE)
+        t0 = time.perf_counter()
+        # empty pending queue: measures the cycle-START tensor build,
+        # the path the mirror serves (decision scatters are O(changed)
+        # either way)
+        rebalance_pool(store, pool, [], dict(spare), params,
+                       resident=mirror)
+        wall_ms = (time.perf_counter() - t0) * 1000
+        stamp = byte_stamp(mark)
+        stamp["encode_h2d_bytes"] = (
+            _family_h2d(data_plane.FAM_REBALANCE) - fam0)
+        return wall_ms, stamp
+
+    cold_ms, cold = cycle()
+    warm_walls, warm = [], {"h2d_bytes": 0, "d2h_bytes": 0,
+                            "encode_h2d_bytes": 0}
+    for i in range(3):
+        if i == 2:
+            # one delta cycle: a finished task must ride the
+            # donated-buffer scatter, not a rebuild
+            from cook_tpu.models.entities import InstanceStatus
+
+            store.update_instance_state("t-0-0", InstanceStatus.SUCCESS)
+        wall_ms, stamp = cycle()
+        warm_walls.append(wall_ms)
+        for col in warm:
+            warm[col] += stamp[col]
+    warm_p50 = float(np.percentile(warm_walls, 50))
+    reduction = (1.0 - warm["encode_h2d_bytes"] / 3.0
+                 / max(cold["encode_h2d_bytes"], 1))
+    n_tasks = n_hosts * tasks_per_host
+    log(f"rebalance_resident {n_tasks} tasks x {n_hosts} hosts: cold "
+        f"{cold_ms:.1f} ms / {cold['encode_h2d_bytes']} B; warm p50 "
+        f"{warm_p50:.1f} ms / {warm['encode_h2d_bytes']} B over 3 "
+        f"cycles (per-cycle reduction {reduction:.1%}); last "
+        f"delta_rows={mirror.last.get('delta_rows')} "
+        f"rebuild={mirror.last.get('rebuild')}")
+    return {
+        "rebalance_resident": {"p50_ms": warm_p50, "tasks": n_tasks,
+                               "hosts": n_hosts, "warm_cycles": 3,
+                               **warm, "encode_reduction": reduction},
+        "rebalance_resident_cold": {"p50_ms": cold_ms, "tasks": n_tasks,
+                                    "hosts": n_hosts, **cold},
+    }
+
+
+def bench_elastic_resident(*, smoke=False) -> dict:
+    """`elastic_resident` tier: the capacity planner's per-interval
+    demand/capacity tensors through the keyed-row resident mirror —
+    cold plan, two unchanged warm plans, one delta plan (one pool's
+    queue grows by a job).  `encode_h2d_bytes` is the FAM_ELASTIC
+    column; gated like the other resident tiers."""
+    import types
+
+    from cook_tpu.cluster.mock import MockCluster, MockHost
+    from cook_tpu.elastic import CapacityPlanner, ElasticParams
+    from cook_tpu.models.entities import Job, Pool, Resources
+    from cook_tpu.models.store import JobStore
+    from cook_tpu.obs import data_plane
+    from cook_tpu.txn import TransactionLog
+
+    if smoke:
+        n_pools, queue_len = 4, 16
+    else:
+        n_pools, queue_len = 16, 256
+    store = JobStore(clock=lambda: 1_000_000)
+    for i in range(n_pools):
+        store.set_pool(Pool(name=f"p{i}"))
+    cluster = MockCluster("bench", [
+        MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=8000.0, cpus=8.0,
+                 pool=f"p{i}") for i in range(n_pools)],
+        clock=store.clock)
+    planner = CapacityPlanner(store, [cluster], TransactionLog(store),
+                              ElasticParams(enabled=True, resident=True))
+
+    def job(pool, k):
+        return Job(uuid=f"el-{pool}-{k}", user="u", pool=pool, priority=50,
+                   resources=Resources(mem=100.0 + k, cpus=1.0),
+                   command="true")
+
+    queues = {f"p{i}": types.SimpleNamespace(
+        jobs=[job(f"p{i}", k) for k in range(queue_len)])
+        for i in range(n_pools - 1)}  # last pool idles: a lender
+
+    def cycle():
+        mark = byte_mark()
+        fam0 = _family_h2d(data_plane.FAM_ELASTIC)
+        t0 = time.perf_counter()
+        planner.plan_cycle(queues)
+        wall_ms = (time.perf_counter() - t0) * 1000
+        stamp = byte_stamp(mark)
+        stamp["encode_h2d_bytes"] = (
+            _family_h2d(data_plane.FAM_ELASTIC) - fam0)
+        return wall_ms, stamp
+
+    cold_ms, cold = cycle()
+    warm_walls, warm = [], {"h2d_bytes": 0, "d2h_bytes": 0,
+                            "encode_h2d_bytes": 0}
+    for i in range(3):
+        if i == 2:
+            # delta plan: ONE pool's queue grows within its j_pad
+            # bucket -> exactly one mirror row scatters
+            queues["p0"].jobs.append(job("p0", queue_len))
+        wall_ms, stamp = cycle()
+        warm_walls.append(wall_ms)
+        for col in warm:
+            warm[col] += stamp[col]
+    warm_p50 = float(np.percentile(warm_walls, 50))
+    reduction = (1.0 - warm["encode_h2d_bytes"] / 3.0
+                 / max(cold["encode_h2d_bytes"], 1))
+    log(f"elastic_resident {n_pools} pools x {queue_len} queued: cold "
+        f"{cold_ms:.1f} ms / {cold['encode_h2d_bytes']} B; warm p50 "
+        f"{warm_p50:.1f} ms / {warm['encode_h2d_bytes']} B over 3 "
+        f"plans (per-cycle reduction {reduction:.1%}); last "
+        f"delta_rows={planner._resident.last.get('delta_rows')} "
+        f"rebuild={planner._resident.last.get('rebuild')}")
+    return {
+        "elastic_resident": {"p50_ms": warm_p50, "pools": n_pools,
+                             "queued": queue_len, "warm_cycles": 3,
+                             **warm, "encode_reduction": reduction},
+        "elastic_resident_cold": {"p50_ms": cold_ms, "pools": n_pools,
+                                  "queued": queue_len, **cold},
+    }
+
+
 def bench_control_plane(*, rps=150.0, duration_s=8.0, seed=13,
                         smoke=False) -> dict:
     """Control-plane write-path phase: sustained submit/query/kill
@@ -1199,6 +1493,7 @@ def device_main():
     match_p50, cpu_ms, eff, (j_real, n_real), match_bytes = bench_match(
         jax, jnp, platform)
     xl_phases = bench_match_xl(jax, jnp, platform)
+    xxl_phases = bench_match_xxl(jax, jnp, platform)
     dru_p50 = bench_dru(jax, jnp)
     reb_p50 = bench_rebalance(jax, jnp)
     multi_p50 = bench_multipool(jax, jnp, load_tuned())
@@ -1222,11 +1517,14 @@ def device_main():
                   "packing_eff": eff, "baseline_ms": cpu_ms,
                   **match_bytes},
         **xl_phases,
+        **xxl_phases,
         "dru": {"p50_ms": dru_p50},
         "rebalance": {"p50_ms": reb_p50},
         "multipool": {"p50_ms": multi_p50},
         "elastic_plan": {"p50_ms": elastic_p50, "pools": 64, "jobs": 16384},
         **resident_phases,
+        **bench_rebalance_resident(),
+        **bench_elastic_resident(),
         "control_plane": control_plane,
         "control_plane_sharded": control_plane_sharded,
         "control_plane_mp": control_plane_mp,
@@ -1258,13 +1556,21 @@ def cpu_main():
     # hierarchical decomposition is precisely what makes the XL pool
     # tractable without an accelerator (the flat solve is not)
     xl_phases = bench_match_xl(jax, jnp, "cpu")
+    # match_xxl (1M x 100k) runs at FULL scale on the CPU fallback too:
+    # the superblock decomposition is what makes the mega-pool
+    # tractable at all, and the phase rows carry honest backend=cpu +
+    # cores stamps
+    xxl_phases = bench_match_xxl(jax, jnp, "cpu")
     write_bench_record(make_record("full", "cpu", {
         "match": {"p50_ms": match_p50, "jobs": j_real, "nodes": n_real,
                   "packing_eff": eff, "baseline_ms": cpu_ms,
                   **match_bytes},
         **xl_phases,
+        **xxl_phases,
         # device residency moves the same logical bytes on any backend
         **bench_match_resident(),
+        **bench_rebalance_resident(),
+        **bench_elastic_resident(),
         # the control plane never needed the accelerator; its phases are
         # measured at full scale even on the CPU fallback
         "control_plane": bench_control_plane(),
@@ -1372,10 +1678,22 @@ def bench_smoke(jax, jnp, repeats: int = 3) -> dict:
     phases.update(bench_match_xl(jax, jnp, jax.devices()[0].platform,
                                  smoke=True, repeats=repeats))
 
+    # superblock mega-matcher, tiny tier (8k jobs x 1k nodes, 4
+    # superblocks x 4 blocks): the two-level super-coarse/coarse path
+    # plus per-level walls, gate-tracked toward the 1M x 100k full tier
+    phases.update(bench_match_xxl(jax, jnp, jax.devices()[0].platform,
+                                  smoke=True, repeats=repeats))
+
     # device-resident match state: cold rebuild + 3 warm delta cycles
     # (warm p50 AND warm h2d_bytes are gate-visible; bytes growth on
     # warm cycles is a regression)
     phases.update(bench_match_resident(smoke=True))
+
+    # keyed-row resident mirrors: rebalancer victim tensors + elastic
+    # demand/capacity tensors (warm encode bytes gated like
+    # match_resident's)
+    phases.update(bench_rebalance_resident(smoke=True))
+    phases.update(bench_elastic_resident(smoke=True))
 
     # control plane: the smoke loadtest against an in-process server —
     # commit-ack latency under sustained submit/query/kill traffic

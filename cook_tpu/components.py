@@ -306,6 +306,7 @@ def build_process(
         count_block_headroom=bool(
             elastic_conf.get("count_block_headroom", True)),
         gang_block_hosts=int(elastic_conf.get("gang_block_hosts", 0)),
+        resident=bool(elastic_conf.get("resident", False)),
     )
     incident_dir = settings.incident_dir
     if not incident_dir and settings.data_dir:

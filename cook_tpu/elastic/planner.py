@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from cook_tpu.elastic.recorder import ElasticRecorder, PlanRecord
 from cook_tpu.models.entities import Job, Resources
+from cook_tpu.obs import data_plane
 from cook_tpu.models.store import JobStore
 from cook_tpu.ops.common import bucket_size, fetch_result
 from cook_tpu.ops.elastic import (
@@ -74,6 +75,11 @@ class ElasticParams:
     count_block_headroom: bool = True
     # topology block width for that check (0 = choose_nodes_per_block)
     gang_block_hosts: int = 0
+    # serve the per-interval demand/capacity tensors from a
+    # device-resident keyed-row mirror (device_state.ResidentRows):
+    # pools whose queues did not change move zero encode bytes.
+    # Config key: [scheduler] resident_elastic
+    resident: bool = False
 
 
 class CapacityPlanner:
@@ -106,6 +112,14 @@ class CapacityPlanner:
             "elastic.unmet_shortage",
             "post-plan unmet shortage per pool/resource")
         self._gauge_keys: set[tuple] = set()
+        self._resident = None
+        if self.params.resident:
+            from cook_tpu.scheduler.device_state import ResidentRows
+
+            self._resident = ResidentRows(
+                "elastic",
+                observatory=getattr(telemetry, "observatory", None),
+                family=data_plane.FAM_ELASTIC)
 
     # ------------------------------------------------------- tensor builds
 
@@ -170,15 +184,37 @@ class CapacityPlanner:
         pool_valid = np.arange(p_pad) < len(pools)
 
         t0 = time.perf_counter()
+        if self._resident is not None:
+            # keyed-row mirror: one [j_pad, 3] demand row per pool,
+            # keyed by pool NAME — a pool whose pending queue did not
+            # change since the last interval ships zero encode bytes.
+            # j_pad growth flips the row width -> width-changed rebuild.
+            cols, _stats = self._resident.build(
+                pools,
+                {"res": res[:len(pools)], "valid": valid[:len(pools)]},
+                out_len=p_pad,
+            )
+            res_dev, valid_dev = cols["res"], cols["valid"]
+            supply_dev = self._resident.whole_array("supply", supply)
+            outstanding_dev = self._resident.whole_array(
+                "outstanding", outstanding)
+            pool_valid_dev = self._resident.whole_array(
+                "pool_valid", pool_valid)
+        else:
+            with data_plane.family(data_plane.FAM_ELASTIC):
+                res_dev = data_plane.h2d(res)
+                valid_dev = data_plane.h2d(valid)
+                supply_dev = data_plane.h2d(supply)
+                outstanding_dev = data_plane.h2d(outstanding)
+                pool_valid_dev = data_plane.h2d(pool_valid)
         demand_dev = weighted_demand(
-            jnp.asarray(res), jnp.asarray(valid),
-            jnp.float32(self.params.rank_half_life))
+            res_dev, valid_dev, jnp.float32(self.params.rank_half_life))
         plan = solve_capacity_plan(
             ElasticProblem(
                 demand=demand_dev,
-                supply=jnp.asarray(supply),
-                outstanding=jnp.asarray(outstanding),
-                pool_valid=jnp.asarray(pool_valid),
+                supply=supply_dev,
+                outstanding=outstanding_dev,
+                pool_valid=pool_valid_dev,
             ),
             jnp.float32(self.params.headroom),
         )

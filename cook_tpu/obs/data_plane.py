@@ -71,10 +71,13 @@ FAM_HIER_FINE = "hier-fine"          # hierarchical fine batch traffic
 FAM_MESH = "mesh-shard"              # parallel/mesh.py device_put sites
 FAM_SOLVE = "solve-results"          # assignment fetches (D2H)
 FAM_FALLBACK = "fallback"            # CPU-fallback / quality-audit puts
+FAM_REBALANCE = "rebalance-state"    # rebalancer victim/spare tensors
+FAM_ELASTIC = "elastic-plan"         # elastic demand/capacity tensors
 FAM_OTHER = "other"                  # unattributed crossings
 
 FAMILIES = (FAM_NODE_ENCODE, FAM_FEASIBILITY, FAM_DRU, FAM_HIER_COARSE,
-            FAM_HIER_FINE, FAM_MESH, FAM_SOLVE, FAM_FALLBACK, FAM_OTHER)
+            FAM_HIER_FINE, FAM_MESH, FAM_SOLVE, FAM_FALLBACK,
+            FAM_REBALANCE, FAM_ELASTIC, FAM_OTHER)
 
 # unpadded per-node byte width of the node encode tensors (avail [4]f32 +
 # totals [2]f32 + node_valid bool) — the residency ledger's weight for

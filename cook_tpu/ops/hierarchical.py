@@ -1,4 +1,5 @@
-"""Hierarchical two-level matcher: one giant pool via block decomposition.
+"""Hierarchical matcher: one giant pool via block (and superblock)
+decomposition.
 
 The flat matchers (`ops/match.py`) hold the whole [J, N] problem on one
 chip, and `parallel/mesh.py` only shards *across* pools — so a single
@@ -28,6 +29,32 @@ passes:
      bounded number of extra coarse+fine rounds against the UPDATED block
      availabilities, reusing the exact same padded shapes (no new XLA
      programs).
+
+**Superblocks** (`HierParams.superblock_nodes`) add a second
+decomposition level above the blocks for mega-scale pools (ROADMAP item
+2's 1M x 100k target).  Blocks group into S contiguous *superblocks* —
+the DCN-domain analog of the blocks' ICI adjacency — and the coarse
+level itself splits in two:
+
+  1a. **super-coarse** — jobs x superblocks on the superblock
+      aggregates (the same `block_aggregates` reduction at superblock
+      width), via the same chunked kernel.  J x S is tiny even at 1M
+      jobs.
+
+  1b. **batched coarse** — jobs scatter to their superblocks and every
+      superblock's [jobs_per_superblock, blocks_per_superblock] routing
+      problem solves as ONE batched MatchProblem with superblocks as the
+      leading batch axis — the SAME mesh axis (and the same
+      `invalid_match_problem` dead-lane padding) the fine batch uses, so
+      any superblock count keeps one XLA program per
+      (superblock-bucket, slot, block) shape
+      (`parallel/mesh.pool_sharded_coarse`).
+
+The fine and refine machinery below is untouched: the two coarse levels
+merge into the same global per-job block assignment, and gang placement
+keeps the FINE block as its co-location domain (`gang_filter` strips at
+`nodes_per_block` granularity — a gang landing in one superblock but two
+blocks is stripped, never admitted).
 
 The coarse pass has an optional fused Pallas backend
 (`ops/pallas_match.best_block`: aggregate-fit + max-node gate + fitness +
@@ -82,6 +109,12 @@ class HierParams:
     jobs_per_block: int = 0       # 0 = auto (block_slack x J/B, bucketed)
     block_slack: float = 2.0      # per-block job-slot headroom factor
     refine_rounds: int = 2        # bounded re-offer rounds (0 disables)
+    # superblock (DCN-domain) layer: nodes per superblock, rounded up to
+    # a power-of-two number of blocks so the (superblock-bucket, slot,
+    # block) shape lattice stays bounded.  0 disables; the layer also
+    # stands down when the rounding yields < 2 superblocks (a single
+    # DCN domain is exactly the classic two-level problem).
+    superblock_nodes: int = 0
     # fine-solve chunked-matcher knobs (MatchConfig equivalents)
     chunk: int = 1024
     rounds: int = 3
@@ -288,6 +321,39 @@ def gather_fine(demands, job_valid, feasible, avail, totals, node_valid,
                         totals=totals_f, node_valid=nv_f, feasible=feas_f)
 
 
+@functools.partial(jax.jit, static_argnames=("sb_blocks",))
+def gather_super(demands, active, gate_demands, need_row, block_sum,
+                 block_max, block_tot, block_valid, block_count, block_any,
+                 job_idx, sb_blocks: int) -> MatchProblem:
+    """Build the batched per-superblock coarse problems: job demands
+    gathered by the super-coarse scatter's slot matrix, BLOCK aggregates
+    sliced by contiguous superblocks (blocks play the node role).  The
+    feasibility gate is the flat coarse pass's, gathered per
+    (superblock, slot): the block's per-resource max single node must
+    fit the row's gate demand (member-wise max for gang leaders), gangs
+    additionally need >= k candidate hosts in the block, and the
+    original constraint mask must have a feasible node there."""
+    s, ss = job_idx.shape
+    r = demands.shape[-1]
+    safe = jnp.maximum(job_idx, 0)
+    demands_f = demands[safe]                                 # [S, ss, R]
+    valid_f = (job_idx >= 0) & active[safe]
+    bs = block_sum.reshape(s, sb_blocks, r)
+    bm = block_max.reshape(s, sb_blocks, r)
+    bt = block_tot.reshape(s, sb_blocks, 2)
+    bv = block_valid.reshape(s, sb_blocks)
+    gate = (demands if gate_demands is None else gate_demands)[safe]
+    feas = jnp.all(bm[:, None, :, :] >= gate[:, :, None, :], axis=-1)
+    if need_row is not None:
+        bc = block_count.reshape(s, sb_blocks)
+        feas = feas & (bc[:, None, :] >= need_row[safe][:, :, None])
+    if block_any is not None:
+        f3 = block_any.reshape(-1, s, sb_blocks)
+        feas = feas & f3[safe, jnp.arange(s)[:, None], :]
+    return MatchProblem(demands=demands_f, job_valid=valid_f, avail=bs,
+                        totals=bt, node_valid=bv, feasible=feas)
+
+
 def _pad_block_axis(problems: MatchProblem, count: int,
                     n_res: int) -> MatchProblem:
     """Extend the fine batch with `count` all-invalid lanes
@@ -389,6 +455,27 @@ def _fine_solve(problems: MatchProblem, params: HierParams,
     return jax.vmap(fn)(problems)
 
 
+def _coarse_batched_solve(problems: MatchProblem, params: HierParams,
+                          mesh) -> MatchResult:
+    """Batched per-superblock coarse routing (jobs x blocks per lane)
+    with the flat coarse pass's exact single-candidate semantics (kc=1,
+    use_approx=False — see `_coarse_xla`); superblocks batch on the SAME
+    mesh axis the fine solve shards."""
+    chunk = _chunk_for(params.coarse_chunk, problems.demands.shape[1])
+    if mesh is not None:
+        from cook_tpu.parallel.mesh import pool_sharded_coarse, shard_pools
+
+        problems = shard_pools(mesh, problems)
+        return pool_sharded_coarse(mesh, problems, chunk=chunk,
+                                   rounds=params.coarse_rounds,
+                                   passes=params.coarse_passes)
+    fn = functools.partial(chunked_match, chunk=chunk,
+                           rounds=params.coarse_rounds,
+                           passes=params.coarse_passes, kc=1,
+                           use_approx=False, **backend_flags("xla"))
+    return jax.vmap(fn)(problems)
+
+
 _metrics = None
 
 
@@ -402,6 +489,10 @@ def _note_metrics(pool: str, backend: str, stats: dict) -> None:
             "blocks": global_registry.gauge(
                 "hierarchical.blocks",
                 "topology blocks of the pool's last hierarchical solve"),
+            "superblocks": global_registry.gauge(
+                "hierarchical.superblocks",
+                "DCN-domain superblocks of the pool's last hierarchical "
+                "solve (0 = superblock layer off/degenerate)"),
             "spilled": global_registry.gauge(
                 "hierarchical.spilled",
                 "jobs the last coarse pass overflowed into refinement"),
@@ -412,6 +503,7 @@ def _note_metrics(pool: str, backend: str, stats: dict) -> None:
     labels = {"pool": pool or "-"}
     _metrics["solves"].inc(labels={**labels, "backend": backend})
     _metrics["blocks"].set(stats["blocks"], labels)
+    _metrics["superblocks"].set(stats.get("superblocks", 0), labels)
     _metrics["spilled"].set(stats["spilled"], labels)
     if stats.get("refine_placed"):
         _metrics["refine_placed"].inc(stats["refine_placed"], labels)
@@ -447,9 +539,16 @@ def hierarchical_match(
     considered/placed/stripped accounting.
 
     `observatory` (obs.CompileObservatory) receives one
-    `match_coarse`/`match_fine` solve report per pass, keyed by the
-    padded shapes — the pin that any block count compiles ONE fine
-    program.
+    `match_coarse`/`match_fine` solve report per pass — plus
+    `match_super_coarse` when `params.superblock_nodes` engages the
+    superblock layer — keyed by the padded shapes: the pin that any
+    block/superblock count compiles ONE program per level.
+
+    With superblocks on, the coarse level splits in two (super-coarse
+    jobs x superblocks, then per-superblock jobs x blocks batched on the
+    mesh axis) and `stats` gains superblock geometry + `super_coarse_s`;
+    the fine/refine machinery, and gang co-location at the FINE block,
+    are unchanged.
     """
     params = params or HierParams()
     t_start = time.perf_counter()
@@ -471,6 +570,24 @@ def hierarchical_match(
     npb = min(npb, bucket_size(n))
     b_real = -(-n // npb)
     n_pad = b_real * npb
+    # ---- superblock (DCN-domain) geometry: blocks group into S
+    # contiguous superblocks of `sb_blocks` blocks each (a power of two,
+    # so the batched-coarse shape lattice stays bounded); the node axis
+    # then pads to a whole number of superblocks so ONE reshape yields
+    # both block and superblock aggregates.  < 2 superblocks means a
+    # single DCN domain — the classic two-level path is exact there.
+    sb_blocks = s_real = sbn = 0
+    if params.superblock_nodes > 0:
+        sb_blocks = bucket_size(max(2, -(-params.superblock_nodes // npb)),
+                                minimum=2)
+        sbn = sb_blocks * npb
+        s_real = -(-n // sbn)
+        if s_real < 2:
+            sb_blocks = s_real = sbn = 0
+        else:
+            n_pad = s_real * sbn
+            b_real = n_pad // npb
+    use_superblocks = s_real >= 2
     mesh_size = int(mesh.devices.size) if mesh is not None else 1
 
     avail = problem.avail
@@ -498,6 +615,17 @@ def hierarchical_match(
     else:
         slots = bucket_size(int(np.ceil(params.block_slack * j / b_real)))
     slots = min(slots, bucket_size(j))
+    s_pad = super_slots = 0
+    if use_superblocks:
+        # the superblock axis pads exactly like the block axis — a
+        # power-of-two bucket that is also a mesh multiple — so the
+        # batched-coarse program is keyed by (s_pad, super_slots,
+        # sb_blocks), never the raw superblock count
+        s_pad = bucket_size(s_real, minimum=max(mesh_size, MIN_BLOCKS))
+        s_pad += (-s_pad) % mesh_size
+        super_slots = bucket_size(
+            int(np.ceil(params.block_slack * j / s_real)))
+        super_slots = min(super_slots, bucket_size(j))
 
     job_valid_np = np.asarray(problem.job_valid)
     data_plane.note_d2h(int(job_valid_np.nbytes),
@@ -505,9 +633,14 @@ def hierarchical_match(
     out = np.full(j, -1, dtype=np.int32)
     block_pad_axis = b_pad - b_real
     coarse_backend = params.coarse_backend
+    if use_superblocks:
+        # the two-level coarse path runs the masked xla kernels at both
+        # levels (the fused pallas block scorer has no batched variant)
+        coarse_backend = "xla"
     fine_backend_label = ("pallas-fine" if params.fine_backend == "pallas"
                           else vmap_safe_backend(params.backend))
-    coarse_s = fine_s = refine_s = 0.0
+    super_coarse_s = coarse_s = fine_s = refine_s = 0.0
+    superblock_spilled = 0
     spilled_total = 0
     refine_placed = 0
     block_stats: list[dict] = []
@@ -622,6 +755,103 @@ def hierarchical_match(
             res[members] = res[leader_row_np[members]]
         return res
 
+    def coarse_two_level(active_mask: np.ndarray):
+        """Two-level coarse routing for superblock pools: a super-coarse
+        jobs x superblocks pass on the superblock aggregates, a host
+        scatter into superblock job slots, then every superblock's
+        jobs x blocks routing problem solved as ONE batched MatchProblem
+        on the mesh axis (the same `invalid_match_problem` dead-lane
+        padding and single-candidate semantics as the flat coarse pass).
+        Same contract as `coarse_pass` — a global per-job block
+        assignment — plus the per-level walls."""
+        nonlocal superblock_spilled
+        eff = active_mask
+        if has_gangs:
+            # gang members ride their leader's row at BOTH coarse levels
+            eff = eff & ~(gang_rows_np & ~is_leader_np)
+        # -- level 1a: jobs x superblocks on the superblock aggregates
+        t0 = time.perf_counter()
+        data_plane.note_padding(
+            "match_super_coarse", (j, s_pad),
+            valid_cells=int(eff.sum()) * s_real,
+            padded_cells=j * s_pad)
+        sup_sum, sup_max, sup_tot, sup_valid, sup_count = \
+            block_aggregates(avail_now, totals, node_valid, sbn)
+        sup_pad_axis = s_pad - s_real
+        if sup_pad_axis:
+            sup_sum = jnp.pad(sup_sum, ((0, sup_pad_axis), (0, 0)))
+            sup_max = jnp.pad(sup_max, ((0, sup_pad_axis), (0, 0)),
+                              constant_values=-1.0)
+            sup_tot = jnp.pad(sup_tot, ((0, sup_pad_axis), (0, 0)),
+                              constant_values=1.0)
+            sup_valid = jnp.pad(sup_valid, (0, sup_pad_axis))
+            sup_count = jnp.pad(sup_count, (0, sup_pad_axis))
+        active = data_plane.h2d(eff, family=data_plane.FAM_HIER_COARSE)
+        sup_any = None
+        if feasible is not None:
+            sup_any = feasible.reshape(j, s_real, sbn).any(axis=-1)
+            if sup_pad_axis:
+                sup_any = jnp.pad(sup_any, ((0, 0), (0, sup_pad_axis)))
+        sup_assignment = _coarse_xla(
+            demands_coarse, active, sup_sum, sup_max, sup_tot,
+            sup_valid, sup_any, params,
+            gate_demands=gate_demands if has_gangs else None,
+            need_row=need_row if has_gangs else None,
+            block_count=sup_count if has_gangs else None)
+        if observatory is not None:
+            observatory.observe_solve("match_super_coarse", (j, s_pad),
+                                      "xla")
+        with data_plane.family(data_plane.FAM_HIER_COARSE):
+            sup_np = np.asarray(fetch_result(sup_assignment))
+        w_super = time.perf_counter() - t0
+        # -- level 1b: per-superblock jobs x blocks, batched on the SAME
+        # mesh axis (and dead-lane padding) the fine batch uses
+        t0 = time.perf_counter()
+        sup_idx, sup_spill = scatter_to_blocks(sup_np, eff, s_real,
+                                               super_slots)
+        superblock_spilled += int(sup_spill.sum())
+        data_plane.note_padding(
+            "match_coarse", (s_pad, super_slots, sb_blocks),
+            valid_cells=int((sup_idx >= 0).sum()) * sb_blocks,
+            padded_cells=s_pad * super_slots * sb_blocks)
+        block_sum, block_max, block_tot, block_valid, block_count = \
+            block_aggregates(avail_now, totals, node_valid, npb)
+        block_any = None
+        if feasible is not None:
+            block_any = feasible.reshape(j, b_real, npb).any(axis=-1)
+        problems = gather_super(
+            demands_coarse, active, gate_demands,
+            need_row if has_gangs else None, block_sum, block_max,
+            block_tot, block_valid, block_count, block_any,
+            data_plane.h2d(sup_idx, family=data_plane.FAM_HIER_COARSE),
+            sb_blocks)
+        problems = _pad_block_axis(problems, sup_pad_axis, n_res)
+        result = _coarse_batched_solve(problems, params, mesh)
+        if observatory is not None:
+            observatory.observe_solve(
+                "match_coarse", (s_pad, super_slots, sb_blocks), "xla")
+        with data_plane.family(data_plane.FAM_HIER_COARSE):
+            local = np.asarray(fetch_result(result.assignment))[:s_real]
+        res = np.full(j, -1, dtype=np.int32)
+        sel = (sup_idx >= 0) & (local >= 0)
+        global_block = (np.arange(s_real, dtype=np.int32)[:, None]
+                        * sb_blocks + np.maximum(local, 0))
+        res[sup_idx[sel]] = global_block[sel]
+        if has_gangs:
+            members = gang_rows_np & ~is_leader_np
+            res[members] = res[leader_row_np[members]]
+        return res, {"super_coarse": w_super,
+                     "coarse": time.perf_counter() - t0}
+
+    def route_jobs(active_mask: np.ndarray):
+        """Coarse routing dispatcher: the two-level superblock path when
+        the layer is engaged, else the classic flat coarse pass."""
+        if use_superblocks:
+            return coarse_two_level(active_mask)
+        t0 = time.perf_counter()
+        res = coarse_pass(active_mask)
+        return res, {"coarse": time.perf_counter() - t0}
+
     def fine_pass(job_idx: np.ndarray):
         """Scattered fine batch solve; returns (assignment [b_real, s]
         local node indices, updated flat availability).  Transfers ride
@@ -682,10 +912,10 @@ def hierarchical_match(
             gangs_stripped_rows += count
         return count
 
-    # ---- round 0: coarse -> scatter -> fine
-    t0 = time.perf_counter()
-    coarse = coarse_pass(job_valid_np)
-    coarse_s += time.perf_counter() - t0
+    # ---- round 0: (super-)coarse -> scatter -> fine
+    coarse, walls0 = route_jobs(job_valid_np)
+    super_coarse_s += walls0.get("super_coarse", 0.0)
+    coarse_s += walls0.get("coarse", 0.0)
     t0 = time.perf_counter()
     job_idx, spilled = scatter_to_blocks(coarse, job_valid_np, b_real, slots)
     spilled_total = int(spilled.sum())
@@ -705,13 +935,19 @@ def hierarchical_match(
     # blocks against the UPDATED availabilities — identical shapes, so
     # no new programs
     rounds_run = 0
-    for _ in range(max(0, params.refine_rounds)):
+    # the superblock path adds a second slot bottleneck (the super
+    # scatter), halving worst-case per-round throughput — so its bounded
+    # re-offer budget doubles; the early no-leftover / no-progress breaks
+    # make unused budget free
+    refine_budget = max(0, params.refine_rounds) * (2 if use_superblocks
+                                                    else 1)
+    for _ in range(refine_budget):
         leftover = job_valid_np & (out < 0)
         if not leftover.any():
             break
         rounds_run += 1
         t0 = time.perf_counter()
-        coarse = coarse_pass(leftover)
+        coarse, _ = route_jobs(leftover)  # walls fold into refine_s
         job_idx, _ = scatter_to_blocks(coarse, leftover, b_real, slots)
         fine_assign, avail_now = fine_pass(job_idx)
         placed = merge(job_idx, fine_assign)
@@ -728,6 +964,13 @@ def hierarchical_match(
         "block_pad": b_pad,
         "nodes_per_block": npb,
         "jobs_per_block": slots,
+        "superblocks": s_real,
+        "superblock_pad": s_pad,
+        "superblock_nodes": sbn,
+        "superblock_blocks": sb_blocks,
+        "jobs_per_superblock": super_slots,
+        "superblock_spilled": superblock_spilled,
+        "super_coarse_s": super_coarse_s,
         "coarse_s": coarse_s,
         "fine_s": fine_s,
         "refine_s": refine_s,
@@ -735,7 +978,9 @@ def hierarchical_match(
         "refine_placed": refine_placed,
         "spilled": spilled_total,
         "placed": int((out >= 0).sum()),
-        "coarse_shape": (j, b_pad),
+        "super_shape": (j, s_pad) if use_superblocks else None,
+        "coarse_shape": ((s_pad, super_slots, sb_blocks)
+                         if use_superblocks else (j, b_pad)),
         "fine_shape": (b_pad, slots, npb),
         "backend": fine_backend_label,
         "coarse_backend": coarse_backend,

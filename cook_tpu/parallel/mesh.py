@@ -125,6 +125,32 @@ def pool_sharded_match(mesh: Mesh, problems: MatchProblem, *,
     return shmapped(problems)
 
 
+def pool_sharded_coarse(mesh: Mesh, problems: MatchProblem, *,
+                        chunk: int = 4096, rounds: int = 2,
+                        passes: int = 8) -> MatchResult:
+    """Batched coarse routing for the hierarchical SUPERBLOCK layer: each
+    lane is one superblock's jobs x blocks problem (blocks play the node
+    role), sharded on the same pool axis as `pool_sharded_match`.  The
+    kernel is pinned to the flat coarse pass's exact semantics — kc=1
+    single-candidate conflict rounds, no approx top-k (see
+    ops/hierarchical._coarse_xla) — so two-level routing matches the
+    one-level pass block-for-block on a single-superblock pool."""
+    fn = functools.partial(chunked_match, chunk=chunk, rounds=rounds,
+                           passes=passes, kc=1, use_approx=False,
+                           **backend_flags("xla"))
+    mapped = jax.vmap(fn)
+    spec = P("pool")
+    feas_spec = spec if problems.feasible is not None else None
+    bonus_spec = spec if problems.node_bonus is not None else None
+    shmapped = shard_map(
+        mapped, mesh=mesh,
+        in_specs=(MatchProblem(spec, spec, spec, spec, spec, feas_spec,
+                               bonus_spec),),
+        out_specs=MatchResult(spec, spec),
+    )
+    return shmapped(problems)
+
+
 def pool_sharded_dru(mesh: Mesh, tasks: DruTasks, mem_div, cpu_div, gpu_div):
     """Batched DRU ranking over pools, pool axis sharded."""
     mapped = jax.vmap(lambda t, m, c, g: dru_rank(t, m, c, g))

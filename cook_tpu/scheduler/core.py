@@ -928,7 +928,28 @@ class Scheduler:
                 "gang_drain_max_wait_ms", base.gang_drain_max_wait_ms)),
             gang_drain_wasted_factor=float(overrides.get(
                 "gang_drain_wasted_factor", base.gang_drain_wasted_factor)),
+            resident=bool(overrides.get("resident", base.resident)),
         )
+
+    def _rebalance_mirror(self, pool: Pool):
+        """Per-pool ResidentRows mirror for the rebalancer's victim
+        tensors — owned HERE so it outlives every cycle (warm reuse is
+        the point; a cycle-scoped mirror would always rebuild cold)."""
+        mirrors = getattr(self, "_rebalance_mirrors", None)
+        if mirrors is None:
+            mirrors = self._rebalance_mirrors = {}
+        mirror = mirrors.get(pool.name)
+        if mirror is None:
+            from cook_tpu.obs import data_plane
+            from cook_tpu.scheduler.device_state import ResidentRows
+
+            mirror = ResidentRows(
+                f"rebalance:{pool.name}",
+                observatory=(self.telemetry.observatory
+                             if self.telemetry is not None else None),
+                family=data_plane.FAM_REBALANCE)
+            mirrors[pool.name] = mirror
+        return mirror
 
     def rebalance_cycle(self, pool: Pool) -> list[Decision]:
         import time as _time
@@ -939,14 +960,17 @@ class Scheduler:
         # rank phase — counting it here too would double-book the wall
         t0 = _time.perf_counter()
         spare = self.last_unmatched_offers.get(pool.name, {})
+        params = self._rebalancer_params()
         decisions = rebalance_pool(
-            self.store, pool, queue.jobs, spare, self._rebalancer_params(),
+            self.store, pool, queue.jobs, spare, params,
             host_info=getattr(self, "last_host_info", {}).get(pool.name),
             telemetry=self.telemetry,
             # reclaim-before-preemption: loaned-out capacity comes home
             # (non-disruptively) before any victim search considers a kill
             reclaimer=(self.elastic.reclaim_for
                        if self.elastic is not None else None),
+            resident=(self._rebalance_mirror(pool)
+                      if params.resident else None),
         )
         # fairness ledger: per-victim wasted-work seconds must be read
         # BEFORE _transact_preemption flips the instances terminal (the

@@ -109,12 +109,21 @@ class _Mirror:
 # every live DeviceResidentState, for the /debug/device section
 _REGISTRY: "weakref.WeakSet[DeviceResidentState]" = weakref.WeakSet()
 
+# every live ResidentRows mirror (rebalancer victim tensors, elastic
+# demand/capacity tensors), same debug surface
+_ROW_REGISTRY: "weakref.WeakSet[ResidentRows]" = weakref.WeakSet()
+
 
 def snapshot_all() -> dict:
     """The `/debug/device` device_state section: every live resident
-    state's pools + guard status (normally exactly one per process)."""
+    state's pools + guard status (normally exactly one per process),
+    plus the keyed-row mirrors (`ResidentRows`: rebalancer + elastic
+    tensor families)."""
     states = [state.debug_json() for state in list(_REGISTRY)]
-    return {"enabled": bool(states), "states": states}
+    rows = sorted((m.debug_json() for m in list(_ROW_REGISTRY)),
+                  key=lambda d: d["name"])
+    return {"enabled": bool(states) or bool(rows), "states": states,
+            "row_mirrors": rows}
 
 
 class DeviceResidentState:
@@ -539,4 +548,287 @@ class DeviceResidentState:
                 "quantized_demoted": sorted(self._demoted),
                 "pools": pools,
                 "resident_arrays": arrays,
+            }
+
+
+class ResidentRows:
+    """Content-addressed keyed-row device mirror for cycle-built tensor
+    families — the rebalancer's victim tensors and the elastic planner's
+    demand rows, which PR 11's ledger showed rebuilding from host state
+    on every dispatch.
+
+    The match mirror above keys row validity on the host EncodeCache's
+    RowServe report; these families have no host cache, so content
+    addressing IS the serve report: each key's row is fingerprinted over
+    the concatenated column bytes, and a row whose fingerprint matches
+    the resident copy moves ZERO bytes (the RowServe hit-rule analog —
+    a stale fingerprint can only cost a re-upload, never a stale solve).
+    Deltas ride the same donated-buffer bucket-padded scatters
+    (`ops/device_update.scatter_rows`), and the per-cycle row order is a
+    device gather through a FINGERPRINT-CACHED permutation — an
+    unchanged layout re-uploads neither rows nor the perm, so a warm
+    dispatch's encode H2D is ~0 against the cold rebuild's 1.0.
+
+    Rebuild-reason ladder (stamped like the match mirror's):
+    `cold` (no buffers), `width-changed` (column set / trailing shape /
+    dtype differs — the offers-changed/dtype-changed analog; e.g. the
+    elastic queue bucket growing), `bucket-growth` (key count outgrew
+    the row bucket, or slot allocation failed).
+
+    Like `_Mirror`, buffers carry cap + 1 rows with a dedicated all-zero
+    pad row at index cap: out-of-window output rows gather zeros, so
+    integer columns that need a -1 pad encode value+1 and subtract on
+    device after the gather (the rebalancer's task->host column).
+    """
+
+    def __init__(self, name: str, observatory=None,
+                 family: Optional[str] = None):
+        self.name = name
+        self.observatory = observatory
+        self.family = family or data_plane.FAM_OTHER
+        self._lock = threading.RLock()
+        self._names: tuple = ()
+        self._widths: dict = {}
+        self._buffers: Optional[dict] = None   # name -> device [cap+1,...]
+        self._cap = 0
+        # key -> (slot row, content fingerprint); LRU order for eviction
+        self._slots: OrderedDict = OrderedDict()
+        self._free: list[int] = []
+        # (perm-bytes fp) -> device perm: the gather permutation is the
+        # warm cycle's only other job-axis upload, and it is ~stable —
+        # uncached it would be a double-digit share of the cold bytes
+        self._perm_cache: Optional[tuple] = None
+        self._arrays: OrderedDict = OrderedDict()  # whole-array cache
+        self.last: dict = {}
+        # the match mirror's metric families, pool-labelled by mirror
+        # name (the registry is idempotent on names)
+        self._resident_gauge = global_registry.gauge(
+            "device_state.resident_bytes")
+        self._delta_counter = global_registry.counter(
+            "device_state.delta_rows")
+        self._update_counter = global_registry.counter(
+            "device_state.updates")
+        self._rebuild_counter = global_registry.counter(
+            "device_state.rebuilds")
+        self._update_hist = global_registry.histogram(
+            "device_state.update_seconds")
+        self._array_counter = global_registry.counter(
+            "device_state.array_reuse")
+        _ROW_REGISTRY.add(self)
+
+    # ------------------------------------------------------------- build
+
+    @staticmethod
+    def _row_fp(columns: dict, names: tuple, i: int) -> bytes:
+        h = hashlib.blake2b(digest_size=16)
+        for name in names:
+            h.update(columns[name][i].tobytes())
+        return h.digest()
+
+    def build(self, keys, columns: dict, out_len: int,
+              flight=NULL_CYCLE) -> tuple[dict, dict]:
+        """Serve this cycle's tensors from the mirror plus the delta.
+
+        `keys`: one hashable identity per row (task id, pool name), in
+        this cycle's row order.  `columns`: {name: host [K, ...] array},
+        all sharing the row axis.  `out_len`: padded output row count —
+        rows beyond len(keys) gather the all-zero pad row.
+
+        Returns ({name: FRESH device [out_len, ...] array}, stats) with
+        the match-mirror stats schema (rebuild/reason/delta_rows/...).
+        """
+        from cook_tpu.ops.common import bucket_size
+        from cook_tpu.ops.device_update import gather_rows
+
+        t0 = time.perf_counter()
+        k = len(keys)
+        names = tuple(sorted(columns))
+        cols = {name: np.ascontiguousarray(columns[name])
+                for name in names}
+        widths = {name: (cols[name].shape[1:], str(cols[name].dtype))
+                  for name in names}
+        pad_k = bucket_size(max(k, 1))
+        fps = [self._row_fp(cols, names, i) for i in range(k)]
+        with self._lock:
+            rebuild = None
+            if self._buffers is None:
+                rebuild = "cold"
+            elif self._names != names or self._widths != widths:
+                rebuild = "width-changed"
+            elif self._cap < pad_k:
+                rebuild = "bucket-growth"
+            if rebuild is None:
+                stats = self._delta_locked(keys, fps, cols, names)
+                if stats is None:
+                    rebuild = "bucket-growth"
+            if rebuild is not None:
+                stats = self._rebuild_locked(keys, fps, cols, names,
+                                             widths, pad_k)
+                stats["reason"] = rebuild
+                self._rebuild_counter.inc(1, {"pool": self.name,
+                                              "reason": rebuild})
+            else:
+                self._update_counter.inc(1, {"pool": self.name})
+                if stats["delta_rows"]:
+                    self._delta_counter.inc(stats["delta_rows"],
+                                            {"pool": self.name})
+            perm = np.full(out_len, self._cap, dtype=np.int32)
+            perm[:k] = stats.pop("_rows")
+            resident_bytes = sum(int(b.nbytes)
+                                 for b in self._buffers.values())
+
+            perm_fp = hashlib.blake2b(perm.tobytes(),
+                                      digest_size=16).digest()
+            cached = self._perm_cache
+            if cached is not None and cached[0] == perm_fp:
+                perm_dev = cached[1]
+            else:
+                perm_dev = data_plane.h2d(perm, family=self.family)
+                self._perm_cache = (perm_fp, perm_dev)
+            out = {
+                name: gather_rows(self._buffers[name], perm_dev,
+                                  observatory=self.observatory,
+                                  op=f"{self.name}_gather")
+                for name in names
+            }
+        update_s = time.perf_counter() - t0
+        stats.update(resident_bytes=resident_bytes, update_s=update_s,
+                     quantized=False, jobs=k,
+                     resident_rows=k - stats["delta_rows"])
+        self._resident_gauge.set(resident_bytes, {"pool": self.name})
+        self._update_hist.observe(update_s)
+        with self._lock:
+            self.last = dict(stats)
+        flight.note_device_state(stats)
+        return out, stats
+
+    def _rebuild_locked(self, keys, fps, cols, names, widths,
+                        pad_k: int) -> dict:
+        from cook_tpu.ops.common import pad_to
+
+        k = len(keys)
+        cap = max(pad_k, 1)
+        self._names = names
+        self._widths = widths
+        self._cap = cap
+        self._slots = OrderedDict()
+        # cap + 1 rows, all-zero pad row at index cap (see class doc)
+        self._buffers = {
+            name: data_plane.h2d(pad_to(cols[name], cap + 1),
+                                 family=self.family)
+            for name in names
+        }
+        rows = list(range(k))
+        for i, key in enumerate(keys):
+            self._slots[key] = (i, fps[i])
+        self._free = list(range(k, cap))
+        return {"rebuild": True, "delta_rows": k, "_rows": rows}
+
+    def _delta_locked(self, keys, fps, cols, names) -> Optional[dict]:
+        from cook_tpu.ops.device_update import scatter_rows
+
+        window = set(keys)
+        rows = [0] * len(keys)
+        delta_i: list[int] = []
+        delta_rows: list[int] = []
+
+        def allocate():
+            if self._free:
+                return self._free.pop()
+            for key in self._slots:  # oldest first (LRU order)
+                if key not in window:
+                    row, _ = self._slots.pop(key)
+                    return row
+            return None
+
+        for i, key in enumerate(keys):
+            slot = self._slots.get(key)
+            if slot is not None and slot[1] == fps[i]:
+                # content hit: the resident row is byte-identical
+                rows[i] = slot[0]
+                self._slots.move_to_end(key)
+                continue
+            if slot is not None:
+                row = slot[0]
+            else:
+                row = allocate()
+                if row is None:
+                    return None
+            rows[i] = row
+            self._slots[key] = (row, fps[i])
+            self._slots.move_to_end(key)
+            delta_i.append(i)
+            delta_rows.append(row)
+
+        if delta_i:
+            idx = np.asarray(delta_rows, dtype=np.int32)
+            for name in names:
+                self._buffers[name] = scatter_rows(
+                    self._buffers[name], idx, cols[name][delta_i],
+                    family=self.family, observatory=self.observatory,
+                    op=f"{self.name}_update")
+        return {"rebuild": False, "reason": "",
+                "delta_rows": len(delta_i), "_rows": rows}
+
+    # ----------------------------------------------------- whole arrays
+
+    def whole_array(self, name: str, host_array: np.ndarray):
+        """Content-fingerprinted whole-array residency for the tensors
+        with no row identity (the rebalancer's spare/host_ok, the
+        elastic supply/outstanding/pool_valid): byte-identical content
+        re-uploads nothing.  Returned arrays are shared across cycles —
+        kernel INPUT only, never donate them."""
+        arr = np.ascontiguousarray(host_array)
+        fp = (arr.shape, str(arr.dtype),
+              hashlib.blake2b(arr.tobytes(), digest_size=16).digest())
+        key = (self.name, name)
+        with self._lock:
+            entry = self._arrays.get(key)
+            if entry is not None and entry[0] == fp:
+                self._arrays.move_to_end(key)
+                dev = entry[1]
+            else:
+                dev = None
+        if dev is not None:
+            self._array_counter.inc(1, {"result": "hit"})
+            return dev
+        dev = data_plane.h2d(arr, family=self.family)
+        with self._lock:
+            self._arrays[key] = (fp, dev)
+            self._arrays.move_to_end(key)
+            while len(self._arrays) > MAX_RESIDENT_ARRAYS:
+                self._arrays.popitem(last=False)
+        self._array_counter.inc(1, {"result": "miss"})
+        return dev
+
+    def invalidate(self) -> None:
+        """Drop the mirror (tests, resync): next build rebuilds cold."""
+        with self._lock:
+            self._buffers = None
+            self._slots = OrderedDict()
+            self._free = []
+            self._perm_cache = None
+            self._arrays.clear()
+
+    # -------------------------------------------------------------- debug
+
+    def debug_json(self) -> dict:
+        with self._lock:
+            resident_bytes = (sum(int(b.nbytes)
+                                  for b in self._buffers.values())
+                              if self._buffers else 0)
+            return {
+                "name": self.name,
+                "family": self.family,
+                "resident_bytes": resident_bytes,
+                "cap": self._cap,
+                "columns": {name: {"shape": list(shape),
+                                   "dtype": dtype}
+                            for name, (shape, dtype)
+                            in self._widths.items()},
+                "slots": len(self._slots),
+                "arrays": {name: int(dev.nbytes)
+                           for (_, name), (fp, dev)
+                           in self._arrays.items()},
+                "last": dict(self.last),
             }

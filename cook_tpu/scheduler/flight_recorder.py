@@ -151,6 +151,10 @@ class CycleRecord:
     # for the round-0 scatter (bounded: one entry per topology block).
     hierarchical: bool = False
     hier_blocks: int = 0
+    # superblock (DCN-domain) count when the mega-scale layer engaged
+    # (0 = off/degenerate); the per-level wall split rides in
+    # hier_phases ("super_coarse_solve" joins the three classic keys)
+    hier_superblocks: int = 0
     hier_phases: dict = field(default_factory=dict)
     hier_spilled: int = 0
     hier_refine_placed: int = 0
@@ -224,6 +228,7 @@ class CycleRecord:
             "compiled": self.compiled,
             "hierarchical": self.hierarchical,
             "hier_blocks": self.hier_blocks,
+            "hier_superblocks": self.hier_superblocks,
             "hier_phases": dict(self.hier_phases),
             "hier_spilled": self.hier_spilled,
             "hier_refine_placed": self.hier_refine_placed,
@@ -340,11 +345,17 @@ class CycleBuilder:
         rec = self.record
         rec.hierarchical = True
         rec.hier_blocks = int(stats.get("blocks", 0))
+        rec.hier_superblocks = int(stats.get("superblocks", 0))
         rec.hier_phases = {
             "coarse_solve": stats.get("coarse_s", 0.0),
             "fine_solve": stats.get("fine_s", 0.0),
             "refine": stats.get("refine_s", 0.0),
         }
+        if rec.hier_superblocks >= 2:
+            # the super-coarse wall only exists when the DCN-domain layer
+            # engaged; classic two-level records keep their shape
+            rec.hier_phases["super_coarse_solve"] = \
+                stats.get("super_coarse_s", 0.0)
         rec.hier_spilled = int(stats.get("spilled", 0))
         rec.hier_refine_placed = int(stats.get("refine_placed", 0))
         rec.block_stats = list(stats.get("block_stats", []))

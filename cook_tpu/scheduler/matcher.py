@@ -131,6 +131,14 @@ class MatchConfig:
     hierarchical_nodes_per_block: int = 0
     hierarchical_jobs_per_block: int = 0
     hierarchical_refine_rounds: int = 2
+    # superblock (DCN-domain) layer above the topology blocks: nodes per
+    # superblock (rounded up to a power-of-two number of blocks).  0
+    # disables; engages only when the pool spans >= 2 superblocks.  The
+    # coarse level then splits into super-coarse jobs x superblocks plus
+    # per-superblock jobs x blocks batched on the mesh axis — the
+    # mega-scale (1M x 100k) decomposition.  Config key:
+    # `hier_superblock_nodes`.
+    hierarchical_superblock_nodes: int = 0
     # coarse block-scoring backend: "xla" (masked chunked kernel) or
     # "pallas" (fused ops/pallas_match.best_block; quality-guarded)
     hierarchical_coarse_backend: str = "xla"
@@ -435,6 +443,7 @@ def hier_params_from_config(config: "MatchConfig"):
         nodes_per_block=config.hierarchical_nodes_per_block,
         jobs_per_block=config.hierarchical_jobs_per_block,
         refine_rounds=config.hierarchical_refine_rounds,
+        superblock_nodes=config.hierarchical_superblock_nodes,
         chunk=config.chunk or 1024,
         rounds=config.chunk_rounds,
         passes=config.chunk_passes,

@@ -26,6 +26,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from cook_tpu.obs import data_plane
 from cook_tpu.models.entities import DruMode, Instance, Job, Pool, Resources
 from cook_tpu.models.store import JobStore
 from cook_tpu.ops.common import BIG, bucket_size
@@ -52,6 +53,11 @@ class RebalancerParams:
     # frozen within-host prefix ORDER and launches consuming spare
     # instead of joining the preemptable rows
     fast_cycle: bool = False
+    # serve the cycle-start victim tensors from a device-resident
+    # keyed-row mirror (scheduler/device_state.ResidentRows): tasks that
+    # survived since the last cycle move zero encode bytes; only new /
+    # changed rows scatter.  Config key: [scheduler] resident_rebalancer
+    resident: bool = False
     # ---- gang admission (scheduler/gang.py) ----
     # topology-aware whole-gang admission from the rebalance cycle:
     # drain-vs-kill per block, reservations tagged gang:<group>
@@ -100,6 +106,7 @@ class RebalanceCycle:
         host_spare: dict[str, Resources],
         params: RebalancerParams,
         host_info: Optional[dict[str, tuple[dict, str]]] = None,
+        resident=None,
     ):
         self.store = store
         self.pool = pool
@@ -175,12 +182,42 @@ class RebalanceCycle:
         self._next_slack = n_tasks
 
         # device-resident tensors; per-iteration updates are small scatters
-        self._dev_host = jnp.asarray(host_np)
-        self._dev_res = jnp.asarray(res_np)
-        self._dev_dru = jnp.asarray(self._dru_np)
-        self._dev_elig = jnp.asarray(self._elig_np)
-        self._dev_spare = jnp.asarray(spare)
-        self._dev_host_ok = jnp.asarray(np.arange(len(spare)) < h)
+        if resident is not None and params.resident:
+            # keyed-row mirror: one row per RUNNING task keyed by task
+            # id, gathered into this cycle's row order on device — a
+            # task that survived since the last cycle ships zero encode
+            # bytes.  Slack rows beyond n_tasks gather the all-zero pad
+            # row, so host encodes value+1 (pad's 0 decodes to the -1
+            # "unknown host" sentinel the slack rows need).
+            keys = self.row_ids[:n_tasks]
+            cols, _stats = resident.build(
+                keys,
+                {
+                    "host1": (host_np[:n_tasks] + 1).astype(np.int32),
+                    "res": res_np[:n_tasks],
+                    "dru": self._dru_np[:n_tasks],
+                    "elig": self._elig_np[:n_tasks],
+                },
+                out_len=total,
+            )
+            self._dev_host = cols["host1"] - 1
+            self._dev_res = cols["res"]
+            self._dev_dru = cols["dru"]
+            self._dev_elig = cols["elig"]
+            self._dev_spare = resident.whole_array("spare", spare)
+            self._dev_host_ok = resident.whole_array(
+                "host_ok", np.arange(len(spare)) < h)
+        else:
+            # classic full upload, ledger-accounted under the same
+            # family so cold-vs-warm encode bytes compare honestly
+            with data_plane.family(data_plane.FAM_REBALANCE):
+                self._dev_host = data_plane.h2d(host_np)
+                self._dev_res = data_plane.h2d(res_np)
+                self._dev_dru = data_plane.h2d(self._dru_np)
+                self._dev_elig = data_plane.h2d(self._elig_np)
+                self._dev_spare = data_plane.h2d(spare)
+                self._dev_host_ok = data_plane.h2d(
+                    np.arange(len(spare)) < h)
         self._spare_np = spare.copy()
         self.preempted: set[str] = set()
         self._sorted = None
@@ -483,6 +520,7 @@ def rebalance_pool(
     host_info: Optional[dict] = None,
     telemetry=None,
     reclaimer=None,
+    resident=None,
 ) -> list[Decision]:
     """One pool's rebalance cycle: returns the preemption decisions
     (rebalancer.clj:434-479 `rebalance`).  The caller transacts + kills.
@@ -493,13 +531,18 @@ def rebalance_pool(
     capacity is reclaimed — durably, non-disruptively — and the victim
     search below runs against the REFRESHED spare map, so returned
     capacity yields spare-only decisions (no victims) instead of
-    kills."""
+    kills.
+
+    `resident` is an optional `device_state.ResidentRows` mirror owned
+    by the caller (it must OUTLIVE the cycle — warm reuse is the whole
+    point); it serves the cycle-start victim tensors when
+    `params.resident` is set."""
     if reclaimer is not None:
         refreshed = reclaimer(pool.name, pending_in_dru_order, host_spare)
         if refreshed is not None:
             host_spare = refreshed
     cycle = RebalanceCycle(store, pool, host_spare, params,
-                           host_info=host_info)
+                           host_info=host_info, resident=resident)
     solve_shape = (int(cycle._dev_host.shape[0]),
                    int(cycle._dev_spare.shape[0]))
     decisions = []

@@ -267,6 +267,12 @@ def _match_config(d: dict) -> MatchConfig:
             d.get("hierarchical_jobs_per_block", 0)),
         hierarchical_refine_rounds=int(
             d.get("hierarchical_refine_rounds", 2)),
+        # superblock (DCN-domain) layer above the topology blocks:
+        # nodes per superblock, 0 = single-level coarse pass.  Primary
+        # key `hier_superblock_nodes`; the long form is an alias.
+        hierarchical_superblock_nodes=int(
+            d.get("hier_superblock_nodes",
+                  d.get("hierarchical_superblock_nodes", 0))),
         hierarchical_coarse_backend=str(
             d.get("hierarchical_coarse_backend", "xla")),
         hierarchical_use_mesh=bool(d.get("hierarchical_use_mesh", True)),
@@ -360,7 +366,17 @@ def read_config(path: Optional[str] = None,
                 rb.get("gang_drain_max_wait_ms", 300_000.0)),
             gang_drain_wasted_factor=float(
                 rb.get("gang_drain_wasted_factor", 1.0)),
+            resident=bool(rb.get("resident", False)),
         )
+    # resident-mirror shorthands (docs/configuration.md): top-level
+    # bools feeding the rebalancer / elastic `resident` knobs; an
+    # explicit section-level `resident` wins
+    if "resident_rebalancer" in data:
+        rb = data.get("rebalancer")
+        if not isinstance(rb, dict) or "resident" not in rb:
+            settings.rebalancer.resident = bool(data["resident_rebalancer"])
+    if "resident_elastic" in data and "resident" not in settings.elastic:
+        settings.elastic["resident"] = bool(data["resident_elastic"])
     # always route through _match_config so the tuned hardware defaults
     # apply even when the operator config has no `match` section — a bare
     # config must not fall into the exact-kernel (chunk=0) perf trap

@@ -45,8 +45,17 @@ def test_smoke_emits_structured_record(smoke_record):
                                       "control_plane_mp",
                                       "match_xl", "match_xl_coarse",
                                       "match_xl_fine", "match_xl_refine",
+                                      "match_xxl",
+                                      "match_xxl_super_coarse",
+                                      "match_xxl_coarse",
+                                      "match_xxl_fine",
+                                      "match_xxl_refine",
                                       "speculation", "match_resident",
-                                      "match_resident_cold", "gang"}
+                                      "match_resident_cold",
+                                      "rebalance_resident",
+                                      "rebalance_resident_cold",
+                                      "elastic_resident",
+                                      "elastic_resident_cold", "gang"}
     # every record and every phase carries the resolved JAX backend —
     # the label bench_gate uses to refuse cross-backend comparisons
     assert on_disk["backend"] == "cpu"

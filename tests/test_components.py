@@ -185,6 +185,46 @@ class TestConfig:
                              "gang_block_hosts": 8}
         assert s.api == {"max_gang_size": 16}
 
+    def test_superblock_and_resident_knobs_roundtrip(self, tmp_path):
+        # the mega-scale/residency knobs must survive the loader: the
+        # superblock width (short key + long alias), the section-level
+        # resident bools, and the top-level shorthands
+        p = tmp_path / "sb.json"
+        p.write_text(json.dumps({
+            "match": {"hier_superblock_nodes": 8192},
+            "rebalancer": {"resident": True},
+            "elastic": {"resident": True},
+        }))
+        s = read_config(str(p))
+        assert s.match.hierarchical_superblock_nodes == 8192
+        assert s.rebalancer.resident is True
+        assert s.elastic["resident"] is True
+
+        p.write_text(json.dumps({
+            "match": {"hierarchical_superblock_nodes": 4096},
+            "resident_rebalancer": True,
+            "resident_elastic": True,
+        }))
+        s = read_config(str(p))
+        assert s.match.hierarchical_superblock_nodes == 4096
+        assert s.rebalancer.resident is True
+        assert s.elastic["resident"] is True
+
+        # defaults stay off; an explicit section-level knob beats the
+        # top-level shorthand
+        s = read_config(None)
+        assert s.match.hierarchical_superblock_nodes == 0
+        assert s.rebalancer.resident is False
+        p.write_text(json.dumps({
+            "rebalancer": {"resident": False},
+            "elastic": {"resident": False},
+            "resident_rebalancer": True,
+            "resident_elastic": True,
+        }))
+        s = read_config(str(p))
+        assert s.rebalancer.resident is False
+        assert s.elastic["resident"] is False
+
     def test_validation(self, tmp_path):
         p = tmp_path / "bad.json"
         p.write_text(json.dumps({"port": -1}))

@@ -1,0 +1,349 @@
+"""Resident mirrors for the rebalancer's victim tensors and the elastic
+planner's demand/capacity tensors (scheduler/device_state.ResidentRows):
+the >= 90% warm-cycle transfer floor on BOTH families, decision/plan
+parity with the mirror on vs off, O(delta) scatters, the content-keyed
+rebuild ladder (cold / width-changed / bucket-growth), perm + whole-array
+caching, and the /debug/device row_mirrors surface."""
+import types
+
+import numpy as np
+
+from cook_tpu.cluster.mock import MockCluster, MockHost
+from cook_tpu.elastic import CapacityPlanner, ElasticParams
+from cook_tpu.models.entities import (
+    DEFAULT_USER,
+    InstanceStatus,
+    Job,
+    Pool,
+    Resources,
+    Share,
+)
+from cook_tpu.models.store import JobStore
+from cook_tpu.obs import data_plane
+from cook_tpu.scheduler.device_state import ResidentRows, snapshot_all
+from cook_tpu.scheduler.rebalancer import RebalancerParams, rebalance_pool
+from cook_tpu.txn import TransactionLog
+
+from conftest import FakeClock, make_job
+
+
+def fam_h2d(family):
+    return data_plane.LEDGER.family_totals().get(
+        family, {}).get("h2d_bytes", 0)
+
+
+# ------------------------------------------------------------ rebalancer
+
+
+def _rebalance_rig(n_hosts=8, tasks_per_host=4):
+    """Hog users holding every host (test_rebalancer_fast fixture
+    family): the cycle-START victim tensors are the mirror's payload."""
+    clock = FakeClock()
+    store = JobStore(clock=clock)
+    store.set_pool(Pool(name="default"))
+    store.set_share(Share(user=DEFAULT_USER, pool="default",
+                          resources=Resources(mem=400, cpus=4, gpus=1)))
+    for h in range(n_hosts):
+        for k in range(tasks_per_host):
+            job = make_job(user=f"hog{k % 2}", mem=300 + 10 * h, cpus=3)
+            store.submit_jobs([job])
+            store.create_instance(job.uuid, f"t-{h}-{k}",
+                                  hostname=f"h{h}", node_id=f"h{h}",
+                                  compute_cluster="m")
+    spare = {f"h{h}": Resources(mem=50.0, cpus=1.0)
+             for h in range(n_hosts)}
+    return clock, store, spare
+
+
+def _pending(store, n=4):
+    jobs = [make_job(user=f"starved{i}", mem=300, cpus=2)
+            for i in range(n)]
+    store.submit_jobs(jobs)
+    return jobs
+
+
+def _decision_sig(decisions, pending):
+    # pending-queue POSITION, not uuid: make_job uuids are random
+    order = {job.uuid: i for i, job in enumerate(pending)}
+    return [(order[d.job.uuid], d.hostname, sorted(d.task_ids))
+            for d in decisions]
+
+
+def test_rebalancer_warm_cycles_cut_h2d_by_90_percent():
+    """THE acceptance bar (rebalance-state family): a warm
+    unchanged-fleet cycle moves >= 90% fewer FAM_REBALANCE H2D bytes
+    than the cold rebuild cycle."""
+    _, store, spare = _rebalance_rig()
+    params = RebalancerParams(safe_dru_threshold=0.0, min_dru_diff=0.01,
+                              max_preemption=8, resident=True)
+    mirror = ResidentRows("rebalance:test",
+                          family=data_plane.FAM_REBALANCE)
+    pool = store.pools["default"]
+
+    m0 = fam_h2d(data_plane.FAM_REBALANCE)
+    rebalance_pool(store, pool, [], dict(spare), params, resident=mirror)
+    cold = fam_h2d(data_plane.FAM_REBALANCE) - m0
+    assert cold > 0
+    assert mirror.last["rebuild"] is True
+    assert mirror.last["reason"] == "cold"
+    for _ in range(2):
+        m0 = fam_h2d(data_plane.FAM_REBALANCE)
+        rebalance_pool(store, pool, [], dict(spare), params,
+                       resident=mirror)
+        warm = fam_h2d(data_plane.FAM_REBALANCE) - m0
+        assert mirror.last["rebuild"] is False
+        assert mirror.last["delta_rows"] == 0
+        assert warm <= 0.1 * cold, (warm, cold)
+
+
+def test_rebalancer_decisions_identical_resident_on_off():
+    """Residency is a transfer optimization, never a decision change:
+    identical preemption decisions (job, host, victims) with the mirror
+    on or off, across cold, warm, and post-termination cycles."""
+    def run(resident_on):
+        _, store, spare = _rebalance_rig(n_hosts=6, tasks_per_host=3)
+        params = RebalancerParams(safe_dru_threshold=0.0,
+                                  min_dru_diff=0.01, max_preemption=10,
+                                  resident=resident_on)
+        mirror = (ResidentRows(f"rebalance:parity-{resident_on}",
+                               family=data_plane.FAM_REBALANCE)
+                  if resident_on else None)
+        pool = store.pools["default"]
+        sigs = []
+        for i in range(3):
+            if i == 2:
+                store.update_instance_state("t-0-0",
+                                            InstanceStatus.SUCCESS)
+            pending = _pending(store, n=3)
+            decisions = rebalance_pool(store, pool, pending, dict(spare),
+                                       params, resident=mirror)
+            sigs.append(_decision_sig(decisions, pending))
+            store.kill_jobs([job.uuid for job in pending])
+        return sigs
+
+    on, off = run(True), run(False)
+    assert any(on), "scenario must produce preemptions"
+    assert on == off
+
+
+def test_rebalancer_termination_is_delta_scatter_not_rebuild():
+    """A finished task's row rides the donated-buffer scatter: no
+    rebuild, O(changed-rows) delta, still under the 10% byte bar."""
+    _, store, spare = _rebalance_rig()
+    params = RebalancerParams(safe_dru_threshold=0.0, min_dru_diff=0.01,
+                              max_preemption=8, resident=True)
+    mirror = ResidentRows("rebalance:delta",
+                          family=data_plane.FAM_REBALANCE)
+    pool = store.pools["default"]
+    m0 = fam_h2d(data_plane.FAM_REBALANCE)
+    rebalance_pool(store, pool, [], dict(spare), params, resident=mirror)
+    cold = fam_h2d(data_plane.FAM_REBALANCE) - m0
+    rebalance_pool(store, pool, [], dict(spare), params, resident=mirror)
+    store.update_instance_state("t-0-0", InstanceStatus.SUCCESS)
+    m0 = fam_h2d(data_plane.FAM_REBALANCE)
+    rebalance_pool(store, pool, [], dict(spare), params, resident=mirror)
+    delta_bytes = fam_h2d(data_plane.FAM_REBALANCE) - m0
+    assert mirror.last["rebuild"] is False
+    # the terminated task's row plus its USER's rows (the shared DRU
+    # trajectory shifts for every task the user still runs) — here one
+    # hog owns half the fleet, so up to 16 of 32 rows move, never all
+    assert 1 <= mirror.last["delta_rows"] <= 16
+    assert delta_bytes < cold, (delta_bytes, cold)
+
+
+# --------------------------------------------------------------- elastic
+
+
+def _elastic_rig(n_pools=4, queue_len=16):
+    store = JobStore(clock=lambda: 1_000_000)
+    for i in range(n_pools):
+        store.set_pool(Pool(name=f"p{i}"))
+    cluster = MockCluster("m", [
+        MockHost(node_id=f"h{i}", hostname=f"h{i}", mem=8000.0, cpus=8.0,
+                 pool=f"p{i}") for i in range(n_pools)],
+        clock=store.clock)
+
+    def job(pool, k):
+        return Job(uuid=f"el-{pool}-{k}", user="u", pool=pool,
+                   priority=50,
+                   resources=Resources(mem=100.0 + k, cpus=1.0),
+                   command="true")
+
+    # last pool idles: a lender
+    queues = {f"p{i}": types.SimpleNamespace(
+        jobs=[job(f"p{i}", k) for k in range(queue_len)])
+        for i in range(n_pools - 1)}
+    return store, cluster, queues, job
+
+
+def test_elastic_warm_plans_cut_h2d_by_90_percent():
+    """The same bar on the elastic-plan family: unchanged queues replan
+    from the resident demand/capacity tensors."""
+    store, cluster, queues, _ = _elastic_rig()
+    planner = CapacityPlanner(store, [cluster], TransactionLog(store),
+                              ElasticParams(enabled=True, resident=True))
+    m0 = fam_h2d(data_plane.FAM_ELASTIC)
+    assert planner.plan_cycle(queues) is not None
+    cold = fam_h2d(data_plane.FAM_ELASTIC) - m0
+    assert cold > 0
+    assert planner._resident.last["reason"] == "cold"
+    for _ in range(2):
+        m0 = fam_h2d(data_plane.FAM_ELASTIC)
+        planner.plan_cycle(queues)
+        warm = fam_h2d(data_plane.FAM_ELASTIC) - m0
+        assert planner._resident.last["rebuild"] is False
+        assert warm <= 0.1 * cold, (warm, cold)
+
+
+def test_elastic_plans_identical_resident_on_off():
+    def run(resident_on):
+        store, cluster, queues, job = _elastic_rig()
+        planner = CapacityPlanner(
+            store, [cluster], TransactionLog(store),
+            ElasticParams(enabled=True, resident=resident_on))
+        out = []
+        for i in range(3):
+            if i == 2:
+                queues["p0"].jobs.append(job("p0", 99))
+            record = planner.plan_cycle(queues)
+            out.append((record.demand, record.moves, record.unmet))
+        return out
+
+    assert run(True) == run(False)
+
+
+def test_elastic_queue_growth_within_bucket_is_one_delta_row():
+    """One pool's queue growing inside its padded job bucket scatters
+    exactly that pool's demand row — the other pools' rows are content
+    hits."""
+    store, cluster, queues, job = _elastic_rig()
+    planner = CapacityPlanner(store, [cluster], TransactionLog(store),
+                              ElasticParams(enabled=True, resident=True))
+    planner.plan_cycle(queues)
+    planner.plan_cycle(queues)
+    queues["p1"].jobs.append(job("p1", 99))
+    planner.plan_cycle(queues)
+    assert planner._resident.last["rebuild"] is False
+    assert planner._resident.last["delta_rows"] == 1
+
+
+def test_elastic_queue_bucket_growth_rebuilds_width_changed():
+    """The demand columns carry the padded queue axis in their trailing
+    shape: a queue outgrowing its j_pad bucket changes the column width
+    and must rebuild (reason width-changed), never serve stale rows."""
+    store, cluster, queues, job = _elastic_rig(queue_len=8)
+    planner = CapacityPlanner(store, [cluster], TransactionLog(store),
+                              ElasticParams(enabled=True, resident=True))
+    planner.plan_cycle(queues)
+    # push p0 past the shared j_pad bucket
+    queues["p0"].jobs.extend(job("p0", 100 + k) for k in range(128))
+    planner.plan_cycle(queues)
+    assert planner._resident.last["rebuild"] is True
+    assert planner._resident.last["reason"] == "width-changed"
+
+
+# ------------------------------------------------- ResidentRows contract
+
+
+def _cols(vals):
+    return {"a": np.asarray(vals, dtype=np.float32),
+            "b": np.arange(len(vals), dtype=np.int32)}
+
+
+def test_rebuild_ladder_reasons():
+    rows = ResidentRows("ladder")
+    _, s = rows.build(["k0", "k1"], _cols([1.0, 2.0]), out_len=4)
+    assert (s["rebuild"], s["reason"]) == (True, "cold")
+    # column set change -> width-changed
+    _, s = rows.build(["k0"], {"a": np.zeros(1, np.float32)}, out_len=4)
+    assert (s["rebuild"], s["reason"]) == (True, "width-changed")
+    # key count past the row bucket -> bucket-growth
+    keys = [f"g{i}" for i in range(130)]
+    _, s = rows.build(keys, {"a": np.arange(130, dtype=np.float32)},
+                      out_len=256)
+    assert (s["rebuild"], s["reason"]) == (True, "bucket-growth")
+
+
+def test_content_hit_moves_zero_rows_and_caches_perm():
+    rows = ResidentRows("warm", family=data_plane.FAM_OTHER)
+    out1, s1 = rows.build(["x", "y"], _cols([3.0, 4.0]), out_len=8)
+    assert s1["delta_rows"] == 2
+    m0 = fam_h2d(data_plane.FAM_OTHER)
+    out2, s2 = rows.build(["x", "y"], _cols([3.0, 4.0]), out_len=8)
+    assert s2["rebuild"] is False
+    assert s2["delta_rows"] == 0
+    # byte-identical content + stable layout: neither rows nor the perm
+    # re-upload on the warm build
+    assert fam_h2d(data_plane.FAM_OTHER) == m0
+    np.testing.assert_array_equal(np.asarray(out2["a"])[:2], [3.0, 4.0])
+    # pad rows gather the all-zero row
+    assert not np.asarray(out2["a"])[2:].any()
+    # gathers return FRESH arrays (safe against later donation)
+    assert out1["a"] is not out2["a"]
+
+
+def test_changed_row_scatters_only_that_row():
+    rows = ResidentRows("delta")
+    rows.build(["x", "y", "z"], _cols([1.0, 2.0, 3.0]), out_len=4)
+    out, s = rows.build(["x", "y", "z"], _cols([1.0, 9.0, 3.0]),
+                        out_len=4)
+    assert s["rebuild"] is False
+    assert s["delta_rows"] == 1
+    np.testing.assert_array_equal(np.asarray(out["a"])[:3],
+                                  [1.0, 9.0, 3.0])
+
+
+def test_key_churn_reuses_slots_without_rebuild():
+    """Departed keys' slots recycle LRU-first: a rolling key window
+    churns through the bucket with delta-sized scatters, no rebuild."""
+    rows = ResidentRows("churn")
+    rows.build([f"k{i}" for i in range(48)],
+               {"a": np.arange(48, dtype=np.float32)}, out_len=64)
+    for step in (1, 2, 3):
+        keys = [f"k{i}" for i in range(step * 16, step * 16 + 48)]
+        _, s = rows.build(
+            keys, {"a": np.arange(step * 16, step * 16 + 48,
+                                  dtype=np.float32)}, out_len=64)
+        assert s["rebuild"] is False, step
+        assert s["delta_rows"] == 16, step
+
+
+def test_whole_array_reuses_identical_content():
+    rows = ResidentRows("arrays")
+    a = np.arange(16, dtype=np.float32)
+    d1 = rows.whole_array("supply", a)
+    d2 = rows.whole_array("supply", a.copy())
+    assert d1 is d2
+    d3 = rows.whole_array("supply", a + 1)
+    assert d3 is not d1
+    np.testing.assert_array_equal(np.asarray(d3), a + 1)
+
+
+def test_invalidate_forces_cold_rebuild():
+    rows = ResidentRows("inval")
+    rows.build(["k"], {"a": np.ones(1, np.float32)}, out_len=2)
+    rows.invalidate()
+    _, s = rows.build(["k"], {"a": np.ones(1, np.float32)}, out_len=2)
+    assert (s["rebuild"], s["reason"]) == (True, "cold")
+
+
+# ---------------------------------------------------------- debug surface
+
+
+def test_snapshot_all_lists_row_mirrors():
+    mirror = ResidentRows("rebalance:debug",
+                          family=data_plane.FAM_REBALANCE)
+    mirror.build(["t1", "t2"], _cols([1.0, 2.0]), out_len=4)
+    mirror.whole_array("spare", np.ones(3, np.float32))
+    snap = snapshot_all()
+    assert snap["enabled"]
+    mine = [r for r in snap["row_mirrors"]
+            if r["name"] == "rebalance:debug"]
+    assert len(mine) == 1
+    row = mine[0]
+    assert row["family"] == data_plane.FAM_REBALANCE
+    assert row["resident_bytes"] > 0
+    assert row["slots"] == 2
+    assert set(row["columns"]) == {"a", "b"}
+    assert row["arrays"]["spare"] > 0
+    assert row["last"]["rebuild"] is True

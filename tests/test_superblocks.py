@@ -1,0 +1,175 @@
+"""Superblock (DCN-domain) layer of the hierarchical matcher
+(ops/hierarchical.py `superblock_nodes`): packing parity vs the flat CPU
+reference with the two-level coarse engaged, ONE XLA program per
+(super-coarse, coarse, fine) bucket across superblock counts, the
+stand-down rule below two superblocks, gang co-location at the FINE
+block on the superblock path, and the scheduler/CycleRecord wiring
+(`hier_superblock_nodes` -> hier_superblocks + super_coarse_solve
+wall)."""
+import numpy as np
+import pytest
+
+from cook_tpu.obs.compile_observatory import CompileObservatory
+from cook_tpu.ops import cpu_reference as ref
+from cook_tpu.ops.hierarchical import HierParams, hierarchical_match
+from cook_tpu.parallel.mesh import make_mesh
+from tests.test_hierarchical import (
+    HIER_EFF_TOLERANCE,
+    as_problem,
+    assert_valid,
+    dense_problem,
+    efficiency,
+)
+
+# j=512 keeps the job axis at its own bucket, so the super-slot width
+# bucket_size(2 * 512 / s_real) lands on 256 for every s_real in 4..7 —
+# the lattice the one-program pin rides
+SB_PARAMS = dict(nodes_per_block=32, superblock_nodes=64, chunk=64, kc=32)
+
+
+@pytest.mark.parametrize("n", [320, 384, 448])
+def test_superblock_parity_across_widths(n):
+    """Packing parity vs the flat reference greedy with the DCN layer
+    engaged: the extra routing level (super-coarse -> batched coarse ->
+    fine) stays within HIER_EFF_TOLERANCE at several superblock counts
+    of the same seeded shape family the classic-path tests pin."""
+    demands, avail, totals = dense_problem(512, n, seed=n)
+    problem = as_problem(demands, avail, totals)
+    result, stats = hierarchical_match(
+        problem, params=HierParams(**SB_PARAMS))
+    a = np.asarray(result.assignment)
+    assert_valid(demands, avail[:, :3], a)
+    flat = ref.np_greedy_match(demands, avail[:, :3], totals)
+    eff = efficiency(demands, a, flat)
+    assert eff >= HIER_EFF_TOLERANCE, (n, eff)
+    # geometry: sbn=64 (2 blocks of 32) -> n/64 superblocks
+    assert stats["superblocks"] == n // 64
+    assert stats["superblock_blocks"] == 2
+    assert stats["superblock_nodes"] == 64
+    assert stats["coarse_backend"] == "xla"  # forced on the batched path
+
+
+def test_one_program_per_level_across_superblock_counts():
+    """The mega-scale acceptance pin: three different REAL superblock
+    counts (5, 6, 7 — none a power of two) pad onto the SAME
+    (super-coarse, coarse, fine) shapes, so the CompileObservatory sees
+    exactly ONE XLA program per level with the mesh engaged."""
+    mesh = make_mesh()  # 8 virtual cpu devices (conftest)
+    observatory = CompileObservatory()
+    for n in (320, 384, 448):
+        demands, avail, totals = dense_problem(512, n, seed=n)
+        problem = as_problem(demands, avail, totals)
+        result, stats = hierarchical_match(
+            problem, params=HierParams(**SB_PARAMS),
+            mesh=mesh, observatory=observatory)
+        assert stats["superblocks"] == n // 64
+        assert stats["super_shape"] == (512, 8)
+        assert stats["coarse_shape"] == (8, 256, 2)
+        assert stats["fine_shape"] == (16, 128, 32)
+        a = np.asarray(result.assignment)
+        assert_valid(demands, avail[:, :3], a)
+        # zero phantom matches: every placement indexes a REAL node
+        placed = a[a >= 0]
+        assert (placed < n).all()
+        assert (a >= 0).sum() > 0
+    obs_stats = observatory.stats()
+    assert obs_stats["match_super_coarse"]["programs"] == 1
+    assert obs_stats["match_coarse"]["programs"] == 1
+    assert obs_stats["match_fine"]["programs"] == 1
+
+
+def test_superblock_layer_stands_down_below_two():
+    """A pool spanning < 2 superblocks is a single DCN domain: the layer
+    stands down and the solve is the classic two-level path (no
+    super-coarse wall, no batched coarse shape)."""
+    demands, avail, totals = dense_problem(256, 128, seed=1)
+    problem = as_problem(demands, avail, totals)
+    result, stats = hierarchical_match(
+        problem, params=HierParams(nodes_per_block=32,
+                                   superblock_nodes=256,  # > n -> 1 sb
+                                   chunk=64, kc=32))
+    assert stats["superblocks"] == 0
+    assert stats["super_shape"] is None
+    assert stats["super_coarse_s"] == 0.0
+    assert len(stats["coarse_shape"]) == 2  # flat jobs x blocks
+    assert_valid(demands, avail[:, :3], np.asarray(result.assignment))
+
+
+def test_gang_lands_in_one_fine_block_on_superblock_path():
+    """Gang co-location is pinned at the FINE block even with the DCN
+    layer engaged: a gang landing in one superblock but two of its
+    blocks would still be stripped — every placed gang's nodes share one
+    nodes_per_block-aligned block, and no gang partially places."""
+    rng = np.random.default_rng(11)
+    j, n, npb = 128, 256, 32
+    demands, avail, totals = dense_problem(j, n, seed=11)
+    gang_id = np.full(j, -1, dtype=np.int32)
+    gang_need = np.zeros(j, dtype=np.int32)
+    # 8 gangs of 4 on the first 32 rows; the rest solo
+    for g in range(8):
+        rows = np.arange(g * 4, g * 4 + 4)
+        gang_id[rows] = g
+        gang_need[rows] = 4
+    problem = as_problem(demands, avail, totals)
+    result, stats = hierarchical_match(
+        problem,
+        params=HierParams(nodes_per_block=npb, superblock_nodes=64,
+                          chunk=64, kc=32),
+        gang_id=gang_id, gang_need=gang_need)
+    assert stats["superblocks"] == n // 64
+    a = np.asarray(result.assignment)
+    assert_valid(demands, avail[:, :3], a)
+    for g in range(8):
+        rows = np.flatnonzero(gang_id == g)
+        placed = a[rows]
+        if (placed < 0).any():
+            # all-or-nothing: a gang never partially places
+            assert (placed < 0).all(), (g, placed)
+            continue
+        # distinct nodes, all inside ONE fine block
+        assert len(set(placed.tolist())) == len(rows)
+        assert len({int(p) // npb for p in placed}) == 1, (g, placed)
+    assert stats["gangs"]["considered"] == 8
+    assert stats["gangs"]["placed"] >= 1
+
+
+# ------------------------------------------------------ scheduler wiring
+
+
+def test_match_cycle_superblock_record():
+    """MatchConfig.hierarchical_superblock_nodes threads through the
+    matcher: the CycleRecord carries the superblock count and the
+    super_coarse_solve wall joins the three classic hier_phases keys
+    (and the record round-trips to JSON)."""
+    from tests.test_hierarchical import _hier_config, _scenario
+
+    config = _hier_config()
+    # 64 hosts / 16 per block = 4 blocks; superblocks of 16 nodes round
+    # up to 2 blocks (32 nodes) -> 2 DCN domains
+    config.hierarchical_superblock_nodes = 16
+    store, scheduler = _scenario(config)
+    outcome = scheduler.match_cycle(store.pools["default"])
+    assert len(outcome.matched) > 250
+    record = scheduler.recorder.records(limit=1)[0]
+    assert record.hierarchical
+    assert record.hier_blocks == 4
+    assert record.hier_superblocks == 2
+    assert set(record.hier_phases) == {"super_coarse_solve",
+                                       "coarse_solve", "fine_solve",
+                                       "refine"}
+    assert record.hier_phases["super_coarse_solve"] > 0
+    as_json = record.to_json()
+    assert as_json["hier_superblocks"] == 2
+
+
+def test_superblocks_gauge_tracks_last_solve():
+    """The `hierarchical.superblocks` gauge reports the DCN-domain count
+    of the pool's last hierarchical solve (0 when the layer is off)."""
+    from cook_tpu.utils.metrics import global_registry
+
+    demands, avail, totals = dense_problem(256, 320, seed=2)
+    problem = as_problem(demands, avail, totals)
+    hierarchical_match(problem, params=HierParams(**SB_PARAMS),
+                       pool="sb-pool")
+    gauge = global_registry.gauge("hierarchical.superblocks")
+    assert gauge.value(labels={"pool": "sb-pool"}) == 5

@@ -54,7 +54,8 @@ SCHEMA = "cook-bench/v1"
 # even without --bytes-threshold: the match_resident tier's whole point
 # is its warm-cycle transfer floor — bytes growing back on warm cycles
 # is the regression the phase exists to catch, not an informational diff
-BYTE_GATED_PREFIXES = ("match_resident",)
+BYTE_GATED_PREFIXES = ("match_resident", "rebalance_resident",
+                       "elastic_resident")
 
 # the control_plane_mp phase records `cores` and
 # `rps_speedup_vs_sharded`: worker PROCESSES only beat the in-process

@@ -34,8 +34,25 @@ if _TOOLS not in sys.path:
 
 from bench_gate import _round_key, collect_records  # noqa: E402
 
-COLUMNS = ("round", "mode", "backend", "phase", "p50_ms", "h2d_bytes",
-           "d2h_bytes", "vs_cold")
+COLUMNS = ("round", "mode", "backend", "cores", "phase", "p50_ms",
+           "levels", "h2d_bytes", "d2h_bytes", "vs_cold")
+
+# hierarchical per-level wall phases folded into the parent row's
+# `levels` column (short labels keep the table scannable)
+LEVEL_SUFFIXES = (("_super_coarse", "sc"), ("_coarse", "co"),
+                  ("_fine", "fi"), ("_refine", "re"))
+
+
+def _level_split(record: dict, name: str) -> str:
+    """The parent tier's per-level wall split: `sc 64/co 207/fi 127/
+    re 1552` when the record carries `<name>_super_coarse` etc. sibling
+    phases (the match_xl / match_xxl hierarchical tiers)."""
+    parts = []
+    for suffix, label in LEVEL_SUFFIXES:
+        sub = record["phases"].get(name + suffix)
+        if sub and "p50_ms" in sub:
+            parts.append(f"{label} {sub['p50_ms']:.0f}")
+    return "/".join(parts) if parts else "-"
 
 
 def history_rows(records: list[dict],
@@ -46,7 +63,15 @@ def history_rows(records: list[dict],
     The residency warm/cold split: a record carrying both a `<name>`
     and `<name>_cold` phase (the match_resident tier) gets a `vs_cold`
     column on the warm row — warm-cycle H2D as a fraction of the cold
-    rebuild's, the transfer cliff device residency exists to create."""
+    rebuild's, the transfer cliff device residency exists to create.
+
+    Hierarchical tiers (match_xl, match_xxl) get a `levels` column on
+    the parent row: per-level solve walls from the sibling `_coarse` /
+    `_super_coarse` / `_fine` / `_refine` phases — so a CPU-fallback
+    1M x 100k round reads at a glance which level dominates.  The
+    `cores` column echoes the phase's cores stamp (match_xxl and
+    control_plane_mp record one): a backend=cpu wall only means
+    something next to the core count it ran on."""
     rows = []
     for record in records:
         for name, info in sorted(record["phases"].items()):
@@ -65,8 +90,11 @@ def history_rows(records: list[dict],
                 # different backend than the record's resolved one)
                 "backend": (info.get("backend") or record.get("backend")
                             or "?"),
+                "cores": (str(info["cores"])
+                          if "cores" in info else "-"),
                 "phase": name,
                 "p50_ms": f"{info['p50_ms']:.1f}",
+                "levels": _level_split(record, name),
                 "h2d_bytes": (str(info["h2d_bytes"])
                               if "h2d_bytes" in info else "-"),
                 "d2h_bytes": (str(info["d2h_bytes"])

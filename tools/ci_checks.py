@@ -98,13 +98,36 @@ def run_smoke_bench(root: str) -> int:
     process (the same isolation bench.py's own probe uses).  The smoke
     tier includes the pipelined-vs-serial match-cycle phases AND the
     control_plane loadtest phase by default, so bench_gate diffs
-    pipeline walls and commit-ack latency run to run."""
+    pipeline walls and commit-ack latency run to run.
+
+    The written record must carry the match_xxl superblock phases (with
+    their per-level walls) and the resident-mirror tiers: a smoke run
+    that silently dropped them would also drop the gated byte columns,
+    and bench_gate would read the NEXT regression as a baseline."""
     proc = subprocess.run(
         [sys.executable, os.path.join(root, "bench.py"), "--smoke"],
         cwd=root,
         timeout=float(os.environ.get("CI_SMOKE_TIMEOUT_S", "600")),
     )
-    return proc.returncode
+    if proc.returncode != 0:
+        return proc.returncode
+    import json
+
+    try:
+        with open(os.path.join(root, "BENCH_rsmoke.json")) as f:
+            phases = json.load(f).get("phases", {})
+    except (OSError, ValueError) as e:
+        print(f"ci_checks: smoke record unreadable: {e}", file=sys.stderr)
+        return 1
+    required = ("match_xxl", "match_xxl_super_coarse", "match_xxl_coarse",
+                "match_xxl_fine", "match_xxl_refine",
+                "rebalance_resident", "elastic_resident")
+    missing = [p for p in required if p not in phases]
+    if missing:
+        print(f"ci_checks: smoke record missing phases: {missing}",
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def run_bench_gate(root: str, threshold: float) -> int:
